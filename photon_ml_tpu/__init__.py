@@ -1,0 +1,36 @@
+"""photon_ml_tpu — a TPU-native framework with the capabilities of Photon-ML.
+
+A from-scratch JAX/XLA rebuild of the capability surface of
+LinkedIn's Photon-ML (reference: ``photon-lib``, ``photon-api``,
+``photon-client`` Scala modules): fixed-effect GLMs (logistic, linear,
+Poisson, smoothed-hinge SVM) with L1/L2/elastic-net regularization trained
+by L-BFGS / OWL-QN / TRON, and GAME (Generalized Additive Mixed Effects)
+models fit by block coordinate descent — re-designed TPU-first:
+
+- gradient/Hessian reductions are XLA collectives (``psum`` over a device
+  mesh) instead of Spark ``RDD.treeAggregate``
+  (reference: photon-api ``function/glm/DistributedGLMLossFunction.scala``);
+- optimizers are jit-compiled ``lax.while_loop`` state machines over pytrees
+  instead of Breeze wrappers (reference: photon-lib ``optimization/``);
+- per-entity random-effect solves are ``vmap``-batched and sharded over the
+  mesh instead of an ``RDD[(REId, LocalDataset)].mapValues`` loop
+  (reference: photon-api ``algorithm/RandomEffectCoordinate.scala``).
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+
+- ``ops/``        pointwise losses + fused batch aggregations (the hot loops)
+- ``models/``     Coefficients pytree, GLM model classes, GAME models
+- ``optim/``      L-BFGS, OWL-QN, TRON, regularization, state tracking
+- ``parallel/``   mesh conventions + distributed objectives (the "comm backend")
+- ``data/``       LIBSVM/Avro ingestion, GameData columnar batches, bucketing
+- ``evaluation/`` AUC/RMSE/Poisson/precision@k + grouped (per-entity) metrics
+- ``game/``       coordinates + coordinate descent + scoring
+- ``api/``        GameEstimator / GameTransformer front doors
+- ``cli/``        training / scoring / feature-indexing drivers
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import TaskType
+
+__all__ = ["TaskType", "__version__"]

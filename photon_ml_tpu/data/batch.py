@@ -1,0 +1,111 @@
+"""Columnar labeled-example batches.
+
+Reference parity: photon-lib ``data/LabeledPoint.scala`` (label, features,
+offset, weight) and photon-api ``data/LocalDataset.scala`` — but columnar:
+instead of an ``Array[LabeledPoint]`` of per-example objects, a batch is a
+struct-of-arrays pytree so the whole batch feeds one MXU matmul.
+
+Padding: TPU kernels need static shapes, so batches may carry padded rows.
+A padded row has ``weight == 0`` and all kernels treat zero-weight rows as
+absent (masked with ``where``, not just multiplied, so non-finite garbage in
+padding can never poison a sum).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LabeledBatch:
+    """A (possibly padded) batch: X (n, d), labels/weights/offsets (n,)."""
+
+    features: Array
+    labels: Array
+    weights: Array
+    offsets: Array
+
+    @property
+    def num_rows(self) -> int:
+        return self.features.shape[-2]
+
+    @property
+    def dim(self) -> int:
+        return self.features.shape[-1]
+
+    def effective_count(self) -> Array:
+        """Number of non-padded rows."""
+        return jnp.sum((self.weights > 0.0).astype(jnp.int32), axis=-1)
+
+    @staticmethod
+    def build(
+        features,
+        labels,
+        weights=None,
+        offsets=None,
+        dtype=jnp.float32,
+    ) -> "LabeledBatch":
+        features = jnp.asarray(features, dtype=dtype)
+        labels = jnp.asarray(labels, dtype=dtype)
+        n = features.shape[-2]
+        if weights is None:
+            weights = jnp.ones((n,), dtype=dtype)
+        else:
+            weights = jnp.asarray(weights, dtype=dtype)
+        if offsets is None:
+            offsets = jnp.zeros((n,), dtype=dtype)
+        else:
+            offsets = jnp.asarray(offsets, dtype=dtype)
+        return LabeledBatch(features, labels, weights, offsets)
+
+    def pad_to(self, n: int) -> "LabeledBatch":
+        """Pad rows up to ``n`` with zero-weight rows (host-side)."""
+        cur = self.num_rows
+        if cur == n:
+            return self
+        if cur > n:
+            raise ValueError(f"cannot pad {cur} rows down to {n}")
+        pad = n - cur
+
+        def _pad(a, value=0.0):
+            widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+            if a.ndim > 1:  # features: pad rows, not columns
+                widths = [(0, 0)] * (a.ndim - 2) + [(0, pad), (0, 0)]
+            return jnp.pad(a, widths, constant_values=value)
+
+        return LabeledBatch(
+            features=_pad(self.features),
+            labels=_pad(self.labels),
+            weights=_pad(self.weights),
+            offsets=_pad(self.offsets),
+        )
+
+
+def concat_batches(batches: list[LabeledBatch]) -> LabeledBatch:
+    return LabeledBatch(
+        features=jnp.concatenate([b.features for b in batches], axis=-2),
+        labels=jnp.concatenate([b.labels for b in batches], axis=-1),
+        weights=jnp.concatenate([b.weights for b in batches], axis=-1),
+        offsets=jnp.concatenate([b.offsets for b in batches], axis=-1),
+    )
+
+
+def batch_from_numpy(
+    X: np.ndarray,
+    y: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    add_intercept: bool = False,
+) -> LabeledBatch:
+    X = np.asarray(X, dtype=np.float32)
+    if add_intercept:
+        X = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], axis=1)
+    return LabeledBatch.build(X, np.asarray(y, np.float32), weights, offsets)

@@ -1,0 +1,51 @@
+"""Coefficients: the model-parameter pytree.
+
+Reference parity: photon-lib ``model/Coefficients.scala`` — means vector plus
+optional per-coefficient variances, dot/norm helpers, ``computeScore``.
+
+TPU-first design: a frozen dataclass registered as a JAX pytree so it flows
+through ``jit`` / ``vmap`` / ``grad`` / optimizer state machines unchanged.
+Dense f32 by default (TPU-friendly); sparse feature spaces are handled at the
+data layer (feature shards / index maps), not by sparse coefficient vectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """GLM coefficients: means (d,) and optional variances (d,)."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: Array) -> Array:
+        """w·x for a single vector or a batch (…, d) of feature vectors."""
+        return features @ self.means
+
+    def norm(self, ord: int = 2) -> Array:
+        if ord == 1:
+            return jnp.sum(jnp.abs(self.means))
+        if ord == 2:
+            return jnp.sqrt(jnp.sum(self.means * self.means))
+        raise ValueError(f"unsupported norm order {ord!r} (use 1 or 2)")
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32, with_variances: bool = False
+              ) -> "Coefficients":
+        means = jnp.zeros((dim,), dtype=dtype)
+        variances = jnp.zeros((dim,), dtype=dtype) if with_variances else None
+        return Coefficients(means=means, variances=variances)

@@ -1,0 +1,173 @@
+"""Feature normalization applied in-kernel — data is never rewritten.
+
+Reference parity: photon-lib ``normalization/NormalizationContext.scala`` and
+``NormalizationType.scala`` (NONE, SCALE_WITH_STANDARD_DEVIATION,
+SCALE_WITH_MAX_MAGNITUDE, STANDARDIZATION). The reference's key trick is
+preserved: the raw data is untouched; scale factors and shifts are folded
+into margin/gradient computation, the model is trained in the transformed
+space, and coefficients are mapped back to the original space on output.
+
+TPU-first design: normalization is two broadcasted vectors folded into the
+fused margin kernel:
+
+    margin(x) = (w ∘ f)·x − (w ∘ f)·s  ( + offset )
+
+so the transformed-space margin w·((x − s) ∘ f) costs one elementwise
+multiply that XLA fuses into the matmul. The gradient pullback is the same
+algebra transposed:  ∇_w = f ∘ (Xᵀ r) − (Σ r)(f ∘ s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=("factors", "shifts"),
+                   meta_fields=("intercept_index",))
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Per-feature scale ``factors`` and ``shifts`` (both optional).
+
+    Transformed feature: x' = (x − shifts) ∘ factors. The intercept column
+    (if any) must have factor 1 and shift 0 — enforced by the builders, and
+    its position recorded in ``intercept_index`` (static metadata) so the
+    shift mass can be folded back exactly on model export.
+    """
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_index: Optional[int] = None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # -- margin-space helpers (used by objectives) ---------------------------
+
+    def effective_coefficients(self, means: Array) -> tuple[Array, Array]:
+        """Return (w_eff, margin_shift) with margin = X @ w_eff + margin_shift.
+
+        ``w_eff = w ∘ f`` and ``margin_shift = −(w ∘ f)·s`` so that
+        ``X @ w_eff + margin_shift == ((X − s) ∘ f) @ w`` without rewriting X.
+        """
+        w_eff = means if self.factors is None else means * self.factors
+        if self.shifts is None:
+            shift = jnp.zeros(means.shape[:-1], dtype=means.dtype)
+        else:
+            shift = -jnp.sum(w_eff * self.shifts, axis=-1)
+        return w_eff, shift
+
+    def pullback_gradient(self, xtr: Array, r_sum: Array) -> Array:
+        """Map a raw-space gradient accumulation to transformed space.
+
+        Given ``xtr = Xᵀ r`` (raw features) and ``r_sum = Σ r``, the gradient
+        w.r.t. transformed-space coefficients is ``f ∘ xtr − r_sum (f ∘ s)``.
+        """
+        g = xtr if self.factors is None else xtr * self.factors
+        if self.shifts is not None:
+            s_eff = self.shifts if self.factors is None else self.shifts * self.factors
+            g = g - jnp.expand_dims(r_sum, -1) * s_eff
+        return g
+
+    # -- model-space transforms (reference: modelToTransformedSpace etc.) ----
+
+    def model_to_original_space(self, means: Array) -> Array:
+        """Coefficients trained on x' → coefficients applying to raw x.
+
+        w_orig = w ∘ f with the total shift −(w ∘ f)·s folded into the
+        intercept. Requires an intercept if shifts are present; the builders
+        guarantee the intercept column has f=1, s=0.
+        """
+        w = means if self.factors is None else means * self.factors
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shifts present but intercept_index unknown")
+            # Shift mass goes to the intercept column (factor 1, shift 0).
+            adjust = -jnp.sum(w * self.shifts, axis=-1)
+            w = w.at[..., self.intercept_index].add(adjust)
+        return w
+
+    def model_to_transformed_space(self, means: Array) -> Array:
+        """Inverse of ``model_to_original_space`` (for warm starts)."""
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shifts present but intercept_index unknown")
+            shift_mass = jnp.sum(means * self.shifts, axis=-1)
+            means = means.at[..., self.intercept_index].add(shift_mass)
+        if self.factors is not None:
+            means = means / self.factors
+        return means
+
+
+def build_normalization(
+    norm_type: NormalizationType,
+    *,
+    means: Optional[np.ndarray] = None,
+    variances: Optional[np.ndarray] = None,
+    max_magnitudes: Optional[np.ndarray] = None,
+    intercept_index: Optional[int] = None,
+    dtype=jnp.float32,
+) -> NormalizationContext:
+    """Build a NormalizationContext from summary statistics.
+
+    Reference parity: ``NormalizationContext.apply(normalizationType,
+    summary, interceptIdOpt)``. Features with zero variance / zero max
+    magnitude get factor 1 (reference behavior: avoid division by zero).
+    """
+    norm_type = NormalizationType(norm_type)
+    if norm_type == NormalizationType.NONE:
+        return NormalizationContext()
+
+    def _safe_inv(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        return np.where(x > 0.0, 1.0 / np.maximum(x, 1e-300), 1.0)
+
+    factors: Optional[np.ndarray]
+    shifts: Optional[np.ndarray] = None
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        if variances is None:
+            raise ValueError("SCALE_WITH_STANDARD_DEVIATION requires variances")
+        factors = _safe_inv(np.sqrt(np.asarray(variances)))
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        if max_magnitudes is None:
+            raise ValueError("SCALE_WITH_MAX_MAGNITUDE requires max_magnitudes")
+        factors = _safe_inv(np.abs(np.asarray(max_magnitudes)))
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        if variances is None or means is None:
+            raise ValueError("STANDARDIZATION requires means and variances")
+        if intercept_index is None:
+            raise ValueError(
+                "STANDARDIZATION requires an intercept column (reference "
+                "requires addIntercept=true when shifts are used)")
+        factors = _safe_inv(np.sqrt(np.asarray(variances)))
+        shifts = np.asarray(means, dtype=np.float64).copy()
+    else:  # pragma: no cover
+        raise ValueError(norm_type)
+
+    if intercept_index is not None:
+        factors[intercept_index] = 1.0
+        if shifts is not None:
+            shifts[intercept_index] = 0.0
+
+    return NormalizationContext(
+        factors=jnp.asarray(factors, dtype=dtype),
+        shifts=None if shifts is None else jnp.asarray(shifts, dtype=dtype),
+        intercept_index=intercept_index,
+    )

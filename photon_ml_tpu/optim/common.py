@@ -1,0 +1,108 @@
+"""Shared optimizer scaffolding: configs, results, convergence, tracking.
+
+Reference parity: photon-lib ``optimization/Optimizer.scala``,
+``OptimizerConfig.scala``, ``OptimizerType.scala``,
+``OptimizationStatesTracker.scala`` / ``OptimizerState.scala``.
+
+TPU-first design: optimizers are pure functions ``(objective, w0) → OptResult``
+compiled as ``lax.while_loop`` state machines with static shapes. Two
+requirements shape everything here (SURVEY.md §7):
+
+1. **vmap-ability** — the same optimizer must run as one big fixed-effect
+   solve AND as thousands of per-entity random-effect solves batched under
+   ``vmap``. Under vmap, ``while_loop`` keeps stepping until every lane's
+   cond is false, and *done lanes keep executing the body*; therefore every
+   state update is masked with the per-lane ``converged`` flag so finished
+   lanes are frozen rather than perturbed.
+2. **fixed-shape history** — per-iteration (value, grad-norm) history is
+   recorded into preallocated ``max_iterations``-length buffers (the
+   ``OptimizationStatesTracker`` analogue), NaN-padded past convergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# objective(w) -> (value, grad). Regularization is folded in by the caller
+# (see photon_ml_tpu/optim/regularization.py).
+ValueAndGrad = Callable[[Array], tuple[Array, Array]]
+# hvp(w, v) -> H·v for TRON.
+Hvp = Callable[[Array, Array], Array]
+
+
+class OptimizerType(enum.Enum):
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    TRON = "TRON"
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Reference parity: OptimizerConfig (type, maxIter, tolerance)."""
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    # L-BFGS/OWL-QN history length (Breeze default m=10).
+    history_length: int = 10
+    # Max line-search / inner-CG steps (static bounds for while_loops).
+    max_line_search_steps: int = 25
+    max_cg_iterations: int = 20
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OptResult:
+    """Final state + per-iteration history (OptimizationStatesTracker)."""
+
+    w: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array  # int32, iterations actually executed
+    converged: Array  # bool
+    value_history: Array  # (max_iterations + 1,), NaN past the end
+    grad_norm_history: Array  # (max_iterations + 1,), NaN past the end
+
+
+def masked_update(converged: Array, new, old):
+    """Freeze a pytree once this lane has converged (vmap safety)."""
+    def _sel(n, o):
+        c = jnp.reshape(converged, converged.shape + (1,) * (n.ndim - converged.ndim))
+        return jnp.where(c, o, n)
+    return jax.tree.map(_sel, new, old)
+
+
+def check_convergence(
+    value: Array,
+    prev_value: Array,
+    grad_norm: Array,
+    initial_grad_norm: Array,
+    tolerance: float,
+) -> Array:
+    """Photon/Breeze-style convergence: relative gradient norm OR relative
+    objective-change below tolerance.
+
+    Reference parity: Optimizer.scala convergence checks
+    (``relativeTolerance`` on both loss delta and gradient norm).
+    """
+    grad_ok = grad_norm <= tolerance * jnp.maximum(initial_grad_norm, 1.0)
+    val_ok = jnp.abs(value - prev_value) <= tolerance * jnp.maximum(
+        jnp.abs(prev_value), 1e-12)
+    return grad_ok | val_ok
+
+
+def record_history(buf: Array, idx: Array, value: Array) -> Array:
+    """Write ``value`` at ``idx`` into a fixed-size history buffer."""
+    return buf.at[idx].set(value)
+
+
+def init_history(max_iterations: int, first: Array) -> Array:
+    buf = jnp.full((max_iterations + 1,), jnp.nan, dtype=jnp.float32)
+    return buf.at[0].set(first.astype(jnp.float32))

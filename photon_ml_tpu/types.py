@@ -1,0 +1,44 @@
+"""Core type aliases, enums and constants.
+
+Reference parity: photon-lib ``TaskType.scala``, ``Types.scala``,
+``Constants.scala``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+# Type aliases (reference: photon-lib Types.scala)
+REId = int  # random-effect entity id (row index into an entity table)
+REType = str  # random-effect type name, e.g. "userId"
+FeatureShardId = str  # named feature shard, e.g. "globalFeatures"
+CoordinateId = str  # GAME coordinate name, e.g. "per-user"
+UniqueSampleId = int  # stable example index within a dataset
+
+# Canonical intercept feature name (reference: Constants.scala INTERCEPT_KEY:
+# name = "(INTERCEPT)", term = "").
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+INTERCEPT_KEY = (INTERCEPT_NAME, INTERCEPT_TERM)
+
+# Default compute dtype. TPU MXU prefers bf16 inputs / f32 accumulation;
+# GLM coefficient math is small, so f32 everywhere is the safe default.
+DEFAULT_DTYPE = jnp.float32
+
+
+class TaskType(enum.Enum):
+    """Supported training tasks (reference: photon-lib TaskType.scala)."""
+
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )

@@ -1,0 +1,27 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+This is the rebuild's analogue of the reference's local-mode Spark fixture
+(photon-test-utils ``SparkTestUtils.sparkTest``): "distributed" behavior is
+exercised without hardware by running real sharding/collective code paths on
+8 virtual CPU devices (SURVEY.md §4). Must run before any jax import.
+"""
+
+import os
+
+# The axon TPU plugin (sitecustomize) pins JAX_PLATFORMS=axon; tests run on
+# virtual CPU devices so shardings execute with 8 devices deterministically.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

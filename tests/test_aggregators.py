@@ -1,0 +1,153 @@
+"""Aggregator algebra checks vs plain-numpy reference implementations.
+
+Mirrors photon-lib aggregator unit tests (SURVEY.md §4): value/gradient/H·v/
+H-diag sums match a straightforward per-example loop, normalization folded
+in-kernel matches explicitly transformed data, vmap batching matches per-item.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import LabeledBatch, batch_from_numpy
+from photon_ml_tpu.normalization import (NormalizationContext,
+                                         NormalizationType,
+                                         build_normalization)
+from photon_ml_tpu.ops import aggregators as agg
+from photon_ml_tpu.ops import losses
+
+
+def _make(rng, n=50, d=7, loss=losses.LOGISTIC):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    if loss.name == "squared":
+        y = rng.normal(size=n).astype(np.float32)
+    elif loss.name == "poisson":
+        y = rng.poisson(2.0, size=n).astype(np.float32)
+    else:
+        y = rng.integers(0, 2, size=n).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    o = rng.normal(size=n).astype(np.float32) * 0.1
+    return LabeledBatch.build(X, y, w, o)
+
+
+def _numpy_value_grad(loss, means, b):
+    X, y, w, o = (np.asarray(b.features, np.float64), np.asarray(b.labels, np.float64),
+                  np.asarray(b.weights, np.float64), np.asarray(b.offsets, np.float64))
+    z = X @ np.asarray(means, np.float64) + o
+    l, dl = loss.loss_and_dz(jnp.asarray(z), jnp.asarray(y))
+    l, dl = np.asarray(l, np.float64), np.asarray(dl, np.float64)
+    return (w * l).sum(), X.T @ (w * dl)
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.SQUARED,
+                                  losses.POISSON, losses.SMOOTHED_HINGE],
+                         ids=lambda l: l.name)
+def test_value_and_gradient_matches_numpy(loss, rng):
+    b = _make(rng, loss=loss)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.3
+    v, g = agg.value_and_gradient(loss, means, b)
+    v_ref, g_ref = _numpy_value_grad(loss, means, b)
+    np.testing.assert_allclose(v, v_ref, rtol=2e-4)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gradient_matches_jax_grad_of_value(rng):
+    b = _make(rng)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.3
+    _, g = agg.value_and_gradient(losses.LOGISTIC, means, b)
+    g_ad = jax.grad(lambda m: agg.value_only(losses.LOGISTIC, m, b))(means)
+    np.testing.assert_allclose(g, g_ad, rtol=1e-3, atol=1e-4)
+
+
+def test_hessian_vector_matches_jvp_of_grad(rng):
+    b = _make(rng)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.normal(size=b.dim).astype(np.float32))
+    hv = agg.hessian_vector(losses.LOGISTIC, means, v, b)
+    grad_fn = lambda m: agg.value_and_gradient(losses.LOGISTIC, m, b)[1]
+    _, hv_ad = jax.jvp(grad_fn, (means,), (v,))
+    np.testing.assert_allclose(hv, hv_ad, rtol=1e-3, atol=1e-3)
+
+
+def test_hessian_diagonal_and_matrix_consistent(rng):
+    b = _make(rng)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.3
+    H = agg.hessian_matrix(losses.LOGISTIC, means, b)
+    diag = agg.hessian_diagonal(losses.LOGISTIC, means, b)
+    np.testing.assert_allclose(jnp.diagonal(H), diag, rtol=2e-3, atol=1e-3)
+    # H·v through the matrix == matrix-free H·v
+    v = jnp.asarray(rng.normal(size=b.dim).astype(np.float32))
+    np.testing.assert_allclose(H @ v,
+                               agg.hessian_vector(losses.LOGISTIC, means, v, b),
+                               rtol=5e-3, atol=1e-3)
+
+
+def test_padding_rows_are_inert(rng):
+    b = _make(rng, n=33)
+    padded = b.pad_to(64)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.3
+    for fn in (lambda bb: agg.value_and_gradient(losses.POISSON, means, bb),
+               lambda bb: agg.hessian_diagonal(losses.POISSON, means, bb)):
+        out, out_p = fn(b), fn(padded)
+        for a, ap in zip(jax.tree.leaves(out), jax.tree.leaves(out_p)):
+            np.testing.assert_allclose(a, ap, rtol=1e-5, atol=1e-6)
+    assert int(padded.effective_count()) == 33
+
+
+def test_padding_with_nonfinite_garbage_is_masked(rng):
+    b = _make(rng, n=8)
+    padded = b.pad_to(16)
+    # Poison padded feature rows with huge values: exp(margin) would overflow.
+    X = np.asarray(padded.features).copy()
+    X[8:] = 1e30
+    poisoned = LabeledBatch(jnp.asarray(X), padded.labels, padded.weights,
+                            padded.offsets)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32))
+    v, g = agg.value_and_gradient(losses.POISSON, means, poisoned)
+    assert np.isfinite(float(v)) and np.all(np.isfinite(np.asarray(g)))
+
+
+def test_normalization_folded_equals_explicit_transform(rng):
+    b = _make(rng, n=40, d=5)
+    # Intercept column at the end.
+    X = np.asarray(b.features).copy()
+    X[:, -1] = 1.0
+    b = LabeledBatch(jnp.asarray(X), b.labels, b.weights, b.offsets)
+    mean = X.mean(axis=0)
+    var = X.var(axis=0)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION, means=mean, variances=var,
+        intercept_index=X.shape[1] - 1)
+    means = jnp.asarray(rng.normal(size=b.dim).astype(np.float32)) * 0.5
+
+    # Explicitly transformed data, identity context:
+    f = np.asarray(norm.factors)
+    s = np.asarray(norm.shifts)
+    Xt = (X - s) * f
+    bt = LabeledBatch(jnp.asarray(Xt, jnp.float32), b.labels, b.weights, b.offsets)
+
+    for make in (
+        lambda bb, nn: agg.value_and_gradient(losses.LOGISTIC, means, bb, nn),
+        lambda bb, nn: agg.hessian_vector(losses.LOGISTIC, means, means + 1.0, bb, nn),
+        lambda bb, nn: agg.hessian_diagonal(losses.LOGISTIC, means, bb, nn),
+        lambda bb, nn: agg.hessian_matrix(losses.LOGISTIC, means, bb, nn),
+    ):
+        out_folded = make(b, norm)
+        out_explicit = make(bt, NormalizationContext())
+        for a, ae in zip(jax.tree.leaves(out_folded), jax.tree.leaves(out_explicit)):
+            np.testing.assert_allclose(a, ae, rtol=2e-3, atol=2e-3)
+
+
+def test_vmap_batching_matches_per_item(rng):
+    # The per-entity random-effect regime: E independent small problems.
+    E, n, d = 6, 12, 4
+    batches = [_make(rng, n=n, d=d) for _ in range(E)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    means = jnp.asarray(rng.normal(size=(E, d)).astype(np.float32)) * 0.3
+    vg = jax.vmap(lambda m, bb: agg.value_and_gradient(losses.LOGISTIC, m, bb))
+    vals, grads = vg(means, stacked)
+    for i in range(E):
+        v_i, g_i = agg.value_and_gradient(losses.LOGISTIC, means[i], batches[i])
+        np.testing.assert_allclose(vals[i], v_i, rtol=1e-5)
+        np.testing.assert_allclose(grads[i], g_i, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,99 @@
+"""Loss derivative checks: closed forms vs jax.grad vs finite differences.
+
+Mirrors the reference's loss-function unit tests (photon-lib
+``function/glm/*LossFunctionTest`` — derivative checks via finite
+differences, SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+
+ALL_LOSSES = [losses.LOGISTIC, losses.SQUARED, losses.POISSON, losses.SMOOTHED_HINGE]
+
+
+def _labels_for(loss, rng, n):
+    if loss.name == "squared":
+        return rng.normal(size=n).astype(np.float32)
+    if loss.name == "poisson":
+        return rng.poisson(3.0, size=n).astype(np.float32)
+    return rng.integers(0, 2, size=n).astype(np.float32)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_first_derivative_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 2.0, dtype=jnp.float32)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    _, dl = loss.loss_and_dz(z, y)
+    dl_ad = jax.vmap(jax.grad(lambda zz, yy: loss.loss(zz, yy)))(z, y)
+    np.testing.assert_allclose(dl, dl_ad, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_second_derivative_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 2.0, dtype=jnp.float32)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    # Smoothed hinge's d2 is discontinuous at t in {0,1}; keep away from kinks.
+    if loss.name == "smoothed_hinge":
+        z = z + 0.05
+    d2 = loss.d2z(z, y)
+    d2_ad = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss.loss(zz, yy))))(z, y)
+    np.testing.assert_allclose(d2, d2_ad, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_first_derivative_matches_finite_difference(loss, rng):
+    z = rng.normal(size=32).astype(np.float64) * 1.5
+    y = np.asarray(_labels_for(loss, rng, 32), dtype=np.float64)
+    eps = 1e-3  # f32 compute: eps must sit well above float32 resolution
+    lp = np.asarray(loss.loss(jnp.asarray(z + eps, jnp.float32), jnp.asarray(y, jnp.float32)), np.float64)
+    lm = np.asarray(loss.loss(jnp.asarray(z - eps, jnp.float32), jnp.asarray(y, jnp.float32)), np.float64)
+    fd = (lp - lm) / (2 * eps)
+    _, dl = loss.loss_and_dz(jnp.asarray(z, jnp.float32), jnp.asarray(y, jnp.float32))
+    np.testing.assert_allclose(np.asarray(dl), fd, rtol=5e-3, atol=5e-3)
+
+
+def test_logistic_known_values():
+    # At margin 0: l = log 2 regardless of label; dl = 0.5 - y.
+    z = jnp.zeros((2,))
+    y = jnp.asarray([0.0, 1.0])
+    l, dl = losses.LOGISTIC.loss_and_dz(z, y)
+    np.testing.assert_allclose(l, np.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(dl, [0.5, -0.5], rtol=1e-6)
+
+
+def test_logistic_extreme_margins_stable():
+    z = jnp.asarray([80.0, -80.0, 500.0, -500.0])
+    y = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    l, dl = losses.LOGISTIC.loss_and_dz(z, y)
+    assert np.all(np.isfinite(l)) and np.all(np.isfinite(dl))
+
+
+def test_smoothed_hinge_piecewise_values():
+    # label 1 → y=+1, t = z.
+    z = jnp.asarray([-1.0, 0.5, 2.0])
+    y = jnp.ones((3,))
+    l, dl = losses.SMOOTHED_HINGE.loss_and_dz(z, y)
+    np.testing.assert_allclose(l, [1.5, 0.125, 0.0], rtol=1e-6)
+    np.testing.assert_allclose(dl, [-1.0, -0.5, 0.0], rtol=1e-6)
+
+
+def test_poisson_matches_nll():
+    z = jnp.asarray([0.1, -0.3, 1.2])
+    y = jnp.asarray([1.0, 0.0, 4.0])
+    l, dl = losses.POISSON.loss_and_dz(z, y)
+    np.testing.assert_allclose(l, np.exp(z) - y * np.asarray(z), rtol=1e-5)
+    np.testing.assert_allclose(dl, np.exp(z) - y, rtol=1e-5)
+
+
+def test_task_mapping():
+    from photon_ml_tpu.types import TaskType
+    assert losses.loss_for_task(TaskType.LOGISTIC_REGRESSION) is losses.LOGISTIC
+    assert losses.loss_for_task("LINEAR_REGRESSION") is losses.SQUARED
+    assert losses.loss_for_task(TaskType.POISSON_REGRESSION) is losses.POISSON
+    assert (losses.loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+            is losses.SMOOTHED_HINGE)

@@ -1,0 +1,77 @@
+"""Normalization context algebra (reference: NormalizationContextTest)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.normalization import (NormalizationContext,
+                                         NormalizationType,
+                                         build_normalization)
+
+
+def _ctx(rng, d=6, kind=NormalizationType.STANDARDIZATION):
+    mean = rng.normal(size=d)
+    var = rng.uniform(0.5, 4.0, size=d)
+    mm = rng.uniform(0.1, 9.0, size=d)
+    return build_normalization(kind, means=mean, variances=var,
+                               max_magnitudes=mm, intercept_index=d - 1)
+
+
+def test_none_is_identity():
+    ctx = build_normalization(NormalizationType.NONE)
+    assert ctx.is_identity
+    w = jnp.asarray([1.0, 2.0])
+    w_eff, shift = ctx.effective_coefficients(w)
+    np.testing.assert_allclose(w_eff, w)
+    np.testing.assert_allclose(shift, 0.0)
+
+
+def test_intercept_untouched(rng):
+    ctx = _ctx(rng)
+    assert float(ctx.factors[-1]) == 1.0
+    assert float(ctx.shifts[-1]) == 0.0
+
+
+def test_scale_with_std(rng):
+    d = 5
+    var = rng.uniform(0.5, 4.0, size=d)
+    ctx = build_normalization(NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+                              variances=var)
+    np.testing.assert_allclose(ctx.factors, 1.0 / np.sqrt(var), rtol=1e-6)
+    assert ctx.shifts is None
+
+
+def test_zero_variance_gets_factor_one():
+    ctx = build_normalization(NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+                              variances=np.asarray([0.0, 4.0]))
+    np.testing.assert_allclose(ctx.factors, [1.0, 0.5])
+
+
+def test_model_space_round_trip(rng):
+    ctx = _ctx(rng)
+    w = jnp.asarray(rng.normal(size=6).astype(np.float32))
+    back = ctx.model_to_transformed_space(ctx.model_to_original_space(w))
+    np.testing.assert_allclose(back, w, rtol=1e-5, atol=1e-6)
+
+
+def test_original_space_model_scores_raw_data(rng):
+    """w' on x' must equal model_to_original_space(w') on raw x."""
+    d = 6
+    ctx = _ctx(rng, d=d)
+    w_t = rng.normal(size=d).astype(np.float32)
+    X = rng.normal(size=(20, d)).astype(np.float32)
+    X[:, -1] = 1.0  # intercept column
+    f = np.asarray(ctx.factors)
+    s = np.asarray(ctx.shifts)
+    scores_transformed = ((X - s) * f) @ w_t
+    w_orig = np.asarray(ctx.model_to_original_space(jnp.asarray(w_t)))
+    scores_raw = X @ w_orig
+    np.testing.assert_allclose(scores_raw, scores_transformed, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_standardization_requires_intercept(rng):
+    with pytest.raises(ValueError):
+        build_normalization(NormalizationType.STANDARDIZATION,
+                            means=np.ones(3), variances=np.ones(3),
+                            intercept_index=None)
