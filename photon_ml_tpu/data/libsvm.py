@@ -1,0 +1,108 @@
+"""LIBSVM-format reader/writer (a1a, YearPredictionMSD, ... configs).
+
+Reference note: the reference ingests Avro (photon-client
+``data/avro/AvroDataReader.scala``); LIBSVM support is this rebuild's
+equivalent of the bundled-dataset path used by the BASELINE.json configs
+(a1a logistic, YearPredictionMSD TRON); Avro ingestion is a separate
+module.
+
+Host-side parsing to dense or CSR numpy; the device pipeline consumes the
+arrays via LabeledBatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LibsvmData:
+    """Parsed LIBSVM file: labels plus features (dense or CSR triplet)."""
+
+    labels: np.ndarray  # (n,)
+    # Dense path:
+    dense: Optional[np.ndarray] = None  # (n, d)
+    # Sparse path (CSR):
+    indptr: Optional[np.ndarray] = None  # (n+1,)
+    indices: Optional[np.ndarray] = None  # (nnz,)
+    values: Optional[np.ndarray] = None  # (nnz,)
+    num_features: int = 0
+
+    @property
+    def num_rows(self) -> int:
+        return self.labels.shape[0]
+
+    def to_dense(self) -> np.ndarray:
+        if self.dense is not None:
+            return self.dense
+        out = np.zeros((self.num_rows, self.num_features), np.float32)
+        for i in range(self.num_rows):
+            lo, hi = self.indptr[i], self.indptr[i + 1]
+            out[i, self.indices[lo:hi]] = self.values[lo:hi]
+        return out
+
+
+def read_libsvm(
+    path: str,
+    num_features: Optional[int] = None,
+    zero_based: bool = False,
+    dense: bool = True,
+    binary_labels_to_01: bool = True,
+) -> LibsvmData:
+    """Parse a LIBSVM text file.
+
+    ``binary_labels_to_01`` maps {-1,+1} labels to {0,1} (the convention of
+    this framework's classification losses; a1a ships ±1).
+    """
+    labels: list[float] = []
+    indptr = [0]
+    indices: list[int] = []
+    values: list[float] = []
+    offset = 0 if zero_based else 1
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                idx = int(k) - offset
+                if idx > max_idx:
+                    max_idx = idx
+                indices.append(idx)
+                values.append(float(v))
+            indptr.append(len(indices))
+
+    d = num_features if num_features is not None else max_idx + 1
+    y = np.asarray(labels, np.float32)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y + 1.0) / 2.0
+    data = LibsvmData(
+        labels=y,
+        indptr=np.asarray(indptr, np.int64),
+        indices=np.asarray(indices, np.int32),
+        values=np.asarray(values, np.float32),
+        num_features=d,
+    )
+    if dense:
+        data.dense = data.to_dense()
+        data.indptr = data.indices = data.values = None
+    return data
+
+
+def write_libsvm(path: str, X: np.ndarray, y: np.ndarray,
+                 zero_based: bool = False) -> None:
+    """Write a dense matrix in LIBSVM format (test fixture helper)."""
+    offset = 0 if zero_based else 1
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            row = X[i]
+            nz = np.nonzero(row)[0]
+            feats = " ".join(f"{j + offset}:{row[j]:.6g}" for j in nz)
+            f.write(f"{y[i]:g} {feats}\n")

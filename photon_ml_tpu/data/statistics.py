@@ -1,0 +1,80 @@
+"""Per-feature summary statistics.
+
+Reference parity: photon-lib ``stat/FeatureDataStatistics.scala`` (a.k.a.
+``BasicStatisticalSummary``; built via Spark's per-partition
+``MultivariateOnlineSummarizer`` merge) — mean/variance/min/max/numNonzeros
+per feature, feeding NormalizationContext and the model summary output.
+
+TPU-first: one fused pass of weighted segment reductions over the (sharded)
+feature matrix; the treeAggregate merge becomes a psum when run under
+shard_map (see parallel/), but the plain jnp version auto-partitions too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledBatch
+from photon_ml_tpu.normalization import (NormalizationContext,
+                                         NormalizationType,
+                                         build_normalization)
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FeatureDataStatistics:
+    """Weighted per-feature summary (reference: FeatureDataStatistics)."""
+
+    count: Array  # scalar: Σ weights
+    mean: Array  # (d,)
+    variance: Array  # (d,) population variance in weighted form
+    min: Array  # (d,)
+    max: Array  # (d,)
+    num_nonzeros: Array  # (d,)
+    max_magnitude: Array  # (d,): max |x|
+
+    @property
+    def dim(self) -> int:
+        return self.mean.shape[-1]
+
+
+def summarize(batch: LabeledBatch) -> FeatureDataStatistics:
+    """Compute weighted feature statistics in one fused pass."""
+    X = batch.features
+    w = jnp.where(batch.weights > 0.0, batch.weights, 0.0)
+    wsum = jnp.sum(w)
+    wn = w / jnp.maximum(wsum, 1e-12)
+    mean = jnp.einsum("nd,n->d", X, wn)
+    ex2 = jnp.einsum("nd,n->d", X * X, wn)
+    var = jnp.maximum(ex2 - mean * mean, 0.0)
+    live = batch.weights > 0.0
+    big = jnp.float32(np.inf)
+    Xmin = jnp.min(jnp.where(live[:, None], X, big), axis=0)
+    Xmax = jnp.max(jnp.where(live[:, None], X, -big), axis=0)
+    nnz = jnp.sum((X != 0.0) & live[:, None], axis=0).astype(jnp.float32)
+    max_mag = jnp.max(jnp.where(live[:, None], jnp.abs(X), 0.0), axis=0)
+    return FeatureDataStatistics(
+        count=wsum, mean=mean, variance=var, min=Xmin, max=Xmax,
+        num_nonzeros=nnz, max_magnitude=max_mag)
+
+
+def normalization_from_statistics(
+    stats: FeatureDataStatistics,
+    norm_type: NormalizationType,
+    intercept_index: Optional[int],
+) -> NormalizationContext:
+    """Reference parity: NormalizationContext.apply(type, summary, intercept)."""
+    return build_normalization(
+        norm_type,
+        means=np.asarray(stats.mean),
+        variances=np.asarray(stats.variance),
+        max_magnitudes=np.asarray(stats.max_magnitude),
+        intercept_index=intercept_index,
+    )

@@ -96,13 +96,3 @@ def check_convergence(
     val_ok = jnp.abs(value - prev_value) <= tolerance * jnp.maximum(
         jnp.abs(prev_value), 1e-12)
     return grad_ok | val_ok
-
-
-def record_history(buf: Array, idx: Array, value: Array) -> Array:
-    """Write ``value`` at ``idx`` into a fixed-size history buffer."""
-    return buf.at[idx].set(value)
-
-
-def init_history(max_iterations: int, first: Array) -> Array:
-    buf = jnp.full((max_iterations + 1,), jnp.nan, dtype=jnp.float32)
-    return buf.at[0].set(first.astype(jnp.float32))

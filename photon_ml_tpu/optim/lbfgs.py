@@ -1,0 +1,293 @@
+"""L-BFGS and OWL-QN as jit/vmap-compatible ``lax.while_loop`` programs.
+
+Reference parity: photon-lib ``optimization/LBFGS.scala`` (wraps
+``breeze.optimize.LBFGS``, m=10 history, line search) and ``OWLQN.scala``
+(wraps ``breeze.optimize.OWLQN``: L1 via orthant-wise QN with per-coordinate
+L1 weights, intercept excluded).
+
+TPU-first design (SURVEY.md §7 step 2): instead of wrapping a host-side
+optimization library, the whole optimizer is a single compiled state machine:
+
+- fixed-shape circular (m, d) history buffers + ``lax.fori_loop`` two-loop
+  recursion — no Python lists, no dynamic shapes;
+- backtracking Armijo line search as a bounded inner ``while_loop``
+  (each trial costs one fused objective evaluation = one psum when the
+  objective is distributed);
+- every state update is masked by the per-lane ``converged`` flag so the
+  SAME machine runs vmapped over thousands of padded per-entity problems
+  (the random-effect regime, reference ``SingleNodeOptimizationProblem``)
+  with lanes freezing as they individually converge;
+- OWL-QN is the same machine with pseudo-gradients, orthant projection of
+  the direction and the post-step point, and the L1 term added to the
+  line-search objective.
+
+OWL-QN follows Andrew & Gao (2007), as Breeze's implementation does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optim.common import (OptResult, OptimizerConfig,
+                                        ValueAndGrad, check_convergence,
+                                        masked_update)
+
+Array = jax.Array
+
+_EPS = 1e-10
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class _LBFGSState:
+    w: Array
+    f: Array
+    g: Array  # gradient of the SMOOTH part
+    s_hist: Array  # (m, d)
+    y_hist: Array  # (m, d)
+    rho: Array  # (m,)
+    head: Array  # int32: slot of newest pair
+    count: Array  # int32: number of valid pairs
+    it: Array  # int32
+    converged: Array  # bool
+    failed: Array  # bool: line search stalled
+    g0_norm: Array
+    value_history: Array
+    grad_norm_history: Array
+
+
+def _two_loop(g, s_hist, y_hist, rho, head, count):
+    """Two-loop recursion: returns d ≈ H⁻¹ g (descent dir is −d)."""
+    m = s_hist.shape[0]
+    alphas0 = jnp.zeros((m,), dtype=g.dtype)
+
+    def bwd(j, carry):
+        q, alphas = carry
+        idx = (head - j) % m
+        valid = j < count
+        a = jnp.where(valid, rho[idx] * jnp.dot(s_hist[idx], q), 0.0)
+        q = q - a * y_hist[idx]
+        return q, alphas.at[idx].set(a)
+
+    q, alphas = lax.fori_loop(0, m, bwd, (g, alphas0))
+
+    sy = jnp.dot(s_hist[head], y_hist[head])
+    yy = jnp.dot(y_hist[head], y_hist[head])
+    gamma = jnp.where(count > 0, sy / jnp.maximum(yy, _EPS), 1.0)
+    r = gamma * q
+
+    def fwd(j, r):
+        # oldest → newest
+        idx = (head - (count - 1 - j)) % m
+        valid = j < count
+        b = rho[idx] * jnp.dot(y_hist[idx], r)
+        r = r + jnp.where(valid, alphas[idx] - b, 0.0) * s_hist[idx]
+        return r
+
+    return lax.fori_loop(0, m, fwd, r)
+
+
+def _project_orthant(x: Array, orthant: Array) -> Array:
+    """Zero coordinates whose sign disagrees with the orthant."""
+    return jnp.where(jnp.sign(x) == orthant, x, 0.0)
+
+
+def _pseudo_gradient(w: Array, g: Array, l1: Array) -> Array:
+    """OWL-QN pseudo-gradient of f(w) + Σ l1ⱼ|wⱼ| (Andrew & Gao 2007)."""
+    right = g + l1
+    left = g - l1
+    pg_zero = jnp.where(left > 0.0, left, jnp.where(right < 0.0, right, 0.0))
+    return jnp.where(w > 0.0, g + l1, jnp.where(w < 0.0, g - l1, pg_zero))
+
+
+def minimize(
+    value_and_grad: ValueAndGrad,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    l1_weights: Optional[Array] = None,
+) -> OptResult:
+    """Minimize f(w) (+ Σ l1ⱼ|wⱼ| when ``l1_weights`` given → OWL-QN).
+
+    ``value_and_grad`` must be the SMOOTH part only; the L1 term is handled
+    by pseudo-gradients / orthant projection, never differentiated.
+    """
+    m = config.history_length
+    max_iter = config.max_iterations
+    is_owlqn = l1_weights is not None
+    dtype = w0.dtype
+    d = w0.shape[-1]
+
+    def total_value(f_smooth: Array, w: Array) -> Array:
+        if not is_owlqn:
+            return f_smooth
+        return f_smooth + jnp.sum(l1_weights * jnp.abs(w), axis=-1)
+
+    def search_gradient(w: Array, g: Array) -> Array:
+        """The gradient driving direction + convergence (pg for OWL-QN)."""
+        if not is_owlqn:
+            return g
+        return _pseudo_gradient(w, g, l1_weights)
+
+    f0, g0 = value_and_grad(w0)
+    ft0 = total_value(f0, w0)
+    sg0 = search_gradient(w0, g0)
+    g0_norm = jnp.linalg.norm(sg0)
+
+    hist_shape = (m, d)
+    vh = jnp.full((max_iter + 1,), jnp.nan, jnp.float32).at[0].set(
+        ft0.astype(jnp.float32))
+    gh = jnp.full((max_iter + 1,), jnp.nan, jnp.float32).at[0].set(
+        g0_norm.astype(jnp.float32))
+
+    init = _LBFGSState(
+        w=w0, f=f0, g=g0,
+        s_hist=jnp.zeros(hist_shape, dtype), y_hist=jnp.zeros(hist_shape, dtype),
+        rho=jnp.zeros((m,), dtype),
+        head=jnp.asarray(0, jnp.int32), count=jnp.asarray(0, jnp.int32),
+        it=jnp.asarray(0, jnp.int32),
+        converged=g0_norm <= config.tolerance,
+        failed=jnp.asarray(False),
+        g0_norm=g0_norm,
+        value_history=vh, grad_norm_history=gh,
+    )
+
+    def line_search(w, ft, sg, direction):
+        """Backtracking Armijo on the TOTAL objective; returns new point.
+
+        For OWL-QN the trial point is projected onto the orthant defined by
+        sign(w) (or sign(−pg) at zeros) before evaluation.
+        """
+        dg = jnp.dot(sg, direction)
+        if is_owlqn:
+            orthant = jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-sg))
+
+        def trial_point(alpha):
+            cand = w + alpha * direction
+            if is_owlqn:
+                cand = _project_orthant(cand, orthant)
+            return cand
+
+        def ls_cond(st):
+            alpha, steps, done, *_ = st
+            return (~done) & (steps < config.max_line_search_steps)
+
+        def ls_body(st):
+            alpha, steps, done, best_w, best_f, best_g = st
+            cand = trial_point(alpha)
+            f_new, g_new = value_and_grad(cand)
+            ft_new = total_value(f_new, cand)
+            # Armijo with the projected displacement (OWL-QN form).
+            decrease = jnp.dot(sg, cand - w) if is_owlqn else alpha * dg
+            ok = jnp.isfinite(ft_new) & (ft_new <= ft + 1e-4 * decrease)
+            best_w = jnp.where(ok, cand, best_w)
+            best_f = jnp.where(ok, f_new, best_f)
+            best_g = jnp.where(ok, g_new, best_g)
+            return (alpha * 0.5, steps + 1, ok, best_w, best_f, best_g)
+
+        init_alpha = jnp.asarray(1.0, dtype)
+        st = (init_alpha, jnp.asarray(0, jnp.int32), jnp.asarray(False),
+              w, jnp.asarray(jnp.inf, dtype), sg)
+        _, steps, ok, new_w, new_f, new_g = lax.while_loop(ls_cond, ls_body, st)
+        return ok, new_w, new_f, new_g
+
+    def body(state: _LBFGSState) -> _LBFGSState:
+        sg = search_gradient(state.w, state.g)
+        d_dir = -_two_loop(sg, state.s_hist, state.y_hist, state.rho,
+                           state.head, state.count)
+        if is_owlqn:
+            # Constrain the direction to the descent orthant of −pg.
+            d_dir = jnp.where(d_dir * (-sg) > 0.0, d_dir, 0.0)
+        # Safeguard: fall back to steepest descent on non-descent directions.
+        descent = jnp.dot(sg, d_dir) < 0.0
+        d_dir = jnp.where(descent, d_dir, -sg)
+        # First iteration: scale like Breeze (step ~ 1/‖g‖ effect) to avoid
+        # wild first steps on poorly scaled problems.
+        first = state.count == 0
+        d_dir = jnp.where(
+            first, d_dir / jnp.maximum(jnp.linalg.norm(d_dir), 1.0), d_dir)
+
+        ft = total_value(state.f, state.w)
+        ok, new_w, new_f, new_g = line_search(state.w, ft, sg, d_dir)
+
+        s = new_w - state.w
+        y = new_g - state.g
+        sy = jnp.dot(s, y)
+        good_pair = ok & (sy > _EPS)
+        new_head = jnp.where(good_pair, (state.head + 1) % m, state.head)
+        new_count = jnp.where(good_pair, jnp.minimum(state.count + 1, m),
+                              state.count)
+
+        def upd(buf, row):
+            return jnp.where(
+                good_pair,
+                buf.at[new_head].set(row),
+                buf)
+
+        s_hist = upd(state.s_hist, s)
+        y_hist = upd(state.y_hist, y)
+        rho = jnp.where(good_pair,
+                        state.rho.at[new_head].set(1.0 / jnp.maximum(sy, _EPS)),
+                        state.rho)
+
+        new_sg = search_gradient(new_w, new_g)
+        new_gnorm = jnp.linalg.norm(new_sg)
+        ft_new = total_value(new_f, new_w)
+        it = state.it + 1
+        conv = ok & check_convergence(ft_new, ft, new_gnorm, state.g0_norm,
+                                      config.tolerance)
+        failed = ~ok  # line search exhausted: stop (stalled)
+
+        vh = state.value_history.at[it].set(
+            jnp.where(ok, ft_new, ft).astype(jnp.float32))
+        gh = state.grad_norm_history.at[it].set(
+            jnp.where(ok, new_gnorm,
+                      jnp.linalg.norm(sg)).astype(jnp.float32))
+
+        new_state = _LBFGSState(
+            w=jnp.where(ok, new_w, state.w),
+            f=jnp.where(ok, new_f, state.f),
+            g=jnp.where(ok, new_g, state.g),
+            s_hist=s_hist, y_hist=y_hist, rho=rho,
+            head=new_head, count=new_count,
+            it=it,
+            converged=state.converged | conv | failed,
+            failed=state.failed | failed,
+            g0_norm=state.g0_norm,
+            value_history=vh, grad_norm_history=gh,
+        )
+        # vmap safety: freeze lanes that were already converged (history
+        # buffers included — body still executes for them).
+        return masked_update(state.converged, new_state, state)
+
+    def cond(state: _LBFGSState):
+        return (~state.converged) & (state.it < max_iter)
+
+    final = lax.while_loop(cond, body, init)
+    sg_final = search_gradient(final.w, final.g)
+    return OptResult(
+        w=final.w,
+        value=total_value(final.f, final.w),
+        grad_norm=jnp.linalg.norm(sg_final),
+        iterations=final.it,
+        converged=final.converged & ~final.failed,
+        value_history=final.value_history,
+        grad_norm_history=final.grad_norm_history,
+    )
+
+
+def minimize_owlqn(
+    value_and_grad: ValueAndGrad,
+    w0: Array,
+    l1_weights: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptResult:
+    """OWL-QN: minimize smooth f(w) + Σⱼ l1ⱼ |wⱼ|.
+
+    Reference parity: photon-lib ``optimization/OWLQN.scala``.
+    """
+    return minimize(value_and_grad, w0, config, l1_weights=l1_weights)

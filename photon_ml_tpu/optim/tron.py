@@ -1,0 +1,183 @@
+"""TRON: Trust-Region Newton with conjugate-gradient inner solves.
+
+Reference parity: photon-lib ``optimization/TRON.scala`` — itself a port of
+LIBLINEAR's tron.cpp (Lin, Weng & Keerthi 2008): an outer trust-region loop
+whose step comes from a Steihaug conjugate-gradient solve of H·s = −g using
+Hessian-VECTOR products only (H is never materialized), truncated at the
+trust-region boundary.
+
+TPU-first design: both loops are ``lax.while_loop``s compiled into one XLA
+program; each CG iteration costs exactly one Hessian-vector product — one
+fused matmul pair (+ one psum when distributed), the analogue of the
+reference's one ``treeAggregate(HessianVectorAggregator)`` per CG step.
+Masked updates make the machine vmappable for per-entity solves, like
+photon_ml_tpu/optim/lbfgs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optim.common import (Hvp, OptResult, OptimizerConfig,
+                                        ValueAndGrad, check_convergence,
+                                        masked_update)
+
+Array = jax.Array
+
+# LIBLINEAR trust-region constants (tron.cpp).
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class _TronState:
+    w: Array
+    f: Array
+    g: Array
+    delta: Array  # trust-region radius
+    it: Array
+    converged: Array
+    failed: Array  # trust region collapsed before convergence
+    g0_norm: Array
+    value_history: Array
+    grad_norm_history: Array
+
+
+def _cg_steihaug(hvp, w, g, delta, max_cg, tol_cg):
+    """Truncated CG: approximately solve H s = −g within ‖s‖ ≤ delta.
+
+    Returns (s, sHs, gs) where sHs = sᵀHs and gs = gᵀs, the pieces needed
+    for the model-decrease computation.
+    """
+    d = g.shape[-1]
+    s0 = jnp.zeros_like(g)
+    r0 = -g  # residual = -g - H s, s=0
+    p0 = r0
+    rr0 = jnp.dot(r0, r0)
+    cg_tol = tol_cg * jnp.sqrt(rr0)
+
+    def cond(st):
+        s, r, p, rr, i, done = st
+        return (~done) & (i < max_cg) & (jnp.sqrt(rr) > cg_tol)
+
+    def body(st):
+        s, r, p, rr, i, done = st
+        hp = hvp(w, p)
+        php = jnp.dot(p, hp)
+        # Negative curvature or tiny curvature → step to the boundary.
+        alpha = rr / jnp.maximum(php, 1e-30)
+        s_next = s + alpha * p
+        over = (php <= 0.0) | (jnp.linalg.norm(s_next) >= delta)
+
+        # Boundary step: find tau >= 0 with ‖s + tau p‖ = delta.
+        ss, sp, pp = jnp.dot(s, s), jnp.dot(s, p), jnp.dot(p, p)
+        disc = jnp.sqrt(jnp.maximum(sp * sp + pp * (delta * delta - ss), 0.0))
+        tau = (disc - sp) / jnp.maximum(pp, 1e-30)
+        s_bound = s + tau * p
+
+        s_new = jnp.where(over, s_bound, s_next)
+        r_new = r - jnp.where(over, tau, alpha) * hp
+        rr_new = jnp.dot(r_new, r_new)
+        beta = rr_new / jnp.maximum(rr, 1e-30)
+        p_new = r_new + beta * p
+        return (s_new, r_new, p_new, rr_new, i + 1, done | over)
+
+    st = (s0, r0, p0, rr0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    s, r, p, rr, i, done = lax.while_loop(cond, body, st)
+    sHs = jnp.dot(s, -g - r)  # H s = -g - r by the residual invariant
+    gs = jnp.dot(g, s)
+    return s, sHs, gs
+
+
+def minimize(
+    value_and_grad: ValueAndGrad,
+    hvp: Hvp,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptResult:
+    """Trust-region Newton minimization of a twice-differentiable objective."""
+    max_iter = config.max_iterations
+
+    f0, g0 = value_and_grad(w0)
+    g0_norm = jnp.linalg.norm(g0)
+    vh = jnp.full((max_iter + 1,), jnp.nan, jnp.float32).at[0].set(
+        f0.astype(jnp.float32))
+    gh = jnp.full((max_iter + 1,), jnp.nan, jnp.float32).at[0].set(
+        g0_norm.astype(jnp.float32))
+
+    init = _TronState(
+        w=w0, f=f0, g=g0,
+        delta=g0_norm,  # LIBLINEAR: initial radius = ‖g0‖
+        it=jnp.asarray(0, jnp.int32),
+        converged=g0_norm <= config.tolerance,
+        failed=jnp.asarray(False),
+        g0_norm=g0_norm,
+        value_history=vh, grad_norm_history=gh,
+    )
+
+    def body(state: _TronState) -> _TronState:
+        s, sHs, gs = _cg_steihaug(hvp, state.w, state.g, state.delta,
+                                  config.max_cg_iterations, 0.1)
+        prered = -(gs + 0.5 * sHs)  # predicted decrease of the quadratic model
+        w_new = state.w + s
+        f_new, g_new = value_and_grad(w_new)
+        actred = state.f - f_new
+        snorm = jnp.linalg.norm(s)
+
+        # Radius update (LIBLINEAR tron.cpp rules, simplified alpha=1 form).
+        ratio = actred / jnp.maximum(prered, 1e-30)
+        delta = state.delta
+        delta = jnp.where(
+            ratio < _ETA0, _SIGMA1 * jnp.minimum(delta, snorm),
+            jnp.where(
+                ratio < _ETA1, jnp.maximum(_SIGMA1 * delta, _SIGMA2 * snorm),
+                jnp.where(
+                    ratio < _ETA2, delta,  # acceptable step: keep radius
+                    jnp.maximum(delta, _SIGMA3 * snorm))))
+
+        accept = (actred > _ETA0 * prered) & jnp.isfinite(f_new)
+        w_acc = jnp.where(accept, w_new, state.w)
+        f_acc = jnp.where(accept, f_new, state.f)
+        g_acc = jnp.where(accept, g_new, state.g)
+
+        gnorm = jnp.linalg.norm(g_acc)
+        it = state.it + 1
+        # Value-based convergence only counts on accepted steps (a rejected
+        # step trivially has Δf = 0); gradient-based convergence is valid at
+        # the current iterate regardless of acceptance.
+        grad_conv = gnorm <= config.tolerance * jnp.maximum(state.g0_norm, 1.0)
+        conv = grad_conv | (accept & check_convergence(
+            f_acc, state.f, gnorm, state.g0_norm, config.tolerance))
+        # A collapsed radius with the gradient still large is a true stall.
+        stalled = delta < 1e-12
+
+        vh = state.value_history.at[it].set(f_acc.astype(jnp.float32))
+        gh = state.grad_norm_history.at[it].set(gnorm.astype(jnp.float32))
+
+        new_state = _TronState(
+            w=w_acc, f=f_acc, g=g_acc, delta=delta, it=it,
+            converged=state.converged | conv | stalled,
+            failed=state.failed | (stalled & ~conv),
+            g0_norm=state.g0_norm,
+            value_history=vh, grad_norm_history=gh,
+        )
+        return masked_update(state.converged, new_state, state)
+
+    def cond(state: _TronState):
+        return (~state.converged) & (state.it < max_iter)
+
+    final = lax.while_loop(cond, body, init)
+    return OptResult(
+        w=final.w,
+        value=final.f,
+        grad_norm=jnp.linalg.norm(final.g),
+        iterations=final.it,
+        converged=final.converged & ~final.failed,
+        value_history=final.value_history,
+        grad_norm_history=final.grad_norm_history,
+    )

@@ -1,0 +1,115 @@
+"""Distributed GLM objectives: per-shard aggregation + psum over ICI.
+
+Reference parity: photon-api ``function/DistributedObjectiveFunction.scala``
+and ``function/glm/DistributedGLMLossFunction.scala`` — there, each
+evaluation broadcasts coefficients to executors and runs
+``RDD[LabeledPoint].treeAggregate(aggregator)(add, merge, depth=2)``; here,
+coefficients are replicated by sharding (no explicit broadcast exists), each
+device computes its shard's fused aggregate (one MXU matmul pair), and the
+tree-merge is a single ``lax.psum`` compiled onto the ICI ring. The entire
+optimizer runs inside ONE jit program — there is no per-iteration host
+round-trip at all, which is the key structural speedup over the reference
+(driver⇄executor RPC per L-BFGS iteration).
+
+``shard_map`` is used (rather than relying on jit's auto-spmd alone) so the
+collective placement is explicit and testable: sharded == unsharded numerics
+is asserted in tests, mirroring the reference's
+``DistributedGLMLossFunctionIntegTest`` (distributed grad == local grad).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.data.batch import LabeledBatch
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops import aggregators as agg
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+
+_IDENTITY = NormalizationContext()
+
+
+def _batch_specs(batch: LabeledBatch) -> LabeledBatch:
+    """PartitionSpecs sharding the example dim of every leaf over ``data``."""
+    return jax.tree.map(
+        lambda leaf: P(DATA_AXIS, *(None,) * (jnp.ndim(leaf) - 1)), batch)
+
+
+def make_value_and_gradient(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: LabeledBatch,
+    norm: NormalizationContext = _IDENTITY,
+):
+    """(w) → (Σ value, Σ grad) over the full sharded batch.
+
+    The returned callable closes over the sharded batch; coefficients are
+    replicated in, results are replicated out.
+    """
+    specs = _batch_specs(batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), specs), out_specs=(P(), P()))
+    def _vg(w, b):
+        v, g = agg.value_and_gradient(loss, w, b, norm)
+        return lax.psum(v, DATA_AXIS), lax.psum(g, DATA_AXIS)
+
+    return lambda w: _vg(w, batch)
+
+
+def make_hvp(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: LabeledBatch,
+    norm: NormalizationContext = _IDENTITY,
+):
+    """(w, v) → Σ H·v over the full sharded batch (TRON's inner product)."""
+    specs = _batch_specs(batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P(), specs), out_specs=P())
+    def _hvp(w, v, b):
+        return lax.psum(agg.hessian_vector(loss, w, v, b, norm), DATA_AXIS)
+
+    return lambda w, v: _hvp(w, v, batch)
+
+
+def make_hessian_diagonal(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: LabeledBatch,
+    norm: NormalizationContext = _IDENTITY,
+):
+    specs = _batch_specs(batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), specs), out_specs=P())
+    def _hd(w, b):
+        return lax.psum(agg.hessian_diagonal(loss, w, b, norm), DATA_AXIS)
+
+    return lambda w: _hd(w, batch)
+
+
+def make_hessian_matrix(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: LabeledBatch,
+    norm: NormalizationContext = _IDENTITY,
+):
+    specs = _batch_specs(batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), specs), out_specs=P())
+    def _hm(w, b):
+        return lax.psum(agg.hessian_matrix(loss, w, b, norm), DATA_AXIS)
+
+    return lambda w: _hm(w, batch)

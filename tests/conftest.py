@@ -3,20 +3,35 @@
 This is the rebuild's analogue of the reference's local-mode Spark fixture
 (photon-test-utils ``SparkTestUtils.sparkTest``): "distributed" behavior is
 exercised without hardware by running real sharding/collective code paths on
-8 virtual CPU devices (SURVEY.md §4). Must run before any jax import.
+8 virtual CPU devices (SURVEY.md §4).
+
+The axon TPU sitecustomize imports jax at interpreter startup, which locks
+XLA_FLAGS before this file runs — so setting the env here is too late. If
+the environment isn't already correct, re-exec pytest once with it fixed.
 """
 
 import os
+import sys
 
-# The axon TPU plugin (sitecustomize) pins JAX_PLATFORMS=axon; tests run on
-# virtual CPU devices so shardings execute with 8 devices deterministically.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _env_ok() -> bool:
+    return (
+        os.environ.get("JAX_PLATFORMS") == "cpu"
+        and "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+        and not os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
+
+
+if not _env_ok() and os.environ.get("_PHOTON_TEST_REEXEC") != "1":
+    os.environ["_PHOTON_TEST_REEXEC"] = "1"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + _WANT_FLAG).strip()
+    os.execv(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:])
 
 import numpy as np
 import pytest

@@ -1,0 +1,140 @@
+"""Evaluator tests vs sklearn and hand-computed values.
+
+Mirrors photon-api ``evaluation/`` unit tests: AUC vs known values (sklearn
+here), grouped AUC == per-group loop, parsing of evaluator specs.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from photon_ml_tpu.evaluation import evaluators as ev
+
+
+def test_auc_matches_sklearn(rng):
+    scores = rng.normal(size=500).astype(np.float32)
+    labels = rng.integers(0, 2, size=500).astype(np.float32)
+    ours = float(ev.auc(jnp.asarray(scores), jnp.asarray(labels)))
+    ref = skm.roc_auc_score(labels, scores)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_auc_with_ties_matches_sklearn(rng):
+    scores = rng.integers(0, 5, size=400).astype(np.float32)  # heavy ties
+    labels = rng.integers(0, 2, size=400).astype(np.float32)
+    ours = float(ev.auc(jnp.asarray(scores), jnp.asarray(labels)))
+    ref = skm.roc_auc_score(labels, scores)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_weighted_auc_matches_sklearn(rng):
+    scores = rng.normal(size=300).astype(np.float32)
+    labels = rng.integers(0, 2, size=300).astype(np.float32)
+    w = rng.uniform(0.2, 3.0, size=300).astype(np.float32)
+    ours = float(ev.auc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(w)))
+    ref = skm.roc_auc_score(labels, scores, sample_weight=w)
+    assert abs(ours - ref) < 1e-4
+
+
+def test_rmse_and_losses(rng):
+    s = rng.normal(size=100).astype(np.float32)
+    y = rng.normal(size=100).astype(np.float32)
+    np.testing.assert_allclose(float(ev.rmse(jnp.asarray(s), jnp.asarray(y))),
+                               np.sqrt(np.mean((s - y) ** 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(ev.squared_loss(jnp.asarray(s), jnp.asarray(y))),
+        0.5 * np.mean((s - y) ** 2), rtol=1e-5)
+    yc = rng.poisson(2.0, size=100).astype(np.float32)
+    np.testing.assert_allclose(
+        float(ev.poisson_loss(jnp.asarray(s), jnp.asarray(yc))),
+        np.mean(np.exp(s) - yc * s), rtol=1e-5)
+
+
+def test_precision_at_k():
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.1, 0.05])
+    labels = jnp.asarray([1.0, 0.0, 1.0, 1.0, 0.0])
+    assert float(ev.precision_at_k(scores, labels, 3)) == pytest.approx(2 / 3)
+
+
+def test_grouped_auc_matches_per_group_loop(rng):
+    n, g = 600, 12
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    groups = rng.integers(0, g, size=n).astype(np.int32)
+    auc_g, valid = ev.grouped_auc(jnp.asarray(scores), jnp.asarray(labels),
+                                  jnp.asarray(groups), g)
+    for gi in range(g):
+        m = groups == gi
+        if len(np.unique(labels[m])) < 2:
+            assert not bool(valid[gi])
+            continue
+        assert bool(valid[gi])
+        ref = skm.roc_auc_score(labels[m], scores[m])
+        assert abs(float(auc_g[gi]) - ref) < 1e-4, gi
+
+
+def test_grouped_auc_with_ties(rng):
+    n, g = 300, 6
+    scores = rng.integers(0, 4, size=n).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    groups = rng.integers(0, g, size=n).astype(np.int32)
+    auc_g, valid = ev.grouped_auc(jnp.asarray(scores), jnp.asarray(labels),
+                                  jnp.asarray(groups), g)
+    for gi in range(g):
+        m = groups == gi
+        if len(np.unique(labels[m])) < 2:
+            continue
+        ref = skm.roc_auc_score(labels[m], scores[m])
+        assert abs(float(auc_g[gi]) - ref) < 1e-4, gi
+
+
+def test_grouped_precision_at_k_matches_loop(rng):
+    n, g, k = 400, 8, 5
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    groups = rng.integers(0, g, size=n).astype(np.int32)
+    prec, valid = ev.grouped_precision_at_k(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(groups), g, k)
+    for gi in range(g):
+        m = groups == gi
+        cnt = int(m.sum())
+        assert bool(valid[gi]) == (cnt >= k)
+        if cnt == 0:
+            continue
+        order = np.argsort(-scores[m])
+        ref = labels[m][order][:k].mean() if cnt >= k else labels[m][order].mean()
+        assert abs(float(prec[gi]) - ref) < 1e-5
+
+
+def test_evaluator_type_parsing():
+    et = ev.EvaluatorType.parse("AUC")
+    assert et.name == "AUC" and et.group_column is None
+    et = ev.EvaluatorType.parse("auc@userId")
+    assert et.name == "AUC" and et.group_column == "userId"
+    et = ev.EvaluatorType.parse("PRECISION@5")
+    assert et.name == "PRECISION" and et.k == 5
+    et = ev.EvaluatorType.parse("PRECISION@10@queryId")
+    assert et.k == 10 and et.group_column == "queryId"
+    assert ev.EvaluatorType.parse("RMSE").direction == ev.MetricDirection.LOWER_IS_BETTER
+    with pytest.raises(ValueError):
+        ev.EvaluatorType.parse("RMSE@userId")
+    with pytest.raises(ValueError):
+        ev.EvaluatorType.parse("NOPE")
+
+
+def test_evaluation_suite_and_selection(rng):
+    scores = rng.normal(size=200).astype(np.float32)
+    labels = rng.integers(0, 2, size=200).astype(np.float32)
+    groups = rng.integers(0, 5, size=200).astype(np.int32)
+    res = ev.evaluation_suite(
+        ["AUC", "RMSE", "AUC@userId"],
+        jnp.asarray(scores), jnp.asarray(labels),
+        group_ids_by_column={"userId": jnp.asarray(groups)},
+        num_groups_by_column={"userId": 5})
+    assert set(res.metrics) == {"AUC", "RMSE", "AUC@userId"}
+    assert res.primary == "AUC"
+    better = ev.EvaluationResults({"AUC": 0.9}, "AUC")
+    worse = ev.EvaluationResults({"AUC": 0.7}, "AUC")
+    assert better.better_than(worse) and not worse.better_than(better)
+    assert worse.better_than(None)
