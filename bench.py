@@ -1,0 +1,131 @@
+"""Benchmark: GLM gradient-step throughput on the current accelerator.
+
+Measures the primary BASELINE.json metric — **GLM gradient-step
+samples/sec/chip** on the fixed-effect data-parallel path (the reference's
+``DistributedGLMLossFunction.treeAggregate`` hot loop, here one fused
+jit-compiled psum objective) — plus the GAME coordinate-descent iteration
+time as a secondary record.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+ratio is against an in-process numpy CPU implementation of the same fused
+value+gradient computation — a stand-in for the reference's single-executor
+per-partition aggregator loop on comparable hardware.
+
+Prints ONE JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _numpy_value_grad(X, y, w):
+    z = X @ w
+    p = 1.0 / (1.0 + np.exp(-z))
+    l = np.logaddexp(0.0, z) - y * z
+    r = p - y
+    return l.sum(), X.T @ r
+
+
+def bench_gradient_step(n=1 << 19, d=256, iters=30, warmup=5):
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledBatch
+    from photon_ml_tpu.ops import aggregators as agg
+    from photon_ml_tpu.ops import losses
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    batch = LabeledBatch.build(X, y)
+    batch = jax.device_put(batch)
+    w = jnp.zeros((d,), jnp.float32)
+
+    step = jax.jit(lambda ww, bb: agg.value_and_gradient(
+        losses.LOGISTIC, ww, bb))
+    v, g = step(w, batch)
+    jax.block_until_ready((v, g))
+    for _ in range(warmup):
+        jax.block_until_ready(step(w, batch))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v, g = step(w, batch)
+    jax.block_until_ready((v, g))
+    dt = (time.perf_counter() - t0) / iters
+    samples_per_sec = n / dt
+
+    # CPU numpy baseline (subsampled for time, scaled):
+    n_cpu = min(n, 1 << 16)
+    Xc, yc = X[:n_cpu], y[:n_cpu]
+    wc = np.zeros(d, np.float32)
+    _numpy_value_grad(Xc, yc, wc)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        _numpy_value_grad(Xc, yc, wc)
+    cpu_dt = (time.perf_counter() - t0) / reps
+    cpu_samples_per_sec = n_cpu / cpu_dt
+    return samples_per_sec, cpu_samples_per_sec
+
+
+def bench_game_iteration():
+    """Secondary: one GAME coordinate-descent sweep (fixed + per-user)."""
+    import jax
+
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.coordinates import (FixedEffectCoordinate,
+                                                RandomEffectCoordinate)
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=100_000, d_global=32,
+        re_specs={"userId": (2000, 8), "itemId": (500, 8)}))
+    mesh = make_mesh()
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    coords = {
+        "fixed": FixedEffectCoordinate(ds, "global", losses.LOGISTIC, cfg,
+                                       mesh),
+        "per-user": RandomEffectCoordinate(ds, "userId", "re_userId",
+                                           losses.LOGISTIC, cfg, mesh),
+        "per-item": RandomEffectCoordinate(ds, "itemId", "re_itemId",
+                                           losses.LOGISTIC, cfg, mesh),
+    }
+    cd = descent.CoordinateDescentConfig(["fixed", "per-user", "per-item"],
+                                         iterations=1)
+    # Warm-up sweep compiles everything; the timed sweep is steady-state.
+    descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
+    t0 = time.perf_counter()
+    descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
+    return time.perf_counter() - t0
+
+
+def main():
+    samples_per_sec, cpu_baseline = bench_gradient_step()
+    game_iter_s = bench_game_iteration()
+    print(json.dumps({
+        "metric": "glm_gradient_step_samples_per_sec_per_chip",
+        "value": round(samples_per_sec),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / cpu_baseline, 3),
+        "secondary": {
+            "game_cd_iteration_seconds": round(game_iter_s, 3),
+            "cpu_numpy_baseline_samples_per_sec": round(cpu_baseline),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Coordinate data/optimization configuration bundles.
+
+Reference parity: photon-api ``data/FixedEffectDataConfiguration.scala``,
+``data/RandomEffectDataConfiguration.scala``,
+``data/CoordinateDataConfiguration.scala`` and the per-coordinate
+optimization bundles of ``optimization/game/*Configuration.scala``; the
+reference encodes these as mini-DSL CLI strings parsed by
+``parseAndBuild`` — here they are dataclasses with a compact string parser
+for CLI use (see photon_ml_tpu/cli/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+from photon_ml_tpu.optim import (OptimizerConfig, OptimizerType,
+                                 RegularizationContext, RegularizationType)
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfiguration:
+    """Reference: FixedEffectDataConfiguration (featureShardId, minPartitions
+    — partitions have no TPU referent)."""
+
+    feature_shard_id: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    """Reference: RandomEffectDataConfiguration (randomEffectType,
+    featureShardId, active-data bounds)."""
+
+    random_effect_type: str
+    feature_shard_id: str
+    active_data_lower_bound: int = 1
+    active_data_upper_bound: Optional[int] = None
+
+
+CoordinateDataConfiguration = Union[FixedEffectDataConfiguration,
+                                    RandomEffectDataConfiguration]
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateConfiguration:
+    """One coordinate: its data slice + optimization settings + an optional
+    regularization-weight grid (the reference's GameEstimator loops over a
+    Seq[GameOptimizationConfiguration] built from per-coordinate grids)."""
+
+    data: CoordinateDataConfiguration
+    optimization: GLMOptimizationConfiguration
+    reg_weight_grid: tuple[float, ...] = ()
+
+    def expand_grid(self) -> list[GLMOptimizationConfiguration]:
+        if not self.reg_weight_grid:
+            return [self.optimization]
+        out = []
+        for w in self.reg_weight_grid:
+            reg = dataclasses.replace(self.optimization.regularization,
+                                      reg_weight=w)
+            out.append(dataclasses.replace(self.optimization,
+                                           regularization=reg))
+        return out
+
+
+def parse_kv(spec: str) -> dict[str, str]:
+    """Parse the ``key=value,...`` mini-DSL used by reference-style config
+    strings (shared by optimizer configs and CLI coordinate specs)."""
+    kv: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, v = part.partition("=")
+        if not sep:
+            raise ValueError(f"bad config token {part!r} in {spec!r}")
+        kv[k.strip()] = v.strip()
+    return kv
+
+
+def parse_optimizer_config(spec: str) -> GLMOptimizationConfiguration:
+    """Parse ``key=value,...`` mini-DSL (reference-style config strings).
+
+    Keys: optimizer (LBFGS|OWLQN|TRON), max_iter, tolerance,
+    reg (NONE|L1|L2|ELASTIC_NET), reg_weight, alpha, down_sampling_rate,
+    variance (NONE|SIMPLE|FULL).
+    """
+    kv = parse_kv(spec)
+
+    opt = OptimizerConfig(
+        optimizer_type=OptimizerType(kv.get("optimizer", "LBFGS").upper()),
+        max_iterations=int(kv.get("max_iter", 100)),
+        tolerance=float(kv.get("tolerance", 1e-7)),
+    )
+    reg = RegularizationContext(
+        reg_type=RegularizationType(kv.get("reg", "NONE").upper()),
+        reg_weight=float(kv.get("reg_weight", 0.0)),
+        elastic_net_alpha=float(kv.get("alpha", 0.5)),
+    )
+    return GLMOptimizationConfiguration(
+        optimizer=opt,
+        regularization=reg,
+        variance_computation=VarianceComputationType(
+            kv.get("variance", "NONE").upper()),
+        down_sampling_rate=float(kv.get("down_sampling_rate", 1.0)),
+    )

@@ -1,0 +1,189 @@
+"""GameEstimator: the training front door.
+
+Reference parity: photon-api ``estimators/GameEstimator.scala`` — builds
+per-coordinate datasets/coordinates from the input data, runs
+``CoordinateDescent`` once per GameOptimizationConfiguration (the
+regularization-weight grid), evaluates each candidate on validation data,
+and exposes best-model selection
+(``fit(data, validationData, configs) → Seq[(GameModel, EvaluationResults,
+config)]``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from typing import Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration)
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.evaluation import evaluators as ev
+from photon_ml_tpu.game import descent
+from photon_ml_tpu.game.coordinates import (FixedEffectCoordinate,
+                                            RandomEffectCoordinate)
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger("photon_ml_tpu.api")
+
+
+@dataclasses.dataclass
+class GameResult:
+    model: GameModel
+    evaluation: Optional[ev.EvaluationResults]
+    configs: dict[str, GLMOptimizationConfiguration]
+
+
+class GameEstimator:
+    """Train GAME models over a device mesh (reference: GameEstimator)."""
+
+    def __init__(
+        self,
+        task: TaskType,
+        coordinates: dict[str, CoordinateConfiguration],
+        update_sequence: list[str],
+        mesh,
+        descent_iterations: int = 1,
+        validation_evaluators: Optional[list[str]] = None,
+        normalization: Optional[dict[str, NormalizationContext]] = None,
+        compute_variances_at_end: bool = True,
+    ):
+        self.task = TaskType(task)
+        self.coordinate_configs = coordinates
+        self.update_sequence = update_sequence
+        self.mesh = mesh
+        self.descent_iterations = descent_iterations
+        self.validation_evaluators = validation_evaluators or []
+        self.normalization = normalization or {}
+        self.compute_variances_at_end = compute_variances_at_end
+        self.loss = losses_mod.loss_for_task(self.task)
+
+    # -- coordinate construction ------------------------------------------
+
+    def _build_coordinates(
+        self,
+        dataset: GameDataset,
+        opt_configs: dict[str, GLMOptimizationConfiguration],
+    ) -> dict[str, object]:
+        coords: dict[str, object] = {}
+        for cid, cc in self.coordinate_configs.items():
+            opt = opt_configs[cid]
+            if isinstance(cc.data, FixedEffectDataConfiguration):
+                coords[cid] = FixedEffectCoordinate(
+                    dataset, cc.data.feature_shard_id, self.loss, opt,
+                    self.mesh,
+                    norm=self.normalization.get(cc.data.feature_shard_id,
+                                                NormalizationContext()))
+            elif isinstance(cc.data, RandomEffectDataConfiguration):
+                coords[cid] = RandomEffectCoordinate(
+                    dataset, cc.data.random_effect_type,
+                    cc.data.feature_shard_id, self.loss, opt, self.mesh,
+                    lower_bound=cc.data.active_data_lower_bound,
+                    upper_bound=cc.data.active_data_upper_bound,
+                    norm=self.normalization.get(cc.data.feature_shard_id,
+                                                NormalizationContext()))
+            else:  # pragma: no cover
+                raise TypeError(type(cc.data))
+        return coords
+
+    # -- evaluation --------------------------------------------------------
+
+    def _evaluate(self, model: GameModel, dataset: GameDataset
+                  ) -> Optional[ev.EvaluationResults]:
+        if not self.validation_evaluators:
+            return None
+        scores = model.score(dataset)
+        gids = {name: jnp.asarray(ids)
+                for name, ids in dataset.entity_ids.items()}
+        ngroups = dict(dataset.num_entities)
+        return ev.evaluation_suite(
+            self.validation_evaluators, scores,
+            jnp.asarray(dataset.response), jnp.asarray(dataset.weights),
+            group_ids_by_column=gids, num_groups_by_column=ngroups)
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        initial_models: Optional[dict] = None,
+        locked_coordinates: Optional[set[str]] = None,
+    ) -> list[GameResult]:
+        """Train one GAME model per point of the regularization grid.
+
+        Returns one GameResult per grid combination (cartesian product of
+        each coordinate's ``reg_weight_grid``), mirroring the reference's
+        Seq[GameOptimizationConfiguration] loop.
+        """
+        cids = list(self.coordinate_configs)
+        grids = [self.coordinate_configs[c].expand_grid() for c in cids]
+        results: list[GameResult] = []
+        for combo in itertools.product(*grids):
+            opt_configs = dict(zip(cids, combo))
+            coords = self._build_coordinates(data, opt_configs)
+            val_fn = None
+            if validation_data is not None and self.validation_evaluators:
+                def val_fn(m, _vd=validation_data):
+                    return self._evaluate(m, _vd).metrics
+            model, history = descent.run(
+                self.task, coords,
+                descent.CoordinateDescentConfig(
+                    self.update_sequence, self.descent_iterations),
+                initial_models=initial_models,
+                locked_coordinates=locked_coordinates,
+                validation_fn=val_fn)
+            model = self._finalize_variances(model, coords, data)
+            evaluation = (self._evaluate(model, validation_data)
+                          if validation_data is not None else None)
+            logger.info("GAME fit done for %s: %s",
+                        {c: o.regularization.reg_weight
+                         for c, o in opt_configs.items()},
+                        evaluation.metrics if evaluation else "")
+            results.append(GameResult(model=model, evaluation=evaluation,
+                                      configs=opt_configs))
+        return results
+
+    def _finalize_variances(self, model: GameModel, coords, data: GameDataset
+                            ) -> GameModel:
+        """Compute per-coordinate coefficient variances at the optimum
+        (reference: variance computation happens once after training)."""
+        if not self.compute_variances_at_end:
+            return model
+        any_requested = any(
+            VarianceComputationType(c.optimization.variance_computation)
+            != VarianceComputationType.NONE
+            for c in self.coordinate_configs.values())
+        if not any_requested:
+            return model
+        scores = {cid: coords[cid].score(m)
+                  for cid, m in model.models.items()}
+        total = jnp.asarray(data.offsets) + sum(scores.values())
+        models = dict(model.models)
+        for cid, m in model.models.items():
+            offsets = total - scores[cid]
+            models[cid] = coords[cid].compute_model_variances(m, offsets)
+        return dataclasses.replace(model, models=models)
+
+    def select_best_model(self, results: list[GameResult]) -> GameResult:
+        """Pick by the primary validation evaluator (reference:
+        GameEstimator/driver best-model selection)."""
+        best = None
+        for r in results:
+            if best is None:
+                best = r
+            elif (r.evaluation is not None
+                  and r.evaluation.better_than(best.evaluation)):
+                best = r
+        if best is None:
+            raise ValueError("no results to select from")
+        return best

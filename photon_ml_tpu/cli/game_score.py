@@ -1,0 +1,70 @@
+"""GAME scoring driver.
+
+Reference parity: photon-client ``cli/game/scoring/GameScoringDriver.scala``
+— load a GameModel, score a dataset, write scoring results (uid, score +
+label/offset/weight passthrough), optionally evaluate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+import numpy as np
+
+from photon_ml_tpu.api.transformer import GameTransformer
+from photon_ml_tpu.data.io import load_game_dataset
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", required=True, help="GameDataset directory")
+    p.add_argument("--model-dir", required=True, help="GameModel directory")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--evaluators", default="",
+                   help="optional comma-separated evaluators")
+    p.add_argument("--as-mean", action="store_true",
+                   help="apply the inverse link (probabilities/rates)")
+    return p
+
+
+def run(args) -> dict:
+    setup_logging()
+    t0 = time.time()
+    data = load_game_dataset(args.data)
+    model = model_io.load_game_model(args.model_dir)
+    evaluators = [e for e in args.evaluators.split(",") if e]
+    transformer = GameTransformer(model, evaluators)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    summary = {"num_rows": data.num_rows}
+    if evaluators:
+        result, evaluation = transformer.transform_and_evaluate(
+            data, as_mean=args.as_mean)
+        summary["metrics"] = evaluation.metrics
+    else:
+        result = transformer.transform(data, as_mean=args.as_mean)
+    np.savez_compressed(
+        os.path.join(args.output_dir, "scores.npz"),
+        uid=result.uids, score=result.scores, label=result.labels,
+        offset=result.offsets, weight=result.weights)
+    summary["wall_seconds"] = time.time() - t0
+    with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    logger.info("wrote %s", args.output_dir)
+    return summary
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""GAME training driver.
+
+Reference parity: photon-client ``cli/game/training/GameTrainingDriver.
+scala`` + ``cli/game/GameDriver.scala`` — parse params, read train/validation
+data, run GameEstimator.fit over the regularization grid, select the best
+model by the primary validation evaluator, write model + summary. Supports
+warm start (``--model-input-dir``) and partial retraining
+(``--locked-coordinates``).
+
+Coordinate specs use the same mini-DSL style as the reference's config
+strings, e.g.:
+
+    --coordinate "name=fixed,type=fixed,shard=global"
+    --coordinate "name=per-user,type=random,shard=re_userId,re=userId,min_samples=2"
+    --opt-config "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0"
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import time
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration,
+                                       parse_kv, parse_optimizer_config)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.data.io import load_game_dataset
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+
+def parse_coordinate(spec: str) -> tuple[str, dict]:
+    kv = parse_kv(spec)
+    if "name" not in kv or "type" not in kv or "shard" not in kv:
+        raise ValueError(f"coordinate spec needs name/type/shard: {spec!r}")
+    return kv.pop("name"), kv
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train", required=True,
+                   help="training GameDataset directory (data/io.py format)")
+    p.add_argument("--validation")
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--coordinate", action="append", required=True,
+                   help="coordinate spec (repeatable)")
+    p.add_argument("--opt-config", action="append", default=[],
+                   help="'<coordinate>:<optimizer mini-DSL>' (repeatable)")
+    p.add_argument("--update-sequence", required=True,
+                   help="comma-separated coordinate order")
+    p.add_argument("--iterations", type=int, default=1)
+    p.add_argument("--evaluators", default="",
+                   help="comma-separated, first is primary (e.g. AUC,AUC@userId)")
+    p.add_argument("--reg-weight-grid", default=[],
+                   help="'<coordinate>:w1,w2,...' (repeatable)",
+                   action="append")
+    p.add_argument("--model-input-dir", help="warm-start GameModel directory")
+    p.add_argument("--locked-coordinates", default="",
+                   help="comma-separated coordinates to keep fixed")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL"])
+    return p
+
+
+def run(args) -> dict:
+    setup_logging()
+    t0 = time.time()
+    task = TaskType(args.task)
+    train = load_game_dataset(args.train)
+    validation = load_game_dataset(args.validation) if args.validation else None
+
+    opt_by_coord: dict[str, GLMOptimizationConfiguration] = {}
+    for spec in args.opt_config:
+        cid, _, dsl = spec.partition(":")
+        opt_by_coord[cid.strip()] = parse_optimizer_config(dsl)
+
+    grid_by_coord: dict[str, tuple[float, ...]] = {}
+    for spec in args.reg_weight_grid:
+        if not spec:
+            continue
+        cid, _, ws = spec.partition(":")
+        grid_by_coord[cid.strip()] = tuple(
+            float(w) for w in ws.split(",") if w)
+
+    coordinates: dict[str, CoordinateConfiguration] = {}
+    for spec in args.coordinate:
+        name, kv = parse_coordinate(spec)
+        if kv["type"] == "fixed":
+            data = FixedEffectDataConfiguration(kv["shard"])
+        elif kv["type"] == "random":
+            data = RandomEffectDataConfiguration(
+                random_effect_type=kv["re"],
+                feature_shard_id=kv["shard"],
+                active_data_lower_bound=int(kv.get("min_samples", 1)),
+                active_data_upper_bound=(int(kv["max_samples"])
+                                         if "max_samples" in kv else None))
+        else:
+            raise ValueError(f"unknown coordinate type {kv['type']!r}")
+        coordinates[name] = CoordinateConfiguration(
+            data=data,
+            optimization=opt_by_coord.get(name, GLMOptimizationConfiguration()),
+            reg_weight_grid=grid_by_coord.get(name, ()))
+
+    evaluators = [e for e in args.evaluators.split(",") if e]
+    est = GameEstimator(
+        task=task,
+        coordinates=coordinates,
+        update_sequence=[c for c in args.update_sequence.split(",") if c],
+        mesh=make_mesh(),
+        descent_iterations=args.iterations,
+        validation_evaluators=evaluators)
+
+    initial_models = None
+    if args.model_input_dir:
+        initial_models = dict(
+            model_io.load_game_model(args.model_input_dir).models)
+    locked = {c for c in args.locked_coordinates.split(",") if c}
+
+    results = est.fit(train, validation, initial_models=initial_models,
+                      locked_coordinates=locked or None)
+    best = est.select_best_model(results)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    if args.output_mode == "ALL":
+        for i, r in enumerate(results):
+            model_io.save_game_model(
+                r.model, os.path.join(args.output_dir, f"model-{i}"))
+    model_io.save_game_model(best.model,
+                             os.path.join(args.output_dir, "best"))
+    summary = {
+        "task": task.value,
+        "candidates": [
+            {"configs": {
+                c: {"reg_type": o.regularization.reg_type.value,
+                    "reg_weight": o.regularization.reg_weight}
+                for c, o in r.configs.items()},
+             "metrics": r.evaluation.metrics if r.evaluation else None}
+            for r in results],
+        "best_metrics": (best.evaluation.metrics if best.evaluation else None),
+        "wall_seconds": time.time() - t0,
+    }
+    with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    logger.info("wrote %s", args.output_dir)
+    return summary
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

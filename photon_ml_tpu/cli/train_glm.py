@@ -1,0 +1,180 @@
+"""Single-GLM training driver (the legacy Photon pipeline).
+
+Reference parity: photon-client ``Driver.scala`` + ``io/GLMSuite.scala`` —
+stages INIT → TRAIN → VALIDATE: read data, summarize/normalize, train one
+model per regularization weight, evaluate each on validation data, select
+and save the best model (``ModelOutputMode`` ALL/BEST).
+
+Usage:
+    python -m photon_ml_tpu.cli.train_glm \
+        --train a1a.libsvm --validation a1a.t.libsvm \
+        --task LOGISTIC_REGRESSION --optimizer LBFGS \
+        --reg-weights 0.1,1,10 --normalization STANDARDIZATION \
+        --output-dir /tmp/model
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledBatch
+from photon_ml_tpu.data.libsvm import read_libsvm
+from photon_ml_tpu.data.statistics import (normalization_from_statistics,
+                                           summarize)
+from photon_ml_tpu.evaluation import evaluators as ev
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.normalization import NormalizationType
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.optim import OptimizerConfig, OptimizerType
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel import problem as dist_problem
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+_DEFAULT_EVALUATOR = {
+    TaskType.LOGISTIC_REGRESSION: "AUC",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "AUC",
+    TaskType.LINEAR_REGRESSION: "RMSE",
+    TaskType.POISSON_REGRESSION: "POISSON_LOSS",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train", required=True, help="training LIBSVM file")
+    p.add_argument("--validation", help="validation LIBSVM file")
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.value for o in OptimizerType])
+    p.add_argument("--reg-type", default="L2",
+                   choices=[r.value for r in RegularizationType])
+    p.add_argument("--reg-weights", default="1.0",
+                   help="comma-separated regularization weight grid")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.value for n in NormalizationType])
+    p.add_argument("--no-intercept", action="store_true")
+    p.add_argument("--variance", default="NONE",
+                   choices=[v.value for v in VarianceComputationType])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL"])
+    p.add_argument("--num-features", type=int,
+                   help="fixed feature-space size (else inferred)")
+    return p
+
+
+def run(args) -> dict:
+    setup_logging()
+    task = TaskType(args.task)
+    loss = losses_mod.loss_for_task(task)
+    t0 = time.time()
+
+    train = read_libsvm(args.train, num_features=args.num_features)
+    X = train.to_dense()
+    intercept_index = None
+    if not args.no_intercept:
+        X = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
+        intercept_index = X.shape[1] - 1
+    batch = LabeledBatch.build(X, train.labels)
+    logger.info("read %d x %d training examples", *X.shape)
+
+    stats = summarize(batch)
+    norm = normalization_from_statistics(
+        stats, NormalizationType(args.normalization), intercept_index)
+
+    mesh = make_mesh()
+    reg_weights = [float(w) for w in args.reg_weights.split(",") if w]
+    evaluator = _DEFAULT_EVALUATOR[task]
+    et = ev.EvaluatorType.parse(evaluator)
+
+    val_batch = None
+    if args.validation:
+        val = read_libsvm(args.validation, num_features=X.shape[1]
+                          - (0 if args.no_intercept else 1))
+        Xv = val.to_dense()
+        if not args.no_intercept:
+            Xv = np.concatenate([Xv, np.ones((Xv.shape[0], 1), np.float32)], 1)
+        val_batch = (Xv, val.labels)
+
+    candidates = []
+    for lam in reg_weights:
+        cfg = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(
+                optimizer_type=OptimizerType(args.optimizer),
+                max_iterations=args.max_iterations,
+                tolerance=args.tolerance),
+            regularization=RegularizationContext(
+                RegularizationType(args.reg_type), lam,
+                args.elastic_net_alpha),
+            variance_computation=VarianceComputationType(args.variance))
+        coef, result = dist_problem.run(
+            loss, batch, mesh, cfg, norm=norm,
+            intercept_index=intercept_index)
+        # Export coefficients in the ORIGINAL feature space (reference:
+        # models are transformed back before writing).
+        raw_means = norm.model_to_original_space(coef.means)
+        model = GeneralizedLinearModel(
+            task=task, coefficients=Coefficients(raw_means, coef.variances))
+        record = {
+            "reg_weight": lam,
+            "converged": bool(result.converged),
+            "iterations": int(result.iterations),
+            "final_loss": float(result.value),
+        }
+        if val_batch is not None:
+            scores = model.compute_score(jnp.asarray(val_batch[0]))
+            record[evaluator] = float(ev.evaluate(
+                et, scores, jnp.asarray(val_batch[1])))
+        logger.info("lambda=%g: %s", lam, record)
+        candidates.append((model, record))
+
+    if val_batch is not None:
+        best_i = max(range(len(candidates)),
+                     key=lambda i: (candidates[i][1][evaluator]
+                                    if et.direction == ev.MetricDirection.HIGHER_IS_BETTER
+                                    else -candidates[i][1][evaluator]))
+    else:
+        best_i = int(np.argmin([c[1]["final_loss"] for c in candidates]))
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    to_save = (range(len(candidates)) if args.output_mode == "ALL"
+               else [best_i])
+    for i in to_save:
+        model_io.save_glm(candidates[i][0],
+                          os.path.join(args.output_dir, f"model-{i}"))
+    summary = {
+        "task": task.value,
+        "models": [c[1] for c in candidates],
+        "best_index": best_i,
+        "wall_seconds": time.time() - t0,
+    }
+    with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    logger.info("wrote %s", args.output_dir)
+    return summary
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""GAME dataset: columnar examples with feature shards and entity keys.
+
+Reference parity: photon-api ``data/GameDatum.scala`` (response, offset,
+weight, featureShards: Map[FeatureShardId, Vector], idTagToValueMap:
+Map[REType, REId]) and ``data/GameConverters.scala`` (DataFrame → RDD of
+GameDatum).
+
+TPU-first design: instead of an RDD of per-example objects, ONE columnar
+struct holds the whole (host or device) dataset: each feature shard is a
+dense (n, d_shard) matrix, each random-effect type is an int32 id column
+indexing an entity table. Examples keep a stable order (UniqueSampleId ==
+row index), which turns the reference's outer-join score arithmetic
+(CoordinateDataScores + / -) into plain elementwise adds on (n,) arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledBatch
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Columnar GAME dataset (host-side numpy; device placement per use)."""
+
+    response: np.ndarray  # (n,)
+    offsets: np.ndarray  # (n,) base offsets from the data (prior scores)
+    weights: np.ndarray  # (n,)
+    feature_shards: dict[str, np.ndarray]  # shard id -> (n, d_shard)
+    entity_ids: dict[str, np.ndarray]  # RE type -> (n,) int32 entity rows
+    num_entities: dict[str, int]  # RE type -> entity-table size
+    # Optional per-RE-type intercept column index within that shard.
+    intercept_index: dict[str, Optional[int]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.response.shape[0])
+
+    def shard_dim(self, shard_id: str) -> int:
+        return int(self.feature_shards[shard_id].shape[1])
+
+    def labeled_batch(self, shard_id: str,
+                      offsets: Optional[np.ndarray] = None) -> LabeledBatch:
+        """A LabeledBatch view over one feature shard with given offsets."""
+        return LabeledBatch.build(
+            self.feature_shards[shard_id], self.response, self.weights,
+            self.offsets if offsets is None else offsets)
+
+    def subset(self, idx: np.ndarray) -> "GameDataset":
+        """Row subset (host-side) — used by down-sampling and tests."""
+        return GameDataset(
+            response=self.response[idx],
+            offsets=self.offsets[idx],
+            weights=self.weights[idx],
+            feature_shards={k: v[idx] for k, v in self.feature_shards.items()},
+            entity_ids={k: v[idx] for k, v in self.entity_ids.items()},
+            num_entities=dict(self.num_entities),
+            intercept_index=dict(self.intercept_index),
+        )
+
+
+def from_synthetic(syn) -> GameDataset:
+    """Adapter from data/synthetic.py SyntheticGameData."""
+    shards = {"global": syn.X_global}
+    ids = {}
+    intercepts = {"global": syn.X_global.shape[1] - 1}
+    for name, Xr in syn.X_entity.items():
+        shards[f"re_{name}"] = Xr
+        ids[name] = syn.entity_ids[name]
+        intercepts[f"re_{name}"] = Xr.shape[1] - 1
+    return GameDataset(
+        response=syn.response,
+        offsets=syn.offsets,
+        weights=syn.weights,
+        feature_shards=shards,
+        entity_ids=ids,
+        num_entities=dict(syn.num_entities),
+        intercept_index=intercepts,
+    )

@@ -1,0 +1,56 @@
+"""GameDataset persistence (npz + JSON metadata).
+
+Reference note: the reference stores training data as Avro records on HDFS
+(photon-client ``data/avro/AvroDataReader.scala``); this module is the
+rebuild's fast native container for the same columnar content, used by the
+CLI drivers. Avro interchange lives in photon_ml_tpu/data/avro.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+
+_META = "dataset.json"
+_ARRAYS = "arrays.npz"
+
+
+def save_game_dataset(ds: GameDataset, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    arrays = {
+        "response": ds.response,
+        "offsets": ds.offsets,
+        "weights": ds.weights,
+    }
+    for k, v in ds.feature_shards.items():
+        arrays[f"shard_{k}"] = v
+    for k, v in ds.entity_ids.items():
+        arrays[f"entity_{k}"] = v
+    np.savez_compressed(os.path.join(path, _ARRAYS), **arrays)
+    meta = {
+        "shards": list(ds.feature_shards),
+        "entities": {k: int(n) for k, n in ds.num_entities.items()},
+        "intercept_index": {k: v for k, v in ds.intercept_index.items()},
+    }
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+
+def load_game_dataset(path: str) -> GameDataset:
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    z = np.load(os.path.join(path, _ARRAYS))
+    return GameDataset(
+        response=z["response"],
+        offsets=z["offsets"],
+        weights=z["weights"],
+        feature_shards={k: z[f"shard_{k}"] for k in meta["shards"]},
+        entity_ids={k: z[f"entity_{k}"] for k in meta["entities"]},
+        num_entities={k: int(v) for k, v in meta["entities"].items()},
+        intercept_index={k: (None if v is None else int(v))
+                         for k, v in meta["intercept_index"].items()},
+    )

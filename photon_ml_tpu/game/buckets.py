@@ -1,0 +1,152 @@
+"""Entity bucketing: the TPU answer to RandomEffectDataset partitioning.
+
+Reference parity: photon-api ``data/RandomEffectDataset.scala`` (build:
+keyBy(REId) → ``RandomEffectDatasetPartitioner`` greedy bin-packing →
+active/passive split with ``numActiveDataPointsLowerBound`` /
+``numActiveDataPointsUpperBound``) and ``data/LocalDataset.scala``.
+
+TPU-first design (SURVEY.md §2.5 P2): instead of an RDD of ragged per-entity
+``LocalDataset``s solved sequentially per executor, entities are grouped
+into a small number of BUCKETS by sample count (power-of-two capacities).
+Each bucket is a dense padded block:
+
+    features (E_b, cap_b, d)   labels/weights/offsets (E_b, cap_b)
+
+so one ``vmap``-ped optimizer solves every entity in the bucket
+simultaneously, and the entity axis shards over the mesh. Padding rows have
+weight 0 (inert by the aggregator contract). The permutation indices into
+the flat example order are kept so per-iteration offsets can be gathered
+(and scores scattered) without re-bucketing.
+
+Active/passive semantics (reference):
+- entities with fewer than ``lower_bound`` examples get NO model (their
+  examples are passive: scored with zero random-effect contribution);
+- entities keep at most ``upper_bound`` examples for training (the rest of
+  their examples are passive but still scored with the trained model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EntityBucket:
+    """One padded bucket of entities with similar sample counts."""
+
+    entity_rows: np.ndarray  # (E_b,) int32: rows into the entity table; -1 pad
+    example_idx: np.ndarray  # (E_b, cap) int64: flat example indices; -1 pad
+    counts: np.ndarray  # (E_b,) int32 true (capped) sample counts
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.entity_rows.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.example_idx.shape[1])
+
+
+@dataclasses.dataclass
+class EntityBucketing:
+    """Bucketed grouping of a dataset's examples by entity."""
+
+    buckets: list[EntityBucket]
+    num_entities: int
+    trained_entities: np.ndarray  # bool (num_entities,): has a model
+    # Entities dropped by the lower bound (passive-only).
+    num_passive_only_entities: int
+    num_passive_examples: int
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, int(np.ceil(np.log2(max(1, x)))))
+
+
+def build_bucketing(
+    entity_ids: np.ndarray,
+    num_entities: int,
+    lower_bound: int = 1,
+    upper_bound: Optional[int] = None,
+    entity_pad_multiple: int = 8,
+    min_capacity: int = 8,
+    rng: Optional[np.random.Generator] = None,
+) -> EntityBucketing:
+    """Group example rows by entity into padded power-of-two buckets.
+
+    ``upper_bound`` caps examples per entity (reference
+    numActiveDataPointsUpperBound: keeps a random subset); ``lower_bound``
+    drops entities with too few examples from training entirely.
+    """
+    entity_ids = np.asarray(entity_ids)
+    n = entity_ids.shape[0]
+    order = np.argsort(entity_ids, kind="stable")
+    sorted_ids = entity_ids[order]
+    uniq, starts, counts = np.unique(sorted_ids, return_index=True,
+                                     return_counts=True)
+
+    trained = np.zeros(num_entities, bool)
+    capped = counts if upper_bound is None else np.minimum(counts, upper_bound)
+    keep = counts >= max(1, lower_bound)
+    num_passive_only = int((~keep).sum())
+    passive_examples = int(counts[~keep].sum())
+    if upper_bound is not None:
+        passive_examples += int((counts - capped)[keep].sum())
+
+    # Bucket key: power-of-two capacity of the capped count.
+    caps = np.maximum(min_capacity, np.array([_next_pow2(c) for c in capped]))
+    buckets: list[EntityBucket] = []
+    for cap in np.unique(caps[keep]):
+        sel = np.where(keep & (caps == cap))[0]
+        e_b = len(sel)
+        pad_e = ((e_b + entity_pad_multiple - 1) // entity_pad_multiple
+                 ) * entity_pad_multiple
+        ex = np.full((pad_e, int(cap)), -1, np.int64)
+        rows = np.full((pad_e,), -1, np.int32)
+        cnts = np.zeros((pad_e,), np.int32)
+        for i, u in enumerate(sel):
+            c = int(capped[u])
+            idx = order[starts[u]: starts[u] + counts[u]]
+            if c < counts[u]:
+                # Cap: random subset (reference uses reservoir-style sampling).
+                pick = (rng.choice(counts[u], size=c, replace=False)
+                        if rng is not None else np.arange(c))
+                idx = idx[pick]
+            ex[i, :c] = idx
+            rows[i] = uniq[u]
+            cnts[i] = c
+            trained[uniq[u]] = True
+        buckets.append(EntityBucket(entity_rows=rows, example_idx=ex,
+                                    counts=cnts))
+
+    return EntityBucketing(
+        buckets=buckets,
+        num_entities=num_entities,
+        trained_entities=trained,
+        num_passive_only_entities=num_passive_only,
+        num_passive_examples=passive_examples,
+    )
+
+
+def gather_bucket_arrays(
+    bucket: EntityBucket,
+    *arrays: np.ndarray,
+) -> tuple[np.ndarray, ...]:
+    """Gather per-example arrays into the bucket's (E_b, cap, ...) layout.
+
+    Padded slots gather row 0 but are masked by the zero weight produced by
+    ``bucket_weights`` — callers must use that weight array.
+    """
+    idx = np.maximum(bucket.example_idx, 0)
+    return tuple(a[idx] for a in arrays)
+
+
+def bucket_weights(bucket: EntityBucket, weights: np.ndarray) -> np.ndarray:
+    """Example weights in bucket layout with padding slots zeroed."""
+    idx = np.maximum(bucket.example_idx, 0)
+    w = weights[idx]
+    w[bucket.example_idx < 0] = 0.0
+    return w

@@ -1,0 +1,304 @@
+"""GAME coordinates: fixed-effect and random-effect training units.
+
+Reference parity: photon-api ``algorithm/Coordinate.scala``,
+``algorithm/FixedEffectCoordinate.scala`` (one distributed GLM fit over the
+whole dataset), ``algorithm/RandomEffectCoordinate.scala`` (per-entity local
+GLM fits inside ``mapValues`` over ``RDD[(REId, LocalDataset)]``).
+
+TPU-first design:
+- FixedEffectCoordinate = the data-parallel psum objective + compiled
+  optimizer (photon_ml_tpu/parallel/problem.py) over the mesh (P1).
+- RandomEffectCoordinate = per-bucket ``vmap``-ped compiled optimizer over
+  padded entity blocks (photon_ml_tpu/game/buckets.py), entity axis sharded
+  over the mesh, per-lane convergence masks freezing finished entities (P2).
+  One compiled solve per bucket shape, cached across coordinate-descent
+  iterations (shapes are static once bucketing is fixed).
+
+Both expose ``train_model(offsets, initial)`` and ``score(model)`` plus
+variance computation, mirroring the reference Coordinate contract
+(trainModel / score / updateOffset — offsets here are passed explicitly
+rather than mutating a dataset).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledBatch
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.game import buckets as bkt
+from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.game.sampling import (binary_classification_down_sample,
+                                         default_down_sample)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim import optimize
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType,
+                                         compute_variances, make_objective,
+                                         resolve_optimizer_config,
+                                         variances_from_diagonal,
+                                         variances_from_matrix)
+from photon_ml_tpu.optim.regularization import intercept_mask
+from photon_ml_tpu.parallel import objective as dobj
+from photon_ml_tpu.parallel import problem as dist_problem
+from photon_ml_tpu.parallel.mesh import data_sharded, shard_batch
+
+Array = jax.Array
+
+
+class FixedEffectCoordinate:
+    """One shared GLM trained data-parallel over the mesh.
+
+    Reference parity: FixedEffectCoordinate + DistributedOptimizationProblem.
+
+    Model-space contract: the optimizer runs in the normalization-transformed
+    space, but the FixedEffectModel handed out ALWAYS holds ORIGINAL-space
+    coefficients (converted at the train boundary, reconverted for warm
+    starts) so every scorer — GameModel.score, the transformer, the CLIs,
+    save/load — is a plain X @ w. The two are algebraically identical:
+    X @ (w∘f) − (w∘f)·s == X @ model_to_original_space(w).
+    """
+
+    def __init__(
+        self,
+        dataset: GameDataset,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        mesh,
+        norm: NormalizationContext = NormalizationContext(),
+        down_sampling_seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.shard_id = shard_id
+        self.loss = loss
+        self.config = config
+        self.mesh = mesh
+        self.norm = norm
+        self.intercept_index = dataset.intercept_index.get(shard_id)
+        self._rng = np.random.default_rng(down_sampling_seed)
+        self._X = jnp.asarray(dataset.feature_shards[shard_id])
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shard_dim(self.shard_id)
+
+    def train_model(
+        self,
+        offsets: Array,
+        initial: Optional[FixedEffectModel] = None,
+    ) -> FixedEffectModel:
+        ds = self.dataset
+        rate = self.config.down_sampling_rate
+        if rate < 1.0:
+            # Reference: DownSampler subsamples the fixed-effect coordinate's
+            # data each training pass, rescaling weights by 1/rate. The
+            # sampler is picked by TASK (reference behavior), not by
+            # inspecting label values.
+            if self.loss.name in ("logistic", "smoothed_hinge"):
+                idx, mult = binary_classification_down_sample(
+                    self._rng, ds.response, rate)
+            else:
+                idx, mult = default_down_sample(self._rng, ds.num_rows, rate)
+            batch = LabeledBatch.build(
+                ds.feature_shards[self.shard_id][idx], ds.response[idx],
+                ds.weights[idx] * mult, np.asarray(offsets)[idx])
+        else:
+            batch = LabeledBatch.build(
+                ds.feature_shards[self.shard_id], ds.response, ds.weights,
+                offsets)
+        init = None
+        if initial is not None:
+            init = Coefficients(self.norm.model_to_transformed_space(
+                initial.coefficients.means))
+        # Variances are computed once after descent (compute_model_variances),
+        # not on every training pass.
+        cfg = dataclasses.replace(
+            self.config, variance_computation=VarianceComputationType.NONE)
+        coef, _ = dist_problem.run(
+            self.loss, batch, self.mesh, cfg, initial=init,
+            norm=self.norm, intercept_index=self.intercept_index)
+        raw = Coefficients(self.norm.model_to_original_space(coef.means))
+        return FixedEffectModel(shard_id=self.shard_id, coefficients=raw)
+
+    def compute_model_variances(
+        self, model: FixedEffectModel, offsets: Array
+    ) -> FixedEffectModel:
+        """Coefficient variances at the optimum (post-descent pass).
+
+        Variances are computed in the transformed space and mapped back by
+        the factor² scaling implied by w_orig = w∘f (the intercept's extra
+        shift term is a location change and does not rescale its variance).
+        """
+        kind = VarianceComputationType(self.config.variance_computation)
+        if kind == VarianceComputationType.NONE:
+            return model
+        batch = shard_batch(LabeledBatch.build(
+            self.dataset.feature_shards[self.shard_id], self.dataset.response,
+            self.dataset.weights, offsets), self.mesh)
+        w_t = self.norm.model_to_transformed_space(model.coefficients.means)
+        mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
+        l2 = self.config.regularization.l2_weight()
+        if kind == VarianceComputationType.SIMPLE:
+            diag = dobj.make_hessian_diagonal(
+                self.loss, self.mesh, batch, self.norm)(w_t)
+            var_t = variances_from_diagonal(diag, l2, mask)
+        else:
+            H = dobj.make_hessian_matrix(
+                self.loss, self.mesh, batch, self.norm)(w_t)
+            var_t = variances_from_matrix(H, l2, mask)
+        if self.norm.factors is not None:
+            var_t = var_t * self.norm.factors * self.norm.factors
+        return dataclasses.replace(
+            model, coefficients=Coefficients(model.coefficients.means, var_t))
+
+    def score(self, model: FixedEffectModel) -> Array:
+        """Raw-space score (identical to the training margins by algebra)."""
+        return self._X @ model.coefficients.means
+
+    def initial_model(self) -> FixedEffectModel:
+        return FixedEffectModel(
+            shard_id=self.shard_id,
+            coefficients=Coefficients.zeros(self.dim))
+
+
+class RandomEffectCoordinate:
+    """Per-entity GLMs trained as vmapped bucket solves.
+
+    Reference parity: RandomEffectCoordinate + SingleNodeOptimizationProblem
+    (per-entity local L-BFGS inside mapValues) — here all entities of a
+    bucket solve simultaneously under vmap with convergence masks.
+
+    Model-space contract: same as FixedEffectCoordinate — solves run in the
+    shard's normalization-transformed space; the RandomEffectModel rows are
+    ORIGINAL-space, so scoring is the plain gather + rowwise dot everywhere.
+    """
+
+    def __init__(
+        self,
+        dataset: GameDataset,
+        re_type: str,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        mesh,
+        lower_bound: int = 1,
+        upper_bound: Optional[int] = None,
+        norm: NormalizationContext = NormalizationContext(),
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.re_type = re_type
+        self.shard_id = shard_id
+        self.loss = loss
+        self.config = config
+        self.mesh = mesh
+        self.norm = norm
+        self.num_entities = dataset.num_entities[re_type]
+        self.intercept_index = dataset.intercept_index.get(shard_id)
+        self.bucketing = bkt.build_bucketing(
+            dataset.entity_ids[re_type], self.num_entities,
+            lower_bound=lower_bound, upper_bound=upper_bound,
+            entity_pad_multiple=max(8, int(np.prod(list(mesh.shape.values())))),
+            rng=np.random.default_rng(seed))
+        self._X = jnp.asarray(dataset.feature_shards[shard_id])
+        self._ids = jnp.asarray(dataset.entity_ids[re_type])
+        # Pre-gather static per-bucket arrays (features/labels/weights).
+        self._bucket_data = []
+        ds = dataset
+        X = ds.feature_shards[shard_id]
+        for b in self.bucketing.buckets:
+            Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
+            wb = bkt.bucket_weights(b, ds.weights)
+            self._bucket_data.append(
+                (jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb)))
+        self._solver = self._make_solver(compute_variance=False)
+        self._var_solver = None  # built lazily if variances requested
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shard_dim(self.shard_id)
+
+    def _make_solver(self, compute_variance: bool):
+        loss = self.loss
+        config = self.config
+        intercept_index = self.intercept_index
+        dim = self.dim
+        norm = self.norm
+
+        def solve_one(X, y, w, o, w0):
+            batch = LabeledBatch(X, y, w, o)
+            vg, hvp, l1w = make_objective(
+                loss, batch, norm, config.regularization, intercept_index, dim)
+            opt_cfg = resolve_optimizer_config(config.optimizer, l1w is not None)
+            result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+            if compute_variance:
+                var = compute_variances(
+                    loss, result.w, batch, norm, config.variance_computation,
+                    config.regularization, intercept_index)
+            else:
+                var = jnp.zeros_like(result.w)
+            return result.w, var
+
+        return jax.jit(jax.vmap(solve_one))
+
+    def train_model(
+        self,
+        offsets: Array,
+        initial: Optional[RandomEffectModel] = None,
+    ) -> RandomEffectModel:
+        # Warm starts arrive in original space; solve in transformed space.
+        if initial is None:
+            W = np.zeros((self.num_entities, self.dim), np.float32)
+        else:
+            W = np.array(
+                self.norm.model_to_transformed_space(initial.means))
+        offsets_np = np.asarray(offsets)
+        for b, (Xb, yb, wb) in zip(self.bucketing.buckets, self._bucket_data):
+            ob = jnp.asarray(offsets_np[np.maximum(b.example_idx, 0)])
+            w0 = jnp.asarray(W[np.maximum(b.entity_rows, 0)])
+            w_fit, _ = self._solver(Xb, yb, wb, ob, w0)
+            w_fit = np.asarray(w_fit)
+            live = b.entity_rows >= 0
+            W[b.entity_rows[live]] = w_fit[live]
+        W_raw = self.norm.model_to_original_space(jnp.asarray(W))
+        return RandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id, means=W_raw)
+
+    def compute_model_variances(
+        self, model: RandomEffectModel, offsets: Array
+    ) -> RandomEffectModel:
+        """Per-entity coefficient variances at the trained optimum."""
+        if VarianceComputationType(self.config.variance_computation) == \
+                VarianceComputationType.NONE:
+            return model
+        if self._var_solver is None:
+            self._var_solver = self._make_solver(compute_variance=True)
+        W = np.array(self.norm.model_to_transformed_space(model.means))
+        V = np.zeros_like(W)
+        offsets_np = np.asarray(offsets)
+        for b, (Xb, yb, wb) in zip(self.bucketing.buckets, self._bucket_data):
+            ob = jnp.asarray(offsets_np[np.maximum(b.example_idx, 0)])
+            w0 = jnp.asarray(W[np.maximum(b.entity_rows, 0)])
+            _, var = self._var_solver(Xb, yb, wb, ob, w0)
+            var = np.asarray(var)
+            live = b.entity_rows >= 0
+            V[b.entity_rows[live]] = var[live]
+        if self.norm.factors is not None:
+            V = V * np.asarray(self.norm.factors) ** 2
+        return dataclasses.replace(model, variances=jnp.asarray(V))
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return jnp.einsum("nd,nd->n", self._X, model.means[self._ids])
+
+    def initial_model(self) -> RandomEffectModel:
+        return RandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            means=jnp.zeros((self.num_entities, self.dim), jnp.float32))

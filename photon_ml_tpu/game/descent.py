@@ -1,0 +1,117 @@
+"""Block coordinate descent: the GAME training loop.
+
+Reference parity: photon-api ``algorithm/CoordinateDescent.scala`` — for
+each iteration, for each coordinate in the update sequence: subtract the
+coordinate's current scores from the residual, train it against the
+remaining offsets, add its new scores back; track per-iteration validation
+metrics; support locked (pretrained, partial-retraining) coordinates.
+
+TPU-first notes: coordinates are trained SEQUENTIALLY by design (the block
+residual dependency — SURVEY.md §2.5 P4: no pipeline parallelism exists in
+this workload); the parallelism is inside each coordinate (data-parallel
+psum for fixed effects, vmapped entity blocks for random effects). Score
+bookkeeping is elementwise adds on stable-order (n,) device arrays instead
+of the reference's outer-join RDD arithmetic (CoordinateDataScores +/-).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.models import CoordinateModel, GameModel
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger("photon_ml_tpu.game")
+
+
+@dataclasses.dataclass
+class CoordinateDescentConfig:
+    """Update sequence + outer iterations (reference: GameTrainingDriver
+    params ``coordinateUpdateSequence`` / ``coordinateDescentIterations``)."""
+
+    update_sequence: list[str]
+    iterations: int = 1
+
+
+@dataclasses.dataclass
+class CoordinateDescentHistory:
+    """Per-(iteration, coordinate) timing and validation records."""
+
+    records: list[dict] = dataclasses.field(default_factory=list)
+
+
+def run(
+    task: TaskType,
+    coordinates: dict[str, object],
+    config: CoordinateDescentConfig,
+    *,
+    initial_models: Optional[dict[str, CoordinateModel]] = None,
+    locked_coordinates: Optional[set[str]] = None,
+    validation_fn: Optional[Callable[[GameModel], dict]] = None,
+) -> tuple[GameModel, CoordinateDescentHistory]:
+    """Run block coordinate descent (reference: CoordinateDescent.run).
+
+    ``coordinates`` maps coordinate id → Fixed/RandomEffectCoordinate (all
+    sharing one GameDataset's example order). ``locked_coordinates`` are
+    scored but never retrained (reference partial retraining).
+    ``validation_fn`` is called after each coordinate update with the
+    current GameModel (reference: per-iteration EvaluationSuite logging).
+    """
+    seq = list(config.update_sequence)
+    unknown = [c for c in seq if c not in coordinates]
+    if unknown:
+        raise ValueError(f"update sequence references unknown coordinates "
+                         f"{unknown}")
+    locked = set(locked_coordinates or ())
+    for c in locked:
+        if initial_models is None or c not in initial_models:
+            raise ValueError(f"locked coordinate {c!r} needs an initial model")
+
+    models: dict[str, CoordinateModel] = {}
+    scores: dict[str, jnp.ndarray] = {}
+    some = coordinates[seq[0]]
+    n = some.dataset.num_rows
+    base = jnp.asarray(some.dataset.offsets)
+    total = jnp.zeros((n,), jnp.float32)
+
+    # Initialize models (warm starts) and their scores.
+    for cid in seq:
+        coord = coordinates[cid]
+        if initial_models and cid in initial_models:
+            models[cid] = initial_models[cid]
+        else:
+            models[cid] = coord.initial_model()
+        s = coord.score(models[cid])
+        scores[cid] = s
+        total = total + s
+
+    history = CoordinateDescentHistory()
+    for it in range(config.iterations):
+        for cid in seq:
+            if cid in locked:
+                continue
+            coord = coordinates[cid]
+            t0 = time.monotonic()
+            # Residual offsets: everything except this coordinate.
+            offsets = base + total - scores[cid]
+            model = coord.train_model(offsets, initial=models[cid])
+            new_scores = coord.score(model)
+            total = total + new_scores - scores[cid]
+            scores[cid] = new_scores
+            models[cid] = model
+            elapsed = time.monotonic() - t0
+            rec = {"iteration": it, "coordinate": cid,
+                   "train_seconds": elapsed}
+            if validation_fn is not None:
+                rec["validation"] = validation_fn(
+                    GameModel(task=task, models=dict(models)))
+            logger.info("CD iter %d coordinate %s: %.2fs %s", it, cid,
+                        elapsed, rec.get("validation", ""))
+            history.records.append(rec)
+
+    return GameModel(task=task, models=models), history

@@ -1,0 +1,94 @@
+"""GAME model classes: fixed-effect and random-effect submodels.
+
+Reference parity: photon-api ``model/GameModel.scala``
+(``Map[CoordinateId, DatumScoringModel]``), ``model/FixedEffectModel.scala``
+(a broadcast GLM), ``model/RandomEffectModel.scala``
+(``RDD[(REId, GeneralizedLinearModel)]``), ``model/DatumScoringModel.scala``.
+
+TPU-first design: a RandomEffectModel is ONE dense (num_entities, d) matrix
+(plus optional variances) instead of an RDD of per-entity models — scoring
+is a row gather + rowwise dot (one fused kernel), and "broadcast" of the
+fixed-effect model is just replicated sharding. Entities without a trained
+model keep zero rows, matching the reference's passive-data scoring (no
+random-effect contribution).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """One shared GLM over a feature shard (reference: FixedEffectModel)."""
+
+    shard_id: str
+    coefficients: Coefficients
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def score(self, dataset: GameDataset) -> Array:
+        X = jnp.asarray(dataset.feature_shards[self.shard_id])
+        return X @ self.coefficients.means
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient table (reference: RandomEffectModel).
+
+    ``means`` is (num_entities, d); untrained entities hold zero rows.
+    """
+
+    re_type: str
+    shard_id: str
+    means: Array  # (num_entities, d)
+    variances: Optional[Array] = None  # (num_entities, d)
+
+    @property
+    def num_entities(self) -> int:
+        return self.means.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[1]
+
+    def score(self, dataset: GameDataset) -> Array:
+        X = jnp.asarray(dataset.feature_shards[self.shard_id])
+        ids = jnp.asarray(dataset.entity_ids[self.re_type])
+        # Row-gather then fused rowwise dot: score_i = x_i · W[e_i].
+        return jnp.einsum("nd,nd->n", X, self.means[ids])
+
+
+CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Additive combination of coordinate models (reference: GameModel)."""
+
+    task: TaskType
+    models: dict[str, CoordinateModel]  # CoordinateId -> model
+
+    def score(self, dataset: GameDataset,
+              include_offsets: bool = True) -> Array:
+        total = jnp.asarray(dataset.offsets) if include_offsets else jnp.zeros(
+            dataset.num_rows, jnp.float32)
+        for model in self.models.values():
+            total = total + model.score(dataset)
+        return total
+
+    def coordinate_scores(self, dataset: GameDataset) -> dict[str, Array]:
+        return {cid: m.score(dataset) for cid, m in self.models.items()}

@@ -1,0 +1,112 @@
+"""Model persistence: GAME + GLM models on disk.
+
+Reference parity: photon-client ``data/avro/ModelProcessingUtils.scala`` —
+GameModel ↔ HDFS layout ``fixed-effect/<coord>/coefficients.avro`` +
+``random-effect/<coord>/...`` (BayesianLinearModelAvro: per-feature
+name/term → mean/variance) plus id-info/metadata. This module writes the
+same directory SHAPE with npz coefficient payloads + JSON metadata; the
+Avro-record path (feature-name-keyed BayesianLinearModelAvro) lives in
+photon_ml_tpu/data/avro.py and is used when an index map is supplied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+_METADATA = "metadata.json"
+
+
+def save_game_model(model: GameModel, path: str) -> None:
+    """Write a GameModel directory (reference: saveGameModelToHDFS layout)."""
+    os.makedirs(path, exist_ok=True)
+    meta = {"task": TaskType(model.task).value, "coordinates": {}}
+    for cid, m in model.models.items():
+        if isinstance(m, FixedEffectModel):
+            sub = os.path.join(path, "fixed-effect", cid)
+            os.makedirs(sub, exist_ok=True)
+            payload = {"means": np.asarray(m.coefficients.means)}
+            if m.coefficients.variances is not None:
+                payload["variances"] = np.asarray(m.coefficients.variances)
+            np.savez(os.path.join(sub, "coefficients.npz"), **payload)
+            meta["coordinates"][cid] = {
+                "type": "fixed", "shard_id": m.shard_id,
+                "dim": int(m.coefficients.dim)}
+        elif isinstance(m, RandomEffectModel):
+            sub = os.path.join(path, "random-effect", cid)
+            os.makedirs(sub, exist_ok=True)
+            payload = {"means": np.asarray(m.means)}
+            if m.variances is not None:
+                payload["variances"] = np.asarray(m.variances)
+            np.savez(os.path.join(sub, "coefficients.npz"), **payload)
+            meta["coordinates"][cid] = {
+                "type": "random", "shard_id": m.shard_id,
+                "re_type": m.re_type, "num_entities": int(m.num_entities),
+                "dim": int(m.dim)}
+        else:  # pragma: no cover
+            raise TypeError(type(m))
+    with open(os.path.join(path, _METADATA), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+
+def load_game_model(path: str) -> GameModel:
+    """Inverse of save_game_model (reference: loadGameModelFromHDFS)."""
+    with open(os.path.join(path, _METADATA)) as f:
+        meta = json.load(f)
+    models = {}
+    for cid, info in meta["coordinates"].items():
+        if info["type"] == "fixed":
+            z = np.load(os.path.join(path, "fixed-effect", cid,
+                                     "coefficients.npz"))
+            coef = Coefficients(
+                means=jnp.asarray(z["means"]),
+                variances=(jnp.asarray(z["variances"])
+                           if "variances" in z else None))
+            models[cid] = FixedEffectModel(shard_id=info["shard_id"],
+                                           coefficients=coef)
+        else:
+            z = np.load(os.path.join(path, "random-effect", cid,
+                                     "coefficients.npz"))
+            models[cid] = RandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                means=jnp.asarray(z["means"]),
+                variances=(jnp.asarray(z["variances"])
+                           if "variances" in z else None))
+    return GameModel(task=TaskType(meta["task"]), models=models)
+
+
+def save_glm(model: GeneralizedLinearModel, path: str) -> None:
+    """Write a single GLM (reference: legacy GLMSuite text/Avro output)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"means": np.asarray(model.coefficients.means)}
+    if model.coefficients.variances is not None:
+        payload["variances"] = np.asarray(model.coefficients.variances)
+    np.savez(path if path.endswith(".npz") else path + ".npz", **payload)
+    meta_path = (path[:-4] if path.endswith(".npz") else path) + ".json"
+    with open(meta_path, "w") as f:
+        json.dump({"task": TaskType(model.task).value,
+                   "dim": int(model.coefficients.dim)}, f)
+
+
+def load_glm(path: str) -> GeneralizedLinearModel:
+    base = path[:-4] if path.endswith(".npz") else path
+    z = np.load(base + ".npz")
+    with open(base + ".json") as f:
+        meta = json.load(f)
+    return GeneralizedLinearModel(
+        task=TaskType(meta["task"]),
+        coefficients=Coefficients(
+            means=jnp.asarray(z["means"]),
+            variances=(jnp.asarray(z["variances"])
+                       if "variances" in z else None)))

@@ -1,0 +1,69 @@
+"""Logging + timing utilities.
+
+Reference parity: photon-lib ``util/PhotonLogger.scala`` (log4j logger whose
+output is also persisted next to the job output) and ``util/Timer.scala``
+(wall-clock scopes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+
+def setup_logging(
+    level: int = logging.INFO,
+    log_file: Optional[str] = None,
+) -> logging.Logger:
+    """Configure the framework logger; optionally tee to a file beside the
+    job output (PhotonLogger behavior)."""
+    logger = logging.getLogger("photon_ml_tpu")
+    logger.setLevel(level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(h)
+    if log_file:
+        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(fh)
+    return logger
+
+
+class Timer:
+    """Wall-clock scope timer (reference: util/Timer.scala)."""
+
+    def __init__(self):
+        self.durations: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.durations[name] = self.durations.get(name, 0.0) + (
+                time.monotonic() - t0)
+
+
+class MetricsWriter:
+    """Structured per-step metrics to a JSONL file (the rebuild's
+    OptimizationStatesTracker/EvaluationResults observability sink)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def write(self, record: dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()

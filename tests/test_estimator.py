@@ -1,0 +1,144 @@
+"""GameEstimator / GameTransformer / model IO tests.
+
+Mirrors ``GameEstimatorIntegTest`` + model save/load round trips (SURVEY.md
+§4): grid over reg weights, best-model selection on validation AUC,
+score-after-load equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration,
+                                       parse_optimizer_config)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.api.transformer import GameTransformer
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.optim import OptimizerConfig, OptimizerType
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _datasets(rng, n=2400):
+    syn = synthetic.game_data(rng, n=n, d_global=8,
+                              re_specs={"userId": (30, 4)})
+    ds = from_synthetic(syn)
+    split = int(0.8 * n)
+    idx = rng.permutation(n)
+    return ds.subset(idx[:split]), ds.subset(idx[split:])
+
+
+def _coordinates(grid=()):
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    return {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=opt, reg_weight_grid=grid),
+        "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration("userId", "re_userId"),
+            optimization=opt),
+    }
+
+
+def test_fit_evaluate_select_and_roundtrip(rng, mesh, tmp_path):
+    train, val = _datasets(rng)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates=_coordinates(grid=(0.1, 10.0)),
+        update_sequence=["fixed", "per-user"],
+        mesh=mesh,
+        descent_iterations=2,
+        validation_evaluators=["AUC", "AUC@userId"],
+    )
+    results = est.fit(train, val)
+    assert len(results) == 2  # the reg-weight grid
+    for r in results:
+        assert r.evaluation is not None
+        assert 0.5 < r.evaluation.metrics["AUC"] <= 1.0
+    best = est.select_best_model(results)
+    assert best.evaluation.primary_value == max(
+        r.evaluation.metrics["AUC"] for r in results)
+
+    # Transformer scores = model scores; save/load round trip is exact.
+    path = str(tmp_path / "model")
+    model_io.save_game_model(best.model, path)
+    loaded = model_io.load_game_model(path)
+    t1 = GameTransformer(best.model).transform(val)
+    t2 = GameTransformer(loaded).transform(val)
+    np.testing.assert_array_equal(t1.scores, t2.scores)
+
+    _, evaluation = GameTransformer(loaded, ["AUC"]).transform_and_evaluate(val)
+    np.testing.assert_allclose(evaluation.metrics["AUC"],
+                               best.evaluation.metrics["AUC"], atol=1e-6)
+
+
+def test_variances_computed_at_end(rng, mesh):
+    train, val = _datasets(rng, n=1200)
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0),
+        variance_computation=VarianceComputationType.SIMPLE)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={
+            "fixed": CoordinateConfiguration(
+                data=FixedEffectDataConfiguration("global"), optimization=opt),
+            "per-user": CoordinateConfiguration(
+                data=RandomEffectDataConfiguration("userId", "re_userId"),
+                optimization=opt),
+        },
+        update_sequence=["fixed", "per-user"],
+        mesh=mesh)
+    results = est.fit(train)
+    re_model = results[0].model.models["per-user"]
+    assert re_model.variances is not None
+    v = np.asarray(re_model.variances)
+    # Trained entities got positive variances; untrained rows stay zero.
+    trained_ids = np.unique(train.entity_ids["userId"])
+    assert np.all(v[trained_ids] > 0)
+
+
+def test_warm_start_through_estimator(rng, mesh):
+    train, val = _datasets(rng, n=1000)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates=_coordinates(),
+        update_sequence=["fixed", "per-user"],
+        mesh=mesh,
+        validation_evaluators=["AUC"])
+    first = est.fit(train, val)[0]
+    second = est.fit(train, val,
+                     initial_models=dict(first.model.models),
+                     locked_coordinates={"fixed"})[0]
+    np.testing.assert_array_equal(
+        np.asarray(second.model.models["fixed"].coefficients.means),
+        np.asarray(first.model.models["fixed"].coefficients.means))
+
+
+def test_parse_optimizer_config():
+    cfg = parse_optimizer_config(
+        "optimizer=TRON,max_iter=17,tolerance=1e-5,reg=L2,reg_weight=3.5,"
+        "variance=SIMPLE,down_sampling_rate=0.25")
+    assert cfg.optimizer.optimizer_type == OptimizerType.TRON
+    assert cfg.optimizer.max_iterations == 17
+    assert cfg.optimizer.tolerance == pytest.approx(1e-5)
+    assert cfg.regularization.reg_type == RegularizationType.L2
+    assert cfg.regularization.reg_weight == pytest.approx(3.5)
+    assert cfg.variance_computation == VarianceComputationType.SIMPLE
+    assert cfg.down_sampling_rate == pytest.approx(0.25)
+    with pytest.raises(ValueError):
+        parse_optimizer_config("optimizer")
