@@ -128,9 +128,18 @@ class GameEstimator:
         cids = list(self.coordinate_configs)
         grids = [self.coordinate_configs[c].expand_grid() for c in cids]
         results: list[GameResult] = []
+        base_coords: Optional[dict[str, object]] = None
         for combo in itertools.product(*grids):
             opt_configs = dict(zip(cids, combo))
-            coords = self._build_coordinates(data, opt_configs)
+            if base_coords is None:
+                # Coordinates (bucketing, device staging) are built ONCE;
+                # later grid points swap only the optimization config
+                # (reference: datasets built once, configs looped).
+                base_coords = self._build_coordinates(data, opt_configs)
+                coords = base_coords
+            else:
+                coords = {cid: base_coords[cid].with_optimization_config(
+                    opt_configs[cid]) for cid in cids}
             val_fn = None
             if validation_data is not None and self.validation_evaluators:
                 def val_fn(m, _vd=validation_data):

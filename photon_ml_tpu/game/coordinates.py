@@ -90,6 +90,19 @@ class FixedEffectCoordinate:
     def dim(self) -> int:
         return self.dataset.shard_dim(self.shard_id)
 
+    def with_optimization_config(
+        self, config: GLMOptimizationConfiguration
+    ) -> "FixedEffectCoordinate":
+        """Cheap copy with a new optimization config (same data/device
+        arrays) — the estimator's reg-weight grid loop swaps configs without
+        re-staging data (reference: datasets built once per coordinate,
+        reused across the GameOptimizationConfiguration grid)."""
+        import copy
+
+        c = copy.copy(self)
+        c.config = config
+        return c
+
     def train_model(
         self,
         offsets: Array,
@@ -225,6 +238,20 @@ class RandomEffectCoordinate:
     @property
     def dim(self) -> int:
         return self.dataset.shard_dim(self.shard_id)
+
+    def with_optimization_config(
+        self, config: GLMOptimizationConfiguration
+    ) -> "RandomEffectCoordinate":
+        """Cheap copy with a new optimization config, reusing the bucketing
+        and the staged per-bucket device arrays (the expensive part of
+        __init__). Only the jitted solver is rebuilt."""
+        import copy
+
+        c = copy.copy(self)
+        c.config = config
+        c._solver = c._make_solver(compute_variance=False)
+        c._var_solver = None
+        return c
 
     def _make_solver(self, compute_variance: bool):
         loss = self.loss

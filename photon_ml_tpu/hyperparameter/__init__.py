@@ -1,0 +1,20 @@
+"""Hyperparameter tuning: random search and Bayesian (GP + EI) search.
+
+Reference parity: photon-lib ``hyperparameter/`` — ``search/RandomSearch``,
+``search/GaussianProcessSearch``, ``estimators/GaussianProcessEstimator``
+with Matern52/RBF kernels, ``criteria/ExpectedImprovement``, and
+``EvaluationFunction`` — the inner loop of GameTrainingDriver's
+``hyperParameterTuning`` mode.
+"""
+
+from photon_ml_tpu.hyperparameter.criteria import (  # noqa: F401
+    expected_improvement, lower_confidence_bound)
+from photon_ml_tpu.hyperparameter.evaluation import (  # noqa: F401
+    GameEvaluationFunction)
+from photon_ml_tpu.hyperparameter.gp import (  # noqa: F401
+    GaussianProcessModel, fit_gp, fit_gp_with_kernel_search)
+from photon_ml_tpu.hyperparameter.kernels import (  # noqa: F401
+    RBF, Matern52, StationaryKernel, get_kernel)
+from photon_ml_tpu.hyperparameter.search import (  # noqa: F401
+    GaussianProcessSearch, Observation, RandomSearch, SearchDimension,
+    SearchResult)

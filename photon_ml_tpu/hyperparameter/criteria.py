@@ -1,0 +1,25 @@
+"""Acquisition criteria for Bayesian hyperparameter search.
+
+Reference parity: photon-lib ``hyperparameter/criteria/
+ExpectedImprovement.scala`` (+ ConfidenceBound). Convention: the searcher
+MINIMIZES — evaluation functions negate reward metrics such as AUC.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import stats
+
+
+def expected_improvement(mean: np.ndarray, std: np.ndarray,
+                         best: float) -> np.ndarray:
+    """EI for minimization: E[max(best - f, 0)] under N(mean, std²)."""
+    std = np.maximum(std, 1e-12)
+    z = (best - mean) / std
+    return (best - mean) * stats.norm.cdf(z) + std * stats.norm.pdf(z)
+
+
+def lower_confidence_bound(mean: np.ndarray, std: np.ndarray,
+                           kappa: float = 2.0) -> np.ndarray:
+    """LCB acquisition (higher is better for minimization): -(μ - κσ)."""
+    return -(mean - kappa * std)

@@ -1,0 +1,93 @@
+"""Evaluation functions bridging the searchers to GAME training.
+
+Reference parity: photon-lib ``hyperparameter/EvaluationFunction.scala`` and
+the GameEstimator glue in GameTrainingDriver's hyperparameter-tuning mode:
+a config vector (one regularization weight per tunable coordinate, searched
+in log space) → train a GAME model → validation metric. Reward metrics
+(AUC, precision@k) are negated so every searcher minimizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType, MetricDirection
+from photon_ml_tpu.hyperparameter.search import (Observation,
+                                                 SearchDimension)
+from photon_ml_tpu.utils.ranges import DoubleRange
+
+logger = logging.getLogger("photon_ml_tpu.hyperparameter")
+
+
+@dataclasses.dataclass
+class GameEvaluationFunction:
+    """Vector of per-coordinate reg weights → validation objective.
+
+    ``estimator`` is a ``GameEstimator``; ``coordinate_ids`` names the
+    coordinates whose regularization weight is being tuned (the searched
+    vector is ordered the same way). The estimator's grids are bypassed:
+    each trial fits exactly one configuration.
+    """
+
+    estimator: "GameEstimator"  # noqa: F821 - avoid circular import
+    data: object                # GameDataset
+    validation_data: object     # GameDataset
+    coordinate_ids: Sequence[str]
+    reg_weight_range: DoubleRange = DoubleRange(1e-4, 1e4)
+
+    def dimensions(self) -> list[SearchDimension]:
+        return [SearchDimension(cid, self.reg_weight_range, log_scale=True)
+                for cid in self.coordinate_ids]
+
+    def _sign(self) -> float:
+        primary = EvaluatorType.parse(
+            self.estimator.validation_evaluators[0])
+        return (-1.0 if primary.direction == MetricDirection.HIGHER_IS_BETTER
+                else 1.0)
+
+    def __call__(self, point: np.ndarray) -> float:
+        est = self._with_weights(point)
+        results = est.fit(self.data, self.validation_data)
+        assert len(results) == 1, "tuning trials must fit one config"
+        evaluation = results[0].evaluation
+        assert evaluation is not None, "tuning requires validation evaluators"
+        return self._sign() * float(evaluation.primary_value)
+
+    def _with_weights(self, point: np.ndarray):
+        import copy
+
+        est = copy.copy(self.estimator)
+        weights = dict(zip(self.coordinate_ids, point))
+        coords = {}
+        for cid, cc in est.coordinate_configs.items():
+            opt = cc.optimization
+            if cid in weights:
+                reg = dataclasses.replace(opt.regularization,
+                                          reg_weight=float(weights[cid]))
+                opt = dataclasses.replace(opt, regularization=reg)
+            # Grids cleared on EVERY coordinate: each trial fits one config.
+            coords[cid] = dataclasses.replace(cc, optimization=opt,
+                                              reg_weight_grid=())
+        est.coordinate_configs = coords
+        return est
+
+    def observations_from_results(
+        self, results, points: Optional[Sequence[dict]] = None
+    ) -> list[Observation]:
+        """Convert prior GameResults (e.g. the initial grid sweep) into
+        seed observations (reference: findWithPriors' prior data)."""
+        sign = self._sign()
+        obs = []
+        for r in results:
+            if r.evaluation is None:
+                continue
+            point = np.array([
+                r.configs[cid].regularization.reg_weight
+                for cid in self.coordinate_ids])
+            obs.append(Observation(point, sign * float(
+                r.evaluation.primary_value)))
+        return obs

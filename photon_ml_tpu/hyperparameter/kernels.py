@@ -1,0 +1,83 @@
+"""Stationary covariance kernels for the Gaussian-process tuner.
+
+Reference parity: photon-lib ``hyperparameter/estimators/kernels/`` —
+``RBF.scala``, ``Matern52.scala``, ``StationaryKernel.scala``. Host-side
+numpy: kernel algebra runs on a handful of observed configs (tens of
+points), never on device.
+
+Both kernels support per-dimension lengthscales (ARD) and an amplitude;
+inputs are expected pre-normalized to [0, 1]^d by the search driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_SQRT5 = np.sqrt(5.0)
+
+
+def _scaled_sqdist(x1: np.ndarray, x2: np.ndarray,
+                   lengthscale: np.ndarray) -> np.ndarray:
+    """Pairwise squared distance after per-dimension lengthscale division."""
+    a = x1 / lengthscale
+    b = x2 / lengthscale
+    d2 = (np.sum(a * a, axis=1)[:, None] + np.sum(b * b, axis=1)[None, :]
+          - 2.0 * a @ b.T)
+    return np.maximum(d2, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class StationaryKernel:
+    """amplitude² · k(r/lengthscale) with optional observation noise."""
+
+    amplitude: float = 1.0
+    lengthscale: np.ndarray | float = 1.0
+    noise: float = 1e-4
+
+    def _ls(self, dim: int) -> np.ndarray:
+        ls = np.asarray(self.lengthscale, dtype=np.float64)
+        if ls.ndim == 0:
+            ls = np.full(dim, float(ls))
+        return ls
+
+    def __call__(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def with_params(self, amplitude: float, lengthscale,
+                    noise: float) -> "StationaryKernel":
+        return dataclasses.replace(self, amplitude=amplitude,
+                                   lengthscale=lengthscale, noise=noise)
+
+
+@dataclasses.dataclass(frozen=True)
+class RBF(StationaryKernel):
+    """Squared-exponential kernel (reference: kernels/RBF.scala)."""
+
+    def __call__(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        d2 = _scaled_sqdist(x1, x2, self._ls(x1.shape[1]))
+        return self.amplitude ** 2 * np.exp(-0.5 * d2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Matern52(StationaryKernel):
+    """Matérn ν=5/2 kernel (reference: kernels/Matern52.scala) — the
+    reference's default for hyperparameter response surfaces (twice
+    differentiable but heavier-tailed than RBF)."""
+
+    def __call__(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        d = np.sqrt(_scaled_sqdist(x1, x2, self._ls(x1.shape[1])))
+        s = _SQRT5 * d
+        return self.amplitude ** 2 * (1.0 + s + s * s / 3.0) * np.exp(-s)
+
+
+KERNELS = {"rbf": RBF, "matern52": Matern52}
+
+
+def get_kernel(name: str, **kw) -> StationaryKernel:
+    try:
+        return KERNELS[name.lower()](**kw)
+    except KeyError:
+        raise ValueError(
+            f"unknown kernel {name!r}; have {sorted(KERNELS)}") from None

@@ -1,0 +1,48 @@
+"""Numeric ranges for hyperparameter search.
+
+Reference parity: photon-lib ``util/DoubleRange.scala`` — an inclusive
+[start, end] interval with linear/log transforms used to describe
+hyperparameter search spaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleRange:
+    """Inclusive [start, end] interval (reference: DoubleRange)."""
+
+    start: float
+    end: float
+
+    def __post_init__(self):
+        if self.start > self.end:
+            raise ValueError(
+                f"invalid range: start {self.start} > end {self.end}")
+
+    def transform(self, fn) -> "DoubleRange":
+        return DoubleRange(fn(self.start), fn(self.end))
+
+    @property
+    def length(self) -> float:
+        return self.end - self.start
+
+    def contains(self, x: float) -> bool:
+        return self.start <= x <= self.end
+
+    def clip(self, x):
+        return np.clip(x, self.start, self.end)
+
+    def denormalize(self, u):
+        """Map u in [0,1] onto this range linearly."""
+        return self.start + u * self.length
+
+    def normalize(self, x):
+        """Inverse of :meth:`denormalize` (constant ranges map to 0)."""
+        if self.length == 0:
+            return np.zeros_like(np.asarray(x, dtype=np.float64))
+        return (x - self.start) / self.length

@@ -1,0 +1,174 @@
+"""Hyperparameter search tests.
+
+Mirrors the reference's photon-lib hyperparameter unit tests (SURVEY.md §2.1
+``hyperparameter/``): kernel algebra, GP posterior sanity, EI behavior,
+random vs Bayesian search on closed-form objectives, and the GAME
+evaluation-function integration (tuning mode of the training driver).
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.hyperparameter import (RBF, GameEvaluationFunction,
+                                          GaussianProcessSearch, Matern52,
+                                          Observation, RandomSearch,
+                                          SearchDimension,
+                                          expected_improvement, fit_gp,
+                                          fit_gp_with_kernel_search,
+                                          get_kernel)
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.ranges import DoubleRange
+
+
+# ------------------------------------------------------------------ ranges
+
+def test_double_range():
+    r = DoubleRange(1e-3, 1e3)
+    assert r.contains(1.0) and not r.contains(1e4)
+    assert r.transform(np.log10).start == pytest.approx(-3)
+    np.testing.assert_allclose(r.denormalize(r.normalize(250.0)), 250.0)
+    with pytest.raises(ValueError):
+        DoubleRange(2.0, 1.0)
+
+
+# ----------------------------------------------------------------- kernels
+
+@pytest.mark.parametrize("name", ["rbf", "matern52"])
+def test_kernel_properties(name, rng):
+    k = get_kernel(name, amplitude=1.7, lengthscale=0.4)
+    x = rng.uniform(size=(20, 3))
+    K = k(x, x)
+    np.testing.assert_allclose(K, K.T, atol=1e-12)
+    np.testing.assert_allclose(np.diag(K), 1.7 ** 2, atol=1e-10)
+    # PSD up to jitter:
+    w = np.linalg.eigvalsh(K)
+    assert w.min() > -1e-8
+    # Decays with distance:
+    far = k(np.zeros((1, 3)), np.full((1, 3), 10.0))
+    assert far[0, 0] < 1e-4
+
+
+def test_matern_heavier_tail_than_rbf():
+    x0 = np.zeros((1, 1))
+    x1 = np.full((1, 1), 2.0)
+    assert Matern52()(x0, x1)[0, 0] > RBF()(x0, x1)[0, 0]
+
+
+# ---------------------------------------------------------------------- GP
+
+def test_gp_interpolates_and_quantifies_uncertainty(rng):
+    x = rng.uniform(size=(12, 1))
+    y = np.sin(6 * x[:, 0])
+    model = fit_gp(Matern52(amplitude=1.0, lengthscale=0.3, noise=1e-6),
+                   x, y)
+    mean, std = model.predict(x)
+    np.testing.assert_allclose(mean, y, atol=1e-2)
+    assert std.max() < 0.05
+    # Uncertainty grows away from data (probe far corner).
+    _, std_far = model.predict(np.array([[5.0]]))
+    assert std_far[0] > std.max()
+
+
+def test_gp_kernel_search_improves_lml(rng):
+    x = rng.uniform(size=(16, 2))
+    y = np.cos(4 * x[:, 0]) + 0.5 * x[:, 1]
+    base = Matern52(noise=1e-6)
+    fixed = fit_gp(base.with_params(1.0, 0.5, 1e-6), x, y)
+    searched = fit_gp_with_kernel_search(base, x, y, rng,
+                                         num_kernel_samples=24)
+    assert (searched.log_marginal_likelihood(y)
+            >= fixed.log_marginal_likelihood(y) - 1e-9)
+
+
+# ---------------------------------------------------------------------- EI
+
+def test_expected_improvement():
+    # Mean below best -> substantial EI; far above best w/ tiny std -> ~0.
+    ei = expected_improvement(np.array([0.0, 10.0]),
+                              np.array([1.0, 1e-6]), best=1.0)
+    assert ei[0] > 1.0 - 0.1
+    assert ei[1] == pytest.approx(0.0, abs=1e-12)
+    # More uncertainty -> more EI at the same mean.
+    lo, hi = expected_improvement(np.array([2.0, 2.0]),
+                                  np.array([0.1, 2.0]), best=1.0)
+    assert hi > lo
+
+
+# ------------------------------------------------------------------ search
+
+def _quadratic_logspace(point):
+    # Minimum at x = 1.0 (log10 x = 0) in each dimension.
+    return float(np.sum(np.log10(point) ** 2))
+
+
+def test_random_search_minimizes_and_is_seeded():
+    dims = [SearchDimension("lambda", DoubleRange(1e-3, 1e3))]
+    r1 = RandomSearch(dims, _quadratic_logspace, seed=7).find(40)
+    r2 = RandomSearch(dims, _quadratic_logspace, seed=7).find(40)
+    np.testing.assert_array_equal(r1.best_point, r2.best_point)
+    assert r1.best_value < 0.5  # log10 within ±0.7 of optimum
+    assert len(r1.observations) == 40
+    assert all(1e-3 <= o.point[0] <= 1e3 for o in r1.observations)
+    assert set(r1.best_config(dims)) == {"lambda"}
+
+
+def test_gp_search_beats_its_seed_phase():
+    dims = [SearchDimension("a", DoubleRange(1e-3, 1e3)),
+            SearchDimension("b", DoubleRange(1e-3, 1e3))]
+    gp = GaussianProcessSearch(dims, _quadratic_logspace, seed=3,
+                               num_seed_points=4, num_candidates=256)
+    res = gp.find(20)
+    seed_best = min(o.value for o in res.observations[:4])
+    assert res.best_value <= seed_best
+    assert res.best_value < 0.5
+
+
+def test_find_with_priors_seeds_observations():
+    dims = [SearchDimension("a", DoubleRange(1e-3, 1e3))]
+    priors = [Observation(np.array([1.0]), 0.0)]  # the exact optimum
+    gp = GaussianProcessSearch(dims, _quadratic_logspace, seed=5,
+                               num_seed_points=2)
+    res = gp.find_with_priors(5, priors)
+    assert res.best_value == 0.0  # prior kept as best
+    assert len(res.observations) == 6
+
+
+# --------------------------------------------------- GAME tuning integration
+
+def test_game_evaluation_function_tunes_reg_weight(rng):
+    syn = synthetic.game_data(rng, n=800, d_global=6, re_specs={})
+    ds = from_synthetic(syn)
+    idx = rng.permutation(ds.num_rows)
+    train, val = ds.subset(idx[:600]), ds.subset(idx[600:])
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=GLMOptimizationConfiguration(
+                optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-7),
+                regularization=RegularizationContext(
+                    RegularizationType.L2, 1.0)))},
+        update_sequence=["fixed"],
+        mesh=make_mesh(),
+        validation_evaluators=["AUC"],
+        compute_variances_at_end=False)
+    fn = GameEvaluationFunction(est, train, val, ["fixed"],
+                                reg_weight_range=DoubleRange(1e-2, 1e2))
+    search = RandomSearch(fn.dimensions(), fn, seed=11)
+    res = search.find(3)
+    # Objective is -AUC; anything learnable should beat random (-0.5).
+    assert res.best_value < -0.55
+    # Prior seeding from a grid sweep converts results to observations.
+    grid_results = est.fit(train, val)
+    obs = fn.observations_from_results(grid_results)
+    assert len(obs) == 1 and obs[0].value < -0.5
