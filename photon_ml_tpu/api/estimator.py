@@ -66,6 +66,11 @@ class GameEstimator:
         self.normalization = normalization or {}
         self.compute_variances_at_end = compute_variances_at_end
         self.loss = losses_mod.loss_for_task(self.task)
+        # (cache key, coords) of the last fit — lets repeated fits on the
+        # SAME dataset (hyperparameter tuning trials) swap optimization
+        # configs instead of re-running bucketing + device staging. The
+        # cached coordinates keep the dataset alive, so id() keys are stable.
+        self._coord_cache: Optional[tuple[tuple, dict]] = None
 
     # -- coordinate construction ------------------------------------------
 
@@ -133,9 +138,21 @@ class GameEstimator:
             opt_configs = dict(zip(cids, combo))
             if base_coords is None:
                 # Coordinates (bucketing, device staging) are built ONCE;
-                # later grid points swap only the optimization config
-                # (reference: datasets built once, configs looped).
-                base_coords = self._build_coordinates(data, opt_configs)
+                # later grid points — and later fit() calls on the same
+                # dataset, e.g. tuning trials — swap only the optimization
+                # config (reference: datasets built once, configs looped).
+                cache_key = (id(data), tuple(
+                    (cid, self.coordinate_configs[cid].data)
+                    for cid in cids))
+                if (self._coord_cache is not None
+                        and self._coord_cache[0] == cache_key):
+                    base_coords = {
+                        cid: self._coord_cache[1][cid]
+                        .with_optimization_config(opt_configs[cid])
+                        for cid in cids}
+                else:
+                    base_coords = self._build_coordinates(data, opt_configs)
+                self._coord_cache = (cache_key, base_coords)
                 coords = base_coords
             else:
                 coords = {cid: base_coords[cid].with_optimization_config(

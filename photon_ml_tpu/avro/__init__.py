@@ -1,0 +1,20 @@
+"""Avro layer (L0) — wire/storage records.
+
+Reference parity: ``photon-avro-schemas/`` (Avro schema definitions compiled
+to Java) plus the Avro container-file I/O used by photon-client. No Avro
+library ships in this image, so ``codec``/``container`` implement the Avro
+1.x binary encoding and Object Container File format from the spec.
+"""
+
+from photon_ml_tpu.avro.codec import BinaryDecoder, BinaryEncoder, parse_schema
+from photon_ml_tpu.avro.container import DataFileReader, DataFileWriter
+from photon_ml_tpu.avro import schemas
+
+__all__ = [
+    "BinaryDecoder",
+    "BinaryEncoder",
+    "parse_schema",
+    "DataFileReader",
+    "DataFileWriter",
+    "schemas",
+]

@@ -1,0 +1,264 @@
+"""Avro binary encoding: schema-driven encoder/decoder.
+
+Implements the Avro 1.x binary encoding (zigzag varints, length-prefixed
+bytes/strings, block-encoded arrays/maps, index-prefixed unions) for the
+schema subset the photon record types need: null, boolean, int, long, float,
+double, bytes, string, record, enum, array, map, union, fixed.
+
+Values map to plain Python: records <-> dict, arrays <-> list, maps <-> dict,
+enums <-> str, unions <-> the branch value (encoder picks the first matching
+branch; ``None`` always matches the ``null`` branch).
+
+Reference parity: stands in for the generated-Java Avro runtime used by
+``photon-avro-schemas/`` (exact upstream files unavailable — reference mount
+empty; see SURVEY.md header).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, Union
+
+PRIMITIVES = {
+    "null", "boolean", "int", "long", "float", "double", "bytes", "string",
+}
+
+SchemaT = Union[str, dict, list]
+
+
+def parse_schema(schema: Union[str, SchemaT]) -> SchemaT:
+    """Accept a JSON string or an already-parsed schema structure.
+
+    Bare strings that aren't JSON documents are primitive names or named-type
+    references and pass through unchanged.
+    """
+    if isinstance(schema, str) and schema[:1] in "{[\"":
+        return json.loads(schema)
+    return schema
+
+
+def _schema_type(schema: SchemaT) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+class _NamedSchemas:
+    """Registry so named types (records/enums/fixed) can self-reference."""
+
+    def __init__(self):
+        self.by_name: dict[str, SchemaT] = {}
+
+    def register(self, schema: dict) -> None:
+        name = schema.get("name")
+        if name:
+            self.by_name[name] = schema
+            ns = schema.get("namespace")
+            if ns:
+                self.by_name[f"{ns}.{name}"] = schema
+
+    def resolve(self, schema: SchemaT) -> SchemaT:
+        if isinstance(schema, str) and schema not in PRIMITIVES:
+            if schema in self.by_name:
+                return self.by_name[schema]
+            raise ValueError(f"unknown named type: {schema}")
+        return schema
+
+
+class BinaryEncoder:
+    """Encode Python values against a schema into an Avro byte stream."""
+
+    def __init__(self, schema: SchemaT):
+        self.schema = parse_schema(schema)
+        self.names = _NamedSchemas()
+
+    def encode(self, value: Any) -> bytes:
+        buf = io.BytesIO()
+        self.write(buf, value)
+        return buf.getvalue()
+
+    def write(self, buf: io.BytesIO, value: Any,
+              schema: SchemaT = None) -> None:
+        schema = self.schema if schema is None else schema
+        schema = self.names.resolve(parse_schema(schema))
+        t = _schema_type(schema)
+        if t == "null":
+            return
+        if t == "boolean":
+            buf.write(b"\x01" if value else b"\x00")
+        elif t in ("int", "long"):
+            _write_long(buf, int(value))
+        elif t == "float":
+            buf.write(struct.pack("<f", float(value)))
+        elif t == "double":
+            buf.write(struct.pack("<d", float(value)))
+        elif t == "bytes":
+            _write_long(buf, len(value))
+            buf.write(value)
+        elif t == "string":
+            raw = value.encode("utf-8")
+            _write_long(buf, len(raw))
+            buf.write(raw)
+        elif t == "fixed":
+            self.names.register(schema)
+            if len(value) != schema["size"]:
+                raise ValueError("fixed size mismatch")
+            buf.write(value)
+        elif t == "enum":
+            self.names.register(schema)
+            _write_long(buf, schema["symbols"].index(value))
+        elif t == "array":
+            if value:
+                _write_long(buf, len(value))
+                for item in value:
+                    self.write(buf, item, schema["items"])
+            _write_long(buf, 0)
+        elif t == "map":
+            if value:
+                _write_long(buf, len(value))
+                for k, v in value.items():
+                    self.write(buf, k, "string")
+                    self.write(buf, v, schema["values"])
+            _write_long(buf, 0)
+        elif t == "union":
+            idx = _pick_union_branch(self.names, schema, value)
+            _write_long(buf, idx)
+            self.write(buf, value, schema[idx])
+        elif t == "record":
+            self.names.register(schema)
+            for field in schema["fields"]:
+                name = field["name"]
+                if name in value:
+                    fv = value[name]
+                elif "default" in field:
+                    fv = field["default"]
+                else:
+                    raise ValueError(f"missing field {name} with no default")
+                self.write(buf, fv, field["type"])
+        else:
+            raise ValueError(f"unsupported schema type: {t}")
+
+
+class BinaryDecoder:
+    """Decode an Avro byte stream against a schema into Python values."""
+
+    def __init__(self, schema: SchemaT):
+        self.schema = parse_schema(schema)
+        self.names = _NamedSchemas()
+
+    def decode(self, data: bytes) -> Any:
+        return self.read(io.BytesIO(data))
+
+    def read(self, buf: io.BytesIO, schema: SchemaT = None) -> Any:
+        schema = self.schema if schema is None else schema
+        schema = self.names.resolve(parse_schema(schema))
+        t = _schema_type(schema)
+        if t == "null":
+            return None
+        if t == "boolean":
+            return buf.read(1) != b"\x00"
+        if t in ("int", "long"):
+            return _read_long(buf)
+        if t == "float":
+            return struct.unpack("<f", buf.read(4))[0]
+        if t == "double":
+            return struct.unpack("<d", buf.read(8))[0]
+        if t == "bytes":
+            return buf.read(_read_long(buf))
+        if t == "string":
+            return buf.read(_read_long(buf)).decode("utf-8")
+        if t == "fixed":
+            self.names.register(schema)
+            return buf.read(schema["size"])
+        if t == "enum":
+            self.names.register(schema)
+            return schema["symbols"][_read_long(buf)]
+        if t == "array":
+            out = []
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return out
+                if count < 0:  # block with byte-size prefix
+                    count = -count
+                    _read_long(buf)
+                for _ in range(count):
+                    out.append(self.read(buf, schema["items"]))
+        if t == "map":
+            out = {}
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return out
+                if count < 0:
+                    count = -count
+                    _read_long(buf)
+                for _ in range(count):
+                    k = self.read(buf, "string")
+                    out[k] = self.read(buf, schema["values"])
+        if t == "union":
+            return self.read(buf, schema[_read_long(buf)])
+        if t == "record":
+            self.names.register(schema)
+            return {f["name"]: self.read(buf, f["type"])
+                    for f in schema["fields"]}
+        raise ValueError(f"unsupported schema type: {t}")
+
+
+def _write_long(buf: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1  # zigzag
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes((b | 0x80,)))
+        else:
+            buf.write(bytes((b,)))
+            return
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    shift, acc = 0, 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError("truncated varint")
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # un-zigzag
+
+
+def _matches(names: _NamedSchemas, schema: SchemaT, value: Any) -> bool:
+    schema = names.resolve(parse_schema(schema))
+    t = _schema_type(schema)
+    if t == "null":
+        return value is None
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t in ("bytes", "fixed"):
+        return isinstance(value, (bytes, bytearray))
+    if t in ("string", "enum"):
+        return isinstance(value, str)
+    if t == "array":
+        return isinstance(value, list)
+    if t in ("map", "record"):
+        return isinstance(value, dict)
+    return False
+
+
+def _pick_union_branch(names: _NamedSchemas, union: list, value: Any) -> int:
+    for i, branch in enumerate(union):
+        if _matches(names, branch, value):
+            return i
+    raise ValueError(f"no union branch matches {type(value)}")

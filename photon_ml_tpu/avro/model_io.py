@@ -1,0 +1,185 @@
+"""GAME model <-> Avro directory layout (feature-name-keyed records).
+
+Reference parity: photon-client ``data/avro/ModelProcessingUtils.scala`` —
+``saveGameModelToHDFS`` / ``loadGameModelFromHDFS``:
+
+    <root>/fixed-effect/<coordinate>/coefficients.avro   (1 record)
+    <root>/random-effect/<coordinate>/part-00000.avro    (1 record / entity)
+    <root>/id-info.json                                  (metadata [MED])
+
+Records are ``BayesianLinearModelAvro``: coefficients keyed by feature
+(name, term) so models survive feature-map changes; variances optional.
+The npz fast path (no index maps needed) lives in photon_ml_tpu/models/io.py;
+this module is the interoperable format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.container import read_records, write_records
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.index.indexmap import (DefaultIndexMap, IndexMap,
+                                          split_key)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.types import TaskType
+
+_FIXED, _RANDOM = "fixed-effect", "random-effect"
+_ID_INFO = "id-info.json"
+
+
+def _vector_to_ntv(vec: np.ndarray, imap: IndexMap) -> list[dict]:
+    out = []
+    for j in np.nonzero(vec)[0]:
+        key = imap.get_feature_name(int(j))
+        if key is None:
+            raise KeyError(f"index map has no feature for column {j}")
+        name, term = split_key(key)
+        out.append({"name": name, "term": term, "value": float(vec[j])})
+    return out
+
+
+def _ntv_to_vector(ntv: list[dict], imap: IndexMap, dim: int) -> np.ndarray:
+    vec = np.zeros(dim, np.float32)
+    from photon_ml_tpu.index.indexmap import feature_key
+    for rec in ntv:
+        j = imap.get_index(feature_key(rec["name"], rec.get("term", "")))
+        if j >= 0:
+            vec[j] = rec["value"]
+    return vec
+
+
+def save_game_model_avro(
+    model: GameModel,
+    path: str,
+    index_maps: dict[str, IndexMap],
+    entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
+    codec: str = "deflate",
+) -> None:
+    """Write the reference's Avro model directory layout."""
+    entity_vocabs = entity_vocabs or {}
+    os.makedirs(path, exist_ok=True)
+    meta = {"task": TaskType(model.task).value, "coordinates": {}}
+    for cid, m in model.models.items():
+        imap = index_maps[m.shard_id]
+        if isinstance(m, FixedEffectModel):
+            sub = os.path.join(path, _FIXED, cid)
+            rec = {
+                "modelId": cid,
+                "modelClass": "FixedEffectModel",
+                "means": _vector_to_ntv(
+                    np.asarray(m.coefficients.means), imap),
+            }
+            if m.coefficients.variances is not None:
+                rec["variances"] = _vector_to_ntv(
+                    np.asarray(m.coefficients.variances), imap)
+            write_records(os.path.join(sub, "coefficients.avro"),
+                          schemas.BAYESIAN_LINEAR_MODEL_AVRO, [rec],
+                          codec=codec)
+            meta["coordinates"][cid] = {"type": "fixed",
+                                        "shard": m.shard_id}
+        else:
+            sub = os.path.join(path, _RANDOM, cid)
+            vocab = entity_vocabs.get(m.re_type)
+            if vocab is None:
+                vocab = {str(i): i for i in range(m.num_entities)}
+            means = np.asarray(m.means)
+            variances = (None if m.variances is None
+                         else np.asarray(m.variances))
+            recs = []
+            for ent, row in sorted(vocab.items(), key=lambda kv: kv[1]):
+                rec = {
+                    "modelId": ent,
+                    "modelClass": "RandomEffectModel",
+                    "means": _vector_to_ntv(means[row], imap),
+                }
+                if variances is not None:
+                    rec["variances"] = _vector_to_ntv(variances[row], imap)
+                recs.append(rec)
+            write_records(os.path.join(sub, "part-00000.avro"),
+                          schemas.BAYESIAN_LINEAR_MODEL_AVRO, recs,
+                          codec=codec)
+            meta["coordinates"][cid] = {
+                "type": "random", "shard": m.shard_id,
+                "re_type": m.re_type, "num_entities": m.num_entities,
+            }
+    with open(os.path.join(path, _ID_INFO), "w") as fh:
+        json.dump(meta, fh, indent=2)
+
+
+def load_game_model_avro(
+    path: str,
+    index_maps: dict[str, IndexMap],
+    entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
+) -> GameModel:
+    """Load the Avro model directory written by :func:`save_game_model_avro`
+    (or by the reference's ModelProcessingUtils, same layout)."""
+    entity_vocabs = entity_vocabs or {}
+    with open(os.path.join(path, _ID_INFO)) as fh:
+        meta = json.load(fh)
+    models = {}
+    for cid, info in meta["coordinates"].items():
+        imap = index_maps[info["shard"]]
+        dim = len(imap)
+        if info["type"] == "fixed":
+            recs = read_records(os.path.join(path, _FIXED, cid))
+            rec = recs[0]
+            means = _ntv_to_vector(rec["means"], imap, dim)
+            var = rec.get("variances")
+            models[cid] = FixedEffectModel(
+                shard_id=info["shard"],
+                coefficients=Coefficients(
+                    means=jnp.asarray(means),
+                    variances=(None if var is None
+                               else jnp.asarray(_ntv_to_vector(
+                                   var, imap, dim)))))
+        else:
+            recs = read_records(os.path.join(path, _RANDOM, cid))
+            vocab = entity_vocabs.get(info["re_type"]) or {
+                r["modelId"]: i for i, r in enumerate(recs)}
+            n_ent = info.get("num_entities", len(vocab))
+            means = np.zeros((n_ent, dim), np.float32)
+            variances = None
+            for rec in recs:
+                row = vocab.get(rec["modelId"])
+                if row is None:
+                    continue
+                means[row] = _ntv_to_vector(rec["means"], imap, dim)
+                if rec.get("variances") is not None:
+                    if variances is None:
+                        variances = np.zeros((n_ent, dim), np.float32)
+                    variances[row] = _ntv_to_vector(rec["variances"], imap,
+                                                    dim)
+            models[cid] = RandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard"],
+                means=jnp.asarray(means),
+                variances=(None if variances is None
+                           else jnp.asarray(variances)))
+    return GameModel(task=TaskType(meta["task"]), models=models)
+
+
+def save_index_maps(index_maps: dict[str, IndexMap], path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    for shard, imap in index_maps.items():
+        if not isinstance(imap, DefaultIndexMap):
+            imap = DefaultIndexMap(
+                {imap.get_feature_name(i): i for i in range(len(imap))})
+        imap.save(os.path.join(path, f"{shard}.json"))
+
+
+def load_index_maps(path: str) -> dict[str, IndexMap]:
+    out = {}
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".json"):
+            out[name[:-5]] = DefaultIndexMap.load(os.path.join(path, name))
+        elif name.endswith(".pidx"):
+            from photon_ml_tpu.index.native_store import NativeIndexMap
+            out[name[:-5]] = NativeIndexMap(os.path.join(path, name))
+    return out

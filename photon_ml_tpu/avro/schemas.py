@@ -1,0 +1,112 @@
+"""Photon Avro record schemas (L0).
+
+Reference parity: ``photon-avro-schemas/src/main/avro/*.avsc`` —
+``TrainingExampleAvro`` (label/weight/offset + features as name/term/value
+triples), ``BayesianLinearModelAvro`` (coefficient means + variances),
+``ScoringResultAvro``, ``FeatureSummarizationResultAvro``,
+``LatentFactorAvro``. The reference mount was empty (SURVEY.md header), so
+field sets follow upstream linkedin/photon-ml [MED]; the codec round-trips
+whatever schema a file declares, so drift in optional fields is tolerated at
+read time.
+"""
+
+NAMESPACE = "com.linkedin.photon.avro.generated"
+
+FEATURE_AVRO = {
+    "type": "record",
+    "name": "FeatureAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE_AVRO = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array",
+                                      "items": FEATURE_AVRO}},
+        # Random-effect ids and other passthrough columns (e.g. userId).
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+NAME_TERM_VALUE_AVRO = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL_AVRO = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "means", "type": {"type": "array",
+                                   "items": NAME_TERM_VALUE_AVRO}},
+        {"name": "variances",
+         "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+         "default": None},
+        {"name": "lossFunction", "type": ["null", "string"],
+         "default": None},
+    ],
+}
+
+SCORING_RESULT_AVRO = {
+    "type": "record",
+    "name": "ScoringResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long"], "default": None},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+FEATURE_SUMMARIZATION_RESULT_AVRO = {
+    "type": "record",
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "max", "type": "double"},
+        {"name": "min", "type": "double"},
+        {"name": "mean", "type": "double"},
+        {"name": "variance", "type": "double"},
+        {"name": "numNonzeros", "type": "double"},
+        {"name": "count", "type": "long"},
+    ],
+}
+
+LATENT_FACTOR_AVRO = {
+    "type": "record",
+    "name": "LatentFactorAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "effectId", "type": "string"},
+        {"name": "factors", "type": {"type": "array", "items": "double"}},
+    ],
+}

@@ -1,0 +1,49 @@
+"""Scoring-result Avro output.
+
+Reference parity: the ``ScoringResultAvro`` write in photon-client
+``cli/game/scoring/GameScoringDriver.scala`` (uid, score, label/offset/weight
+passthrough).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.container import read_records, write_records
+
+
+def write_scoring_results(
+    path: str,
+    scores: np.ndarray,
+    uids: Optional[np.ndarray] = None,
+    labels: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    codec: str = "deflate",
+) -> None:
+    n = len(scores)
+
+    def _uid(i):
+        if uids is None:
+            return int(i)
+        u = uids[i]
+        return int(u) if isinstance(u, (int, np.integer)) else str(u)
+
+    records = []
+    for i in range(n):
+        rec = {"uid": _uid(i), "predictionScore": float(scores[i])}
+        if labels is not None:
+            rec["label"] = float(labels[i])
+        if weights is not None:
+            rec["weight"] = float(weights[i])
+        if offsets is not None:
+            rec["offset"] = float(offsets[i])
+        records.append(rec)
+    write_records(path, schemas.SCORING_RESULT_AVRO, records, codec=codec)
+
+
+def read_scoring_results(path: str) -> list[dict]:
+    return read_records(path)

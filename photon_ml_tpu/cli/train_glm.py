@@ -129,12 +129,11 @@ def run(args) -> dict:
             loss, batch, mesh, cfg, norm=norm,
             intercept_index=intercept_index)
         # Export coefficients in the ORIGINAL feature space (reference:
-        # models are transformed back before writing). Variances rescale by
-        # factor² under w_orig = w∘f (intercept shift is location-only).
+        # models are transformed back before writing).
         raw_means = norm.model_to_original_space(coef.means)
         raw_vars = coef.variances
-        if raw_vars is not None and norm.factors is not None:
-            raw_vars = raw_vars * norm.factors * norm.factors
+        if raw_vars is not None:
+            raw_vars = norm.variances_to_original_space(raw_vars)
         model = GeneralizedLinearModel(
             task=task, coefficients=Coefficients(raw_means, raw_vars))
         record = {

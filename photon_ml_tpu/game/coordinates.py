@@ -83,6 +83,7 @@ class FixedEffectCoordinate:
         self.mesh = mesh
         self.norm = norm
         self.intercept_index = dataset.intercept_index.get(shard_id)
+        self._down_sampling_seed = down_sampling_seed
         self._rng = np.random.default_rng(down_sampling_seed)
         self._X = jnp.asarray(dataset.feature_shards[shard_id])
 
@@ -101,6 +102,10 @@ class FixedEffectCoordinate:
 
         c = copy.copy(self)
         c.config = config
+        # Fresh, identically-seeded RNG so every grid point trains on the
+        # SAME down-sampled subsets (grid comparison must not depend on how
+        # far a shared RNG advanced in earlier grid points).
+        c._rng = np.random.default_rng(self._down_sampling_seed)
         return c
 
     def train_model(
@@ -167,8 +172,7 @@ class FixedEffectCoordinate:
             H = dobj.make_hessian_matrix(
                 self.loss, self.mesh, batch, self.norm)(w_t)
             var_t = variances_from_matrix(H, l2, mask)
-        if self.norm.factors is not None:
-            var_t = var_t * self.norm.factors * self.norm.factors
+        var_t = self.norm.variances_to_original_space(var_t)
         return dataclasses.replace(
             model, coefficients=Coefficients(model.coefficients.means, var_t))
 

@@ -75,9 +75,7 @@ class GameEvaluationFunction:
         est.coordinate_configs = coords
         return est
 
-    def observations_from_results(
-        self, results, points: Optional[Sequence[dict]] = None
-    ) -> list[Observation]:
+    def observations_from_results(self, results) -> list[Observation]:
         """Convert prior GameResults (e.g. the initial grid sweep) into
         seed observations (reference: findWithPriors' prior data)."""
         sign = self._sign()

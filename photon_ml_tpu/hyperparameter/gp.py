@@ -36,7 +36,8 @@ class GaussianProcessModel:
         k_star = self.kernel(self.x_train, x)            # (n, m)
         mean = self.y_mean + k_star.T @ self._alpha
         v = linalg.solve_triangular(self._chol, k_star, lower=True)
-        prior = self.kernel(x, x).diagonal()
+        # Stationary kernels have k(x,x) = amplitude² on the diagonal.
+        prior = self.kernel.amplitude ** 2
         var = np.maximum(prior - np.sum(v * v, axis=0), 1e-12)
         return mean, np.sqrt(var)
 

@@ -1,0 +1,14 @@
+"""Feature index maps (reference: photon-lib/client ``index/``)."""
+
+from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
+                                          IndexMap, feature_key,
+                                          load_index_map, split_key)
+
+__all__ = [
+    "DefaultIndexMap",
+    "INTERCEPT_KEY",
+    "IndexMap",
+    "feature_key",
+    "load_index_map",
+    "split_key",
+]

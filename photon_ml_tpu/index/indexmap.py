@@ -1,0 +1,93 @@
+"""Feature index maps: (name, term) <-> column index.
+
+Reference parity: photon-lib ``index/IndexMap.scala`` /
+``DefaultIndexMap.scala`` / ``PalDBIndexMap.scala`` and the loaders in
+photon-client ``index/``. The reference stores huge maps in PalDB (read-only
+off-heap key-value store); the native analogue here is
+``photon_ml_tpu.index.native_store`` (C++ mmap'd open-addressing table) with
+:class:`NativeIndexMap` as its loader-facing wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Iterator, Optional
+
+INTERCEPT_KEY = "(INTERCEPT)"  # Constants.INTERCEPT_KEY parity
+_SEP = "\x01"  # name/term separator, matches reference's delimiter idea
+
+
+def feature_key(name: str, term: str = "") -> str:
+    """Canonical string key for a (name, term) feature."""
+    return name if not term else f"{name}{_SEP}{term}"
+
+
+def split_key(key: str) -> tuple[str, str]:
+    name, _, term = key.partition(_SEP)
+    return name, term
+
+
+class IndexMap:
+    """Read API shared by all index maps (IndexMap.scala parity)."""
+
+    def get_index(self, key: str) -> int:
+        """Column index for a feature key, or -1 if absent."""
+        raise NotImplementedError
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_index(key) >= 0
+
+
+class DefaultIndexMap(IndexMap):
+    """In-memory dict-backed index map (DefaultIndexMap.scala parity)."""
+
+    def __init__(self, key_to_index: dict[str, int]):
+        self._fwd = dict(key_to_index)
+        self._rev = {i: k for k, i in self._fwd.items()}
+
+    @classmethod
+    def from_keys(cls, keys: Iterable[str],
+                  add_intercept: bool = False) -> "DefaultIndexMap":
+        uniq = sorted(set(keys))
+        if add_intercept and INTERCEPT_KEY not in uniq:
+            uniq.append(INTERCEPT_KEY)
+        return cls({k: i for i, k in enumerate(uniq)})
+
+    def get_index(self, key: str) -> int:
+        return self._fwd.get(key, -1)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        return self._rev.get(index)
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def __iter__(self) -> Iterator[tuple[str, int]]:
+        return iter(self._fwd.items())
+
+    def save(self, path: str) -> None:
+        """JSON sidecar persistence for small/medium maps."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self._fwd, fh)
+
+    @classmethod
+    def load(cls, path: str) -> "DefaultIndexMap":
+        with open(path) as fh:
+            return cls(json.load(fh))
+
+
+def load_index_map(path: str) -> IndexMap:
+    """Open an index map by file type: ``.json`` dict or ``.pidx`` native
+    store (PalDBIndexMapLoader parity — one loader call works for both)."""
+    if path.endswith(".pidx"):
+        from photon_ml_tpu.index.native_store import NativeIndexMap
+        return NativeIndexMap(path)
+    return DefaultIndexMap.load(path)

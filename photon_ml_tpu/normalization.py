@@ -104,6 +104,24 @@ class NormalizationContext:
             w = w.at[..., self.intercept_index].add(adjust)
         return w
 
+    def variances_to_original_space(self, variances: Array) -> Array:
+        """Diagonal-approximation variance transform matching
+        ``model_to_original_space``: w_orig = w ∘ f with shift mass folded
+        into the intercept, so Var(w_orig_j) = f_j² Var(w_j) and
+        Var(w0_orig) = Var(w0) + Σ_j (f_j s_j)² Var(w_j) (treating
+        coefficients as independent — the same approximation SIMPLE variance
+        mode already makes; the intercept's own shift is 0 so it is not
+        double-counted)."""
+        v = variances if self.factors is None \
+            else variances * self.factors * self.factors
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shifts present but intercept_index unknown")
+            f = 1.0 if self.factors is None else self.factors
+            shift_mass = jnp.sum((f * self.shifts) ** 2 * variances, axis=-1)
+            v = v.at[..., self.intercept_index].add(shift_mass)
+        return v
+
     def model_to_transformed_space(self, means: Array) -> Array:
         """Inverse of ``model_to_original_space`` (for warm starts)."""
         if self.shifts is not None:

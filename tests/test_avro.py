@@ -1,0 +1,227 @@
+"""Avro layer tests: codec, container files, data reader, model I/O.
+
+Mirrors the reference's AvroDataReaderIntegTest / model round-trip coverage
+(SURVEY.md §4) at unit scale.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.codec import BinaryDecoder, BinaryEncoder
+from photon_ml_tpu.avro.container import (DataFileReader, DataFileWriter,
+                                          read_records, write_records)
+from photon_ml_tpu.avro.data_reader import (AvroDataReader,
+                                            FeatureShardConfig)
+from photon_ml_tpu.avro.model_io import (load_game_model_avro,
+                                         save_game_model_avro)
+from photon_ml_tpu.avro.scoring import (read_scoring_results,
+                                        write_scoring_results)
+from photon_ml_tpu.index.indexmap import INTERCEPT_KEY, DefaultIndexMap
+
+
+def _roundtrip(schema, value):
+    data = BinaryEncoder(schema).encode(value)
+    return BinaryDecoder(schema).decode(data)
+
+
+class TestCodec:
+    def test_primitives(self):
+        assert _roundtrip("long", -12345) == -12345
+        assert _roundtrip("long", 2**40) == 2**40
+        assert _roundtrip("int", 0) == 0
+        assert _roundtrip("boolean", True) is True
+        assert _roundtrip("string", "héllo") == "héllo"
+        assert _roundtrip("bytes", b"\x00\xff") == b"\x00\xff"
+        assert _roundtrip("double", 3.25) == 3.25
+        assert abs(_roundtrip("float", 1.5) - 1.5) < 1e-6
+        assert _roundtrip("null", None) is None
+
+    def test_zigzag_extremes(self):
+        for v in (-1, 1, -2**62, 2**62, 63, -64):
+            assert _roundtrip("long", v) == v
+
+    def test_array_map_union(self):
+        assert _roundtrip({"type": "array", "items": "long"},
+                          [1, -2, 3]) == [1, -2, 3]
+        assert _roundtrip({"type": "array", "items": "long"}, []) == []
+        assert _roundtrip({"type": "map", "values": "string"},
+                          {"a": "x", "b": "y"}) == {"a": "x", "b": "y"}
+        u = ["null", "double", "string"]
+        assert _roundtrip(u, None) is None
+        assert _roundtrip(u, 2.5) == 2.5
+        assert _roundtrip(u, "s") == "s"
+
+    def test_enum_fixed(self):
+        e = {"type": "enum", "name": "E", "symbols": ["A", "B", "C"]}
+        assert _roundtrip(e, "B") == "B"
+        f = {"type": "fixed", "name": "F", "size": 4}
+        assert _roundtrip(f, b"abcd") == b"abcd"
+
+    def test_record_with_defaults(self):
+        rec = {"name": "ex", "label": 1.0,
+               "features": [{"name": "f", "term": "t", "value": 2.0}]}
+        out = _roundtrip(schemas.TRAINING_EXAMPLE_AVRO, rec)
+        assert out["label"] == 1.0
+        assert out["uid"] is None  # default applied on encode
+        assert out["features"][0]["term"] == "t"
+
+    def test_named_type_reference(self):
+        # BayesianLinearModelAvro's variances refer to NameTermValueAvro
+        # by name — exercises the named-schema registry.
+        rec = {"modelId": "m",
+               "means": [{"name": "a", "term": "", "value": 1.0}],
+               "variances": [{"name": "a", "term": "", "value": 0.5}]}
+        out = _roundtrip(schemas.BAYESIAN_LINEAR_MODEL_AVRO, rec)
+        assert out["variances"][0]["value"] == 0.5
+
+
+class TestContainer:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_roundtrip(self, tmp_path, codec):
+        path = str(tmp_path / "data.avro")
+        recs = [{"name": "ex", "label": float(i),
+                 "features": [{"name": f"f{i}", "term": "", "value": 1.0}]}
+                for i in range(100)]
+        write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+        got = read_records(path)
+        assert len(got) == 100
+        assert got[7]["label"] == 7.0
+        assert got[7]["features"][0]["name"] == "f7"
+
+    def test_multiple_blocks(self, tmp_path):
+        path = str(tmp_path / "blocks.avro")
+        with DataFileWriter(path, schemas.FEATURE_AVRO,
+                            block_records=10) as w:
+            for i in range(35):
+                w.append({"name": str(i), "term": "", "value": float(i)})
+        with DataFileReader(path) as r:
+            got = list(r)
+        assert [g["value"] for g in got] == [float(i) for i in range(35)]
+
+    def test_directory_read(self, tmp_path):
+        for part in range(3):
+            write_records(str(tmp_path / f"part-{part}.avro"),
+                          schemas.FEATURE_AVRO,
+                          [{"name": f"p{part}", "term": "", "value": 1.0}])
+        got = read_records(str(tmp_path))
+        assert [g["name"] for g in got] == ["p0", "p1", "p2"]
+
+
+def _write_game_avro(tmp_path, n=40, n_users=5, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        recs.append({
+            "name": "ex", "uid": i,
+            "label": float(rng.integers(0, 2)),
+            "weight": 1.0, "offset": 0.0,
+            "features": [
+                {"name": "x0", "term": "", "value": float(rng.normal())},
+                {"name": "x1", "term": "a", "value": float(rng.normal())},
+            ],
+            "metadataMap": {"userId": f"u{rng.integers(0, n_users)}"},
+        })
+    path = str(tmp_path / "train.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+    return path, recs
+
+
+class TestDataReader:
+    def test_read_builds_maps_and_vocab(self, tmp_path):
+        path, recs = _write_game_avro(tmp_path)
+        reader = AvroDataReader()
+        ds, meta = reader.read(
+            path,
+            {"global": FeatureShardConfig(("features",), True)},
+            random_effect_types=["userId"])
+        assert ds.num_rows == 40
+        imap = meta.index_maps["global"]
+        assert len(imap) == 3  # x0, x1␁a, intercept
+        assert INTERCEPT_KEY in imap
+        # intercept column is all ones
+        j = imap.get_index(INTERCEPT_KEY)
+        assert np.all(ds.feature_shards["global"][:, j] == 1.0)
+        # feature value landed in the right column
+        j0 = imap.get_index("x0")
+        assert ds.feature_shards["global"][0, j0] == pytest.approx(
+            recs[0]["features"][0]["value"], abs=1e-6)
+        assert ds.num_entities["userId"] == len(meta.entity_vocabs["userId"])
+        assert ds.entity_ids["userId"].max() < ds.num_entities["userId"]
+
+    def test_read_with_frozen_maps(self, tmp_path):
+        path, _ = _write_game_avro(tmp_path)
+        reader = AvroDataReader()
+        _, meta = reader.read(
+            path, {"global": FeatureShardConfig(("features",), True)},
+            random_effect_types=["userId"])
+        ds2, meta2 = reader.read(
+            path, {"global": FeatureShardConfig(("features",), True)},
+            random_effect_types=["userId"],
+            index_maps=meta.index_maps, entity_vocabs=meta.entity_vocabs)
+        assert meta2.index_maps is meta.index_maps
+        assert ds2.num_entities["userId"] == len(meta.entity_vocabs["userId"])
+
+    def test_unseen_entity_under_frozen_vocab_raises(self, tmp_path):
+        path, _ = _write_game_avro(tmp_path)
+        reader = AvroDataReader()
+        _, meta = reader.read(
+            path, {"global": FeatureShardConfig(("features",), True)},
+            random_effect_types=["userId"])
+        with pytest.raises(KeyError):
+            reader.read(path,
+                        {"global": FeatureShardConfig(("features",), True)},
+                        random_effect_types=["userId"],
+                        index_maps=meta.index_maps,
+                        entity_vocabs={"userId": {"only": 0}})
+
+
+class TestModelAvro:
+    def test_game_model_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                               RandomEffectModel)
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.types import TaskType
+
+        imap_g = DefaultIndexMap({"a": 0, "b": 1, INTERCEPT_KEY: 2})
+        imap_u = DefaultIndexMap({"c": 0, INTERCEPT_KEY: 1})
+        vocab = {"alice": 0, "bob": 1, "carol": 2}
+        model = GameModel(
+            task=TaskType.LOGISTIC_REGRESSION,
+            models={
+                "global": FixedEffectModel(
+                    shard_id="g",
+                    coefficients=Coefficients(
+                        means=jnp.asarray([0.5, -1.25, 2.0]),
+                        variances=jnp.asarray([0.1, 0.2, 0.3]))),
+                "per-user": RandomEffectModel(
+                    re_type="userId", shard_id="u",
+                    means=jnp.asarray([[1.0, 0.0], [0.0, -2.0],
+                                       [0.5, 0.5]])),
+            })
+        path = str(tmp_path / "model")
+        save_game_model_avro(model, path, {"g": imap_g, "u": imap_u},
+                             {"userId": vocab})
+        loaded = load_game_model_avro(path, {"g": imap_g, "u": imap_u},
+                                      {"userId": vocab})
+        assert loaded.task == TaskType.LOGISTIC_REGRESSION
+        np.testing.assert_allclose(
+            np.asarray(loaded.models["global"].coefficients.means),
+            [0.5, -1.25, 2.0], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(loaded.models["global"].coefficients.variances),
+            [0.1, 0.2, 0.3], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(loaded.models["per-user"].means),
+            np.asarray(model.models["per-user"].means), atol=1e-6)
+
+    def test_scoring_results_roundtrip(self, tmp_path):
+        path = str(tmp_path / "scores.avro")
+        scores = np.asarray([0.1, 0.9, 0.5])
+        write_scoring_results(path, scores,
+                              labels=np.asarray([0.0, 1.0, 1.0]))
+        got = read_scoring_results(path)
+        assert [g["predictionScore"] for g in got] == pytest.approx(
+            [0.1, 0.9, 0.5])
+        assert got[1]["label"] == 1.0
