@@ -68,9 +68,11 @@ class GameEstimator:
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
-        # configs instead of re-running bucketing + device staging. The
-        # cached coordinates keep the dataset alive, so id() keys are stable.
-        self._coord_cache: Optional[tuple[tuple, dict]] = None
+        # configs instead of re-running bucketing + device staging. A shared
+        # mutable holder, not a plain attribute: tuning fits shallow-copied
+        # estimators, and the copies must feed the same cache. The cached
+        # coordinates keep the dataset alive, so id() keys are stable.
+        self._coord_cache: dict[str, tuple[tuple, dict]] = {}
 
     # -- coordinate construction ------------------------------------------
 
@@ -144,15 +146,15 @@ class GameEstimator:
                 cache_key = (id(data), tuple(
                     (cid, self.coordinate_configs[cid].data)
                     for cid in cids))
-                if (self._coord_cache is not None
-                        and self._coord_cache[0] == cache_key):
+                cached = self._coord_cache.get("last")
+                if cached is not None and cached[0] == cache_key:
                     base_coords = {
-                        cid: self._coord_cache[1][cid]
+                        cid: cached[1][cid]
                         .with_optimization_config(opt_configs[cid])
                         for cid in cids}
                 else:
                     base_coords = self._build_coordinates(data, opt_configs)
-                self._coord_cache = (cache_key, base_coords)
+                self._coord_cache["last"] = (cache_key, base_coords)
                 coords = base_coords
             else:
                 coords = {cid: base_coords[cid].with_optimization_config(

@@ -48,10 +48,36 @@ def _schema_type(schema: SchemaT) -> str:
 
 
 class _NamedSchemas:
-    """Registry so named types (records/enums/fixed) can self-reference."""
+    """Registry so named types (records/enums/fixed) can self-reference.
 
-    def __init__(self):
+    Filled eagerly by pre-walking the schema at construction — registration
+    during traversal alone would miss definitions skipped in data order
+    (e.g. a by-name reference whose defining occurrence sits in an empty
+    array).
+    """
+
+    def __init__(self, root: SchemaT = None):
         self.by_name: dict[str, SchemaT] = {}
+        if root is not None:
+            self._walk(root)
+
+    def _walk(self, schema: SchemaT) -> None:
+        if isinstance(schema, list):
+            for branch in schema:
+                self._walk(branch)
+            return
+        if not isinstance(schema, dict):
+            return
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed"):
+            self.register(schema)
+        if t == "record":
+            for field in schema.get("fields", ()):
+                self._walk(parse_schema(field["type"]))
+        elif t == "array":
+            self._walk(parse_schema(schema["items"]))
+        elif t == "map":
+            self._walk(parse_schema(schema["values"]))
 
     def register(self, schema: dict) -> None:
         name = schema.get("name")
@@ -74,7 +100,7 @@ class BinaryEncoder:
 
     def __init__(self, schema: SchemaT):
         self.schema = parse_schema(schema)
-        self.names = _NamedSchemas()
+        self.names = _NamedSchemas(self.schema)
 
     def encode(self, value: Any) -> bytes:
         buf = io.BytesIO()
@@ -148,7 +174,7 @@ class BinaryDecoder:
 
     def __init__(self, schema: SchemaT):
         self.schema = parse_schema(schema)
-        self.names = _NamedSchemas()
+        self.names = _NamedSchemas(self.schema)
 
     def decode(self, data: bytes) -> Any:
         return self.read(io.BytesIO(data))

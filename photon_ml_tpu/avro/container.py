@@ -55,7 +55,11 @@ class DataFileWriter:
         self._fh.write(self._sync)
 
     def append(self, record: Any) -> None:
-        self._encoder.write(self._buf, record)
+        # Encode to a scratch buffer first: a mid-encode failure (bad record)
+        # must not leave partial bytes in the block.
+        scratch = io.BytesIO()
+        self._encoder.write(scratch, record)
+        self._buf.write(scratch.getvalue())
         self._count += 1
         if self._count >= self.block_records:
             self._flush_block()

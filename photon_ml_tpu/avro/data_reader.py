@@ -122,7 +122,8 @@ class AvroDataReader:
             offsets[i] = 0.0 if off is None else off
             w = rec.get(fields.weight)
             weights[i] = 1.0 if w is None else w
-            uids[i] = rec.get(fields.uid, i)
+            uid = rec.get(fields.uid)
+            uids[i] = i if uid is None else uid
             for shard, cfg in feature_shard_configs.items():
                 imap, mat = index_maps[shard], shard_mats[shard]
                 for bag in cfg.feature_bags:

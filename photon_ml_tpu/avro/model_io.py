@@ -175,11 +175,10 @@ def save_index_maps(index_maps: dict[str, IndexMap], path: str) -> None:
 
 
 def load_index_maps(path: str) -> dict[str, IndexMap]:
+    from photon_ml_tpu.index.indexmap import load_index_map
     out = {}
     for name in sorted(os.listdir(path)):
-        if name.endswith(".json"):
-            out[name[:-5]] = DefaultIndexMap.load(os.path.join(path, name))
-        elif name.endswith(".pidx"):
-            from photon_ml_tpu.index.native_store import NativeIndexMap
-            out[name[:-5]] = NativeIndexMap(os.path.join(path, name))
+        if name.endswith((".json", ".pidx")):
+            out[name.rsplit(".", 1)[0]] = load_index_map(
+                os.path.join(path, name))
     return out

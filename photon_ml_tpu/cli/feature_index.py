@@ -1,0 +1,96 @@
+"""Feature indexing driver.
+
+Reference parity: photon-client ``index/FeatureIndexingDriver.scala`` — the
+standalone job that scans feature (name, term) pairs in training data and
+builds per-shard read-only index stores, later opened by the training /
+scoring drivers. Output per shard is either a ``.pidx`` native mmap store
+(PalDB analogue, photon_ml_tpu/index/native_store.py) or a ``.json`` map.
+
+Usage:
+
+    python -m photon_ml_tpu.cli.feature_index \\
+        --data /path/train.avro --output /path/index \\
+        --shard "global:features" --shard "user:userFeatures" \\
+        --format pidx --add-intercept
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+from photon_ml_tpu.avro.container import read_records
+from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
+                                          feature_key)
+from photon_ml_tpu.index.native_store import build_store
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+
+def parse_shard(spec: str) -> tuple[str, list[str]]:
+    """``shardName:bag1+bag2`` -> (shardName, [bag1, bag2])."""
+    shard, _, bags = spec.partition(":")
+    if not bags:
+        raise ValueError(f"shard spec needs '<name>:<bag>[+<bag>...]': "
+                         f"{spec!r}")
+    return shard, bags.split("+")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", action="append", required=True,
+                   help="Avro file or directory (repeatable)")
+    p.add_argument("--output", required=True, help="output directory")
+    p.add_argument("--shard", action="append", required=True,
+                   help="'<shardName>:<bag>[+<bag>...]' (repeatable)")
+    p.add_argument("--format", default="pidx", choices=["pidx", "json"])
+    p.add_argument("--add-intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept",
+                   action="store_false")
+    return p
+
+
+def run(args) -> dict:
+    shards = dict(parse_shard(s) for s in args.shard)
+    keys: dict[str, set[str]] = {s: set() for s in shards}
+    num_records = 0
+    for path in args.data:
+        for rec in read_records(path):
+            num_records += 1
+            for shard, bags in shards.items():
+                dst = keys[shard]
+                for bag in bags:
+                    for f in rec.get(bag) or ():
+                        dst.add(feature_key(f["name"], f.get("term", "")))
+
+    os.makedirs(args.output, exist_ok=True)
+    summary = {"num_records": num_records, "shards": {}}
+    for shard, ks in keys.items():
+        ordered = sorted(ks)
+        if args.add_intercept and INTERCEPT_KEY not in ks:
+            ordered.append(INTERCEPT_KEY)
+        if args.format == "pidx":
+            out = os.path.join(args.output, f"{shard}.pidx")
+            build_store(ordered, out)
+        else:
+            out = os.path.join(args.output, f"{shard}.json")
+            DefaultIndexMap(
+                {k: i for i, k in enumerate(ordered)}).save(out)
+        summary["shards"][shard] = {"num_features": len(ordered),
+                                    "path": out}
+        logger.info("shard %s: %d features -> %s", shard, len(ordered), out)
+    with open(os.path.join(args.output, "_summary.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    return summary
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

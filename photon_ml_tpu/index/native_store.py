@@ -1,0 +1,200 @@
+"""Native mmap'd read-only index store (PalDB analogue).
+
+Reference parity: photon-lib ``index/PalDBIndexMap.scala`` + photon-client
+``index/PalDBIndexMapLoader.scala`` — a read-only key-value store holding
+feature maps too large for in-process dicts, built offline by the feature
+indexing driver and opened (cheaply, shared) by every worker.
+
+Here: ``build_store`` writes the ``.pidx`` format from Python;
+:class:`NativeIndexMap` serves lookups through the C++ mmap reader
+(``photon_ml_tpu/native/pidx.cc``) via ctypes, falling back to a pure-Python
+mmap reader when no C++ toolchain is available. Both readers share the same
+on-disk format, documented in pidx.cc.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+from typing import Iterable, Optional
+
+from photon_ml_tpu.index.indexmap import IndexMap
+
+_MAGIC = b"PIDXv01\x00"
+_HEADER = struct.Struct("<8sQQQQQQ")  # magic n slots table ridx blob blobsz
+_SLOT = struct.Struct("<QQII")  # hash key_off key_len index_plus1
+_RIDX = struct.Struct("<QII")  # key_off key_len pad
+
+_FNV_OFFSET = 1469598103934665603
+_FNV_PRIME = 1099511628211
+_MASK64 = (1 << 64) - 1
+
+
+def _fnv1a(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
+
+
+def build_store(keys: Iterable[str], path: str,
+                load_factor: float = 0.7) -> None:
+    """Write a ``.pidx`` store mapping each key to its position in ``keys``.
+
+    Keys must be unique; their iteration order defines the column indices
+    (mirrors the indexing driver's partition-range assignment).
+    """
+    encoded = [k.encode("utf-8") for k in keys]
+    n = len(encoded)
+    if len(set(encoded)) != n:
+        raise ValueError("duplicate keys in index store")
+    slots = 1
+    while slots < max(1, int(n / load_factor)):
+        slots *= 2
+
+    blob = bytearray()
+    ridx = bytearray()
+    offsets = []
+    for kb in encoded:
+        offsets.append(len(blob))
+        ridx += _RIDX.pack(len(blob), len(kb), 0)
+        blob += kb
+
+    table = bytearray(_SLOT.size * slots)
+    occupied = [False] * slots
+    for idx, kb in enumerate(encoded):
+        h = _fnv1a(kb)
+        i = h & (slots - 1)
+        while occupied[i]:
+            i = (i + 1) & (slots - 1)
+        occupied[i] = True
+        _SLOT.pack_into(table, i * _SLOT.size, h, offsets[idx], len(kb),
+                        idx + 1)
+
+    table_off = _HEADER.size
+    ridx_off = table_off + len(table)
+    blob_off = ridx_off + len(ridx)
+    header = _HEADER.pack(_MAGIC, n, slots, table_off, ridx_off, blob_off,
+                          len(blob))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(header)
+        fh.write(table)
+        fh.write(ridx)
+        fh.write(blob)
+    os.replace(tmp, path)
+
+
+class _CppReader:
+    """ctypes wrapper over the mmap'd C++ reader."""
+
+    def __init__(self, path: str):
+        from photon_ml_tpu.native import build_library
+
+        lib = ctypes.CDLL(build_library("pidx"))
+        lib.pidx_open.restype = ctypes.c_void_p
+        lib.pidx_open.argtypes = [ctypes.c_char_p]
+        lib.pidx_close.argtypes = [ctypes.c_void_p]
+        lib.pidx_size.restype = ctypes.c_int64
+        lib.pidx_size.argtypes = [ctypes.c_void_p]
+        lib.pidx_get.restype = ctypes.c_int64
+        lib.pidx_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64]
+        lib.pidx_name.restype = ctypes.c_int64
+        lib.pidx_name.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                  ctypes.c_char_p, ctypes.c_uint64]
+        handle = lib.pidx_open(path.encode())
+        if not handle:
+            raise OSError(f"pidx_open failed for {path}")
+        self._lib = lib
+        self._handle = handle
+        self.size = int(lib.pidx_size(handle))
+
+    def get(self, key: bytes) -> int:
+        return int(self._lib.pidx_get(self._handle, key, len(key)))
+
+    def name(self, index: int) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(256)
+        got = self._lib.pidx_name(self._handle, index, buf, 256)
+        if got < 0:
+            return None
+        if got <= 256:
+            return buf.raw[:got]
+        big = ctypes.create_string_buffer(got)
+        self._lib.pidx_name(self._handle, index, big, got)
+        return big.raw[:got]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.pidx_close(self._handle)
+            self._handle = None
+
+
+class _PyReader:
+    """Pure-Python mmap reader of the same format (toolchain-free hosts)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "rb")
+        self._mm = mmap.mmap(self._fh.fileno(), 0, access=mmap.ACCESS_READ)
+        (magic, self.size, self._slots, self._table_off, self._ridx_off,
+         self._blob_off, _) = _HEADER.unpack_from(self._mm, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: bad magic")
+
+    def get(self, key: bytes) -> int:
+        if self._slots == 0:
+            return -1
+        h = _fnv1a(key)
+        i = h & (self._slots - 1)
+        while True:
+            sh, off, klen, idx1 = _SLOT.unpack_from(
+                self._mm, self._table_off + i * _SLOT.size)
+            if idx1 == 0:
+                return -1
+            if sh == h and klen == len(key):
+                start = self._blob_off + off
+                if self._mm[start:start + klen] == key:
+                    return idx1 - 1
+            i = (i + 1) & (self._slots - 1)
+
+    def name(self, index: int) -> Optional[bytes]:
+        if not 0 <= index < self.size:
+            return None
+        off, klen, _ = _RIDX.unpack_from(
+            self._mm, self._ridx_off + index * _RIDX.size)
+        start = self._blob_off + off
+        return self._mm[start:start + klen]
+
+    def close(self) -> None:
+        self._mm.close()
+        self._fh.close()
+
+
+class NativeIndexMap(IndexMap):
+    """IndexMap served from a ``.pidx`` store (PalDBIndexMap parity)."""
+
+    def __init__(self, path: str, force_python: bool = False):
+        self.path = path
+        if force_python:
+            self._reader = _PyReader(path)
+        else:
+            try:
+                self._reader = _CppReader(path)
+            except Exception:  # no g++ / load failure → same format, Python
+                self._reader = _PyReader(path)
+
+    def get_index(self, key: str) -> int:
+        return self._reader.get(key.encode("utf-8"))
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        raw = self._reader.name(index)
+        return None if raw is None else raw.decode("utf-8")
+
+    def __len__(self) -> int:
+        return self._reader.size
+
+    def close(self) -> None:
+        self._reader.close()

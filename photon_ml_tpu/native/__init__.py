@@ -1,0 +1,34 @@
+"""Native (C++) runtime components.
+
+The compute path is JAX/XLA; these are host-side runtime pieces where the
+reference uses native-adjacent code (PalDB). Shared objects build on first
+use with g++ and are cached under ``_build/``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+
+
+def build_library(name: str) -> str:
+    """Compile ``<name>.cc`` into ``_build/lib<name>.so`` (once) and return
+    the path. Rebuilds when the source is newer than the cached object."""
+    src = os.path.join(_HERE, f"{name}.cc")
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    with _LOCK:
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            tmp = out + ".tmp"
+            subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+                 "-o", tmp, src],
+                check=True, capture_output=True)
+            os.replace(tmp, out)
+    return out

@@ -1,0 +1,147 @@
+// pidx: read-only mmap'd feature-index store.
+//
+// Reference parity: PalDB (LinkedIn's read-only key-value store, Java) as
+// used by photon-ml's PalDBIndexMap for 1e6–1e8-feature maps. Same role,
+// native implementation: the store is built once (offline, by the feature
+// indexing driver), then opened read-only by every training process. mmap
+// keeps the table out of the Python heap and shares pages across processes
+// on one host (the TPU-host analogue of per-executor PalDB opens).
+//
+// File layout (little-endian, built by photon_ml_tpu/index/native_store.py):
+//   0:  8  magic "PIDXv01\0"
+//   8:  u64 n                 (number of entries)
+//   16: u64 slots             (hash-table slots, power of two)
+//   24: u64 table_off         (open-addressing table, slots * 24 bytes:
+//                              {u64 hash, u64 key_off, u32 key_len,
+//                               u32 index_plus1}; index_plus1==0 => empty)
+//   32: u64 ridx_off          (reverse index, n * 16 bytes:
+//                              {u64 key_off, u32 key_len, u32 pad})
+//   40: u64 blob_off          (key-bytes blob)
+//   48: u64 blob_size
+//
+// Exported C API (ctypes-consumed): pidx_open/close/size/get/name.
+
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'I', 'D', 'X', 'v', '0', '1', '\0'};
+
+struct Slot {
+  uint64_t hash;
+  uint64_t key_off;
+  uint32_t key_len;
+  uint32_t index_plus1;
+};
+
+struct RIdx {
+  uint64_t key_off;
+  uint32_t key_len;
+  uint32_t pad;
+};
+
+struct Store {
+  void* base = nullptr;
+  size_t length = 0;
+  uint64_t n = 0;
+  uint64_t slots = 0;
+  const Slot* table = nullptr;
+  const RIdx* ridx = nullptr;
+  const char* blob = nullptr;
+  uint64_t blob_size = 0;
+};
+
+inline uint64_t fnv1a(const char* data, uint64_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint64_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+inline uint64_t read_u64(const char* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pidx_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 56) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping persists past close
+  if (base == MAP_FAILED) return nullptr;
+  const char* p = static_cast<const char*>(base);
+  if (std::memcmp(p, kMagic, 8) != 0) {
+    munmap(base, st.st_size);
+    return nullptr;
+  }
+  Store* s = new Store;
+  s->base = base;
+  s->length = st.st_size;
+  s->n = read_u64(p + 8);
+  s->slots = read_u64(p + 16);
+  s->table = reinterpret_cast<const Slot*>(p + read_u64(p + 24));
+  s->ridx = reinterpret_cast<const RIdx*>(p + read_u64(p + 32));
+  s->blob = p + read_u64(p + 40);
+  s->blob_size = read_u64(p + 48);
+  return s;
+}
+
+void pidx_close(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  if (!s) return;
+  munmap(s->base, s->length);
+  delete s;
+}
+
+int64_t pidx_size(void* handle) {
+  return static_cast<Store*>(handle)->n;
+}
+
+// Returns the feature's column index, or -1 if absent.
+int64_t pidx_get(void* handle, const char* key, uint64_t key_len) {
+  const Store* s = static_cast<Store*>(handle);
+  if (s->slots == 0) return -1;
+  const uint64_t h = fnv1a(key, key_len);
+  uint64_t i = h & (s->slots - 1);
+  for (;;) {
+    const Slot& slot = s->table[i];
+    if (slot.index_plus1 == 0) return -1;  // empty: not present
+    if (slot.hash == h && slot.key_len == key_len &&
+        std::memcmp(s->blob + slot.key_off, key, key_len) == 0) {
+      return static_cast<int64_t>(slot.index_plus1) - 1;
+    }
+    i = (i + 1) & (s->slots - 1);
+  }
+}
+
+// Copies the key for `index` into buf (up to buf_len bytes); returns the
+// key's full length, or -1 if index is out of range.
+int64_t pidx_name(void* handle, uint64_t index, char* buf,
+                  uint64_t buf_len) {
+  const Store* s = static_cast<Store*>(handle);
+  if (index >= s->n) return -1;
+  const RIdx& r = s->ridx[index];
+  const uint64_t ncopy = r.key_len < buf_len ? r.key_len : buf_len;
+  std::memcpy(buf, s->blob + r.key_off, ncopy);
+  return r.key_len;
+}
+
+}  // extern "C"

@@ -66,6 +66,15 @@ class TestCodec:
         assert out["uid"] is None  # default applied on encode
         assert out["features"][0]["term"] == "t"
 
+    def test_named_reference_with_empty_defining_array(self):
+        # The by-name NameTermValueAvro reference must resolve even when the
+        # defining occurrence (means' items) is skipped by an empty array.
+        rec = {"modelId": "m", "means": [],
+               "variances": [{"name": "a", "term": "", "value": 0.5}]}
+        out = _roundtrip(schemas.BAYESIAN_LINEAR_MODEL_AVRO, rec)
+        assert out["means"] == []
+        assert out["variances"][0]["value"] == 0.5
+
     def test_named_type_reference(self):
         # BayesianLinearModelAvro's variances refer to NameTermValueAvro
         # by name — exercises the named-schema registry.
@@ -98,6 +107,15 @@ class TestContainer:
         with DataFileReader(path) as r:
             got = list(r)
         assert [g["value"] for g in got] == [float(i) for i in range(35)]
+
+    def test_failed_append_does_not_corrupt_block(self, tmp_path):
+        path = str(tmp_path / "bad.avro")
+        with DataFileWriter(path, schemas.FEATURE_AVRO) as w:
+            with pytest.raises(ValueError):
+                w.append({"name": "x", "term": ""})  # missing 'value'
+            w.append({"name": "ok", "term": "", "value": 1.0})
+        got = read_records(path)
+        assert got == [{"name": "ok", "term": "", "value": 1.0}]
 
     def test_directory_read(self, tmp_path):
         for part in range(3):

@@ -1,0 +1,106 @@
+"""Index-map tests: in-memory map, native mmap store (C++ and Python
+readers), feature indexing CLI."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
+                                          feature_key, load_index_map,
+                                          split_key)
+from photon_ml_tpu.index.native_store import (NativeIndexMap, _CppReader,
+                                              _PyReader, build_store)
+
+
+class TestDefaultIndexMap:
+    def test_roundtrip(self, tmp_path):
+        imap = DefaultIndexMap.from_keys(["b", "a", "c"], add_intercept=True)
+        assert len(imap) == 4
+        assert imap.get_index("a") == 0
+        assert imap.get_index(INTERCEPT_KEY) >= 0
+        assert imap.get_index("zzz") == -1
+        assert imap.get_feature_name(imap.get_index("b")) == "b"
+        path = str(tmp_path / "map.json")
+        imap.save(path)
+        loaded = load_index_map(path)
+        assert len(loaded) == 4
+        assert loaded.get_index("c") == imap.get_index("c")
+
+    def test_feature_key_split(self):
+        assert split_key(feature_key("n", "t")) == ("n", "t")
+        assert split_key(feature_key("n")) == ("n", "")
+
+
+@pytest.fixture(scope="module")
+def store_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("pidx") / "feats.pidx")
+    keys = [f"feat_{i:05d}\x01term{i % 7}" for i in range(5000)]
+    build_store(keys, path)
+    return path, keys
+
+
+class TestNativeStore:
+    @pytest.mark.parametrize("reader_cls", [_CppReader, _PyReader])
+    def test_readers_agree(self, store_path, reader_cls):
+        path, keys = store_path
+        r = reader_cls(path)
+        try:
+            assert r.size == len(keys)
+            rng = np.random.default_rng(0)
+            for i in map(int, rng.integers(0, len(keys), 200)):
+                assert r.get(keys[i].encode()) == i
+                assert r.name(i) == keys[i].encode()
+            assert r.get(b"missing-key") == -1
+            assert r.name(len(keys)) is None
+        finally:
+            r.close()
+
+    def test_native_index_map(self, store_path):
+        path, keys = store_path
+        imap = NativeIndexMap(path)
+        assert len(imap) == len(keys)
+        assert imap.get_index(keys[17]) == 17
+        assert imap.get_feature_name(17) == keys[17]
+        assert keys[17] in imap
+        assert "nope" not in imap
+        assert load_index_map(path).get_index(keys[3]) == 3
+        imap.close()
+
+    def test_duplicate_keys_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            build_store(["a", "a"], str(tmp_path / "dup.pidx"))
+
+    def test_empty_store(self, tmp_path):
+        path = str(tmp_path / "empty.pidx")
+        build_store([], path)
+        imap = NativeIndexMap(path)
+        assert len(imap) == 0
+        assert imap.get_index("x") == -1
+
+
+class TestFeatureIndexCli:
+    def _write_data(self, tmp_path):
+        from photon_ml_tpu.avro import schemas
+        from photon_ml_tpu.avro.container import write_records
+        recs = [{"name": "ex", "label": 0.0,
+                 "features": [{"name": f"g{i % 5}", "term": "",
+                               "value": 1.0}],
+                 "metadataMap": None}
+                for i in range(20)]
+        path = str(tmp_path / "train.avro")
+        write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+        return path
+
+    @pytest.mark.parametrize("fmt", ["pidx", "json"])
+    def test_end_to_end(self, tmp_path, fmt):
+        from photon_ml_tpu.cli.feature_index import build_parser, run
+        data = self._write_data(tmp_path)
+        out = str(tmp_path / "index")
+        args = build_parser().parse_args(
+            ["--data", data, "--output", out,
+             "--shard", "global:features", "--format", fmt])
+        summary = run(args)
+        assert summary["num_records"] == 20
+        assert summary["shards"]["global"]["num_features"] == 6  # 5+intercept
+        imap = load_index_map(summary["shards"]["global"]["path"])
+        assert imap.get_index("g3") >= 0
+        assert imap.get_index(INTERCEPT_KEY) >= 0
