@@ -1,0 +1,165 @@
+"""Sparse example batches in ELL (padded-slot) layout.
+
+The Criteo-scale path (SURVEY.md §7 step 9 / BASELINE config 5): feature
+spaces of 1e6+ columns where dense (n, d) matrices are impossible. The
+reference handles this with sparse Breeze vectors inside per-partition
+aggregator loops; the TPU-first layout is ELL — every example gets a fixed
+``max_nnz`` slots of (feature index, value) pairs:
+
+    indices: (n, max_nnz) int32   — padding slots point at column d
+    values:  (n, max_nnz) float32 — padding slots hold 0.0
+
+Static shapes keep XLA happy; the sentinel column d lands gathers/scatters
+on a zero slot of the (d+1,)-padded coefficient vector, so padding
+contributes exactly nothing without any masking in the kernels. Rows with
+more than ``max_nnz`` non-zeros keep their largest-magnitude entries
+(callers pick ``max_nnz`` at the dataset's true max to make this lossless).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SparseBatch:
+    """ELL sparse batch: indices/values (n, max_nnz), labels etc. (n,)."""
+
+    indices: Array  # int32, padding slot == num_features
+    values: Array
+    labels: Array
+    weights: Array
+    offsets: Array
+    num_features: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[-2]
+
+    @property
+    def max_nnz(self) -> int:
+        return self.indices.shape[-1]
+
+    @property
+    def dim(self) -> int:
+        return self.num_features
+
+    def pad_to(self, n: int) -> "SparseBatch":
+        """Pad rows to ``n`` with zero-weight sentinel rows."""
+        cur = self.num_rows
+        if n == cur:
+            return self
+        if n < cur:
+            raise ValueError(f"cannot shrink {cur} -> {n}")
+        extra = n - cur
+        ind = np.full((extra, self.max_nnz), self.num_features, np.int32)
+        zeros = np.zeros(extra, np.float32)
+        return SparseBatch(
+            indices=np.concatenate([np.asarray(self.indices), ind]),
+            values=np.concatenate(
+                [np.asarray(self.values),
+                 np.zeros((extra, self.max_nnz), np.float32)]),
+            labels=np.concatenate([np.asarray(self.labels), zeros]),
+            weights=np.concatenate([np.asarray(self.weights), zeros]),
+            offsets=np.concatenate([np.asarray(self.offsets), zeros]),
+            num_features=self.num_features,
+        )
+
+
+def from_csr(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+    labels: np.ndarray,
+    num_features: int,
+    weights: np.ndarray = None,
+    offsets: np.ndarray = None,
+    max_nnz: int = None,
+) -> SparseBatch:
+    """CSR triplet -> ELL. ``max_nnz`` defaults to the true row maximum;
+    rows over the cap keep their largest-|value| entries."""
+    n = len(indptr) - 1
+    row_nnz = np.diff(indptr)
+    cap = int(row_nnz.max()) if row_nnz.size else 1
+    if max_nnz is None:
+        max_nnz = max(cap, 1)
+    ell_idx = np.full((n, max_nnz), num_features, np.int32)
+    ell_val = np.zeros((n, max_nnz), np.float32)
+    for i in range(n):
+        lo, hi = int(indptr[i]), int(indptr[i + 1])
+        k = hi - lo
+        if k <= max_nnz:
+            ell_idx[i, :k] = indices[lo:hi]
+            ell_val[i, :k] = values[lo:hi]
+        else:
+            keep = np.argsort(-np.abs(values[lo:hi]))[:max_nnz]
+            keep.sort()
+            ell_idx[i] = indices[lo:hi][keep]
+            ell_val[i] = values[lo:hi][keep]
+    return SparseBatch(
+        indices=ell_idx,
+        values=ell_val,
+        labels=np.asarray(labels, np.float32),
+        weights=(np.ones(n, np.float32) if weights is None
+                 else np.asarray(weights, np.float32)),
+        offsets=(np.zeros(n, np.float32) if offsets is None
+                 else np.asarray(offsets, np.float32)),
+        num_features=num_features,
+    )
+
+
+def from_libsvm(data, max_nnz: int = None,
+                offsets: np.ndarray = None) -> SparseBatch:
+    """LibsvmData (CSR path) -> SparseBatch."""
+    if data.indptr is None:
+        raise ValueError("LibsvmData has no CSR arrays (dense file?)")
+    return from_csr(data.indptr, data.indices, data.values, data.labels,
+                    data.num_features, offsets=offsets, max_nnz=max_nnz)
+
+
+def synthetic_sparse(
+    n: int,
+    num_features: int,
+    nnz_per_row: int,
+    task: str = "logistic",
+    seed: int = 0,
+    noise: float = 0.25,
+    zipf: bool = True,
+) -> tuple[SparseBatch, np.ndarray]:
+    """Synthetic high-dimensional sparse GLM data (Criteo-shaped): returns
+    (batch, true_weights). Feature popularity is Zipf-ish by default, like
+    CTR data (``zipf=False`` gives uniform popularity, so every weight is
+    identifiable — handy for recovery tests)."""
+    rng = np.random.default_rng(seed)
+    w_true = (rng.normal(size=num_features) *
+              (rng.random(num_features) < 0.2)).astype(np.float32)
+    if zipf:
+        # Zipf-ish popularity: low ids much more frequent.
+        raw = rng.zipf(1.3, size=(n, nnz_per_row)).astype(np.int64)
+        ids = np.minimum(raw - 1, num_features - 1).astype(np.int32)
+    else:
+        ids = rng.integers(0, num_features,
+                           size=(n, nnz_per_row)).astype(np.int32)
+    vals = rng.normal(size=(n, nnz_per_row)).astype(np.float32)
+    margin = np.einsum("nk,nk->n", vals, w_true[ids])
+    margin += noise * rng.normal(size=n).astype(np.float32)
+    if task == "logistic":
+        labels = (rng.random(n) < 1.0 / (1.0 + np.exp(-margin))).astype(
+            np.float32)
+    else:
+        labels = margin.astype(np.float32)
+    batch = SparseBatch(
+        indices=ids,
+        values=vals,
+        labels=labels,
+        weights=np.ones(n, np.float32),
+        offsets=np.zeros(n, np.float32),
+        num_features=num_features,
+    )
+    return batch, w_true

@@ -1,0 +1,176 @@
+"""Distributed sparse-GLM objectives: data-parallel and feature-sharded.
+
+The Criteo-scale seam (SURVEY.md §2.5 P3): examples shard over the ``data``
+mesh axis exactly like the dense path; for feature spaces too large to
+replicate, the coefficient vector additionally shards over the ``model``
+axis (tensor-parallel analogue):
+
+    margins:  each model-rank gathers from its coefficient slice for the
+              indices it owns → partial margins → ``psum`` over ``model``
+    gradient: each model-rank scatter-adds ONLY into its own slice (no
+              model-axis communication at all) → ``psum`` over ``data``
+
+That is, the forward pass all-reduces activations (n,) — tiny — while the
+backward pass keeps the (d,) gradient fully sharded; coefficients never
+travel. This mirrors how the reference keeps huge feature maps out of
+driver memory via PalDB + sparse vectors, re-expressed as sharding.
+
+Reference parity: function/glm/DistributedGLMLossFunction.scala
+(treeAggregate → psum), index maps for the huge-d regime.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.data.sparse import SparseBatch
+from photon_ml_tpu.ops import sparse_aggregators as sagg
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+Array = jax.Array
+
+
+def _batch_specs(batch: SparseBatch) -> SparseBatch:
+    return jax.tree.map(
+        lambda leaf: P(DATA_AXIS, *(None,) * (jnp.ndim(leaf) - 1)), batch)
+
+
+def _local_margin_terms(batch: SparseBatch, w_local: Array,
+                        lo: Array) -> Array:
+    """Per-rank partial margins from the locally-owned coefficient slice.
+
+    Out-of-slice indices clip to a masked gather; each nonzero is owned by
+    exactly one rank, so the model-axis psum reconstructs the full margin.
+    """
+    d_local = w_local.shape[0]
+    ids = batch.indices - lo
+    in_slice = (ids >= 0) & (ids < d_local)
+    gathered = w_local[jnp.clip(ids, 0, d_local - 1)]
+    return jnp.sum(jnp.where(in_slice, batch.values * gathered, 0.0),
+                   axis=-1)
+
+
+def _local_scatter(batch: SparseBatch, r: Array, d_local: int,
+                   lo: Array) -> Array:
+    """Scatter r ⊗ values into this rank's slice; others' columns drop."""
+    ids = batch.indices - lo
+    in_slice = (ids >= 0) & (ids < d_local)
+    upd = jnp.where(in_slice, r[..., None] * batch.values, 0.0).reshape(-1)
+    flat = jnp.where(in_slice, ids, d_local).reshape(-1)
+    return jnp.zeros((d_local + 1,), upd.dtype).at[flat].add(upd)[:d_local]
+
+
+def make_value_and_gradient(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: SparseBatch,
+    feature_sharded: bool = False,
+):
+    """(w) → (Σ value, Σ grad) over the sharded sparse batch.
+
+    ``feature_sharded=False``: w replicated (few-M features and below).
+    ``feature_sharded=True``: w sharded over ``model`` — w's padded length
+    must divide evenly by the model-axis size.
+    """
+    specs = _batch_specs(batch)
+
+    if not feature_sharded:
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(P(), specs), out_specs=(P(), P()))
+        def _vg(w, b):
+            v, g = sagg.value_and_gradient(loss, w, b)
+            return lax.psum(v, DATA_AXIS), lax.psum(g, DATA_AXIS)
+
+        return lambda w: _vg(w, batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(MODEL_AXIS), specs),
+                       out_specs=(P(), P(MODEL_AXIS)))
+    def _vg_sharded(w_local, b):
+        d_local = w_local.shape[0]
+        lo = lax.axis_index(MODEL_AXIS) * d_local
+        partial_m = _local_margin_terms(b, w_local, lo)
+        z = lax.psum(partial_m, MODEL_AXIS) + b.offsets
+        l, dl = loss.loss_and_dz(z, b.labels)
+        wmask = b.weights > 0.0
+        value = jnp.sum(jnp.where(wmask, b.weights * l, 0.0), axis=-1)
+        value = lax.psum(value, DATA_AXIS)
+        r = jnp.where(wmask, b.weights * dl, 0.0)
+        g_local = _local_scatter(b, r, d_local, lo)
+        return value, lax.psum(g_local, DATA_AXIS)
+
+    return lambda w: _vg_sharded(w, batch)
+
+
+def make_hvp(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: SparseBatch,
+    feature_sharded: bool = False,
+):
+    """(w, v) → Σ H·v (TRON inner loop) over the sharded sparse batch."""
+    specs = _batch_specs(batch)
+
+    if not feature_sharded:
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(P(), P(), specs), out_specs=P())
+        def _hvp(w, v, b):
+            return lax.psum(sagg.hessian_vector(loss, w, v, b), DATA_AXIS)
+
+        return lambda w, v: _hvp(w, v, batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(MODEL_AXIS), P(MODEL_AXIS), specs),
+                       out_specs=P(MODEL_AXIS))
+    def _hvp_sharded(w_local, v_local, b):
+        d_local = w_local.shape[0]
+        lo = lax.axis_index(MODEL_AXIS) * d_local
+        z = lax.psum(_local_margin_terms(b, w_local, lo), MODEL_AXIS) \
+            + b.offsets
+        xv = lax.psum(_local_margin_terms(b, v_local, lo), MODEL_AXIS)
+        d2 = loss.d2z(z, b.labels)
+        r = jnp.where(b.weights > 0.0, b.weights * d2, 0.0) * xv
+        return lax.psum(_local_scatter(b, r, d_local, lo), DATA_AXIS)
+
+    return lambda w, v: _hvp_sharded(w, v, batch)
+
+
+def make_hessian_diagonal(
+    loss: PointwiseLoss,
+    mesh: Mesh,
+    batch: SparseBatch,
+    feature_sharded: bool = False,
+):
+    specs = _batch_specs(batch)
+
+    if not feature_sharded:
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(P(), specs), out_specs=P())
+        def _hd(w, b):
+            return lax.psum(sagg.hessian_diagonal(loss, w, b), DATA_AXIS)
+
+        return lambda w: _hd(w, batch)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(MODEL_AXIS), specs),
+                       out_specs=P(MODEL_AXIS))
+    def _hd_sharded(w_local, b):
+        d_local = w_local.shape[0]
+        lo = lax.axis_index(MODEL_AXIS) * d_local
+        z = lax.psum(_local_margin_terms(b, w_local, lo), MODEL_AXIS) \
+            + b.offsets
+        d2 = loss.d2z(z, b.labels)
+        r = jnp.where(b.weights > 0.0, b.weights * d2, 0.0)
+        sq = SparseBatch(
+            indices=b.indices, values=b.values * b.values, labels=b.labels,
+            weights=b.weights, offsets=b.offsets,
+            num_features=b.num_features)
+        return lax.psum(_local_scatter(sq, r, d_local, lo), DATA_AXIS)
+
+    return lambda w: _hd_sharded(w, batch)

@@ -1,0 +1,126 @@
+"""Sparse distributed GLM fit: the Criteo-path optimization problem.
+
+Reference parity: optimization/DistributedOptimizationProblem.scala bound to
+a sparse DistributedGLMLossFunction. Same optimizer state machines as the
+dense path (L-BFGS / OWL-QN / TRON run on the dense (d,) coefficient
+vector); only the objective evaluation is sparse. With
+``feature_sharded=True`` the coefficient dimension is padded to a multiple
+of the mesh's ``model`` axis and every optimizer array (w, grads, L-BFGS
+history) carries that sharding — XLA partitions the two-loop recursion's
+dots and axpys automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_ml_tpu.data.sparse import SparseBatch
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim import (OptResult, l1_weights_vector, optimize,
+                                 with_l2, with_l2_hvp)
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType,
+                                         resolve_optimizer_config,
+                                         variances_from_diagonal)
+from photon_ml_tpu.optim.regularization import intercept_mask
+from photon_ml_tpu.parallel import sparse_objective as sobj
+from photon_ml_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                         pad_to_multiple)
+
+Array = jax.Array
+
+
+def shard_sparse_batch(batch: SparseBatch, mesh: Mesh) -> SparseBatch:
+    """Pad rows to the data-axis size and place shards on devices."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    k = mesh.shape[DATA_AXIS]
+    padded = batch.pad_to(pad_to_multiple(batch.num_rows, k))
+    return jax.device_put(
+        padded,
+        jax.tree.map(
+            lambda leaf: NamedSharding(
+                mesh, P(DATA_AXIS, *(None,) * (np.ndim(leaf) - 1))),
+            padded))
+
+
+def _pad_features(batch: SparseBatch, d_pad: int) -> SparseBatch:
+    """Re-point ELL padding slots at the new one-past-end sentinel."""
+    if d_pad == batch.num_features:
+        return batch
+    idx = np.asarray(batch.indices)
+    idx = np.where(idx == batch.num_features, d_pad, idx).astype(np.int32)
+    return SparseBatch(
+        indices=idx, values=batch.values, labels=batch.labels,
+        weights=batch.weights, offsets=batch.offsets, num_features=d_pad)
+
+
+def run(
+    loss: PointwiseLoss,
+    batch: SparseBatch,
+    mesh: Mesh,
+    config: GLMOptimizationConfiguration,
+    initial: Optional[Coefficients] = None,
+    intercept_index: Optional[int] = None,
+    feature_sharded: bool = False,
+    already_sharded: bool = False,
+) -> tuple[Coefficients, OptResult]:
+    """Fit one sparse GLM over the mesh; returns original-dim coefficients."""
+    dim = batch.num_features
+    d_pad = dim
+    if feature_sharded:
+        d_pad = pad_to_multiple(dim, mesh.shape[MODEL_AXIS])
+        batch = _pad_features(batch, d_pad)
+    if not already_sharded:
+        batch = shard_sparse_batch(batch, mesh)
+
+    mask = np.zeros(d_pad, np.float32)
+    mask[:dim] = intercept_mask(dim, intercept_index)
+    mask = jnp.asarray(mask)
+    reg = config.regularization
+    l2 = reg.l2_weight()
+
+    vg = with_l2(
+        sobj.make_value_and_gradient(loss, mesh, batch, feature_sharded),
+        l2, mask)
+    hvp = with_l2_hvp(
+        sobj.make_hvp(loss, mesh, batch, feature_sharded), l2, mask)
+
+    l1 = reg.l1_weight()
+    if l1 > 0.0:
+        l1w = np.zeros(d_pad, np.float32)
+        l1w[:dim] = np.asarray(l1_weights_vector(l1, dim, intercept_index))
+        l1w = jnp.asarray(l1w)
+    else:
+        l1w = None
+    opt_cfg = resolve_optimizer_config(config.optimizer, l1w is not None)
+
+    if initial is not None:
+        w0 = jnp.zeros((d_pad,), jnp.float32).at[:dim].set(initial.means)
+    else:
+        w0 = jnp.zeros((d_pad,), jnp.float32)
+    if feature_sharded:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        w0 = jax.device_put(w0, NamedSharding(mesh, P(MODEL_AXIS)))
+
+    result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+
+    variances = None
+    kind = VarianceComputationType(config.variance_computation)
+    if kind == VarianceComputationType.SIMPLE:
+        diag = sobj.make_hessian_diagonal(loss, mesh, batch,
+                                          feature_sharded)(result.w)
+        variances = variances_from_diagonal(diag, l2, mask)[:dim]
+    elif kind == VarianceComputationType.FULL:
+        raise NotImplementedError(
+            "FULL variance needs the dense d×d Hessian — not available at "
+            "sparse/Criteo scale (use SIMPLE, as the reference does)")
+
+    means = result.w[:dim]
+    return Coefficients(means=means, variances=variances), result
