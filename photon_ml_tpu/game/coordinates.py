@@ -11,8 +11,18 @@ TPU-first design:
 - RandomEffectCoordinate = per-bucket ``vmap``-ped compiled optimizer over
   padded entity blocks (photon_ml_tpu/game/buckets.py), entity axis sharded
   over the mesh, per-lane convergence masks freezing finished entities (P2).
-  One compiled solve per bucket shape, cached across coordinate-descent
-  iterations (shapes are static once bucketing is fixed).
+
+Residency discipline (the point of the rebuild — replaces the reference's
+per-L-BFGS-iteration driver⇄executor broadcast/treeAggregate): every array
+that survives a coordinate-descent step lives on device for the whole run.
+Each coordinate builds its jitted fit program ONCE at construction:
+
+- fixed effect: ``fit(staged_batch, offsets, w0) → w`` — the entire L-BFGS/
+  TRON/OWL-QN while_loop plus psum objective is one cached XLA executable;
+  per CD step the only new inputs are the (n,) offsets and the warm start.
+- random effect: ``fit_bucket(W, offsets, Xb, yb, wb, ex, rows) → W`` —
+  offsets gather, warm-start gather, vmapped solve, and trained-row scatter
+  all happen on device; the (E, d) coefficient table never visits the host.
 
 Both expose ``train_model(offsets, initial)`` and ``score(model)`` plus
 variance computation, mirroring the reference Coordinate contract
@@ -48,7 +58,8 @@ from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
 from photon_ml_tpu.optim.regularization import intercept_mask
 from photon_ml_tpu.parallel import objective as dobj
 from photon_ml_tpu.parallel import problem as dist_problem
-from photon_ml_tpu.parallel.mesh import data_sharded, shard_batch
+from photon_ml_tpu.parallel.mesh import (DATA_AXIS, data_sharded,
+                                         pad_to_multiple, shard_batch)
 
 Array = jax.Array
 
@@ -85,7 +96,57 @@ class FixedEffectCoordinate:
         self.intercept_index = dataset.intercept_index.get(shard_id)
         self._down_sampling_seed = down_sampling_seed
         self._rng = np.random.default_rng(down_sampling_seed)
-        self._X = jnp.asarray(dataset.feature_shards[shard_id])
+        # Stage the full training batch on device ONCE (offsets are a
+        # placeholder — they are the per-CD-step input). shard_batch pads to
+        # a multiple of the data-axis size with zero-weight rows. Scoring
+        # reuses the staged features — no second device copy of X.
+        self._staged = shard_batch(
+            LabeledBatch.build(dataset.feature_shards[shard_id],
+                               dataset.response, dataset.weights),
+            mesh)
+        self._build_fits()
+
+    def _padded_offsets(self, offsets: Array) -> Array:
+        """Extend (n,) offsets with zeros to the staged padded length
+        (padding rows have weight 0, so their offsets are inert)."""
+        offsets = jnp.asarray(offsets)
+        n = self.dataset.num_rows
+        return jnp.zeros((self._staged.num_rows,), offsets.dtype
+                         ).at[:n].set(offsets)
+
+    def _build_fits(self):
+        """(Re)build the cached jitted fit programs for the current config."""
+        cfg = dataclasses.replace(
+            self.config, variance_computation=VarianceComputationType.NONE)
+        loss, mesh, norm = self.loss, self.mesh, self.norm
+        ii = self.intercept_index
+
+        def fit(staged: LabeledBatch, offsets: Array, w0: Array) -> Array:
+            batch = dataclasses.replace(staged,
+                                        offsets=self._padded_offsets(offsets))
+            coef, _ = dist_problem.run(
+                loss, batch, mesh, cfg, initial=Coefficients(w0), norm=norm,
+                intercept_index=ii, already_sharded=True)
+            return coef.means
+
+        def fit_sampled(staged: LabeledBatch, idx: Array, mult: Array,
+                        offsets: Array, w0: Array) -> Array:
+            # Down-sampled pass: gather the subsample on device, rescale
+            # weights, pad back to a data-axis multiple (static shapes: the
+            # samplers return deterministic sizes).
+            sub = LabeledBatch(
+                features=staged.features[idx],
+                labels=staged.labels[idx],
+                weights=staged.weights[idx] * mult,
+                offsets=offsets[idx],
+            ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
+            coef, _ = dist_problem.run(
+                loss, sub, mesh, cfg, initial=Coefficients(w0), norm=norm,
+                intercept_index=ii, already_sharded=True)
+            return coef.means
+
+        self._fit = jax.jit(fit)
+        self._fit_sampled = jax.jit(fit_sampled)
 
     @property
     def dim(self) -> int:
@@ -106,6 +167,7 @@ class FixedEffectCoordinate:
         # SAME down-sampled subsets (grid comparison must not depend on how
         # far a shared RNG advanced in earlier grid points).
         c._rng = np.random.default_rng(self._down_sampling_seed)
+        c._build_fits()
         return c
 
     def train_model(
@@ -113,37 +175,30 @@ class FixedEffectCoordinate:
         offsets: Array,
         initial: Optional[FixedEffectModel] = None,
     ) -> FixedEffectModel:
-        ds = self.dataset
+        if initial is not None:
+            w0 = self.norm.model_to_transformed_space(
+                initial.coefficients.means)
+        else:
+            w0 = jnp.zeros((self.dim,), jnp.float32)
+        offsets = jnp.asarray(offsets)
         rate = self.config.down_sampling_rate
         if rate < 1.0:
             # Reference: DownSampler subsamples the fixed-effect coordinate's
             # data each training pass, rescaling weights by 1/rate. The
             # sampler is picked by TASK (reference behavior), not by
-            # inspecting label values.
+            # inspecting label values. Index draw is host-side (cheap, label
+            # metadata only); the data gather happens on device.
             if self.loss.name in ("logistic", "smoothed_hinge"):
                 idx, mult = binary_classification_down_sample(
-                    self._rng, ds.response, rate)
+                    self._rng, self.dataset.response, rate)
             else:
-                idx, mult = default_down_sample(self._rng, ds.num_rows, rate)
-            batch = LabeledBatch.build(
-                ds.feature_shards[self.shard_id][idx], ds.response[idx],
-                ds.weights[idx] * mult, np.asarray(offsets)[idx])
+                idx, mult = default_down_sample(
+                    self._rng, self.dataset.num_rows, rate)
+            w_t = self._fit_sampled(self._staged, jnp.asarray(idx),
+                                    jnp.asarray(mult), offsets, w0)
         else:
-            batch = LabeledBatch.build(
-                ds.feature_shards[self.shard_id], ds.response, ds.weights,
-                offsets)
-        init = None
-        if initial is not None:
-            init = Coefficients(self.norm.model_to_transformed_space(
-                initial.coefficients.means))
-        # Variances are computed once after descent (compute_model_variances),
-        # not on every training pass.
-        cfg = dataclasses.replace(
-            self.config, variance_computation=VarianceComputationType.NONE)
-        coef, _ = dist_problem.run(
-            self.loss, batch, self.mesh, cfg, initial=init,
-            norm=self.norm, intercept_index=self.intercept_index)
-        raw = Coefficients(self.norm.model_to_original_space(coef.means))
+            w_t = self._fit(self._staged, offsets, w0)
+        raw = Coefficients(self.norm.model_to_original_space(w_t))
         return FixedEffectModel(shard_id=self.shard_id, coefficients=raw)
 
     def compute_model_variances(
@@ -158,9 +213,8 @@ class FixedEffectCoordinate:
         kind = VarianceComputationType(self.config.variance_computation)
         if kind == VarianceComputationType.NONE:
             return model
-        batch = shard_batch(LabeledBatch.build(
-            self.dataset.feature_shards[self.shard_id], self.dataset.response,
-            self.dataset.weights, offsets), self.mesh)
+        batch = dataclasses.replace(self._staged,
+                                    offsets=self._padded_offsets(offsets))
         w_t = self.norm.model_to_transformed_space(model.coefficients.means)
         mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
         l2 = self.config.regularization.l2_weight()
@@ -178,7 +232,8 @@ class FixedEffectCoordinate:
 
     def score(self, model: FixedEffectModel) -> Array:
         """Raw-space score (identical to the training margins by algebra)."""
-        return self._X @ model.coefficients.means
+        n = self.dataset.num_rows
+        return (self._staged.features @ model.coefficients.means)[:n]
 
     def initial_model(self) -> FixedEffectModel:
         return FixedEffectModel(
@@ -227,17 +282,81 @@ class RandomEffectCoordinate:
             rng=np.random.default_rng(seed))
         self._X = jnp.asarray(dataset.feature_shards[shard_id])
         self._ids = jnp.asarray(dataset.entity_ids[re_type])
-        # Pre-gather static per-bucket arrays (features/labels/weights).
+        # Stage static per-bucket device arrays ONCE: features/labels/weights
+        # in (E_b, cap, …) layout plus the gather/scatter index maps. The
+        # entity axis is sharded over the mesh's data axis (P2) when the
+        # padded entity count divides it.
         self._bucket_data = []
         ds = dataset
         X = ds.feature_shards[shard_id]
+        n_data = mesh.shape[DATA_AXIS]
         for b in self.bucketing.buckets:
             Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
             wb = bkt.bucket_weights(b, ds.weights)
+            ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
+            rows = b.entity_rows  # (E_b,) int32; -1 padding
+
+            def put(a):
+                if a.shape[0] % n_data == 0:
+                    return jax.device_put(a, data_sharded(mesh, a.ndim))
+                return jnp.asarray(a)
+
             self._bucket_data.append(
-                (jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb)))
-        self._solver = self._make_solver(compute_variance=False)
-        self._var_solver = None  # built lazily if variances requested
+                tuple(put(np.asarray(a)) for a in (Xb, yb, wb, ex, rows)))
+        self._build_fits()
+
+    def _build_fits(self):
+        """(Re)build the cached jitted per-bucket fit/variance programs.
+
+        ``fit_bucket`` keeps the whole inner step on device: gather each
+        entity's offsets and warm start, run the vmapped masked-lane solve,
+        scatter trained rows back into the (E, d) table. Padding lanes
+        (rows == -1) are redirected to an out-of-bounds index and dropped by
+        the scatter. One executable per bucket SHAPE, cached by jit across
+        buckets and coordinate-descent iterations.
+        """
+        solve = jax.vmap(self._solve_one)
+        var_one = jax.vmap(self._variance_one)
+        num_entities = self.num_entities
+
+        def fit_bucket(W, offsets, Xb, yb, wb, ex, rows):
+            ob = offsets[jnp.maximum(ex, 0)]
+            w0 = W[jnp.maximum(rows, 0)]
+            w_fit = solve(Xb, yb, wb, ob, w0)
+            safe = jnp.where(rows >= 0, rows, num_entities)
+            return W.at[safe].set(w_fit, mode="drop")
+
+        def var_bucket(W, V, offsets, Xb, yb, wb, ex, rows):
+            ob = offsets[jnp.maximum(ex, 0)]
+            w_opt = W[jnp.maximum(rows, 0)]
+            var = var_one(Xb, yb, wb, ob, w_opt)
+            safe = jnp.where(rows >= 0, rows, num_entities)
+            return V.at[safe].set(var, mode="drop")
+
+        # Donate the table being rebuilt (W for fits, V for variances) so the
+        # scatter updates in place instead of copying (E, d) per bucket.
+        self._fit_bucket = jax.jit(fit_bucket, donate_argnums=(0,))
+        self._var_bucket = jax.jit(var_bucket, donate_argnums=(1,))
+
+    def _solve_one(self, X, y, w, o, w0):
+        """One entity's GLM solve in transformed space (vmapped per bucket)."""
+        batch = LabeledBatch(X, y, w, o)
+        vg, hvp, l1w = make_objective(
+            self.loss, batch, self.norm, self.config.regularization,
+            self.intercept_index, self.dim)
+        opt_cfg = resolve_optimizer_config(
+            self.config.optimizer, l1w is not None)
+        result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+        return result.w
+
+    def _variance_one(self, X, y, w, o, w_opt):
+        """Variances at the trained optimum (no re-solve; reference
+        computeVariances evaluates the Hessian at the model coefficients)."""
+        batch = LabeledBatch(X, y, w, o)
+        return compute_variances(
+            self.loss, w_opt, batch, self.norm,
+            self.config.variance_computation, self.config.regularization,
+            self.intercept_index)
 
     @property
     def dim(self) -> int:
@@ -248,37 +367,13 @@ class RandomEffectCoordinate:
     ) -> "RandomEffectCoordinate":
         """Cheap copy with a new optimization config, reusing the bucketing
         and the staged per-bucket device arrays (the expensive part of
-        __init__). Only the jitted solver is rebuilt."""
+        __init__). Only the jitted programs are rebuilt."""
         import copy
 
         c = copy.copy(self)
         c.config = config
-        c._solver = c._make_solver(compute_variance=False)
-        c._var_solver = None
+        c._build_fits()
         return c
-
-    def _make_solver(self, compute_variance: bool):
-        loss = self.loss
-        config = self.config
-        intercept_index = self.intercept_index
-        dim = self.dim
-        norm = self.norm
-
-        def solve_one(X, y, w, o, w0):
-            batch = LabeledBatch(X, y, w, o)
-            vg, hvp, l1w = make_objective(
-                loss, batch, norm, config.regularization, intercept_index, dim)
-            opt_cfg = resolve_optimizer_config(config.optimizer, l1w is not None)
-            result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
-            if compute_variance:
-                var = compute_variances(
-                    loss, result.w, batch, norm, config.variance_computation,
-                    config.regularization, intercept_index)
-            else:
-                var = jnp.zeros_like(result.w)
-            return result.w, var
-
-        return jax.jit(jax.vmap(solve_one))
 
     def train_model(
         self,
@@ -287,19 +382,16 @@ class RandomEffectCoordinate:
     ) -> RandomEffectModel:
         # Warm starts arrive in original space; solve in transformed space.
         if initial is None:
-            W = np.zeros((self.num_entities, self.dim), np.float32)
+            W = jnp.zeros((self.num_entities, self.dim), jnp.float32)
         else:
-            W = np.array(
-                self.norm.model_to_transformed_space(initial.means))
-        offsets_np = np.asarray(offsets)
-        for b, (Xb, yb, wb) in zip(self.bucketing.buckets, self._bucket_data):
-            ob = jnp.asarray(offsets_np[np.maximum(b.example_idx, 0)])
-            w0 = jnp.asarray(W[np.maximum(b.entity_rows, 0)])
-            w_fit, _ = self._solver(Xb, yb, wb, ob, w0)
-            w_fit = np.asarray(w_fit)
-            live = b.entity_rows >= 0
-            W[b.entity_rows[live]] = w_fit[live]
-        W_raw = self.norm.model_to_original_space(jnp.asarray(W))
+            # Explicit copy: fit_bucket donates W, and with identity
+            # normalization the transform may alias the model's own buffer.
+            W = jnp.array(
+                self.norm.model_to_transformed_space(initial.means), copy=True)
+        offsets = jnp.asarray(offsets)
+        for (Xb, yb, wb, ex, rows) in self._bucket_data:
+            W = self._fit_bucket(W, offsets, Xb, yb, wb, ex, rows)
+        W_raw = self.norm.model_to_original_space(W)
         return RandomEffectModel(
             re_type=self.re_type, shard_id=self.shard_id, means=W_raw)
 
@@ -310,21 +402,14 @@ class RandomEffectCoordinate:
         if VarianceComputationType(self.config.variance_computation) == \
                 VarianceComputationType.NONE:
             return model
-        if self._var_solver is None:
-            self._var_solver = self._make_solver(compute_variance=True)
-        W = np.array(self.norm.model_to_transformed_space(model.means))
-        V = np.zeros_like(W)
-        offsets_np = np.asarray(offsets)
-        for b, (Xb, yb, wb) in zip(self.bucketing.buckets, self._bucket_data):
-            ob = jnp.asarray(offsets_np[np.maximum(b.example_idx, 0)])
-            w0 = jnp.asarray(W[np.maximum(b.entity_rows, 0)])
-            _, var = self._var_solver(Xb, yb, wb, ob, w0)
-            var = np.asarray(var)
-            live = b.entity_rows >= 0
-            V[b.entity_rows[live]] = var[live]
+        W = jnp.asarray(self.norm.model_to_transformed_space(model.means))
+        V = jnp.zeros_like(W)
+        offsets = jnp.asarray(offsets)
+        for (Xb, yb, wb, ex, rows) in self._bucket_data:
+            V = self._var_bucket(W, V, offsets, Xb, yb, wb, ex, rows)
         if self.norm.factors is not None:
-            V = V * np.asarray(self.norm.factors) ** 2
-        return dataclasses.replace(model, variances=jnp.asarray(V))
+            V = V * jnp.asarray(self.norm.factors) ** 2
+        return dataclasses.replace(model, variances=V)
 
     def score(self, model: RandomEffectModel) -> Array:
         return jnp.einsum("nd,nd->n", self._X, model.means[self._ids])
