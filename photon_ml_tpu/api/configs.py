@@ -37,6 +37,10 @@ class RandomEffectDataConfiguration:
     feature_shard_id: str
     active_data_lower_bound: int = 1
     active_data_upper_bound: Optional[int] = None
+    # Per-entity feature-subspace projection (reference projectorType:
+    # INDEX_MAP builds a LinearSubspaceProjector per entity; NONE solves at
+    # the full shard dimension).
+    projector: str = "NONE"
 
 
 CoordinateDataConfiguration = Union[FixedEffectDataConfiguration,

@@ -97,7 +97,8 @@ class GameEstimator:
                     lower_bound=cc.data.active_data_lower_bound,
                     upper_bound=cc.data.active_data_upper_bound,
                     norm=self.normalization.get(cc.data.feature_shard_id,
-                                                NormalizationContext()))
+                                                NormalizationContext()),
+                    projection=cc.data.projector.upper() == "INDEX_MAP")
             else:  # pragma: no cover
                 raise TypeError(type(cc.data))
         return coords

@@ -104,7 +104,8 @@ def run(args) -> dict:
                 feature_shard_id=kv["shard"],
                 active_data_lower_bound=int(kv.get("min_samples", 1)),
                 active_data_upper_bound=(int(kv["max_samples"])
-                                         if "max_samples" in kv else None))
+                                         if "max_samples" in kv else None),
+                projector=kv.get("projector", "NONE").upper())
         else:
             raise ValueError(f"unknown coordinate type {kv['type']!r}")
         coordinates[name] = CoordinateConfiguration(

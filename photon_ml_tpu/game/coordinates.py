@@ -42,6 +42,7 @@ import numpy as np
 from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game import buckets as bkt
+from photon_ml_tpu.game import projector as prj
 from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.game.sampling import (binary_classification_down_sample,
                                          default_down_sample)
@@ -251,6 +252,13 @@ class RandomEffectCoordinate:
     Model-space contract: same as FixedEffectCoordinate — solves run in the
     shard's normalization-transformed space; the RandomEffectModel rows are
     ORIGINAL-space, so scoring is the plain gather + rowwise dot everywhere.
+
+    ``projection=True`` enables the per-entity feature-subspace projector
+    (reference: LinearSubspaceProjector + IndexMapProjectorRDD, SURVEY §2.1/
+    §2.2): each bucket stages features at d_active ≪ d (the union of columns
+    its entities actually use), solves in the projected space, and scatters
+    coefficients back to full-space rows — the difference between feasible
+    and OOM when the RE feature space is large and per-entity sparse.
     """
 
     def __init__(
@@ -265,6 +273,7 @@ class RandomEffectCoordinate:
         upper_bound: Optional[int] = None,
         norm: NormalizationContext = NormalizationContext(),
         seed: int = 0,
+        projection: bool = False,
     ):
         self.dataset = dataset
         self.re_type = re_type
@@ -282,27 +291,50 @@ class RandomEffectCoordinate:
             rng=np.random.default_rng(seed))
         self._X = jnp.asarray(dataset.feature_shards[shard_id])
         self._ids = jnp.asarray(dataset.entity_ids[re_type])
+        self.projection = bool(projection)
         # Stage static per-bucket device arrays ONCE: features/labels/weights
         # in (E_b, cap, …) layout plus the gather/scatter index maps. The
         # entity axis is sharded over the mesh's data axis (P2) when the
-        # padded entity count divides it.
+        # padded entity count divides it. With projection on, features are
+        # staged directly at (E_b, cap, d_active) and each tuple carries the
+        # (E_b, d_active) column map plus projected normalization arrays.
         self._bucket_data = []
         ds = dataset
         X = ds.feature_shards[shard_id]
         n_data = mesh.shape[DATA_AXIS]
+
+        def put(a):
+            if a.shape[0] % n_data == 0:
+                return jax.device_put(a, data_sharded(mesh, a.ndim))
+            return jnp.asarray(a)
+
+        # Shifts without factors cannot occur via build_normalization; guard
+        # the manual case so the projected solve has one layout.
+        f_full = None if norm.factors is None else np.asarray(norm.factors)
+        s_full = None if norm.shifts is None else np.asarray(norm.shifts)
+        if s_full is not None and f_full is None:
+            f_full = np.ones_like(s_full)
+
         for b in self.bucketing.buckets:
-            Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
             wb = bkt.bucket_weights(b, ds.weights)
             ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
             rows = b.entity_rows  # (E_b,) int32; -1 padding
-
-            def put(a):
-                if a.shape[0] % n_data == 0:
-                    return jax.device_put(a, data_sharded(mesh, a.ndim))
-                return jnp.asarray(a)
-
+            if self.projection:
+                proj = prj.build_bucket_projection(b, X, self.intercept_index)
+                Xb = prj.gather_projected_features(b, proj, X)
+                (yb,) = bkt.gather_bucket_arrays(b, ds.response)
+                f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
+                extra = [proj.cols]
+                if f_full is not None:
+                    extra.append(f_p)
+                if s_full is not None:
+                    extra.append(s_p)
+                arrays = (Xb, yb, wb, ex, rows, *extra)
+            else:
+                Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
+                arrays = (Xb, yb, wb, ex, rows)
             self._bucket_data.append(
-                tuple(put(np.asarray(a)) for a in (Xb, yb, wb, ex, rows)))
+                tuple(put(np.asarray(a)) for a in arrays))
         self._build_fits()
 
     def _build_fits(self):
@@ -314,10 +346,19 @@ class RandomEffectCoordinate:
         (rows == -1) are redirected to an out-of-bounds index and dropped by
         the scatter. One executable per bucket SHAPE, cached by jit across
         buckets and coordinate-descent iterations.
+
+        Projected variant: warm starts are gathered through each entity's
+        column map (original space, since transforms are per-entity), solved
+        at d_active with a per-entity NormalizationContext, mapped back to
+        original space in-lane, and scattered through the column map; the
+        W table stays in ORIGINAL space throughout.
         """
+        num_entities = self.num_entities
+        if self.projection:
+            self._fit_bucket, self._var_bucket = self._build_projected_fits()
+            return
         solve = jax.vmap(self._solve_one)
         var_one = jax.vmap(self._variance_one)
-        num_entities = self.num_entities
 
         def fit_bucket(W, offsets, Xb, yb, wb, ex, rows):
             ob = offsets[jnp.maximum(ex, 0)]
@@ -337,6 +378,79 @@ class RandomEffectCoordinate:
         # scatter updates in place instead of copying (E, d) per bucket.
         self._fit_bucket = jax.jit(fit_bucket, donate_argnums=(0,))
         self._var_bucket = jax.jit(var_bucket, donate_argnums=(1,))
+
+    def _build_projected_fits(self):
+        """Jitted per-bucket programs for the projected (d_active) path."""
+        num_entities = self.num_entities
+        dim = self.dim
+        has_f = not (self.norm.factors is None and self.norm.shifts is None)
+        has_s = self.norm.shifts is not None
+        ii_proj = 0 if self.intercept_index is not None else None
+        loss, config = self.loss, self.config
+
+        def ctx_for(f, s):
+            if not has_f:
+                return NormalizationContext()
+            return NormalizationContext(factors=f, shifts=s,
+                                        intercept_index=ii_proj)
+
+        def solve_one(X, y, w, o, w0_orig, f, s):
+            """One entity's projected solve; original space in and out."""
+            ctx = ctx_for(f, s)
+            batch = LabeledBatch(X, y, w, o)
+            vg, hvp, l1w = make_objective(
+                loss, batch, ctx, config.regularization, ii_proj, X.shape[-1])
+            opt_cfg = resolve_optimizer_config(
+                config.optimizer, l1w is not None)
+            w0 = ctx.model_to_transformed_space(w0_orig)
+            result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+            return ctx.model_to_original_space(result.w)
+
+        def var_one(X, y, w, o, w_orig, f, s):
+            ctx = ctx_for(f, s)
+            batch = LabeledBatch(X, y, w, o)
+            w_t = ctx.model_to_transformed_space(w_orig)
+            var_t = compute_variances(
+                loss, w_t, batch, ctx, config.variance_computation,
+                config.regularization, ii_proj)
+            return ctx.variances_to_original_space(var_t)
+
+        # vmap lanes: norm arrays are per-entity when present, else closed
+        # over as None (static).
+        norm_axes = (0 if has_f else None, 0 if has_s else None)
+        vsolve = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0) + norm_axes)
+        vvar = jax.vmap(var_one, in_axes=(0, 0, 0, 0, 0) + norm_axes)
+
+        def unpack(extra):
+            cols = extra[0]
+            f = extra[1] if has_f else None
+            s = extra[2 if has_f else 1] if has_s else None
+            return cols, f, s
+
+        def gathers(W, offsets, ex, rows, cols):
+            ob = offsets[jnp.maximum(ex, 0)]
+            valid = (cols >= 0).astype(W.dtype)
+            w0 = W[jnp.maximum(rows, 0)[:, None],
+                   jnp.maximum(cols, 0)] * valid
+            safe_rows = jnp.where(rows >= 0, rows, num_entities)
+            safe_cols = jnp.where(cols >= 0, cols, dim)
+            return ob, w0, safe_rows, safe_cols
+
+        def fit_bucket(W, offsets, Xb, yb, wb, ex, rows, *extra):
+            cols, f, s = unpack(extra)
+            ob, w0, safe_rows, safe_cols = gathers(W, offsets, ex, rows, cols)
+            w_fit = vsolve(Xb, yb, wb, ob, w0, f, s)
+            return W.at[safe_rows[:, None], safe_cols].set(w_fit, mode="drop")
+
+        def var_bucket(W, V, offsets, Xb, yb, wb, ex, rows, *extra):
+            cols, f, s = unpack(extra)
+            ob, w_opt, safe_rows, safe_cols = gathers(W, offsets, ex, rows,
+                                                      cols)
+            var = vvar(Xb, yb, wb, ob, w_opt, f, s)
+            return V.at[safe_rows[:, None], safe_cols].set(var, mode="drop")
+
+        return (jax.jit(fit_bucket, donate_argnums=(0,)),
+                jax.jit(var_bucket, donate_argnums=(1,)))
 
     def _solve_one(self, X, y, w, o, w0):
         """One entity's GLM solve in transformed space (vmapped per bucket)."""
@@ -380,18 +494,22 @@ class RandomEffectCoordinate:
         offsets: Array,
         initial: Optional[RandomEffectModel] = None,
     ) -> RandomEffectModel:
-        # Warm starts arrive in original space; solve in transformed space.
+        # Warm starts arrive in original space. Unprojected path: the W table
+        # is transformed once at entry and mapped back once at exit.
+        # Projected path: transforms are per-entity inside the bucket fit, so
+        # W stays in original space throughout.
         if initial is None:
             W = jnp.zeros((self.num_entities, self.dim), jnp.float32)
+        elif self.projection:
+            # Explicit copies: fit_bucket donates W.
+            W = jnp.array(initial.means, copy=True)
         else:
-            # Explicit copy: fit_bucket donates W, and with identity
-            # normalization the transform may alias the model's own buffer.
             W = jnp.array(
                 self.norm.model_to_transformed_space(initial.means), copy=True)
         offsets = jnp.asarray(offsets)
-        for (Xb, yb, wb, ex, rows) in self._bucket_data:
-            W = self._fit_bucket(W, offsets, Xb, yb, wb, ex, rows)
-        W_raw = self.norm.model_to_original_space(W)
+        for arrays in self._bucket_data:
+            W = self._fit_bucket(W, offsets, *arrays)
+        W_raw = W if self.projection else self.norm.model_to_original_space(W)
         return RandomEffectModel(
             re_type=self.re_type, shard_id=self.shard_id, means=W_raw)
 
@@ -402,12 +520,17 @@ class RandomEffectCoordinate:
         if VarianceComputationType(self.config.variance_computation) == \
                 VarianceComputationType.NONE:
             return model
-        W = jnp.asarray(self.norm.model_to_transformed_space(model.means))
-        V = jnp.zeros_like(W)
+        if self.projection:
+            # Per-entity transforms (and the original-space mapping) happen
+            # inside var_bucket; W stays original space.
+            W = jnp.asarray(model.means)
+        else:
+            W = jnp.asarray(self.norm.model_to_transformed_space(model.means))
+        V = jnp.zeros((self.num_entities, self.dim), jnp.float32)
         offsets = jnp.asarray(offsets)
-        for (Xb, yb, wb, ex, rows) in self._bucket_data:
-            V = self._var_bucket(W, V, offsets, Xb, yb, wb, ex, rows)
-        if self.norm.factors is not None:
+        for arrays in self._bucket_data:
+            V = self._var_bucket(W, V, offsets, *arrays)
+        if not self.projection and self.norm.factors is not None:
             V = V * jnp.asarray(self.norm.factors) ** 2
         return dataclasses.replace(model, variances=V)
 

@@ -1,0 +1,146 @@
+"""Per-entity feature-subspace projection for random effects.
+
+Reference parity: photon-lib ``projector/LinearSubspaceProjector.scala``
+(global feature space ↔ the subspace of features actually present in one
+entity's data; pure index-set math) and photon-api
+``projector/IndexMapProjectorRDD.scala`` (build one projector per entity,
+project active data forward and trained models back).
+
+TPU-first design: instead of one projector object per entity, a bucket of
+entities carries ONE (E_b, d_active) int32 column-index matrix ``cols``:
+
+    cols[e, j] = global column of entity e's j-th active feature (−1 pad)
+
+Features are gathered straight into projected bucket layout on the host —
+``X[example_idx[:, :, None], cols[:, None, :]]`` — so the dense
+(E_b, cap, d) block is never materialized; solves run at d_active ≪ d.
+Coefficients live in the full space (the (E, d) table) and are
+gathered/scattered through ``cols`` on device (projectForward /
+projectBackward).
+
+Conventions:
+- If the shard has an intercept column it is ALWAYS active and is placed at
+  projected slot 0, giving a static intercept index for regularization
+  masks and normalization shift-folding under ``vmap``.
+- Padded slots (cols == −1) have features zeroed, normalization factor 0 and
+  shift 0, and warm starts zeroed, so their coefficients stay exactly 0 and
+  contribute nothing to value/gradient; the backward scatter drops them.
+- ``d_active`` is one power-of-two bucket-wide width (max over the bucket's
+  entities) — entities in a bucket share one padded projected width, the
+  shape-bucketing trick applied to the feature axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.game.buckets import EntityBucket
+
+
+@dataclasses.dataclass
+class BucketProjection:
+    """Per-entity active-column index map for one bucket."""
+
+    cols: np.ndarray  # (E_b, d_active) int32 global column ids; -1 pad
+    d_active: int
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.cols.shape[0])
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, int(np.ceil(np.log2(max(1, x)))))
+
+
+def build_bucket_projection(
+    bucket: EntityBucket,
+    X: np.ndarray,
+    intercept_index: Optional[int],
+    min_dim: int = 8,
+) -> BucketProjection:
+    """Compute each entity's active feature subspace for one bucket.
+
+    A column is active for an entity iff any of the entity's (kept) examples
+    has a nonzero value there (reference LinearSubspaceProjector: the index
+    set of features present in the entity's data).
+    """
+    d = X.shape[1]
+    ex = bucket.example_idx  # (E_b, cap), -1 pad
+    live_rows = bucket.entity_rows >= 0
+    # (E_b, cap, d) boolean would be large; go entity-by-entity (one-time
+    # host staging cost, ~O(nnz)).
+    active_sets: list[np.ndarray] = []
+    max_active = 1
+    for e in range(ex.shape[0]):
+        if not live_rows[e]:
+            active_sets.append(np.empty((0,), np.int64))
+            continue
+        idx = ex[e]
+        idx = idx[idx >= 0]
+        mask = np.any(X[idx] != 0.0, axis=0)
+        if intercept_index is not None:
+            mask[intercept_index] = True
+        cols_e = np.flatnonzero(mask)
+        if intercept_index is not None:
+            # Intercept first: static projected intercept slot 0.
+            cols_e = np.concatenate(
+                [[intercept_index], cols_e[cols_e != intercept_index]])
+        active_sets.append(cols_e)
+        max_active = max(max_active, len(cols_e))
+
+    d_active = min(d, max(min_dim, _next_pow2(max_active)))
+    # An entity with more active columns than d_active cannot be truncated —
+    # widen (can only happen via min() capping above, where d_active == d).
+    cols = np.full((ex.shape[0], d_active), -1, np.int32)
+    for e, cols_e in enumerate(active_sets):
+        cols[e, : len(cols_e)] = cols_e
+    return BucketProjection(cols=cols, d_active=d_active)
+
+
+def gather_projected_features(
+    bucket: EntityBucket,
+    projection: BucketProjection,
+    X: np.ndarray,
+) -> np.ndarray:
+    """Project features forward into (E_b, cap, d_active) bucket layout.
+
+    Padded example rows and padded column slots are zeroed (inert under the
+    zero-weight / zero-feature contracts).
+    """
+    ex = np.maximum(bucket.example_idx, 0)  # (E_b, cap)
+    cols = np.maximum(projection.cols, 0)  # (E_b, d_active)
+    Xp = X[ex[:, :, None], cols[:, None, :]].astype(X.dtype, copy=False)
+    Xp = np.where(projection.cols[:, None, :] < 0, 0.0, Xp)
+    Xp = np.where(bucket.example_idx[:, :, None] < 0, 0.0, Xp)
+    return np.ascontiguousarray(Xp)
+
+
+def project_norm_arrays(
+    projection: BucketProjection,
+    factors: Optional[np.ndarray],
+    shifts: Optional[np.ndarray],
+) -> tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """Project normalization factors/shifts into each entity's subspace.
+
+    Padded slots get factor 1 / shift 0 (the intercept-column convention):
+    with their features zeroed by ``gather_projected_features`` the
+    transformed feature (0 − 0)·1 is identically 0, so padded coordinates
+    see zero gradient and stay at their (zeroed) warm start, while the
+    model-space transforms (divide by factor, shift-mass sums) remain
+    well-defined.
+    """
+    cols = np.maximum(projection.cols, 0)
+    pad = projection.cols < 0
+    f_p = None
+    if factors is not None:
+        f_p = np.asarray(factors)[cols].astype(np.float32)
+        f_p[pad] = 1.0
+    s_p = None
+    if shifts is not None:
+        s_p = np.asarray(shifts)[cols].astype(np.float32)
+        s_p[pad] = 0.0
+    return f_p, s_p
