@@ -42,6 +42,12 @@ class RandomEffectDataConfiguration:
     # the full shard dimension).
     projector: str = "NONE"
 
+    def __post_init__(self):
+        if self.projector.upper() not in ("NONE", "INDEX_MAP"):
+            raise ValueError(
+                f"unknown projector {self.projector!r}; "
+                "expected NONE or INDEX_MAP")
+
 
 CoordinateDataConfiguration = Union[FixedEffectDataConfiguration,
                                     RandomEffectDataConfiguration]

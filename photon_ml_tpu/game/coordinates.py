@@ -64,6 +64,10 @@ from photon_ml_tpu.parallel.mesh import (DATA_AXIS, data_sharded,
 
 Array = jax.Array
 
+# Sentinel distinguishing "use the coordinate's intercept" from an explicit
+# None (projected buckets with no intercept column).
+_UNSET = object()
+
 
 class FixedEffectCoordinate:
     """One shared GLM trained data-parallel over the mesh.
@@ -386,7 +390,6 @@ class RandomEffectCoordinate:
         has_f = not (self.norm.factors is None and self.norm.shifts is None)
         has_s = self.norm.shifts is not None
         ii_proj = 0 if self.intercept_index is not None else None
-        loss, config = self.loss, self.config
 
         def ctx_for(f, s):
             if not has_f:
@@ -397,22 +400,16 @@ class RandomEffectCoordinate:
         def solve_one(X, y, w, o, w0_orig, f, s):
             """One entity's projected solve; original space in and out."""
             ctx = ctx_for(f, s)
-            batch = LabeledBatch(X, y, w, o)
-            vg, hvp, l1w = make_objective(
-                loss, batch, ctx, config.regularization, ii_proj, X.shape[-1])
-            opt_cfg = resolve_optimizer_config(
-                config.optimizer, l1w is not None)
             w0 = ctx.model_to_transformed_space(w0_orig)
-            result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
-            return ctx.model_to_original_space(result.w)
+            w_t = self._solve_one(X, y, w, o, w0, norm=ctx,
+                                  intercept_index=ii_proj)
+            return ctx.model_to_original_space(w_t)
 
         def var_one(X, y, w, o, w_orig, f, s):
             ctx = ctx_for(f, s)
-            batch = LabeledBatch(X, y, w, o)
             w_t = ctx.model_to_transformed_space(w_orig)
-            var_t = compute_variances(
-                loss, w_t, batch, ctx, config.variance_computation,
-                config.regularization, ii_proj)
+            var_t = self._variance_one(X, y, w, o, w_t, norm=ctx,
+                                       intercept_index=ii_proj)
             return ctx.variances_to_original_space(var_t)
 
         # vmap lanes: norm arrays are per-entity when present, else closed
@@ -440,6 +437,10 @@ class RandomEffectCoordinate:
             cols, f, s = unpack(extra)
             ob, w0, safe_rows, safe_cols = gathers(W, offsets, ex, rows, cols)
             w_fit = vsolve(Xb, yb, wb, ob, w0, f, s)
+            # projectBackward semantics: a trained entity's FULL row is
+            # rewritten — zero it first so inactive-column mass from an
+            # external (e.g. unprojected) warm start cannot survive.
+            W = W.at[safe_rows].set(0.0, mode="drop")
             return W.at[safe_rows[:, None], safe_cols].set(w_fit, mode="drop")
 
         def var_bucket(W, V, offsets, Xb, yb, wb, ex, rows, *extra):
@@ -452,25 +453,37 @@ class RandomEffectCoordinate:
         return (jax.jit(fit_bucket, donate_argnums=(0,)),
                 jax.jit(var_bucket, donate_argnums=(1,)))
 
-    def _solve_one(self, X, y, w, o, w0):
-        """One entity's GLM solve in transformed space (vmapped per bucket)."""
+    def _solve_one(self, X, y, w, o, w0, norm=None, intercept_index=_UNSET):
+        """One entity's GLM solve in transformed space (vmapped per bucket).
+
+        The projected path passes a per-entity NormalizationContext and the
+        projected intercept slot; the unprojected path uses the coordinate's
+        own (closed-over) full-space values.
+        """
+        norm = self.norm if norm is None else norm
+        ii = self.intercept_index if intercept_index is _UNSET \
+            else intercept_index
         batch = LabeledBatch(X, y, w, o)
         vg, hvp, l1w = make_objective(
-            self.loss, batch, self.norm, self.config.regularization,
-            self.intercept_index, self.dim)
+            self.loss, batch, norm, self.config.regularization,
+            ii, X.shape[-1])
         opt_cfg = resolve_optimizer_config(
             self.config.optimizer, l1w is not None)
         result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
         return result.w
 
-    def _variance_one(self, X, y, w, o, w_opt):
+    def _variance_one(self, X, y, w, o, w_opt, norm=None,
+                      intercept_index=_UNSET):
         """Variances at the trained optimum (no re-solve; reference
         computeVariances evaluates the Hessian at the model coefficients)."""
+        norm = self.norm if norm is None else norm
+        ii = self.intercept_index if intercept_index is _UNSET \
+            else intercept_index
         batch = LabeledBatch(X, y, w, o)
         return compute_variances(
-            self.loss, w_opt, batch, self.norm,
+            self.loss, w_opt, batch, norm,
             self.config.variance_computation, self.config.regularization,
-            self.intercept_index)
+            ii)
 
     @property
     def dim(self) -> int:
@@ -530,8 +543,12 @@ class RandomEffectCoordinate:
         offsets = jnp.asarray(offsets)
         for arrays in self._bucket_data:
             V = self._var_bucket(W, V, offsets, *arrays)
-        if not self.projection and self.norm.factors is not None:
-            V = V * jnp.asarray(self.norm.factors) ** 2
+        if not self.projection and (self.norm.factors is not None
+                                    or self.norm.shifts is not None):
+            # Same diagonal-approximation transform the projected path and
+            # FixedEffectCoordinate use (factor² scaling + intercept
+            # shift-mass term).
+            V = self.norm.variances_to_original_space(V)
         return dataclasses.replace(model, variances=V)
 
     def score(self, model: RandomEffectModel) -> Array:

@@ -22,8 +22,9 @@ Conventions:
 - If the shard has an intercept column it is ALWAYS active and is placed at
   projected slot 0, giving a static intercept index for regularization
   masks and normalization shift-folding under ``vmap``.
-- Padded slots (cols == −1) have features zeroed, normalization factor 0 and
-  shift 0, and warm starts zeroed, so their coefficients stay exactly 0 and
+- Padded slots (cols == −1) have features zeroed, normalization factor 1 and
+  shift 0 (factor 1, not 0 — ``model_to_transformed_space`` divides by the
+  factor), and warm starts zeroed, so their coefficients stay exactly 0 and
   contribute nothing to value/gradient; the backward scatter drops them.
 - ``d_active`` is one power-of-two bucket-wide width (max over the bucket's
   entities) — entities in a bucket share one padded projected width, the

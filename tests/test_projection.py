@@ -1,0 +1,281 @@
+"""Per-entity feature-subspace projection tests.
+
+Mirrors the reference's projector tests (SURVEY.md §2.1/§2.2:
+``LinearSubspaceProjectorTest`` — forward/backward index math — and the
+integration-level equivalence the survey calls out in §7 hard parts:
+**projected fit == unprojected fit on small data**).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.game import buckets as bkt
+from photon_ml_tpu.game import projector as prj
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.normalization import (NormalizationType,
+                                         build_normalization)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _config(l2=1.0, variance=VarianceComputationType.NONE):
+    return GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=80, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, l2),
+        variance_computation=variance)
+
+
+def _sparse_entity_game(rng, n=900, ne=30, d=16):
+    """GAME data where each entity only ever touches a few RE columns.
+
+    This is the regime the projector exists for (reference: per-entity
+    sparse name+term features): entity e's examples have nonzeros only in
+    a small entity-specific column subset (plus the intercept).
+    """
+    syn = synthetic.game_data(rng, n=n, d_global=6,
+                             re_specs={"userId": (ne, d)})
+    ds = from_synthetic(syn)
+    X = ds.feature_shards["re_userId"].copy()
+    ids = ds.entity_ids["userId"]
+    keep = {}
+    for e in range(ne):
+        # 3 active columns per entity + intercept (last column).
+        cols = rng.choice(d - 1, size=3, replace=False)
+        keep[e] = np.concatenate([cols, [d - 1]])
+        mask = np.zeros(d, bool)
+        mask[keep[e]] = True
+        X[ids == e] = np.where(mask[None, :], X[ids == e], 0.0)
+    ds.feature_shards["re_userId"] = X
+    return ds, keep
+
+
+# ------------------------------------------------------------------ unit level
+
+
+def test_projection_cols_are_exact_active_sets(rng):
+    ds, keep = _sparse_entity_game(rng)
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    b = bkt.build_bucketing(ids, ds.num_entities["userId"])
+    ii = ds.intercept_index["re_userId"]
+    for bucket in b.buckets:
+        proj = prj.build_bucket_projection(bucket, X, ii)
+        live = bucket.entity_rows >= 0
+        for lane, e in enumerate(bucket.entity_rows):
+            if not live[lane]:
+                continue
+            got = proj.cols[lane]
+            got = set(got[got >= 0].tolist())
+            # Active set is a subset of the planted columns (a planted column
+            # can be all-zero by chance in the draw) and must contain the
+            # intercept.
+            assert got <= set(keep[e].tolist())
+            assert ii in got
+            # Intercept pinned to projected slot 0 (static index for masks).
+            assert proj.cols[lane, 0] == ii
+
+
+def test_gather_projected_matches_dense_columns(rng):
+    ds, _ = _sparse_entity_game(rng, n=400)
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    b = bkt.build_bucketing(ids, ds.num_entities["userId"])
+    ii = ds.intercept_index["re_userId"]
+    for bucket in b.buckets:
+        proj = prj.build_bucket_projection(bucket, X, ii)
+        Xp = prj.gather_projected_features(bucket, proj, X)
+        assert Xp.shape == (bucket.num_entities, bucket.capacity,
+                            proj.d_active)
+        for lane in range(bucket.num_entities):
+            if bucket.entity_rows[lane] < 0:
+                assert np.all(Xp[lane] == 0.0)
+                continue
+            for slot in range(bucket.capacity):
+                ex = bucket.example_idx[lane, slot]
+                for j in range(proj.d_active):
+                    c = proj.cols[lane, j]
+                    want = X[ex, c] if (ex >= 0 and c >= 0) else 0.0
+                    assert Xp[lane, slot, j] == want
+
+
+def test_projection_shrinks_solve_width(rng):
+    """The point of the projector: d_active ≪ d for per-entity-sparse data."""
+    ds, _ = _sparse_entity_game(rng, d=64)
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    b = bkt.build_bucketing(ids, ds.num_entities["userId"])
+    ii = ds.intercept_index["re_userId"]
+    for bucket in b.buckets:
+        proj = prj.build_bucket_projection(bucket, X, ii)
+        assert proj.d_active <= 8  # 4 active cols/entity → pow2 pad ≤ 8 ≪ 64
+
+
+def test_project_norm_arrays_pad_conventions(rng):
+    cols = np.array([[5, 2, -1, -1], [0, 1, 3, -1]], np.int32)
+    proj = prj.BucketProjection(cols=cols, d_active=4)
+    factors = np.arange(1.0, 7.0, dtype=np.float32)
+    shifts = np.arange(0.0, 0.6, 0.1, dtype=np.float32)
+    f_p, s_p = prj.project_norm_arrays(proj, factors, shifts)
+    np.testing.assert_allclose(f_p[0], [6.0, 3.0, 1.0, 1.0])
+    np.testing.assert_allclose(s_p[0], [0.5, 0.2, 0.0, 0.0])
+    np.testing.assert_allclose(f_p[1], [1.0, 2.0, 4.0, 1.0])
+
+
+# ---------------------------------------------------------------- equivalence
+
+
+def test_projected_fit_equals_unprojected(rng, mesh):
+    """THE projector equivalence (SURVEY §7): solving each entity in its
+    active subspace must give the same model as solving at full width."""
+    ds, _ = _sparse_entity_game(rng)
+    cfg = _config()
+    offsets = jnp.asarray(ds.offsets)
+    base = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh)
+    proj = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh, projection=True)
+    W0 = np.asarray(base.train_model(offsets).means)
+    W1 = np.asarray(proj.train_model(offsets).means)
+    np.testing.assert_allclose(W1, W0, rtol=2e-3, atol=2e-3)
+    # Inactive columns are exactly zero in the projected model.
+    ids = ds.entity_ids["userId"]
+    X = ds.feature_shards["re_userId"]
+    for e in np.unique(ids)[:8]:
+        inactive = ~np.any(X[ids == e] != 0.0, axis=0)
+        inactive[ds.intercept_index["re_userId"]] = False
+        assert np.all(W1[e][inactive] == 0.0)
+
+
+def test_projected_fit_with_scaling_normalization(rng, mesh):
+    """Factor-only normalization (the sparse-safe reference mode,
+    SCALE_WITH_STANDARD_DEVIATION) must commute with projection."""
+    ds, _ = _sparse_entity_game(rng)
+    X = ds.feature_shards["re_userId"]
+    norm = build_normalization(
+        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+        variances=X.var(0) + 0.1,
+        intercept_index=ds.intercept_index["re_userId"])
+    cfg = _config()
+    offsets = jnp.asarray(ds.offsets)
+    base = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh, norm=norm)
+    proj = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh, norm=norm, projection=True)
+    W0 = np.asarray(base.train_model(offsets).means)
+    W1 = np.asarray(proj.train_model(offsets).means)
+    np.testing.assert_allclose(W1, W0, rtol=3e-3, atol=3e-3)
+
+
+def test_projected_warm_start_round_trip(rng, mesh):
+    """Warm-starting the projected path from its own model must be stable
+    (gather through cols → solve → scatter back reproduces the optimum)."""
+    ds, _ = _sparse_entity_game(rng, n=500)
+    cfg = _config()
+    offsets = jnp.asarray(ds.offsets)
+    coord = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                   cfg, mesh, projection=True)
+    m1 = coord.train_model(offsets)
+    W1 = np.asarray(m1.means).copy()  # train_model donates: snapshot now
+    m2 = coord.train_model(offsets, initial=m1)
+    np.testing.assert_allclose(np.asarray(m2.means), W1, atol=1e-3)
+
+
+def test_projected_fit_zeroes_stale_inactive_warm_start(rng, mesh):
+    """projectBackward semantics: warm-starting the projected path from an
+    UNPROJECTED model (nonzero mass on inactive columns from L2 shrinkage)
+    must not leak that mass into the returned model."""
+    ds, _ = _sparse_entity_game(rng, n=500)
+    cfg = _config()
+    offsets = jnp.asarray(ds.offsets)
+    proj = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh, projection=True)
+    # Adversarial warm start: nonzero everywhere.
+    from photon_ml_tpu.game.models import RandomEffectModel
+    ne, d = ds.num_entities["userId"], ds.shard_dim("re_userId")
+    dirty = RandomEffectModel(
+        re_type="userId", shard_id="re_userId",
+        means=jnp.full((ne, d), 0.37, jnp.float32))
+    W = np.asarray(proj.train_model(offsets, initial=dirty).means)
+    ids = ds.entity_ids["userId"]
+    X = ds.feature_shards["re_userId"]
+    for e in np.where(proj.bucketing.trained_entities)[0][:8]:
+        inactive = ~np.any(X[ids == e] != 0.0, axis=0)
+        inactive[ds.intercept_index["re_userId"]] = False
+        assert np.all(W[e][inactive] == 0.0)
+
+
+def test_unknown_projector_rejected():
+    from photon_ml_tpu.api.configs import RandomEffectDataConfiguration
+
+    with pytest.raises(ValueError, match="projector"):
+        RandomEffectDataConfiguration("userId", "re_userId",
+                                      projector="INDEXMAP")
+
+
+def test_projected_variances_equal_unprojected(rng, mesh):
+    ds, _ = _sparse_entity_game(rng, n=600)
+    cfg = _config(variance=VarianceComputationType.SIMPLE)
+    offsets = jnp.asarray(ds.offsets)
+    base = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh)
+    proj = RandomEffectCoordinate(ds, "userId", "re_userId", losses.LOGISTIC,
+                                  cfg, mesh, projection=True)
+    mb = base.train_model(offsets)
+    mb = base.compute_model_variances(mb, offsets)
+    mp = proj.train_model(offsets)
+    mp = proj.compute_model_variances(mp, offsets)
+    Vb = np.asarray(mb.variances)
+    Vp = np.asarray(mp.variances)
+    ids = ds.entity_ids["userId"]
+    X = ds.feature_shards["re_userId"]
+    trained = base.bucketing.trained_entities
+    for e in np.where(trained)[0][:8]:
+        active = np.any(X[ids == e] != 0.0, axis=0)
+        active[ds.intercept_index["re_userId"]] = True
+        np.testing.assert_allclose(Vp[e][active], Vb[e][active],
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_estimator_projector_config_round_trip(rng, mesh):
+    """projector="INDEX_MAP" through the GameEstimator front door."""
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration,
+                                           RandomEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.types import TaskType
+
+    ds, _ = _sparse_entity_game(rng, n=700)
+    coords = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=_config()),
+        "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration(
+                "userId", "re_userId", projector="INDEX_MAP"),
+            optimization=_config()),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION,
+                        coordinates=coords,
+                        update_sequence=["fixed", "per-user"],
+                        descent_iterations=2, mesh=mesh)
+    fits = est.fit(ds)
+    assert len(fits) == 1
+    model = fits[0].model
+    from photon_ml_tpu.evaluation import evaluators as ev
+    a = float(ev.auc(model.score(ds), jnp.asarray(ds.response)))
+    assert a > 0.6
