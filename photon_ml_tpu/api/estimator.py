@@ -144,9 +144,17 @@ class GameEstimator:
                 # later grid points — and later fit() calls on the same
                 # dataset, e.g. tuning trials — swap only the optimization
                 # config (reference: datasets built once, configs looped).
-                cache_key = (id(data), tuple(
-                    (cid, self.coordinate_configs[cid].data)
-                    for cid in cids))
+                # Key everything that shapes coordinate construction: the
+                # dataset identity, per-coordinate data configs, the task
+                # (picks the loss), and the normalization contexts. Mutating
+                # any of these between fits invalidates the cache instead of
+                # silently reusing stale staged arrays.
+                cache_key = (
+                    id(data), self.task,
+                    tuple(sorted((s, id(ctx))
+                                 for s, ctx in self.normalization.items())),
+                    tuple((cid, self.coordinate_configs[cid].data)
+                          for cid in cids))
                 cached = self._coord_cache.get("last")
                 if cached is not None and cached[0] == cache_key:
                     base_coords = {

@@ -186,7 +186,10 @@ class BinaryDecoder:
         if t == "null":
             return None
         if t == "boolean":
-            return buf.read(1) != b"\x00"
+            b = buf.read(1)
+            if not b:
+                raise EOFError("truncated avro stream reading boolean")
+            return b != b"\x00"
         if t in ("int", "long"):
             return _read_long(buf)
         if t == "float":

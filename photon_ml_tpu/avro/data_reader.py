@@ -117,7 +117,13 @@ class AvroDataReader:
         id_cols = {t: np.zeros(n, np.int32) for t in random_effect_types}
 
         for i, rec in enumerate(records):
-            response[i] = rec.get(fields.response, 0.0)
+            # Reference AvroDataReader fails fast on a missing response
+            # column; defaulting would silently train on all-zero labels.
+            if rec.get(fields.response) is None:
+                raise ValueError(
+                    f"record {i} is missing required response field "
+                    f"{fields.response!r}")
+            response[i] = rec[fields.response]
             off = rec.get(fields.offset)
             offsets[i] = 0.0 if off is None else off
             w = rec.get(fields.weight)

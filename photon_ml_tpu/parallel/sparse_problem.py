@@ -51,11 +51,17 @@ def shard_sparse_batch(batch: SparseBatch, mesh: Mesh) -> SparseBatch:
 
 
 def _pad_features(batch: SparseBatch, d_pad: int) -> SparseBatch:
-    """Re-point ELL padding slots at the new one-past-end sentinel."""
+    """Re-point ELL padding slots at the new one-past-end sentinel.
+
+    Uses jnp ops so an already device-placed batch keeps its sharding
+    (np.asarray here would pull shards back to host and silently drop the
+    row sharding the caller paid for).
+    """
     if d_pad == batch.num_features:
         return batch
-    idx = np.asarray(batch.indices)
-    idx = np.where(idx == batch.num_features, d_pad, idx).astype(np.int32)
+    xp = jnp if isinstance(batch.indices, jax.Array) else np
+    idx = xp.where(batch.indices == batch.num_features, d_pad,
+                   batch.indices).astype(xp.int32)
     return SparseBatch(
         indices=idx, values=batch.values, labels=batch.labels,
         weights=batch.weights, offsets=batch.offsets, num_features=d_pad)
