@@ -146,9 +146,11 @@ class GameEstimator:
                 # config (reference: datasets built once, configs looped).
                 # Key everything that shapes coordinate construction: the
                 # dataset identity, per-coordinate data configs, the task
-                # (picks the loss), and the normalization contexts. Mutating
-                # any of these between fits invalidates the cache instead of
-                # silently reusing stale staged arrays.
+                # (picks the loss), and the normalization contexts.
+                # Rebinding any of these attributes between fits invalidates
+                # the cache. Identity keys (id(data), id(ctx)) do NOT detect
+                # in-place mutation of array contents — datasets and
+                # normalization contexts must be treated as immutable.
                 cache_key = (
                     id(data), self.task,
                     tuple(sorted((s, id(ctx))

@@ -55,6 +55,10 @@ class OptimizerConfig:
     # Max line-search / inner-CG steps (static bounds for while_loops).
     max_line_search_steps: int = 25
     max_cg_iterations: int = 20
+    # Strong-Wolfe constants (Breeze StrongWolfeLineSearch defaults):
+    # sufficient decrease c1, curvature c2.
+    wolfe_c1: float = 1e-4
+    wolfe_c2: float = 0.9
 
 
 @jax.tree_util.register_dataclass

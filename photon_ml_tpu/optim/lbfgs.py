@@ -10,9 +10,11 @@ optimization library, the whole optimizer is a single compiled state machine:
 
 - fixed-shape circular (m, d) history buffers + ``lax.fori_loop`` two-loop
   recursion — no Python lists, no dynamic shapes;
-- backtracking Armijo line search as a bounded inner ``while_loop``
-  (each trial costs one fused objective evaluation = one psum when the
-  objective is distributed);
+- strong-Wolfe line search (Breeze ``StrongWolfeLineSearch`` parity) as a
+  bounded bisection-with-expansion inner ``while_loop`` — each trial costs
+  one fused objective evaluation = one psum when the objective is
+  distributed; OWL-QN uses backtracking Armijo on the projected point
+  (orthant projection makes the Wolfe curvature condition ill-defined);
 - every state update is masked by the per-lane ``converged`` flag so the
   SAME machine runs vmapped over thousands of padded per-entity problems
   (the random-effect regime, reference ``SingleNodeOptimizationProblem``)
@@ -156,21 +158,15 @@ def minimize(
         value_history=vh, grad_norm_history=gh,
     )
 
-    def line_search(w, ft, sg, direction):
+    def line_search_owlqn(w, ft, sg, direction):
         """Backtracking Armijo on the TOTAL objective; returns new point.
 
-        For OWL-QN the trial point is projected onto the orthant defined by
-        sign(w) (or sign(−pg) at zeros) before evaluation.
+        OWL-QN only: the trial point is projected onto the orthant defined
+        by sign(w) (or sign(−pg) at zeros) before evaluation, which makes
+        the Wolfe curvature condition ill-defined — so Armijo it stays
+        (Andrew & Gao 2007 use backtracking too).
         """
-        dg = jnp.dot(sg, direction)
-        if is_owlqn:
-            orthant = jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-sg))
-
-        def trial_point(alpha):
-            cand = w + alpha * direction
-            if is_owlqn:
-                cand = _project_orthant(cand, orthant)
-            return cand
+        orthant = jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-sg))
 
         def ls_cond(st):
             alpha, steps, done, *_ = st
@@ -178,12 +174,12 @@ def minimize(
 
         def ls_body(st):
             alpha, steps, done, best_w, best_f, best_g = st
-            cand = trial_point(alpha)
+            cand = _project_orthant(w + alpha * direction, orthant)
             f_new, g_new = value_and_grad(cand)
             ft_new = total_value(f_new, cand)
             # Armijo with the projected displacement (OWL-QN form).
-            decrease = jnp.dot(sg, cand - w) if is_owlqn else alpha * dg
-            ok = jnp.isfinite(ft_new) & (ft_new <= ft + 1e-4 * decrease)
+            decrease = jnp.dot(sg, cand - w)
+            ok = jnp.isfinite(ft_new) & (ft_new <= ft + config.wolfe_c1 * decrease)
             best_w = jnp.where(ok, cand, best_w)
             best_f = jnp.where(ok, f_new, best_f)
             best_g = jnp.where(ok, g_new, best_g)
@@ -194,6 +190,67 @@ def minimize(
               w, jnp.asarray(jnp.inf, dtype), sg)
         _, steps, ok, new_w, new_f, new_g = lax.while_loop(ls_cond, ls_body, st)
         return ok, new_w, new_f, new_g
+
+    def line_search_wolfe(w, ft, sg, direction):
+        """Strong-Wolfe line search as a bounded bisection-with-expansion.
+
+        Reference parity: breeze ``StrongWolfeLineSearch`` driven by
+        ``optimization/LBFGS.scala``. Instead of Breeze's host-side
+        bracket-and-zoom recursion this is one fixed-bound ``while_loop``
+        maintaining a bracket [a, b] (b = ∞ until an upper bound is seen):
+
+        - Armijo fails, or slope already ≥ +c2·|φ'(0)| (overshot)  → b = α
+        - Armijo holds but slope < c2·φ'(0) (still descending hard) → a = α
+        - Armijo holds and |φ'(α)| ≤ −c2·φ'(0)                      → accept
+
+        Next trial: 2α while unbracketed, else the midpoint. One fused
+        value+grad per trial (one psum when distributed), vmap-safe: under
+        vmap, JAX's while_loop batching select-freezes finished lanes.
+        Guarantees sᵀy > 0 for accepted points, so every step yields a
+        valid curvature pair. On budget exhaustion falls back to the best
+        Armijo-satisfying point seen (the sy > eps gate below discards its
+        pair if curvature is bad).
+        """
+        c1 = config.wolfe_c1
+        c2 = config.wolfe_c2
+        dg0 = jnp.dot(sg, direction)  # φ'(0) < 0 for descent directions
+        inf = jnp.asarray(jnp.inf, dtype)
+
+        def ls_cond(st):
+            _, _, _, steps, done, *_ = st
+            return (~done) & (steps < config.max_line_search_steps)
+
+        def ls_body(st):
+            a, b, alpha, steps, done, has_pt, res_w, res_f, res_g, res_ft = st
+            cand = w + alpha * direction
+            f_new, g_new = value_and_grad(cand)
+            dg_new = jnp.dot(g_new, direction)
+            armijo = jnp.isfinite(f_new) & (f_new <= ft + c1 * alpha * dg0)
+            strong = armijo & (jnp.abs(dg_new) <= -c2 * dg0)
+            curv_low = dg_new < c2 * dg0
+            # Record: a strong point always wins; otherwise keep the best
+            # (lowest-f) Armijo point as the exhaustion fallback.
+            take = strong | (armijo & (f_new < res_ft))
+            res_w = jnp.where(take, cand, res_w)
+            res_f = jnp.where(take, f_new, res_f)
+            res_g = jnp.where(take, g_new, res_g)
+            res_ft = jnp.where(take, f_new, res_ft)
+            grow = armijo & curv_low & ~strong
+            a2 = jnp.where(grow, alpha, a)
+            b2 = jnp.where(~strong & ~grow, alpha, b)
+            alpha2 = jnp.where(grow & ~jnp.isfinite(b2),
+                               2.0 * alpha, 0.5 * (a2 + b2))
+            return (a2, b2, alpha2, steps + 1, strong, has_pt | armijo,
+                    res_w, res_f, res_g, res_ft)
+
+        st = (jnp.asarray(0.0, dtype), inf, jnp.asarray(1.0, dtype),
+              jnp.asarray(0, jnp.int32), jnp.asarray(False),
+              jnp.asarray(False), w, ft, sg, inf)
+        (_, _, _, _, done, has_pt,
+         new_w, new_f, new_g, _) = lax.while_loop(ls_cond, ls_body, st)
+        return done | has_pt, new_w, new_f, new_g
+
+    line_search = line_search_owlqn if is_owlqn else line_search_wolfe
 
     def body(state: _LBFGSState) -> _LBFGSState:
         sg = search_gradient(state.w, state.g)

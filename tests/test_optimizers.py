@@ -243,3 +243,79 @@ def test_factory_dispatch_and_validation(rng):
     with pytest.raises(ValueError):
         optimize(vg, jnp.zeros(4),
                  OptimizerConfig(optimizer_type=OptimizerType.OWLQN))
+
+
+def _ill_conditioned_quadratic(d, rng, cond=1e4):
+    """SPD quadratic with eigenvalues log-spaced over ``cond``."""
+    Q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    eig = np.logspace(0, np.log10(cond), d)
+    A = (Q * eig) @ Q.T
+    b = rng.normal(size=d)
+    A_j = jnp.asarray(A, jnp.float32)
+    b_j = jnp.asarray(b, jnp.float32)
+
+    def vg(w):
+        return 0.5 * w @ A_j @ w - b_j @ w, A_j @ w - b_j
+
+    def f_np(w):
+        return 0.5 * w @ A @ w - b @ w
+
+    def g_np(w):
+        return A @ w - b
+
+    return vg, f_np, g_np, np.linalg.solve(A, b)
+
+
+def test_strong_wolfe_iteration_parity_vs_scipy(rng):
+    """Strong-Wolfe L-BFGS should take a comparable number of iterations to
+    scipy's L-BFGS-B on an ill-conditioned quadratic (breeze
+    StrongWolfeLineSearch parity check: Armijo-only backtracking degrades
+    badly here)."""
+    d = 20
+    vg, f_np, g_np, w_star = _ill_conditioned_quadratic(d, rng)
+    ref = scipy.optimize.minimize(
+        f_np, np.zeros(d), jac=g_np, method="L-BFGS-B",
+        options={"gtol": 1e-8, "maxiter": 500})
+    out = minimize_lbfgs(vg, jnp.zeros(d), OptimizerConfig(
+        max_iterations=500, tolerance=1e-8))
+    assert bool(out.converged)
+    # f32 floor: compare against the f64 optimum loosely, iterations tightly.
+    np.testing.assert_allclose(out.w, w_star, rtol=5e-2, atol=5e-2)
+    assert int(out.iterations) <= 2 * ref.nit + 10
+
+
+def test_strong_wolfe_conditions_hold_on_accepted_steps(rng):
+    """The accepted step must satisfy BOTH strong-Wolfe conditions (which
+    imply s^T y > 0) — checked directly on single optimizer steps from
+    several random starts, conditions evaluated on the step s = w1 − w0
+    (scale-invariant in the direction)."""
+    d = 12
+    vg, _, _, _ = _ill_conditioned_quadratic(d, rng)
+    cfg = OptimizerConfig(max_iterations=1, tolerance=1e-12)
+    c1, c2 = cfg.wolfe_c1, cfg.wolfe_c2
+    for _ in range(5):
+        w0 = jnp.asarray(rng.normal(size=d), jnp.float32)
+        f0, g0 = vg(w0)
+        out = minimize_lbfgs(vg, w0, cfg)
+        s = np.asarray(out.w) - np.asarray(w0)
+        assert np.linalg.norm(s) > 0  # a step was taken
+        f1, g1 = vg(out.w)
+        dg0 = float(np.asarray(g0) @ s)  # α·φ'(0) < 0
+        dg1 = float(np.asarray(g1) @ s)  # α·φ'(α)
+        assert dg0 < 0
+        # Sufficient decrease: f(w1) ≤ f(w0) + c1·g0ᵀs  (small f32 slack).
+        assert float(f1) <= float(f0) + c1 * dg0 + 1e-4 * abs(float(f0))
+        # Strong curvature: |g1ᵀs| ≤ c2·|g0ᵀs| → implies sᵀy > 0.
+        assert abs(dg1) <= c2 * abs(dg0) * (1 + 1e-3)
+        assert float(np.asarray(g1 - g0) @ s) > 0  # sᵀy > 0
+
+
+def test_wolfe_logistic_fewer_evals_than_tolerance_budget(rng):
+    """The Wolfe search should not regress iteration counts on the standard
+    logistic problem (guard against unit-step Armijo being replaced by
+    something slower in the common well-scaled case)."""
+    vg, _, w_ref, _ = _logistic_problem(rng)
+    out = minimize_lbfgs(vg, jnp.zeros(8), OptimizerConfig(
+        max_iterations=200, tolerance=1e-9))
+    np.testing.assert_allclose(out.w, w_ref, rtol=2e-2, atol=2e-2)
+    assert int(out.iterations) < 60
