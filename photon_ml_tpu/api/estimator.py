@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import os
 from typing import Optional
 
 import jax.numpy as jnp
@@ -126,18 +127,26 @@ class GameEstimator:
         validation_data: Optional[GameDataset] = None,
         initial_models: Optional[dict] = None,
         locked_coordinates: Optional[set[str]] = None,
+        checkpoint_dir: Optional[str] = None,
     ) -> list[GameResult]:
         """Train one GAME model per point of the regularization grid.
 
         Returns one GameResult per grid combination (cartesian product of
         each coordinate's ``reg_weight_grid``), mirroring the reference's
         Seq[GameOptimizationConfiguration] loop.
+
+        With ``checkpoint_dir`` set, each grid point checkpoints its
+        coordinate-descent progress under ``<checkpoint_dir>/grid-<i>`` and
+        a rerun with the same arguments resumes mid-descent (SURVEY.md §5
+        failure-recovery: the Spark-lineage replacement).
         """
+        from photon_ml_tpu.game.checkpoint import CheckpointManager
+
         cids = list(self.coordinate_configs)
         grids = [self.coordinate_configs[c].expand_grid() for c in cids]
         results: list[GameResult] = []
         base_coords: Optional[dict[str, object]] = None
-        for combo in itertools.product(*grids):
+        for grid_index, combo in enumerate(itertools.product(*grids)):
             opt_configs = dict(zip(cids, combo))
             if base_coords is None:
                 # Coordinates (bucketing, device staging) are built ONCE;
@@ -174,13 +183,17 @@ class GameEstimator:
             if validation_data is not None and self.validation_evaluators:
                 def val_fn(m, _vd=validation_data):
                     return self._evaluate(m, _vd).metrics
+            manager = (CheckpointManager(
+                os.path.join(checkpoint_dir, f"grid-{grid_index}"))
+                if checkpoint_dir else None)
             model, history = descent.run(
                 self.task, coords,
                 descent.CoordinateDescentConfig(
                     self.update_sequence, self.descent_iterations),
                 initial_models=initial_models,
                 locked_coordinates=locked_coordinates,
-                validation_fn=val_fn)
+                validation_fn=val_fn,
+                checkpoint_manager=manager)
             model = self._finalize_variances(model, coords, data)
             evaluation = (self._evaluate(model, validation_data)
                           if validation_data is not None else None)

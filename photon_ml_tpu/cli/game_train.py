@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated coordinates to keep fixed")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL"])
+    p.add_argument("--checkpoint", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="checkpoint descent progress under "
+                        "<output-dir>/checkpoints after every coordinate "
+                        "update (--no-checkpoint disables)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from an existing checkpoint directory "
+                        "instead of starting fresh")
     return p
 
 
@@ -128,8 +136,21 @@ def run(args) -> dict:
             model_io.load_game_model(args.model_input_dir).models)
     locked = {c for c in args.locked_coordinates.split(",") if c}
 
+    if getattr(args, "resume", False) and not getattr(args, "checkpoint", True):
+        raise ValueError("--resume requires checkpointing; "
+                         "drop --no-checkpoint")
+    checkpoint_dir = None
+    if getattr(args, "checkpoint", True):
+        checkpoint_dir = os.path.join(args.output_dir, "checkpoints")
+        if not getattr(args, "resume", False) and os.path.exists(checkpoint_dir):
+            # Fresh run: stale checkpoints must not silently short-circuit
+            # training (resume is an explicit opt-in).
+            import shutil
+            shutil.rmtree(checkpoint_dir)
+
     results = est.fit(train, validation, initial_models=initial_models,
-                      locked_coordinates=locked or None)
+                      locked_coordinates=locked or None,
+                      checkpoint_dir=checkpoint_dir)
     best = est.select_best_model(results)
 
     os.makedirs(args.output_dir, exist_ok=True)

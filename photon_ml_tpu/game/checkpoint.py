@@ -1,0 +1,141 @@
+"""Checkpoint/restart for coordinate descent.
+
+Reference parity: the Spark reference recovers from executor loss via RDD
+lineage re-execution; XLA has no lineage, so (SURVEY.md §5, failure/elastic
+row) the TPU-native replacement is explicit per-(iteration, coordinate)
+checkpointing of the coefficient state + progress counters, with restart
+from the newest checkpoint (``--resume`` in ``cli/game_train.py``).
+
+Layout under the checkpoint directory::
+
+    state.json            # progress counters + history + fingerprint
+    model/                # models/io.py GameModel directory (newest state)
+
+Crash-consistency model: every file write is atomic (tmp + ``os.replace``)
+and ``state.json`` is the COMMIT POINT, written last. A kill mid-save
+leaves either the previous state.json (the step is simply retrained on
+resume — coefficient files newer than the committed step only change the
+warm start of that retraining) or the new one (fully committed). There is
+never a moment without a readable checkpoint.
+
+Each save rewrites only the coordinate(s) that changed — the others'
+coefficient files are already current on disk — so per-step checkpoint
+cost is one coordinate's coefficients + two small json files, not the
+whole model.
+
+A configuration fingerprint (task, update sequence, iterations, locked
+set, per-coordinate optimizer/regularization, dataset row count) is stored
+alongside and validated on load: a checkpoint written under a different
+configuration is discarded (with a warning) instead of silently resuming
+the wrong run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Optional
+
+from photon_ml_tpu.game.models import CoordinateModel, GameModel
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger("photon_ml_tpu.game")
+
+_STATE = "state.json"
+_MODEL = "model"
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """Restart state: the newest models + how far the loop got."""
+
+    models: dict[str, CoordinateModel]
+    done_steps: int  # completed (iteration, coordinate) updates (linear)
+    records: list[dict]  # CoordinateDescentHistory records so far
+    complete: bool  # descent finished; models are the final result
+    fingerprint: Optional[dict]  # config the checkpoint was written under
+
+
+class CheckpointManager:
+    """Save/restore coordinate-descent state under one directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        # Until this process has written one FULL snapshot, incremental
+        # saves are upgraded to full ones. Guards against a stale model
+        # directory left by a discarded (fingerprint-mismatched) or
+        # unrelated earlier run contaminating coordinates that this run's
+        # `updated` lists haven't touched yet.
+        self._full_snapshot_written = False
+
+    # -- write -------------------------------------------------------------
+
+    def save(
+        self,
+        task: TaskType,
+        models: dict[str, CoordinateModel],
+        *,
+        done_steps: int,
+        records: list[dict],
+        complete: bool = False,
+        fingerprint: Optional[dict] = None,
+        updated: Optional[list[str]] = None,
+    ) -> None:
+        """Persist state. ``updated`` names the coordinates whose
+        coefficients changed since the last save (all, if None or if the
+        model directory does not exist yet)."""
+        model_dir = os.path.join(self.directory, _MODEL)
+        os.makedirs(model_dir, exist_ok=True)
+        write_set = (set(models)
+                     if updated is None or not self._full_snapshot_written
+                     else set(updated))
+        meta = {}
+        for cid, m in models.items():
+            if cid in write_set:
+                meta[cid] = model_io.save_coordinate(model_dir, cid, m)
+            else:
+                meta[cid] = model_io.coordinate_meta(m)
+        model_io.write_metadata(model_dir, task, meta)
+        # Commit point: state.json last, atomically.
+        tmp = os.path.join(self.directory, _STATE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({
+                "done_steps": done_steps,
+                "records": records,
+                "complete": complete,
+                "fingerprint": fingerprint,
+            }, f, indent=2)
+        os.replace(tmp, os.path.join(self.directory, _STATE))
+        self._full_snapshot_written = True
+
+    # -- read --------------------------------------------------------------
+
+    def load(self, expected_fingerprint: Optional[dict] = None
+             ) -> Optional[CheckpointState]:
+        """Return the committed state, or None if absent or written under a
+        different configuration than ``expected_fingerprint``."""
+        state_path = os.path.join(self.directory, _STATE)
+        if not os.path.exists(state_path):
+            return None
+        with open(state_path) as f:
+            state = json.load(f)
+        saved_fp = state.get("fingerprint")
+        if (expected_fingerprint is not None and saved_fp is not None
+                and saved_fp != expected_fingerprint):
+            logger.warning(
+                "checkpoint at %s was written under a different "
+                "configuration — discarding it and training from scratch "
+                "(saved=%s expected=%s)",
+                self.directory, saved_fp, expected_fingerprint)
+            return None
+        game = model_io.load_game_model(os.path.join(self.directory, _MODEL))
+        return CheckpointState(
+            models=dict(game.models),
+            done_steps=int(state["done_steps"]),
+            records=list(state["records"]),
+            complete=bool(state["complete"]),
+            fingerprint=saved_fp,
+        )

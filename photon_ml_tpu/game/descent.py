@@ -17,11 +17,14 @@ of the reference's outer-join RDD arithmetic (CoordinateDataScores +/-).
 from __future__ import annotations
 
 import dataclasses
+import enum
+import hashlib
 import logging
 import time
 from typing import Callable, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.types import TaskType
@@ -53,6 +56,7 @@ def run(
     initial_models: Optional[dict[str, CoordinateModel]] = None,
     locked_coordinates: Optional[set[str]] = None,
     validation_fn: Optional[Callable[[GameModel], dict]] = None,
+    checkpoint_manager=None,
 ) -> tuple[GameModel, CoordinateDescentHistory]:
     """Run block coordinate descent (reference: CoordinateDescent.run).
 
@@ -61,6 +65,14 @@ def run(
     scored but never retrained (reference partial retraining).
     ``validation_fn`` is called after each coordinate update with the
     current GameModel (reference: per-iteration EvaluationSuite logging).
+
+    ``checkpoint_manager`` (game/checkpoint.py) persists models + progress
+    after every coordinate update and, when an existing checkpoint is found
+    under its directory, resumes from it: already-completed (iteration,
+    coordinate) updates are skipped and the checkpointed models replace the
+    warm starts. Restart state is models + a linear step counter — the
+    residual bookkeeping below is recomputed from the models at startup, so
+    a resumed run produces the same final model as an uninterrupted one.
     """
     seq = list(config.update_sequence)
     unknown = [c for c in seq if c not in coordinates]
@@ -72,14 +84,32 @@ def run(
         if initial_models is None or c not in initial_models:
             raise ValueError(f"locked coordinate {c!r} needs an initial model")
 
-    models: dict[str, CoordinateModel] = {}
-    scores: dict[str, jnp.ndarray] = {}
     some = coordinates[seq[0]]
     n = some.dataset.num_rows
+
+    fingerprint = None
+    resume = None
+    if checkpoint_manager is not None:
+        fingerprint = _fingerprint(task, coordinates, seq, config, locked, n)
+        resume = checkpoint_manager.load(expected_fingerprint=fingerprint)
+    history = CoordinateDescentHistory()
+    done_steps = 0
+    if resume is not None:
+        initial_models = {**(initial_models or {}), **resume.models}
+        done_steps = resume.done_steps
+        history.records = list(resume.records)
+        logger.info("resuming coordinate descent from checkpoint: "
+                    "%d updates already done", done_steps)
+        if resume.complete:
+            return (GameModel(task=task, models=dict(resume.models)),
+                    history)
+
+    models: dict[str, CoordinateModel] = {}
+    scores: dict[str, jnp.ndarray] = {}
     base = jnp.asarray(some.dataset.offsets)
     total = jnp.zeros((n,), jnp.float32)
 
-    # Initialize models (warm starts) and their scores.
+    # Initialize models (warm starts / checkpoint state) and their scores.
     for cid in seq:
         coord = coordinates[cid]
         if initial_models and cid in initial_models:
@@ -90,11 +120,14 @@ def run(
         scores[cid] = s
         total = total + s
 
-    history = CoordinateDescentHistory()
+    step = 0
     for it in range(config.iterations):
         for cid in seq:
             if cid in locked:
                 continue
+            step += 1
+            if step <= done_steps:
+                continue  # already covered by the checkpoint
             coord = coordinates[cid]
             t0 = time.monotonic()
             # Residual offsets: everything except this coordinate.
@@ -113,5 +146,55 @@ def run(
             logger.info("CD iter %d coordinate %s: %.2fs %s", it, cid,
                         elapsed, rec.get("validation", ""))
             history.records.append(rec)
+            if checkpoint_manager is not None:
+                checkpoint_manager.save(
+                    task, models, done_steps=step,
+                    records=history.records, fingerprint=fingerprint,
+                    updated=[cid])
 
+    if checkpoint_manager is not None:
+        checkpoint_manager.save(task, models, done_steps=step,
+                                records=history.records, complete=True,
+                                fingerprint=fingerprint)
     return GameModel(task=task, models=models), history
+
+
+def _jsonable(obj):
+    """Dataclass/enum tree → plain JSON-comparable values."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def _fingerprint(task, coordinates, seq, config, locked, n) -> dict:
+    """What a checkpoint must agree on to be resumable: anything that
+    changes the sequence of training steps or their objectives — the FULL
+    per-coordinate optimization config (tolerance, elastic-net alpha, …),
+    the loop shape, and a digest of the training responses/offsets/weights
+    (num_rows alone cannot tell two datasets apart)."""
+    per_coord = {}
+    for cid in seq:
+        c = getattr(coordinates[cid], "config", None)
+        per_coord[cid] = {
+            "config": _jsonable(c) if c is not None else None,
+            "down_sampling_seed": getattr(
+                coordinates[cid], "_down_sampling_seed", None),
+        }
+    ds = coordinates[seq[0]].dataset
+    h = hashlib.sha1()
+    for arr in (ds.response, ds.offsets, ds.weights):
+        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    return {
+        "task": TaskType(task).value,
+        "sequence": list(seq),
+        "iterations": int(config.iterations),
+        "locked": sorted(locked),
+        "num_rows": int(n),
+        "data_digest": h.hexdigest(),
+        "coordinates": per_coord,
+    }

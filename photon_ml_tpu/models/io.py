@@ -28,36 +28,57 @@ from photon_ml_tpu.types import TaskType
 _METADATA = "metadata.json"
 
 
+def coordinate_meta(m) -> dict:
+    """Metadata entry for one coordinate model (no file writes)."""
+    if isinstance(m, FixedEffectModel):
+        return {"type": "fixed", "shard_id": m.shard_id,
+                "dim": int(m.coefficients.dim)}
+    if isinstance(m, RandomEffectModel):
+        return {"type": "random", "shard_id": m.shard_id,
+                "re_type": m.re_type, "num_entities": int(m.num_entities),
+                "dim": int(m.dim)}
+    raise TypeError(type(m))  # pragma: no cover
+
+
+def save_coordinate(path: str, cid: str, m) -> dict:
+    """Atomically write one coordinate's coefficients under a GameModel
+    directory; returns its metadata entry. Atomic via tmp + ``os.replace``
+    so an interrupted write never corrupts an existing checkpoint file."""
+    meta = coordinate_meta(m)
+    sub = os.path.join(
+        path, "fixed-effect" if meta["type"] == "fixed" else "random-effect",
+        cid)
+    os.makedirs(sub, exist_ok=True)
+    if isinstance(m, FixedEffectModel):
+        payload = {"means": np.asarray(m.coefficients.means)}
+        if m.coefficients.variances is not None:
+            payload["variances"] = np.asarray(m.coefficients.variances)
+    else:
+        payload = {"means": np.asarray(m.means)}
+        if m.variances is not None:
+            payload["variances"] = np.asarray(m.variances)
+    tmp = os.path.join(sub, "coefficients.tmp.npz")
+    np.savez(tmp, **payload)
+    os.replace(tmp, os.path.join(sub, "coefficients.npz"))
+    return meta
+
+
+def write_metadata(path: str, task: TaskType,
+                   coordinates_meta: dict[str, dict]) -> None:
+    """Atomically write a GameModel directory's metadata.json."""
+    meta = {"task": TaskType(task).value, "coordinates": coordinates_meta}
+    tmp = os.path.join(path, _METADATA + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    os.replace(tmp, os.path.join(path, _METADATA))
+
+
 def save_game_model(model: GameModel, path: str) -> None:
     """Write a GameModel directory (reference: saveGameModelToHDFS layout)."""
     os.makedirs(path, exist_ok=True)
-    meta = {"task": TaskType(model.task).value, "coordinates": {}}
-    for cid, m in model.models.items():
-        if isinstance(m, FixedEffectModel):
-            sub = os.path.join(path, "fixed-effect", cid)
-            os.makedirs(sub, exist_ok=True)
-            payload = {"means": np.asarray(m.coefficients.means)}
-            if m.coefficients.variances is not None:
-                payload["variances"] = np.asarray(m.coefficients.variances)
-            np.savez(os.path.join(sub, "coefficients.npz"), **payload)
-            meta["coordinates"][cid] = {
-                "type": "fixed", "shard_id": m.shard_id,
-                "dim": int(m.coefficients.dim)}
-        elif isinstance(m, RandomEffectModel):
-            sub = os.path.join(path, "random-effect", cid)
-            os.makedirs(sub, exist_ok=True)
-            payload = {"means": np.asarray(m.means)}
-            if m.variances is not None:
-                payload["variances"] = np.asarray(m.variances)
-            np.savez(os.path.join(sub, "coefficients.npz"), **payload)
-            meta["coordinates"][cid] = {
-                "type": "random", "shard_id": m.shard_id,
-                "re_type": m.re_type, "num_entities": int(m.num_entities),
-                "dim": int(m.dim)}
-        else:  # pragma: no cover
-            raise TypeError(type(m))
-    with open(os.path.join(path, _METADATA), "w") as f:
-        json.dump(meta, f, indent=2, sort_keys=True)
+    meta = {cid: save_coordinate(path, cid, m)
+            for cid, m in model.models.items()}
+    write_metadata(path, model.task, meta)
 
 
 def load_game_model(path: str) -> GameModel:

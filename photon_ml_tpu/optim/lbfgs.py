@@ -221,7 +221,7 @@ def minimize(
             return (~done) & (steps < config.max_line_search_steps)
 
         def ls_body(st):
-            a, b, alpha, steps, done, has_pt, res_w, res_f, res_g, res_ft = st
+            a, b, alpha, steps, done, has_pt, res_w, res_f, res_g = st
             cand = w + alpha * direction
             f_new, g_new = value_and_grad(cand)
             dg_new = jnp.dot(g_new, direction)
@@ -229,25 +229,25 @@ def minimize(
             strong = armijo & (jnp.abs(dg_new) <= -c2 * dg0)
             curv_low = dg_new < c2 * dg0
             # Record: a strong point always wins; otherwise keep the best
-            # (lowest-f) Armijo point as the exhaustion fallback.
-            take = strong | (armijo & (f_new < res_ft))
+            # (lowest-f) Armijo point as the exhaustion fallback. res_f
+            # starts at f(w), and any Armijo point is below that.
+            take = strong | (armijo & (f_new < res_f))
             res_w = jnp.where(take, cand, res_w)
             res_f = jnp.where(take, f_new, res_f)
             res_g = jnp.where(take, g_new, res_g)
-            res_ft = jnp.where(take, f_new, res_ft)
             grow = armijo & curv_low & ~strong
             a2 = jnp.where(grow, alpha, a)
             b2 = jnp.where(~strong & ~grow, alpha, b)
             alpha2 = jnp.where(grow & ~jnp.isfinite(b2),
                                2.0 * alpha, 0.5 * (a2 + b2))
             return (a2, b2, alpha2, steps + 1, strong, has_pt | armijo,
-                    res_w, res_f, res_g, res_ft)
+                    res_w, res_f, res_g)
 
         st = (jnp.asarray(0.0, dtype), inf, jnp.asarray(1.0, dtype),
               jnp.asarray(0, jnp.int32), jnp.asarray(False),
-              jnp.asarray(False), w, ft, sg, inf)
+              jnp.asarray(False), w, ft, sg)
         (_, _, _, _, done, has_pt,
-         new_w, new_f, new_g, _) = lax.while_loop(ls_cond, ls_body, st)
+         new_w, new_f, new_g) = lax.while_loop(ls_cond, ls_body, st)
         return done | has_pt, new_w, new_f, new_g
 
     line_search = line_search_owlqn if is_owlqn else line_search_wolfe

@@ -1,0 +1,174 @@
+"""Checkpoint/restart tests for coordinate descent (SURVEY.md §5 failure
+recovery — the Spark-lineage replacement).
+
+Kill-and-resume: a descent killed mid-run and restarted from its checkpoint
+must produce the same final model as an uninterrupted run (up to f32
+rounding: the resumed run rebuilds the score totals by fresh summation
+while the uninterrupted one updates them incrementally).
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.game import descent
+from photon_ml_tpu.game.checkpoint import CheckpointManager
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _setup(rng, mesh):
+    syn = synthetic.game_data(rng, n=600, d_global=6,
+                              re_specs={"userId": (12, 3)})
+    ds = from_synthetic(syn)
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-7))
+    cc = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"), optimization=opt),
+        "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration("userId", "re_userId"),
+            optimization=opt),
+    }
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc,
+                        ["fixed", "per-user"], mesh, descent_iterations=2)
+    coords = est._build_coordinates(
+        ds, {cid: c.optimization for cid, c in cc.items()})
+    cfg = descent.CoordinateDescentConfig(["fixed", "per-user"], iterations=2)
+    return est, coords, cfg
+
+
+class _KillSwitch:
+    """Proxy a coordinate; raise after ``allow`` train_model calls."""
+
+    def __init__(self, inner, allow):
+        self._inner = inner
+        self._allow = allow
+        self.calls = 0
+
+    def train_model(self, offsets, initial=None):
+        self.calls += 1
+        if self.calls > self._allow:
+            raise KeyboardInterrupt("simulated kill")
+        return self._inner.train_model(offsets, initial=initial)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _model_arrays(model):
+    out = {}
+    for cid, m in model.models.items():
+        out[cid] = np.asarray(getattr(m, "means", None)
+                              if hasattr(m, "means")
+                              else m.coefficients.means)
+    return out
+
+
+def test_kill_and_resume_matches_uninterrupted(rng, mesh, tmp_path):
+    est, coords, cfg = _setup(rng, mesh)
+    task = est.task
+
+    # Ground truth: uninterrupted run, no checkpointing.
+    clean_model, clean_hist = descent.run(task, coords, cfg)
+
+    # Interrupted run: kill during the 3rd coordinate update (of 4).
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    killed = dict(coords)
+    killed["fixed"] = _KillSwitch(coords["fixed"], allow=1)
+    with pytest.raises(KeyboardInterrupt):
+        descent.run(task, killed, cfg, checkpoint_manager=manager)
+    state = manager.load()
+    assert state is not None and not state.complete
+    assert state.done_steps == 2  # iter-0 fixed + iter-0 per-user
+
+    # Resume with pristine coordinates and the same manager.
+    resumed_model, resumed_hist = descent.run(
+        task, coords, cfg, checkpoint_manager=manager)
+    assert len(resumed_hist.records) == len(clean_hist.records)
+
+    clean = _model_arrays(clean_model)
+    resumed = _model_arrays(resumed_model)
+    for cid in clean:
+        np.testing.assert_allclose(resumed[cid], clean[cid],
+                                   rtol=1e-4, atol=1e-5)
+
+    # The final checkpoint is marked complete…
+    final = manager.load()
+    assert final.complete and final.done_steps == 4
+    # …and a THIRD run short-circuits entirely (no training calls).
+    counter = _KillSwitch(coords["fixed"], allow=0)
+    third = dict(coords)
+    third["fixed"] = counter
+    again_model, _ = descent.run(task, third, cfg,
+                                 checkpoint_manager=manager)
+    assert counter.calls == 0
+    for cid, arr in _model_arrays(again_model).items():
+        np.testing.assert_allclose(arr, resumed[cid], rtol=1e-6)
+
+
+def test_checkpoint_save_is_atomic_over_existing(rng, mesh, tmp_path):
+    est, coords, cfg = _setup(rng, mesh)
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    model, hist = descent.run(est.task, coords, cfg,
+                              checkpoint_manager=manager)
+    first = manager.load()
+    # Overwrite with a later state: the directory swap must leave a
+    # readable checkpoint (no partial writes), and reflect the new state.
+    manager.save(est.task, model.models, done_steps=99,
+                 records=hist.records, complete=True)
+    second = manager.load()
+    assert second.done_steps == 99
+    assert set(second.models) == set(first.models)
+
+
+def test_estimator_checkpoint_dir_resumes_grid(rng, mesh, tmp_path):
+    syn = synthetic.game_data(rng, n=400, d_global=5, re_specs={})
+    ds = from_synthetic(syn)
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-7))
+    cc = {"fixed": CoordinateConfiguration(
+        data=FixedEffectDataConfiguration("global"), optimization=opt,
+        reg_weight_grid=(0.1, 10.0))}
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc, ["fixed"], mesh)
+    r1 = est.fit(ds, checkpoint_dir=str(tmp_path / "ck"))
+    assert (tmp_path / "ck" / "grid-0").exists()
+    assert (tmp_path / "ck" / "grid-1").exists()
+    # Second fit resumes every grid point from its complete checkpoint.
+    r2 = est.fit(ds, checkpoint_dir=str(tmp_path / "ck"))
+    for a, b in zip(r1, r2):
+        for cid in a.model.models:
+            np.testing.assert_allclose(
+                np.asarray(a.model.models[cid].coefficients.means),
+                np.asarray(b.model.models[cid].coefficients.means),
+                rtol=1e-6)
+
+
+def test_checkpoint_discarded_on_config_change(rng, mesh, tmp_path):
+    """A checkpoint written under a different configuration must be
+    discarded (retrain), not silently resumed as the wrong result."""
+    est, coords, cfg = _setup(rng, mesh)
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    descent.run(est.task, coords, cfg, checkpoint_manager=manager)
+    assert manager.load().complete
+
+    # Same coords, different iteration count -> fingerprint mismatch.
+    cfg2 = descent.CoordinateDescentConfig(["fixed", "per-user"],
+                                           iterations=1)
+    counter = _KillSwitch(coords["fixed"], allow=10)
+    coords2 = dict(coords)
+    coords2["fixed"] = counter
+    descent.run(est.task, coords2, cfg2, checkpoint_manager=manager)
+    assert counter.calls == 1  # it retrained instead of short-circuiting
