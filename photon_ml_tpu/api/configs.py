@@ -23,9 +23,14 @@ from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
 @dataclasses.dataclass(frozen=True)
 class FixedEffectDataConfiguration:
     """Reference: FixedEffectDataConfiguration (featureShardId, minPartitions
-    — partitions have no TPU referent)."""
+    — partitions have no TPU referent).
+
+    ``feature_sharded`` applies to sparse (ELL) shards only: shard the
+    coefficient dimension over the mesh's ``model`` axis (P3, the Criteo
+    regime where the feature space is too large to replicate)."""
 
     feature_shard_id: str
+    feature_sharded: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

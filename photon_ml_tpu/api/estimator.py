@@ -22,11 +22,12 @@ import jax.numpy as jnp
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FixedEffectDataConfiguration,
                                        RandomEffectDataConfiguration)
-from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.evaluation import evaluators as ev
 from photon_ml_tpu.game import descent
 from photon_ml_tpu.game.coordinates import (FixedEffectCoordinate,
-                                            RandomEffectCoordinate)
+                                            RandomEffectCoordinate,
+                                            SparseFixedEffectCoordinate)
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops import losses as losses_mod
@@ -86,6 +87,17 @@ class GameEstimator:
         for cid, cc in self.coordinate_configs.items():
             opt = opt_configs[cid]
             if isinstance(cc.data, FixedEffectDataConfiguration):
+                shard = dataset.feature_shards[cc.data.feature_shard_id]
+                if isinstance(shard, SparseShard):
+                    if cc.data.feature_shard_id in self.normalization:
+                        raise ValueError(
+                            f"normalization is not supported on sparse "
+                            f"shard {cc.data.feature_shard_id!r}")
+                    coords[cid] = SparseFixedEffectCoordinate(
+                        dataset, cc.data.feature_shard_id, self.loss, opt,
+                        self.mesh,
+                        feature_sharded=cc.data.feature_sharded)
+                    continue
                 coords[cid] = FixedEffectCoordinate(
                     dataset, cc.data.feature_shard_id, self.loss, opt,
                     self.mesh,
@@ -141,6 +153,21 @@ class GameEstimator:
         failure-recovery: the Spark-lineage replacement).
         """
         from photon_ml_tpu.game.checkpoint import CheckpointManager
+
+        if validation_data is not None:
+            # Grouped evaluators index per-entity ids against each
+            # dataset's own vocabulary; scoring gathers RE rows by id. Both
+            # are silently wrong if validation was read with a different
+            # vocabulary than training (reference: shared PalDB index maps
+            # guarantee this; here it must be asserted).
+            for t, n_train in data.num_entities.items():
+                n_val = validation_data.num_entities.get(t)
+                if n_val is not None and n_val != n_train:
+                    raise ValueError(
+                        f"validation entity vocabulary for {t!r} has size "
+                        f"{n_val} != training {n_train}; read validation "
+                        f"with the training vocabularies "
+                        f"(AvroDataReader entity_vocabs=...)")
 
         cids = list(self.coordinate_configs)
         grids = [self.coordinate_configs[c].expand_grid() for c in cids]

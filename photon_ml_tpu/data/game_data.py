@@ -24,13 +24,33 @@ from photon_ml_tpu.data.batch import LabeledBatch
 
 
 @dataclasses.dataclass
+class SparseShard:
+    """ELL sparse feature shard (the Criteo-scale fixed-effect regime).
+
+    Reference parity: the reference's sparse Breeze feature vectors per
+    GameDatum; here one (n, max_nnz) ELL block per shard (see
+    data/sparse.py for the layout contract: padding slots carry index ==
+    ``num_features`` and value 0).
+    """
+
+    indices: np.ndarray  # (n, max_nnz) int32, padding slot == num_features
+    values: np.ndarray  # (n, max_nnz) float32
+    num_features: int
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (int(self.indices.shape[0]), int(self.num_features))
+
+
+@dataclasses.dataclass
 class GameDataset:
     """Columnar GAME dataset (host-side numpy; device placement per use)."""
 
     response: np.ndarray  # (n,)
     offsets: np.ndarray  # (n,) base offsets from the data (prior scores)
     weights: np.ndarray  # (n,)
-    feature_shards: dict[str, np.ndarray]  # shard id -> (n, d_shard)
+    # shard id -> (n, d_shard) dense matrix OR a SparseShard (ELL).
+    feature_shards: dict[str, object]
     entity_ids: dict[str, np.ndarray]  # RE type -> (n,) int32 entity rows
     num_entities: dict[str, int]  # RE type -> entity-table size
     # Optional per-RE-type intercept column index within that shard.
@@ -42,7 +62,10 @@ class GameDataset:
         return int(self.response.shape[0])
 
     def shard_dim(self, shard_id: str) -> int:
-        return int(self.feature_shards[shard_id].shape[1])
+        shard = self.feature_shards[shard_id]
+        if isinstance(shard, SparseShard):
+            return int(shard.num_features)
+        return int(shard.shape[1])
 
     def labeled_batch(self, shard_id: str,
                       offsets: Optional[np.ndarray] = None) -> LabeledBatch:
@@ -53,15 +76,40 @@ class GameDataset:
 
     def subset(self, idx: np.ndarray) -> "GameDataset":
         """Row subset (host-side) — used by down-sampling and tests."""
+        def _sub(shard):
+            if isinstance(shard, SparseShard):
+                return SparseShard(indices=shard.indices[idx],
+                                   values=shard.values[idx],
+                                   num_features=shard.num_features)
+            return shard[idx]
+
         return GameDataset(
             response=self.response[idx],
             offsets=self.offsets[idx],
             weights=self.weights[idx],
-            feature_shards={k: v[idx] for k, v in self.feature_shards.items()},
+            feature_shards={k: _sub(v)
+                            for k, v in self.feature_shards.items()},
             entity_ids={k: v[idx] for k, v in self.entity_ids.items()},
             num_entities=dict(self.num_entities),
             intercept_index=dict(self.intercept_index),
         )
+
+
+def from_sparse_batch(batch, shard_id: str = "global") -> GameDataset:
+    """Adapter: one data/sparse.py SparseBatch → single-shard GameDataset
+    (the Criteo fixed-effect-only configuration, BASELINE config 5)."""
+    return GameDataset(
+        response=np.asarray(batch.labels),
+        offsets=np.asarray(batch.offsets),
+        weights=np.asarray(batch.weights),
+        feature_shards={shard_id: SparseShard(
+            indices=np.asarray(batch.indices),
+            values=np.asarray(batch.values),
+            num_features=int(batch.num_features))},
+        entity_ids={},
+        num_entities={},
+        intercept_index={},
+    )
 
 
 def from_synthetic(syn) -> GameDataset:

@@ -13,7 +13,7 @@ import os
 
 import numpy as np
 
-from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 
 _META = "dataset.json"
 _ARRAYS = "arrays.npz"
@@ -26,13 +26,20 @@ def save_game_dataset(ds: GameDataset, path: str) -> None:
         "offsets": ds.offsets,
         "weights": ds.weights,
     }
+    sparse_shards = {}
     for k, v in ds.feature_shards.items():
-        arrays[f"shard_{k}"] = v
+        if isinstance(v, SparseShard):
+            arrays[f"shard_{k}_indices"] = v.indices
+            arrays[f"shard_{k}_values"] = v.values
+            sparse_shards[k] = int(v.num_features)
+        else:
+            arrays[f"shard_{k}"] = v
     for k, v in ds.entity_ids.items():
         arrays[f"entity_{k}"] = v
     np.savez_compressed(os.path.join(path, _ARRAYS), **arrays)
     meta = {
         "shards": list(ds.feature_shards),
+        "sparse_shards": sparse_shards,  # shard id -> num_features
         "entities": {k: int(n) for k, n in ds.num_entities.items()},
         "intercept_index": {k: v for k, v in ds.intercept_index.items()},
     }
@@ -44,11 +51,20 @@ def load_game_dataset(path: str) -> GameDataset:
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     z = np.load(os.path.join(path, _ARRAYS))
+    sparse = meta.get("sparse_shards", {})
+
+    def _shard(k):
+        if k in sparse:
+            return SparseShard(indices=z[f"shard_{k}_indices"],
+                               values=z[f"shard_{k}_values"],
+                               num_features=int(sparse[k]))
+        return z[f"shard_{k}"]
+
     return GameDataset(
         response=z["response"],
         offsets=z["offsets"],
         weights=z["weights"],
-        feature_shards={k: z[f"shard_{k}"] for k in meta["shards"]},
+        feature_shards={k: _shard(k) for k in meta["shards"]},
         entity_ids={k: z[f"entity_{k}"] for k in meta["entities"]},
         num_entities={k: int(v) for k, v in meta["entities"].items()},
         intercept_index={k: (None if v is None else int(v))

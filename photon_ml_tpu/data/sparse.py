@@ -14,6 +14,13 @@ on a zero slot of the (d+1,)-padded coefficient vector, so padding
 contributes exactly nothing without any masking in the kernels. Rows with
 more than ``max_nnz`` non-zeros keep their largest-magnitude entries
 (callers pick ``max_nnz`` at the dataset's true max to make this lossless).
+
+Contract: rows must be CANONICAL — no feature index may repeat within a
+row (the same contract as the reference's canonical sparse Breeze
+vectors). Margins and gradients are linear and would tolerate duplicates,
+but the Hessian diagonal is quadratic in the per-feature value (Σx² vs
+(Σx)²), so duplicates silently skew SIMPLE variances. ``from_csr``
+inherits canonicality from CSR; ``synthetic_sparse`` dedupes draws.
 """
 
 from __future__ import annotations
@@ -51,13 +58,31 @@ class SparseBatch:
         return self.num_features
 
     def pad_to(self, n: int) -> "SparseBatch":
-        """Pad rows to ``n`` with zero-weight sentinel rows."""
+        """Pad rows to ``n`` with zero-weight sentinel rows. Works on host
+        numpy batches and under jit (device arrays / tracers use jnp)."""
         cur = self.num_rows
         if n == cur:
             return self
         if n < cur:
             raise ValueError(f"cannot shrink {cur} -> {n}")
         extra = n - cur
+        if isinstance(self.indices, jax.Array):
+            import jax.numpy as jnp
+
+            def pad2(a, v):
+                return jnp.pad(a, ((0, extra), (0, 0)), constant_values=v)
+
+            def pad1(a):
+                return jnp.pad(a, ((0, extra),))
+
+            return SparseBatch(
+                indices=pad2(self.indices, self.num_features),
+                values=pad2(self.values, 0.0),
+                labels=pad1(self.labels),
+                weights=pad1(self.weights),
+                offsets=pad1(self.offsets),
+                num_features=self.num_features,
+            )
         ind = np.full((extra, self.max_nnz), self.num_features, np.int32)
         zeros = np.zeros(extra, np.float32)
         return SparseBatch(
@@ -147,7 +172,19 @@ def synthetic_sparse(
         ids = rng.integers(0, num_features,
                            size=(n, nnz_per_row)).astype(np.int32)
     vals = rng.normal(size=(n, nnz_per_row)).astype(np.float32)
-    margin = np.einsum("nk,nk->n", vals, w_true[ids])
+    # Canonicalize rows (ELL contract): duplicate draws of the same index
+    # within a row become sentinel/zero slots.
+    order = np.argsort(ids, axis=1, kind="stable")
+    ids = np.take_along_axis(ids, order, axis=1)
+    vals = np.take_along_axis(vals, order, axis=1)
+    dup = np.zeros_like(ids, dtype=bool)
+    dup[:, 1:] = ids[:, 1:] == ids[:, :-1]
+    ids[dup] = num_features
+    vals[dup] = 0.0
+    valid = ~dup
+    margin = np.einsum(
+        "nk,nk->n", vals,
+        np.where(valid, w_true[np.minimum(ids, num_features - 1)], 0.0))
     margin += noise * rng.normal(size=n).astype(np.float32)
     if task == "logistic":
         labels = (rng.random(n) < 1.0 / (1.0 + np.exp(-margin))).astype(

@@ -86,7 +86,16 @@ class CheckpointManager:
     ) -> None:
         """Persist state. ``updated`` names the coordinates whose
         coefficients changed since the last save (all, if None or if the
-        model directory does not exist yet)."""
+        model directory does not exist yet).
+
+        Multi-host: only process 0 writes (the checkpoint dir is a shared
+        filesystem; concurrent writers would corrupt the incremental
+        layout). Loads run on every rank so control flow stays identical.
+        """
+        import jax
+
+        if jax.process_index() != 0:
+            return
         model_dir = os.path.join(self.directory, _MODEL)
         os.makedirs(model_dir, exist_ok=True)
         write_set = (set(models)

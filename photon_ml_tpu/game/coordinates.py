@@ -245,6 +245,235 @@ class FixedEffectCoordinate:
             shard_id=self.shard_id,
             coefficients=Coefficients.zeros(self.dim))
 
+    def advance_down_sampling(self, steps: int) -> None:
+        """Fast-forward the down-sampling RNG past ``steps`` completed
+        train_model calls (checkpoint resume must subsample the remaining
+        steps exactly as the uninterrupted run would have)."""
+        _advance_down_sampling(self, steps)
+
+
+def _advance_down_sampling(coord, steps: int) -> None:
+    rate = coord.config.down_sampling_rate
+    if rate >= 1.0:
+        return
+    for _ in range(steps):
+        if coord.loss.name in ("logistic", "smoothed_hinge"):
+            binary_classification_down_sample(
+                coord._rng, coord.dataset.response, rate)
+        else:
+            default_down_sample(coord._rng, coord.dataset.num_rows, rate)
+
+
+class SparseFixedEffectCoordinate:
+    """Fixed-effect GLM over an ELL sparse shard (the Criteo path).
+
+    Reference parity: same FixedEffectCoordinate contract, but the
+    objective is the sparse gather/scatter pipeline
+    (parallel/sparse_objective.py) instead of dense matmuls — the analogue
+    of the reference training on sparse Breeze vectors + PalDB index maps.
+    With ``feature_sharded=True`` the coefficient dimension additionally
+    shards over the mesh's ``model`` axis (P3) for feature spaces too large
+    to replicate.
+
+    Residency discipline matches the dense coordinate: the ELL batch is
+    staged on device once; per CD step only (n,) offsets and the warm
+    start move.
+
+    Normalization is not supported here (the reference normalizes dense
+    shards only; scaling sparse values would densify shift terms).
+    Sparse RANDOM effects are deliberately not a separate class: large-d
+    sparse per-entity features are exactly the regime the per-entity
+    subspace projection handles (RandomEffectCoordinate(projection=True)
+    stages dense d_active buckets).
+    """
+
+    def __init__(
+        self,
+        dataset: GameDataset,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        mesh,
+        feature_sharded: bool = False,
+        down_sampling_seed: int = 0,
+    ):
+        from photon_ml_tpu.data.game_data import SparseShard
+        from photon_ml_tpu.data.sparse import SparseBatch
+        from photon_ml_tpu.parallel import sparse_problem as sp
+
+        shard = dataset.feature_shards[shard_id]
+        if not isinstance(shard, SparseShard):
+            raise TypeError(f"shard {shard_id!r} is not sparse")
+        self.dataset = dataset
+        self.shard_id = shard_id
+        self.loss = loss
+        self.config = config
+        self.mesh = mesh
+        self.feature_sharded = bool(feature_sharded)
+        self.intercept_index = dataset.intercept_index.get(shard_id)
+        self._down_sampling_seed = down_sampling_seed
+        self._rng = np.random.default_rng(down_sampling_seed)
+        self._dim = int(shard.num_features)
+        batch = SparseBatch(
+            indices=np.asarray(shard.indices),
+            values=np.asarray(shard.values),
+            labels=np.asarray(dataset.response),
+            weights=np.asarray(dataset.weights),
+            offsets=np.zeros(dataset.num_rows, np.float32),
+            num_features=self._dim)
+        if self.feature_sharded:
+            from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+            batch = sp._pad_features(
+                batch, pad_to_multiple(self._dim, mesh.shape[MODEL_AXIS]))
+        self._staged = sp.shard_sparse_batch(batch, mesh)
+        self._build_fits()
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _padded_offsets(self, offsets: jax.Array) -> jax.Array:
+        offsets = jnp.asarray(offsets)
+        n = self.dataset.num_rows
+        return jnp.zeros((self._staged.num_rows,), offsets.dtype
+                         ).at[:n].set(offsets)
+
+    def _build_fits(self):
+        from photon_ml_tpu.ops import sparse_aggregators as sagg
+        from photon_ml_tpu.parallel import sparse_problem as sp
+
+        cfg = dataclasses.replace(
+            self.config, variance_computation=VarianceComputationType.NONE)
+        loss, mesh, fs = self.loss, self.mesh, self.feature_sharded
+        ii = self.intercept_index
+        d_true = self._dim
+        d_staged = self._staged.num_features
+
+        def lift(w0):
+            """True-dim warm start → staged (possibly feature-padded) dim."""
+            if d_staged == d_true:
+                return w0
+            return jnp.zeros((d_staged,), w0.dtype).at[:d_true].set(w0)
+
+        def fit(staged, offsets, w0):
+            batch = dataclasses.replace(
+                staged, offsets=self._padded_offsets(offsets))
+            coef, _ = sp.run(loss, batch, mesh, cfg,
+                             initial=Coefficients(lift(w0)),
+                             intercept_index=ii,
+                             feature_sharded=fs, already_sharded=True)
+            return coef.means[:d_true]
+
+        def fit_sampled(staged, idx, mult, offsets, w0):
+            sub = dataclasses.replace(
+                staged,
+                indices=staged.indices[idx],
+                values=staged.values[idx],
+                labels=staged.labels[idx],
+                weights=staged.weights[idx] * mult,
+                offsets=offsets[idx],
+            ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
+            coef, _ = sp.run(loss, sub, mesh, cfg,
+                             initial=Coefficients(lift(w0)),
+                             intercept_index=ii,
+                             feature_sharded=fs, already_sharded=True)
+            return coef.means[:d_true]
+
+        def score_fn(staged, means):
+            # Staged offsets are zeros, so margins == X @ w exactly.
+            return sagg.margins(staged, means)
+
+        self._fit = jax.jit(fit)
+        self._fit_sampled = jax.jit(fit_sampled)
+        self._score = jax.jit(score_fn)
+
+    # -- coordinate contract ----------------------------------------------
+
+    @property
+    def dim(self) -> int:
+        return self._dim
+
+    def with_optimization_config(
+        self, config: GLMOptimizationConfiguration
+    ) -> "SparseFixedEffectCoordinate":
+        import copy
+
+        c = copy.copy(self)
+        c.config = config
+        c._rng = np.random.default_rng(self._down_sampling_seed)
+        c._build_fits()
+        return c
+
+    def train_model(
+        self,
+        offsets: jax.Array,
+        initial: Optional[FixedEffectModel] = None,
+    ) -> FixedEffectModel:
+        if initial is not None:
+            w0 = jnp.asarray(initial.coefficients.means)
+        else:
+            w0 = jnp.zeros((self.dim,), jnp.float32)
+        offsets = jnp.asarray(offsets)
+        rate = self.config.down_sampling_rate
+        if rate < 1.0:
+            if self.loss.name in ("logistic", "smoothed_hinge"):
+                idx, mult = binary_classification_down_sample(
+                    self._rng, self.dataset.response, rate)
+            else:
+                idx, mult = default_down_sample(
+                    self._rng, self.dataset.num_rows, rate)
+            w = self._fit_sampled(self._staged, jnp.asarray(idx),
+                                  jnp.asarray(mult),
+                                  self._padded_offsets(offsets), w0)
+        else:
+            w = self._fit(self._staged, offsets, w0)
+        return FixedEffectModel(shard_id=self.shard_id,
+                                coefficients=Coefficients(w))
+
+    def compute_model_variances(
+        self, model: FixedEffectModel, offsets: jax.Array
+    ) -> FixedEffectModel:
+        from photon_ml_tpu.parallel import sparse_objective as sobj
+
+        kind = VarianceComputationType(self.config.variance_computation)
+        if kind == VarianceComputationType.NONE:
+            return model
+        if kind == VarianceComputationType.FULL:
+            raise NotImplementedError(
+                "FULL variance needs the dense d×d Hessian — use SIMPLE at "
+                "sparse scale (as the reference does)")
+        batch = dataclasses.replace(
+            self._staged, offsets=self._padded_offsets(offsets))
+        d_staged = batch.num_features
+        w = jnp.zeros((d_staged,), jnp.float32
+                      ).at[:self.dim].set(model.coefficients.means)
+        diag = sobj.make_hessian_diagonal(
+            self.loss, self.mesh, batch, self.feature_sharded)(w)
+        mask = np.zeros(d_staged, np.float32)
+        mask[:self.dim] = intercept_mask(self.dim, self.intercept_index)
+        var = variances_from_diagonal(
+            diag, self.config.regularization.l2_weight(),
+            jnp.asarray(mask))[:self.dim]
+        return dataclasses.replace(
+            model,
+            coefficients=Coefficients(model.coefficients.means, var))
+
+    def score(self, model: FixedEffectModel) -> jax.Array:
+        n = self.dataset.num_rows
+        means = jnp.asarray(model.coefficients.means)
+        d_staged = self._staged.num_features
+        if d_staged != self.dim:
+            means = jnp.zeros((d_staged,), means.dtype
+                              ).at[:self.dim].set(means)
+        return self._score(self._staged, means)[:n]
+
+    def initial_model(self) -> FixedEffectModel:
+        return FixedEffectModel(
+            shard_id=self.shard_id,
+            coefficients=Coefficients.zeros(self.dim))
+
+    def advance_down_sampling(self, steps: int) -> None:
+        """See FixedEffectCoordinate.advance_down_sampling."""
+        _advance_down_sampling(self, steps)
+
 
 class RandomEffectCoordinate:
     """Per-entity GLMs trained as vmapped bucket solves.
@@ -279,6 +508,13 @@ class RandomEffectCoordinate:
         seed: int = 0,
         projection: bool = False,
     ):
+        from photon_ml_tpu.data.game_data import SparseShard
+        if isinstance(dataset.feature_shards[shard_id], SparseShard):
+            raise TypeError(
+                f"random-effect shard {shard_id!r} is sparse; large-d "
+                f"sparse per-entity features are the subspace-projection "
+                f"regime — densify the shard and use projection=True "
+                f"(stages dense d_active buckets)")
         self.dataset = dataset
         self.re_type = re_type
         self.shard_id = shard_id

@@ -103,6 +103,18 @@ def run(
         if resume.complete:
             return (GameModel(task=task, models=dict(resume.models)),
                     history)
+        # Fast-forward per-coordinate down-sampling RNGs past the completed
+        # train calls so the remaining steps draw the SAME subsamples as an
+        # uninterrupted run would have.
+        completed: dict[str, int] = {}
+        for rec in resume.records:
+            completed[rec["coordinate"]] = \
+                completed.get(rec["coordinate"], 0) + 1
+        for cid, k in completed.items():
+            advance = getattr(coordinates.get(cid), "advance_down_sampling",
+                              None)
+            if advance is not None:
+                advance(k)
 
     models: dict[str, CoordinateModel] = {}
     scores: dict[str, jnp.ndarray] = {}
@@ -159,6 +171,41 @@ def run(
     return GameModel(task=task, models=models), history
 
 
+def _dataset_digest(ds) -> str:
+    """Content digest of a GameDataset (responses, offsets, weights,
+    feature shards, entity assignments) — anything that changes the
+    training objectives. Memoized on the dataset object: at Criteo scale
+    this is a full pass over tens of GB, and a reg-weight grid would
+    otherwise repeat it once per grid point. (Datasets are treated as
+    immutable throughout — see the estimator's coordinate-cache contract.)
+    """
+    cached = getattr(ds, "_content_digest", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha1()
+
+    def _feed(arr):
+        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+
+    for arr in (ds.response, ds.offsets, ds.weights):
+        _feed(arr)
+    for sid in sorted(ds.feature_shards):
+        shard = ds.feature_shards[sid]
+        if hasattr(shard, "indices"):  # SparseShard
+            _feed(shard.indices)
+            _feed(shard.values)
+        else:
+            _feed(shard)
+    for re_type in sorted(ds.entity_ids):
+        _feed(ds.entity_ids[re_type])
+    digest = h.hexdigest()
+    try:
+        ds._content_digest = digest
+    except Exception:  # frozen/slotted datasets: just recompute next time
+        pass
+    return digest
+
+
 def _jsonable(obj):
     """Dataclass/enum tree → plain JSON-comparable values."""
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
@@ -187,8 +234,15 @@ def _fingerprint(task, coordinates, seq, config, locked, n) -> dict:
         }
     ds = coordinates[seq[0]].dataset
     h = hashlib.sha1()
-    for arr in (ds.response, ds.offsets, ds.weights):
-        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    h.update(_dataset_digest(ds).encode())
+    for cid in seq:
+        norm = getattr(coordinates[cid], "norm", None)
+        if norm is not None:
+            for leaf in (getattr(norm, "factors", None),
+                         getattr(norm, "shifts", None)):
+                if leaf is not None:
+                    h.update(
+                        np.ascontiguousarray(np.asarray(leaf)).tobytes())
     return {
         "task": TaskType(task).value,
         "sequence": list(seq),

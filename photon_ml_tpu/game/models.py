@@ -41,8 +41,15 @@ class FixedEffectModel:
         return self.coefficients.dim
 
     def score(self, dataset: GameDataset) -> Array:
-        X = jnp.asarray(dataset.feature_shards[self.shard_id])
-        return X @ self.coefficients.means
+        from photon_ml_tpu.data.game_data import SparseShard
+
+        shard = dataset.feature_shards[self.shard_id]
+        means = self.coefficients.means
+        if isinstance(shard, SparseShard):
+            from photon_ml_tpu.ops.sparse_aggregators import ell_matvec
+            return ell_matvec(jnp.asarray(shard.indices),
+                              jnp.asarray(shard.values), means)
+        return jnp.asarray(shard) @ means
 
 
 @dataclasses.dataclass(frozen=True)

@@ -13,9 +13,11 @@ The coefficient vector is padded with one trailing zero slot so ELL padding
 anywhere in the hot path. Zero-weight (padded) ROWS are handled by the
 weight mask exactly as in the dense aggregators.
 
-Scatter-adds lower to XLA's sort+segment machinery on TPU; for the highest
-throughput the one-hot-matmul variant in ops/pallas_sparse.py can be swapped
-in (MXU-friendly for small-ish d per shard).
+Scatter-adds lower to XLA's sort+segment machinery on TPU; for small and
+moderate coefficient dimensions the Pallas compare+accumulate kernel in
+ops/pallas_sparse.py wins (it is O(d·nnz), so XLA's scatter takes over for
+large d — the auto-dispatch below picks by dimension; set ``USE_PALLAS``
+to force either path).
 """
 
 from __future__ import annotations
@@ -30,17 +32,28 @@ from photon_ml_tpu.ops.losses import PointwiseLoss
 
 Array = jax.Array
 
+# None = auto: Pallas kernel on TPU when dim <= _PALLAS_DIM_MAX, else XLA
+# scatter. True/False force one path (tests, benchmarks).
+USE_PALLAS: Optional[bool] = None
+_PALLAS_DIM_MAX = 2048
+
 
 def _w_padded(means: Array) -> Array:
     """(d,) -> (d+1,) with a zero sentinel slot for ELL padding."""
     return jnp.concatenate([means, jnp.zeros((1,), means.dtype)])
 
 
+def ell_matvec(indices: Array, values: Array, means: Array) -> Array:
+    """(n,) X @ w for ELL rows — THE sentinel gather-dot; every consumer
+    of the ELL layout (objectives, model scoring) goes through here so the
+    padding contract lives in one place."""
+    w_pad = _w_padded(means)
+    return jnp.sum(values * w_pad[indices], axis=-1)
+
+
 def margins(batch: SparseBatch, means: Array) -> Array:
     """(n,) margins wᵀx + offset via slot gather."""
-    w_pad = _w_padded(means)
-    return jnp.sum(batch.values * w_pad[batch.indices], axis=-1) \
-        + batch.offsets
+    return ell_matvec(batch.indices, batch.values, means) + batch.offsets
 
 
 def _masked(weights: Array, term: Array) -> Array:
@@ -49,9 +62,17 @@ def _masked(weights: Array, term: Array) -> Array:
 
 def _scatter_rowterm(batch: SparseBatch, r: Array, dim: int) -> Array:
     """Σ_i r_i · x_i as a scatter-add of r ⊗ values into (d,)."""
-    upd = (r[..., None] * batch.values).reshape(-1)
+    upd = r[..., None] * batch.values
+    use_pallas = USE_PALLAS
+    if use_pallas is None:
+        use_pallas = (dim <= _PALLAS_DIM_MAX
+                      and jax.default_backend() == "tpu")
+    if use_pallas:
+        from photon_ml_tpu.ops import pallas_sparse
+        return pallas_sparse.scatter_rowterm(batch.indices, upd, dim)
     flat = batch.indices.reshape(-1)
-    return jnp.zeros((dim + 1,), upd.dtype).at[flat].add(upd)[:dim]
+    return jnp.zeros((dim + 1,), upd.dtype).at[flat].add(
+        upd.reshape(-1))[:dim]
 
 
 def value_and_gradient(

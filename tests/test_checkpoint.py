@@ -172,3 +172,36 @@ def test_checkpoint_discarded_on_config_change(rng, mesh, tmp_path):
     coords2["fixed"] = counter
     descent.run(est.task, coords2, cfg2, checkpoint_manager=manager)
     assert counter.calls == 1  # it retrained instead of short-circuiting
+
+
+def test_kill_and_resume_with_down_sampling(rng, mesh, tmp_path):
+    """Resume must fast-forward the down-sampling RNG so remaining steps
+    subsample exactly as the uninterrupted run would."""
+    syn = synthetic.game_data(rng, n=800, d_global=6, re_specs={})
+    ds = from_synthetic(syn)
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+        down_sampling_rate=0.5)
+    cc = {"fixed": CoordinateConfiguration(
+        data=FixedEffectDataConfiguration("global"), optimization=opt)}
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc, ["fixed"], mesh,
+                        descent_iterations=3)
+    cfg = descent.CoordinateDescentConfig(["fixed"], iterations=3)
+
+    coords = est._build_coordinates(ds, {"fixed": opt})
+    clean_model, _ = descent.run(est.task, coords, cfg)
+
+    coords2 = est._build_coordinates(ds, {"fixed": opt})
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    killed = dict(coords2)
+    killed["fixed"] = _KillSwitch(coords2["fixed"], allow=2)
+    with pytest.raises(KeyboardInterrupt):
+        descent.run(est.task, killed, cfg, checkpoint_manager=manager)
+
+    coords3 = est._build_coordinates(ds, {"fixed": opt})
+    resumed_model, _ = descent.run(est.task, coords3, cfg,
+                                   checkpoint_manager=manager)
+    np.testing.assert_allclose(
+        np.asarray(resumed_model.models["fixed"].coefficients.means),
+        np.asarray(clean_model.models["fixed"].coefficients.means),
+        rtol=1e-4, atol=1e-5)
