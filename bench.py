@@ -1,15 +1,28 @@
-"""Benchmark: GLM gradient-step throughput on the current accelerator.
+"""Benchmark: GLM training throughput on the current accelerator.
 
-Measures the primary BASELINE.json metric — **GLM gradient-step
-samples/sec/chip** on the fixed-effect data-parallel path (the reference's
-``DistributedGLMLossFunction.treeAggregate`` hot loop, here one fused
-jit-compiled psum objective) — plus the GAME coordinate-descent iteration
-time as a secondary record.
+Primary BASELINE.json metric — **GLM gradient-step samples/sec/chip** on the
+fixed-effect data-parallel path (the reference's
+``DistributedGLMLossFunction.treeAggregate`` hot loop as one fused
+jit-compiled objective) — plus, as secondaries: a FULL jitted L-BFGS
+iteration (value+grad + two-loop + strong-Wolfe line search) and TRON
+iteration with donated buffers, the sparse/Criteo gradient step (1M-feature
+ELL), the Pallas-vs-XLA scatter comparison, and the GAME coordinate-descent
+sweep.
+
+Measurement discipline: on this environment the device is behind an async
+tunnel where ``block_until_ready`` can return before execution finishes
+(round-1 reported 21e9 samples/s ⇒ an impossible ~21 TB/s effective HBM
+rate — that artifact). Every timing here therefore chains iterations
+through a data dependency and forces ONE host read-back at the end, at two
+different iteration counts; the reported per-step time is the SLOPE
+(t_big − t_small)/(iters_big − iters_small), which cancels both the
+constant RPC overhead and the dispatch cost. Achieved FLOP/s and bytes/s
+are printed next to samples/sec so the numbers can be audited against peak
+(v5e: ~197 bf16 TFLOP/s, ~0.8 TB/s HBM).
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 ratio is against an in-process numpy CPU implementation of the same fused
-value+gradient computation — a stand-in for the reference's single-executor
-per-partition aggregator loop on comparable hardware.
+value+gradient pass.
 
 Prints ONE JSON line.
 """
@@ -28,7 +41,20 @@ def _numpy_value_grad(X, y, w):
     return l.sum(), X.T @ r
 
 
-def bench_gradient_step(n=1 << 19, d=256, iters=30, warmup=5):
+def _slope(run, iters_small, iters_large):
+    """Per-iteration seconds via the dependency-chain slope method.
+
+    The span must be wide enough that (iters_large − iters_small) × step
+    time dwarfs the tunnel's RPC jitter (~10 ms) — callers pick spans per
+    workload; median of 3 runs each.
+    """
+    run(iters_small)  # warm-up / compile
+    t_small = sorted(run(iters_small) for _ in range(3))[1]
+    t_large = sorted(run(iters_large) for _ in range(3))[1]
+    return max(t_large - t_small, 1e-9) / (iters_large - iters_small)
+
+
+def bench_gradient_step(n=1 << 19, d=256):
     import jax
     import jax.numpy as jnp
 
@@ -39,41 +65,158 @@ def bench_gradient_step(n=1 << 19, d=256, iters=30, warmup=5):
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, d)).astype(np.float32)
     y = rng.integers(0, 2, size=n).astype(np.float32)
-    batch = LabeledBatch.build(X, y)
-    batch = jax.device_put(batch)
-    w = jnp.zeros((d,), jnp.float32)
+    batch = jax.device_put(LabeledBatch.build(X, y))
 
     step = jax.jit(lambda ww, bb: agg.value_and_gradient(
         losses.LOGISTIC, ww, bb))
-    v, g = step(w, batch)
-    jax.block_until_ready((v, g))
-    for _ in range(warmup):
-        jax.block_until_ready(step(w, batch))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        v, g = step(w, batch)
-    jax.block_until_ready((v, g))
-    dt = (time.perf_counter() - t0) / iters
-    samples_per_sec = n / dt
 
-    # CPU numpy baseline (subsampled for time, scaled):
+    def run(iters):
+        w = jnp.zeros((d,), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, g = step(w, batch)
+            w = w - 1e-9 * g  # chain: next step depends on this one
+        np.asarray(w)  # force the whole chain
+        return time.perf_counter() - t0
+
+    dt = _slope(run, 20, 220)
+    samples_per_sec = n / dt
+    flops = 4.0 * n * d  # X@w and X.T@r, 2nd each
+    bytes_moved = 2.0 * 4 * n * d  # X streamed twice (f32)
+
+    # CPU numpy baseline (subsampled for time):
     n_cpu = min(n, 1 << 16)
-    Xc, yc = X[:n_cpu], y[:n_cpu]
-    wc = np.zeros(d, np.float32)
+    Xc, yc, wc = X[:n_cpu], y[:n_cpu], np.zeros(d, np.float32)
     _numpy_value_grad(Xc, yc, wc)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         _numpy_value_grad(Xc, yc, wc)
     cpu_dt = (time.perf_counter() - t0) / reps
-    cpu_samples_per_sec = n_cpu / cpu_dt
-    return samples_per_sec, cpu_samples_per_sec
+    return {
+        "samples_per_sec": samples_per_sec,
+        "achieved_gflops": flops / dt / 1e9,
+        "achieved_gbytes_per_sec": bytes_moved / dt / 1e9,
+        "cpu_numpy_samples_per_sec": n_cpu / cpu_dt,
+    }
+
+
+def bench_optimizer_steps(n=1 << 17, d=256):
+    """Per-iteration cost of the FULL compiled optimizers (value+grad +
+    history update + line search / CG), donated warm start."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledBatch
+    from photon_ml_tpu.ops import aggregators as agg
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.optim import (OptimizerConfig, minimize_lbfgs,
+                                     minimize_tron, with_l2, with_l2_hvp)
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
+        np.float32)
+    batch = jax.device_put(LabeledBatch.build(X, y))
+    vg = with_l2(lambda w: agg.value_and_gradient(losses.LOGISTIC, w, batch),
+                 1e-3)
+    hvp = with_l2_hvp(
+        lambda w, v: agg.hessian_vector(losses.LOGISTIC, w, v, batch), 1e-3)
+
+    out = {}
+    for name, solver in (
+        ("lbfgs", lambda w0, k: minimize_lbfgs(
+            vg, w0, OptimizerConfig(max_iterations=k, tolerance=0.0))),
+        ("tron", lambda w0, k: minimize_tron(
+            vg, hvp, w0, OptimizerConfig(max_iterations=k, tolerance=0.0,
+                                         max_cg_iterations=10))),
+    ):
+        jitted = {}
+
+        def run(iters, _name=name, _solver=solver, _jitted=jitted):
+            if iters not in _jitted:
+                _jitted[iters] = jax.jit(
+                    lambda w0, _k=iters: _solver(w0, _k).w,
+                    donate_argnums=0)
+            t0 = time.perf_counter()
+            w = _jitted[iters](jnp.zeros((d,), jnp.float32))
+            np.asarray(w)
+            return time.perf_counter() - t0
+
+        spans = {"lbfgs": (10, 60), "tron": (8, 32)}[name]
+        out[f"{name}_iteration_ms"] = _slope(run, *spans) * 1e3
+    return out
+
+
+def bench_sparse(n=1 << 17, d=1_000_000, nnz=32):
+    """Criteo-regime sparse gradient step (BASELINE config 5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.ops import losses, sparse_aggregators as sagg
+
+    batch, _ = sp.synthetic_sparse(n, d, nnz, seed=2)
+    batch = jax.device_put(batch)
+    step = jax.jit(lambda ww, bb: sagg.value_and_gradient(
+        losses.LOGISTIC, ww, bb))
+
+    def run(iters):
+        w = jnp.zeros((d,), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, g = step(w, batch)
+            w = w - 1e-9 * g
+        np.asarray(w[:8])
+        return time.perf_counter() - t0
+
+    dt = _slope(run, 3, 23)
+    return {
+        "sparse_samples_per_sec": n / dt,
+        "sparse_gnnz_per_sec": n * nnz / dt / 1e9,
+    }
+
+
+def bench_pallas_scatter(n=1 << 17, k=32, d=512):
+    """Pallas compare+accumulate scatter vs XLA sort/segment scatter at the
+    moderate-d regime the kernel targets. Skipped off-TPU (the Mosaic
+    kernel doesn't lower elsewhere; interpret mode is orders slower)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return {}
+
+    from photon_ml_tpu.ops.pallas_sparse import scatter_rowterm
+
+    rng = np.random.default_rng(3)
+    idx = jnp.asarray(rng.integers(0, d, (n, k)).astype(np.int32))
+    rv = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+
+    xla = jax.jit(
+        lambda i, v: jnp.zeros((d + 1,), jnp.float32)
+        .at[i.reshape(-1)].add(v.reshape(-1))[:d])
+
+    out = {}
+    for name, f in (("pallas", lambda i, v: scatter_rowterm(i, v, d)),
+                    ("xla", xla)):
+        def run(iters, _f=f):
+            v = rv
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                o = _f(idx, v)
+                v = rv * (1.0 + 1e-20 * o[0])  # chain
+            np.asarray(o[:4])
+            return time.perf_counter() - t0
+
+        out[f"scatter_{name}_d{d}_us"] = _slope(run, 5, 45) * 1e6
+    return out
 
 
 def bench_game_iteration():
-    """Secondary: one GAME coordinate-descent sweep (fixed + per-user)."""
-    import jax
-
+    """One GAME coordinate-descent sweep (fixed + per-user + per-item),
+    steady-state, by the slope between 1- and 3-iteration runs."""
     from photon_ml_tpu.data import synthetic
     from photon_ml_tpu.data.game_data import from_synthetic
     from photon_ml_tpu.game import descent
@@ -103,26 +246,45 @@ def bench_game_iteration():
         "per-item": RandomEffectCoordinate(ds, "itemId", "re_itemId",
                                            losses.LOGISTIC, cfg, mesh),
     }
-    cd = descent.CoordinateDescentConfig(["fixed", "per-user", "per-item"],
-                                         iterations=1)
-    # Warm-up sweep compiles everything; the timed sweep is steady-state.
-    descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
-    t0 = time.perf_counter()
-    descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
-    return time.perf_counter() - t0
+    seq = ["fixed", "per-user", "per-item"]
+
+    def run(iters):
+        cd = descent.CoordinateDescentConfig(seq, iterations=iters)
+        t0 = time.perf_counter()
+        model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
+        np.asarray(model.models["fixed"].coefficients.means)
+        np.asarray(model.models["per-user"].means[:1])
+        return time.perf_counter() - t0
+
+    return _slope(run, 1, 3)
 
 
 def main():
-    samples_per_sec, cpu_baseline = bench_gradient_step()
+    grad = bench_gradient_step()
+    opt = bench_optimizer_steps()
+    sparse = bench_sparse()
+    scatter = bench_pallas_scatter()  # {} off-TPU
     game_iter_s = bench_game_iteration()
     print(json.dumps({
         "metric": "glm_gradient_step_samples_per_sec_per_chip",
-        "value": round(samples_per_sec),
+        "value": round(grad["samples_per_sec"]),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(samples_per_sec / cpu_baseline, 3),
+        "vs_baseline": round(grad["samples_per_sec"]
+                             / grad["cpu_numpy_samples_per_sec"], 3),
         "secondary": {
+            "achieved_gflops": round(grad["achieved_gflops"], 1),
+            "achieved_gbytes_per_sec": round(
+                grad["achieved_gbytes_per_sec"], 1),
+            "lbfgs_full_iteration_ms": round(opt["lbfgs_iteration_ms"], 3),
+            "tron_full_iteration_ms": round(opt["tron_iteration_ms"], 3),
+            "sparse_1m_feature_samples_per_sec": round(
+                sparse["sparse_samples_per_sec"]),
+            "sparse_gnnz_per_sec": round(sparse["sparse_gnnz_per_sec"], 3),
+            **{key: round(v, 1) for key, v in scatter.items()},
             "game_cd_iteration_seconds": round(game_iter_s, 3),
-            "cpu_numpy_baseline_samples_per_sec": round(cpu_baseline),
+            "cpu_numpy_baseline_samples_per_sec": round(
+                grad["cpu_numpy_samples_per_sec"]),
+            "timing_method": "dependency-chain slope (async-tunnel safe)",
         },
     }))
 

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI entry point (reference repo's dev-scripts/ + travis analog).
+# Runs the full suite on a virtual 8-device CPU mesh — no TPU required —
+# then compile-checks the graft entry points the driver exercises.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+unset PALLAS_AXON_POOL_IPS || true
+
+python -m pytest tests/ -q "$@"
+python -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8)"
+echo "ALL CHECKS PASSED"

@@ -78,6 +78,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from an existing checkpoint directory "
                         "instead of starting fresh")
+    p.add_argument("--tuning", default="NONE",
+                   choices=["NONE", "RANDOM", "BAYESIAN"],
+                   help="hyperparameter-tuning mode: search per-coordinate "
+                        "regularization weights after the grid sweep "
+                        "(reference: GameTrainingDriver hyperParameterTuning)")
+    p.add_argument("--tuning-iters", type=int, default=10,
+                   help="number of tuning trials")
+    p.add_argument("--tuning-range", default="1e-4:1e4",
+                   help="lo:hi regularization-weight search range "
+                        "(log scale)")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler trace of the fit into this "
+                        "directory (TensorBoard/Perfetto viewable)")
+    p.add_argument("--distributed", action="store_true",
+                   help="join the multi-host world before building the "
+                        "mesh (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
+                        "/ JAX_PROCESS_ID; automatic on Cloud TPU). "
+                        "Recovery from a lost host is restart + --resume.")
     return p
 
 
@@ -101,11 +119,15 @@ def run(args) -> dict:
         grid_by_coord[cid.strip()] = tuple(
             float(w) for w in ws.split(",") if w)
 
+    locked = {c for c in args.locked_coordinates.split(",") if c}
     coordinates: dict[str, CoordinateConfiguration] = {}
     for spec in args.coordinate:
         name, kv = parse_coordinate(spec)
         if kv["type"] == "fixed":
-            data = FixedEffectDataConfiguration(kv["shard"])
+            data = FixedEffectDataConfiguration(
+                kv["shard"],
+                feature_sharded=kv.get("feature_sharded",
+                                       "false").lower() == "true")
         elif kv["type"] == "random":
             data = RandomEffectDataConfiguration(
                 random_effect_type=kv["re"],
@@ -116,17 +138,27 @@ def run(args) -> dict:
                 projector=kv.get("projector", "NONE").upper())
         else:
             raise ValueError(f"unknown coordinate type {kv['type']!r}")
+        opt = opt_by_coord.get(name, GLMOptimizationConfiguration())
+        grid = grid_by_coord.get(name, ())
+        # Locked coordinates are never retrained, so tuning/grids don't
+        # apply to them — don't demand a regularizer for them.
+        if ((grid or (args.tuning != "NONE" and name not in locked))
+                and opt.regularization.reg_type.value == "NONE"):
+            # A reg-weight grid / tuning sweep over a NONE-regularized
+            # coordinate silently fits the identical model at every point.
+            raise ValueError(
+                f"coordinate {name!r} has regularization NONE; "
+                f"--reg-weight-grid/--tuning need an --opt-config with "
+                f"reg=L1|L2|ELASTIC_NET for it")
         coordinates[name] = CoordinateConfiguration(
-            data=data,
-            optimization=opt_by_coord.get(name, GLMOptimizationConfiguration()),
-            reg_weight_grid=grid_by_coord.get(name, ()))
+            data=data, optimization=opt, reg_weight_grid=grid)
 
     evaluators = [e for e in args.evaluators.split(",") if e]
     est = GameEstimator(
         task=task,
         coordinates=coordinates,
         update_sequence=[c for c in args.update_sequence.split(",") if c],
-        mesh=make_mesh(),
+        mesh=make_mesh(distributed=getattr(args, "distributed", False)),
         descent_iterations=args.iterations,
         validation_evaluators=evaluators)
 
@@ -134,7 +166,14 @@ def run(args) -> dict:
     if args.model_input_dir:
         initial_models = dict(
             model_io.load_game_model(args.model_input_dir).models)
-    locked = {c for c in args.locked_coordinates.split(",") if c}
+
+    # Multi-host: every process runs the same device program, but only the
+    # primary touches shared files (checkpoint cleanup, model/summary
+    # output). Checkpoint LOADS happen on every rank (identical control
+    # flow needs identical resume state — checkpoint_dir must be a shared
+    # filesystem); SAVES are rank-0-only inside CheckpointManager.
+    import jax
+    is_primary = jax.process_index() == 0
 
     if getattr(args, "resume", False) and not getattr(args, "checkpoint", True):
         raise ValueError("--resume requires checkpointing; "
@@ -142,24 +181,74 @@ def run(args) -> dict:
     checkpoint_dir = None
     if getattr(args, "checkpoint", True):
         checkpoint_dir = os.path.join(args.output_dir, "checkpoints")
-        if not getattr(args, "resume", False) and os.path.exists(checkpoint_dir):
+        if (is_primary and not getattr(args, "resume", False)
+                and os.path.exists(checkpoint_dir)):
             # Fresh run: stale checkpoints must not silently short-circuit
             # training (resume is an explicit opt-in).
             import shutil
             shutil.rmtree(checkpoint_dir)
 
-    results = est.fit(train, validation, initial_models=initial_models,
-                      locked_coordinates=locked or None,
-                      checkpoint_dir=checkpoint_dir)
+    from photon_ml_tpu.utils.logging import profile_trace
+
+    with profile_trace(getattr(args, "profile_dir", None)):
+        results = est.fit(train, validation, initial_models=initial_models,
+                          locked_coordinates=locked or None,
+                          checkpoint_dir=checkpoint_dir)
+
+    tuning_summary = None
+    if args.tuning != "NONE":
+        # Reference: GameTrainingDriver's hyperparameter-tuning mode — the
+        # grid results seed the search as prior observations, then RANDOM /
+        # BAYESIAN (GP + expected improvement) trials refine the
+        # per-coordinate regularization weights on the validation metric.
+        from photon_ml_tpu.hyperparameter.evaluation import \
+            GameEvaluationFunction
+        from photon_ml_tpu.hyperparameter.search import (
+            GaussianProcessSearch, RandomSearch)
+        from photon_ml_tpu.utils.ranges import DoubleRange
+
+        if validation is None or not evaluators:
+            raise ValueError("--tuning requires --validation and "
+                             "--evaluators")
+        lo, _, hi = args.tuning_range.partition(":")
+        evalfn = GameEvaluationFunction(
+            est, train, validation,
+            coordinate_ids=[c for c in est.update_sequence
+                            if c not in locked],
+            reg_weight_range=DoubleRange(float(lo), float(hi)),
+            initial_models=initial_models,
+            locked_coordinates=locked or None)
+        dims = evalfn.dimensions()
+        searcher_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"
+                        else RandomSearch)
+        searcher = searcher_cls(dims, evalfn)
+        priors = evalfn.observations_from_results(results)
+        search = searcher.find_with_priors(args.tuning_iters, priors)
+        tuned_est = evalfn._with_weights(search.best_point)
+        results = results + tuned_est.fit(
+            train, validation, initial_models=initial_models,
+            locked_coordinates=locked or None)
+        tuning_summary = {
+            "mode": args.tuning,
+            "iterations": args.tuning_iters,
+            "best_config": search.best_config(dims),
+            "trials": [
+                {"point": {d.name: float(p)
+                           for d, p in zip(dims, o.point)},
+                 "objective": float(o.value)}
+                for o in search.observations],
+        }
+
     best = est.select_best_model(results)
 
     os.makedirs(args.output_dir, exist_ok=True)
-    if args.output_mode == "ALL":
-        for i, r in enumerate(results):
-            model_io.save_game_model(
-                r.model, os.path.join(args.output_dir, f"model-{i}"))
-    model_io.save_game_model(best.model,
-                             os.path.join(args.output_dir, "best"))
+    if is_primary:
+        if args.output_mode == "ALL":
+            for i, r in enumerate(results):
+                model_io.save_game_model(
+                    r.model, os.path.join(args.output_dir, f"model-{i}"))
+        model_io.save_game_model(best.model,
+                                 os.path.join(args.output_dir, "best"))
     summary = {
         "task": task.value,
         "candidates": [
@@ -170,11 +259,13 @@ def run(args) -> dict:
              "metrics": r.evaluation.metrics if r.evaluation else None}
             for r in results],
         "best_metrics": (best.evaluation.metrics if best.evaluation else None),
+        "tuning": tuning_summary,
         "wall_seconds": time.time() - t0,
     }
-    with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
-    logger.info("wrote %s", args.output_dir)
+    if is_primary:
+        with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        logger.info("wrote %s", args.output_dir)
     return summary
 
 

@@ -38,6 +38,10 @@ class GameEvaluationFunction:
     validation_data: object     # GameDataset
     coordinate_ids: Sequence[str]
     reg_weight_range: DoubleRange = DoubleRange(1e-4, 1e4)
+    # Warm starts / partial retraining carried into every trial — without
+    # these a tuned run would silently retrain locked coordinates.
+    initial_models: Optional[dict] = None
+    locked_coordinates: Optional[set] = None
 
     def dimensions(self) -> list[SearchDimension]:
         return [SearchDimension(cid, self.reg_weight_range, log_scale=True)
@@ -51,7 +55,9 @@ class GameEvaluationFunction:
 
     def __call__(self, point: np.ndarray) -> float:
         est = self._with_weights(point)
-        results = est.fit(self.data, self.validation_data)
+        results = est.fit(self.data, self.validation_data,
+                          initial_models=self.initial_models,
+                          locked_coordinates=self.locked_coordinates)
         assert len(results) == 1, "tuning trials must fit one config"
         evaluation = results[0].evaluation
         assert evaluation is not None, "tuning requires validation evaluators"

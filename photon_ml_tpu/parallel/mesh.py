@@ -13,29 +13,101 @@ Axis conventions:
 - ``model``  — feature dimension for the sharded sparse path (P3, Criteo
                regime). Usually size 1.
 
-Multi-host: call ``jax.distributed.initialize()`` before building the mesh;
-XLA routes intra-slice collectives over ICI and cross-slice over DCN. The
-same code compiles unchanged on 1 device (all collectives become no-ops).
+Multi-host (the DCN story, SURVEY.md §2.5 P6 / §5): call
+``initialize_distributed()`` (or ``make_mesh(distributed=True)``) once per
+process before building the mesh. It wraps ``jax.distributed.initialize``,
+which wires every host's local devices into one global device set; XLA then
+routes intra-slice collectives over ICI and cross-slice traffic over DCN —
+the same ``shard_map``/``psum`` programs compile unchanged from 1 chip to a
+multi-host pod (collectives become no-ops at world size 1).
+
+Coordinator discovery follows the standard JAX environment contract
+(honored automatically on Cloud TPU metadata; settable explicitly anywhere):
+
+- ``JAX_COORDINATOR_ADDRESS`` (or the ``coordinator_address`` argument)
+- ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` (or arguments)
+
+Failure model: there is NO lineage re-execution in XLA — a lost host kills
+the step. Recovery is restart-from-checkpoint: relaunch the job and pass
+``--resume`` to ``cli/game_train.py`` (game/checkpoint.py restores
+per-(iteration, coordinate) state; see that module's crash-consistency
+notes). This mirrors how the reference's Spark lineage recovery is replaced
+throughout the rebuild.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+logger = logging.getLogger("photon_ml_tpu.parallel")
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+_distributed_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process to the multi-host world (idempotent).
+
+    Arguments default to the ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` environment variables; on
+    Cloud TPU all three are discoverable from metadata and may be omitted
+    entirely. Returns True when running multi-process afterwards.
+
+    Reference parity: the Spark cluster bootstrap (SparkSession + executor
+    registration) — here one collective-runtime handshake, after which
+    ``jax.devices()`` spans every host and ``make_mesh`` lays axes over the
+    global device set.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return jax.process_count() > 1
+    kwargs = {}
+    if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (
+            coordinator_address or os.environ["JAX_COORDINATOR_ADDRESS"])
+    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes if num_processes is not None
+            else os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(
+            process_id if process_id is not None
+            else os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
+    logger.info("distributed runtime up: process %d/%d, %d local / %d "
+                "global devices", jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+    return jax.process_count() > 1
 
 
 def make_mesh(
     num_data: Optional[int] = None,
     num_model: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    distributed: bool = False,
 ) -> Mesh:
-    """Build a (data, model) mesh over the available devices."""
+    """Build a (data, model) mesh over the available devices.
+
+    With ``distributed=True``, first joins the multi-host world (see
+    ``initialize_distributed``) so the mesh spans every host's devices;
+    shardings over ``data`` then reduce over ICI within a slice and DCN
+    across slices, exactly as laid out.
+    """
+    if distributed:
+        initialize_distributed()
     devices = list(devices if devices is not None else jax.devices())
     if num_data is None:
         num_data = len(devices) // num_model

@@ -67,3 +67,27 @@ class MetricsWriter:
 
     def close(self) -> None:
         self._f.close()
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str]):
+    """XLA/TPU profiler scope (SURVEY.md §5 tracing row): when ``trace_dir``
+    is set, everything inside the scope is captured with ``jax.profiler``
+    (HLO timelines, per-op device time, memory) viewable in
+    TensorBoard/Perfetto; a no-op when None. This replaces the reference's
+    Spark-UI stage timeline as the "where did the time go" tool."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-scope inside a profiler trace (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,200 @@
+"""CLI driver integration tests at tiny scale — the
+``GameTrainingDriverIntegTest`` / ``GameScoringDriverIntegTest`` analogs
+(SURVEY.md §4, VERDICT round-1 weak #5).
+
+Each BASELINE.md target config is represented by a synthetic miniature:
+
+1. fixed-effect logistic, L-BFGS + L2 (a1a-style dense GLM)
+2. linear regression with TRON (YearPredictionMSD-style)
+3. Poisson regression with offsets + L1 / OWL-QN
+4. GAME mixed-effects logistic, global + per-user (MovieLens-style)
+5. sparse GAME logistic (Criteo-style ELL shard)
+
+Every test goes through the real ``main()``/``run()`` entry points:
+arg parsing → fit → save → load → score round trip, asserting metric
+thresholds and artifact integrity.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import feature_index, game_score, game_train, train_glm
+from photon_ml_tpu.data import sparse as sparse_mod
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_sparse_batch, from_synthetic
+from photon_ml_tpu.data.io import save_game_dataset
+from photon_ml_tpu.models import io as model_io
+
+
+def _write_game_data(tmp_path, rng, n=1200, re_specs=None, task="logistic"):
+    syn = synthetic.game_data(rng, n=n, d_global=8,
+                              re_specs=re_specs or {}, task=task)
+    ds = from_synthetic(syn)
+    split = int(0.8 * n)
+    idx = rng.permutation(n)
+    train_dir = str(tmp_path / "train")
+    val_dir = str(tmp_path / "val")
+    save_game_dataset(ds.subset(idx[:split]), train_dir)
+    save_game_dataset(ds.subset(idx[split:]), val_dir)
+    return train_dir, val_dir
+
+
+# -- config 4: GAME mixed effects through game_train + game_score ----------
+
+def test_game_train_and_score_mixed_effects(rng, tmp_path):
+    train_dir, val_dir = _write_game_data(
+        tmp_path, rng, re_specs={"userId": (20, 4)})
+    out = str(tmp_path / "out")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", val_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId,min_samples=2",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", "2",
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.7
+    # Round trip: load the saved model and score via the scoring driver.
+    model = model_io.load_game_model(os.path.join(out, "best"))
+    assert set(model.models) == {"fixed", "per-user"}
+    score_out = str(tmp_path / "scores")
+    score_summary = game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", os.path.join(out, "best"),
+        "--output-dir", score_out, "--evaluators", "AUC",
+    ]))
+    assert score_summary["metrics"]["AUC"] > 0.7
+    scores = np.load(os.path.join(score_out, "scores.npz"))
+    assert scores["score"].shape[0] == score_summary["num_rows"]
+
+
+# -- config 1/2/3: the legacy GLM driver over LIBSVM-style data ------------
+
+def _write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for row, label in zip(X, y):
+            feats = " ".join(f"{j + 1}:{v:.6f}"
+                             for j, v in enumerate(row) if v != 0.0)
+            f.write(f"{label:g} {feats}\n")
+
+
+def _split_libsvm(tmp_path, rng, X, y, name):
+    split = int(0.8 * len(y))
+    idx = rng.permutation(len(y))
+    tr, va = str(tmp_path / f"{name}.tr"), str(tmp_path / f"{name}.va")
+    _write_libsvm(tr, X[idx[:split]], y[idx[:split]])
+    _write_libsvm(va, X[idx[split:]], y[idx[split:]])
+    return tr, va
+
+
+def test_train_glm_logistic_l2(rng, tmp_path):
+    X = rng.normal(size=(800, 10)).astype(np.float32)
+    w = rng.normal(size=10)
+    y = (rng.uniform(size=800) < 1 / (1 + np.exp(-X @ w))).astype(int)
+    tr, va = _split_libsvm(tmp_path, rng, X, y, "a1a")
+    out = str(tmp_path / "glm")
+    summary = train_glm.run(train_glm.build_parser().parse_args([
+        "--train", tr, "--validation", va,
+        "--task", "LOGISTIC_REGRESSION",
+        "--optimizer", "LBFGS", "--reg-type", "L2", "--reg-weights", "1.0",
+        "--output-dir", out,
+    ]))
+    best = summary["models"][summary["best_index"]]
+    assert best["converged"] and best["AUC"] > 0.75
+    # Model round trip.
+    model = model_io.load_glm(os.path.join(
+        out, f"model-{summary['best_index']}"))
+    assert model.coefficients.dim == 11  # 10 features + intercept
+
+
+def test_train_glm_linear_tron(rng, tmp_path):
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    w = rng.normal(size=8)
+    y = X @ w + 0.1 * rng.normal(size=600)
+    tr, va = _split_libsvm(tmp_path, rng, X, y, "msd")
+    out = str(tmp_path / "glm")
+    summary = train_glm.run(train_glm.build_parser().parse_args([
+        "--train", tr, "--validation", va, "--task", "LINEAR_REGRESSION",
+        "--optimizer", "TRON", "--reg-type", "L2", "--reg-weights", "0.1",
+        "--output-dir", out,
+    ]))
+    best = summary["models"][summary["best_index"]]
+    assert best["RMSE"] < 0.3
+
+
+def test_train_glm_poisson_owlqn(rng, tmp_path):
+    X = rng.normal(size=(600, 6)).astype(np.float32) * 0.4
+    w = np.zeros(6)
+    w[:3] = rng.normal(size=3)
+    y = rng.poisson(np.exp(X @ w)).astype(float)
+    tr, va = _split_libsvm(tmp_path, rng, X, y, "poisson")
+    out = str(tmp_path / "glm")
+    summary = train_glm.run(train_glm.build_parser().parse_args([
+        "--train", tr, "--validation", va, "--task", "POISSON_REGRESSION",
+        "--optimizer", "OWLQN", "--reg-type", "L1", "--reg-weights", "0.05",
+        "--output-dir", out,
+    ]))
+    best = summary["models"][summary["best_index"]]
+    assert np.isfinite(best["POISSON_LOSS"])
+
+
+# -- config 5: sparse GAME through game_train ------------------------------
+
+def test_game_train_sparse_shard(rng, tmp_path):
+    batch, _ = sparse_mod.synthetic_sparse(1500, 64, 16, seed=3,
+                                           zipf=False, noise=0.1)
+    ds = from_sparse_batch(batch)
+    train_dir = str(tmp_path / "train")
+    save_game_dataset(ds, train_dir)
+    out = str(tmp_path / "out")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", train_dir,
+        "--coordinate",
+        "name=fixed,type=fixed,shard=global,feature_sharded=true",
+        "--update-sequence", "fixed",
+        "--evaluators", "AUC",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.75
+
+
+# -- tuning mode (VERDICT round-1 item 9) ----------------------------------
+
+@pytest.mark.parametrize("mode", ["RANDOM", "BAYESIAN"])
+def test_game_train_tuning_beats_worst_grid_point(rng, tmp_path, mode):
+    train_dir, val_dir = _write_game_data(tmp_path, rng, n=1000)
+    out = str(tmp_path / f"out-{mode}")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", val_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--update-sequence", "fixed",
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--reg-weight-grid", "fixed:0.01,10000.0",  # 1e4 is deliberately bad
+        "--tuning", mode, "--tuning-iters", "4",
+        "--tuning-range", "1e-3:1e3",
+        "--output-dir", out,
+    ]))
+    assert summary["tuning"]["mode"] == mode
+    # 2 grid points + 4 trials (priors included in observations).
+    assert len(summary["tuning"]["trials"]) >= 4
+    grid_aucs = [c["metrics"]["AUC"] for c in summary["candidates"][:2]]
+    assert summary["best_metrics"]["AUC"] >= max(grid_aucs) - 1e-9
+    assert summary["best_metrics"]["AUC"] > min(grid_aucs)
+
+
+def test_resume_flag_contradiction_rejected(rng, tmp_path):
+    train_dir, _ = _write_game_data(tmp_path, rng, n=200)
+    with pytest.raises(ValueError, match="resume"):
+        game_train.run(game_train.build_parser().parse_args([
+            "--train", train_dir,
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--update-sequence", "fixed",
+            "--output-dir", str(tmp_path / "o"),
+            "--no-checkpoint", "--resume",
+        ]))

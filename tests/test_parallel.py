@@ -120,3 +120,42 @@ def test_distributed_variances(mesh, rng):
                                  intercept_index=b.dim - 1)
     assert coef_f.variances is not None
     assert np.all(np.asarray(coef_f.variances) > 0)
+
+
+class TestDistributedSeam:
+    """Multi-host initialization plumbing (SURVEY §2.5 P6): verifies the
+    env-var contract and idempotence without starting a real coordinator
+    (jax.distributed.initialize is monkeypatched)."""
+
+    def test_env_contract_and_idempotence(self, monkeypatch):
+        from photon_ml_tpu.parallel import mesh as mesh_mod
+
+        calls = []
+        monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
+        monkeypatch.setattr(
+            mesh_mod.jax.distributed, "initialize",
+            lambda **kw: calls.append(kw))
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "2")
+        mesh_mod.initialize_distributed()
+        assert calls == [{"coordinator_address": "10.0.0.1:1234",
+                          "num_processes": 4, "process_id": 2}]
+        # Idempotent: a second call must not re-initialize.
+        mesh_mod.initialize_distributed()
+        assert len(calls) == 1
+
+    def test_explicit_args_override_env(self, monkeypatch):
+        from photon_ml_tpu.parallel import mesh as mesh_mod
+
+        calls = []
+        monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
+        monkeypatch.setattr(
+            mesh_mod.jax.distributed, "initialize",
+            lambda **kw: calls.append(kw))
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        mesh_mod.initialize_distributed(
+            coordinator_address="10.9.9.9:999", num_processes=2,
+            process_id=0)
+        assert calls == [{"coordinator_address": "10.9.9.9:999",
+                          "num_processes": 2, "process_id": 0}]
