@@ -154,6 +154,9 @@ def run(args) -> dict:
             data=data, optimization=opt, reg_weight_grid=grid)
 
     evaluators = [e for e in args.evaluators.split(",") if e]
+    if args.tuning != "NONE" and (not args.validation or not evaluators):
+        # Fail at argument time, not after an hours-long grid sweep.
+        raise ValueError("--tuning requires --validation and --evaluators")
     est = GameEstimator(
         task=task,
         coordinates=coordinates,
@@ -187,6 +190,11 @@ def run(args) -> dict:
             # training (resume is an explicit opt-in).
             import shutil
             shutil.rmtree(checkpoint_dir)
+        if jax.process_count() > 1:
+            # All ranks load checkpoints inside fit; none may read before
+            # rank 0's cleanup above lands on the shared filesystem.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("checkpoint-cleanup")
 
     from photon_ml_tpu.utils.logging import profile_trace
 
@@ -207,9 +215,6 @@ def run(args) -> dict:
             GaussianProcessSearch, RandomSearch)
         from photon_ml_tpu.utils.ranges import DoubleRange
 
-        if validation is None or not evaluators:
-            raise ValueError("--tuning requires --validation and "
-                             "--evaluators")
         lo, _, hi = args.tuning_range.partition(":")
         evalfn = GameEvaluationFunction(
             est, train, validation,
@@ -224,10 +229,13 @@ def run(args) -> dict:
         searcher = searcher_cls(dims, evalfn)
         priors = evalfn.observations_from_results(results)
         search = searcher.find_with_priors(args.tuning_iters, priors)
-        tuned_est = evalfn._with_weights(search.best_point)
-        results = results + tuned_est.fit(
-            train, validation, initial_models=initial_models,
-            locked_coordinates=locked or None)
+        best_trial = evalfn.best_trial()
+        if (best_trial is not None
+                and best_trial[0] <= search.best_value + 1e-12):
+            # The winning trial's model was already trained during the
+            # search — reuse it instead of refitting an (n+1)-th time.
+            results = results + best_trial[2]
+        # else: the winner is a grid prior, already present in `results`.
         tuning_summary = {
             "mode": args.tuning,
             "iterations": args.tuning_iters,

@@ -7,15 +7,71 @@ equivalent of the bundled-dataset path used by the BASELINE.json configs
 module.
 
 Host-side parsing to dense or CSR numpy; the device pipeline consumes the
-arrays via LabeledBatch.
+arrays via LabeledBatch. The hot path is the native single-pass C++ parser
+(``photon_ml_tpu/native/libsvm.cc``, the rebuild's executor-side ingestion
+analog) with a pure-Python fallback of identical semantics when no
+toolchain is available.
 """
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 from typing import Optional
 
 import numpy as np
+
+_native_lib = None
+_native_failed = False
+
+
+def _load_native():
+    """Compile/load the C++ parser once; None when unavailable."""
+    global _native_lib, _native_failed
+    if _native_lib is not None or _native_failed:
+        return _native_lib
+    try:
+        from photon_ml_tpu.native import build_library
+
+        lib = ctypes.CDLL(build_library("libsvm"))
+        lib.lsvm_parse.restype = ctypes.c_void_p
+        lib.lsvm_parse.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.lsvm_num_rows.restype = ctypes.c_long
+        lib.lsvm_num_rows.argtypes = [ctypes.c_void_p]
+        lib.lsvm_nnz.restype = ctypes.c_long
+        lib.lsvm_nnz.argtypes = [ctypes.c_void_p]
+        lib.lsvm_max_index.restype = ctypes.c_int
+        lib.lsvm_max_index.argtypes = [ctypes.c_void_p]
+        lib.lsvm_error.restype = ctypes.c_int
+        lib.lsvm_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.lsvm_fill.argtypes = [ctypes.c_void_p] + [
+            np.ctypeslib.ndpointer(dt, flags="C_CONTIGUOUS")
+            for dt in (np.float32, np.int64, np.int32, np.float32)]
+        lib.lsvm_free.argtypes = [ctypes.c_void_p]
+        _native_lib = lib
+    except Exception:
+        _native_failed = True
+    return _native_lib
+
+
+def _parse_native(lib, path: str, zero_based: bool):
+    handle = lib.lsvm_parse(path.encode(), int(zero_based))
+    try:
+        buf = ctypes.create_string_buffer(256)
+        if lib.lsvm_error(handle, buf, 256):
+            raise ValueError(
+                f"libsvm parse error in {path}: {buf.value.decode()}")
+        n = lib.lsvm_num_rows(handle)
+        nnz = lib.lsvm_nnz(handle)
+        labels = np.empty(n, np.float32)
+        indptr = np.empty(n + 1, np.int64)
+        indices = np.empty(nnz, np.int32)
+        values = np.empty(nnz, np.float32)
+        lib.lsvm_fill(handle, labels, indptr, indices, values)
+        return labels, indptr, indices, values, lib.lsvm_max_index(handle)
+    finally:
+        lib.lsvm_free(handle)
 
 
 @dataclasses.dataclass
@@ -57,37 +113,50 @@ def read_libsvm(
     ``binary_labels_to_01`` maps {-1,+1} labels to {0,1} (the convention of
     this framework's classification losses; a1a ships ±1).
     """
-    labels: list[float] = []
-    indptr = [0]
-    indices: list[int] = []
-    values: list[float] = []
-    offset = 0 if zero_based else 1
-    max_idx = -1
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            for tok in parts[1:]:
-                k, v = tok.split(":")
-                idx = int(k) - offset
-                if idx > max_idx:
-                    max_idx = idx
-                indices.append(idx)
-                values.append(float(v))
-            indptr.append(len(indices))
+    import os
+
+    if not os.path.exists(path):
+        # Uniform exception type across the native and fallback paths.
+        raise FileNotFoundError(path)
+    lib = _load_native()
+    if lib is not None:
+        y, indptr_a, indices_a, values_a, max_idx = _parse_native(
+            lib, path, zero_based)
+    else:
+        labels: list[float] = []
+        indptr = [0]
+        indices: list[int] = []
+        values: list[float] = []
+        offset = 0 if zero_based else 1
+        max_idx = -1
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    idx = int(k) - offset
+                    if idx > max_idx:
+                        max_idx = idx
+                    indices.append(idx)
+                    values.append(float(v))
+                indptr.append(len(indices))
+        y = np.asarray(labels, np.float32)
+        indptr_a = np.asarray(indptr, np.int64)
+        indices_a = np.asarray(indices, np.int32)
+        values_a = np.asarray(values, np.float32)
 
     d = num_features if num_features is not None else max_idx + 1
-    y = np.asarray(labels, np.float32)
     if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
         y = (y + 1.0) / 2.0
     data = LibsvmData(
         labels=y,
-        indptr=np.asarray(indptr, np.int64),
-        indices=np.asarray(indices, np.int32),
-        values=np.asarray(values, np.float32),
+        indptr=indptr_a,
+        indices=indices_a,
+        values=values_a,
         num_features=d,
     )
     if dense:

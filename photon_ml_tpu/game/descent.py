@@ -28,6 +28,7 @@ import numpy as np
 
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import events as ev_mod
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
@@ -132,6 +133,11 @@ def run(
         scores[cid] = s
         total = total + s
 
+    emitter = ev_mod.default_emitter
+    emitter.emit(ev_mod.TrainingStart(
+        task=TaskType(task).value, update_sequence=tuple(seq),
+        iterations=config.iterations))
+
     step = 0
     for it in range(config.iterations):
         for cid in seq:
@@ -158,12 +164,17 @@ def run(
             logger.info("CD iter %d coordinate %s: %.2fs %s", it, cid,
                         elapsed, rec.get("validation", ""))
             history.records.append(rec)
+            emitter.emit(ev_mod.CoordinateUpdate(
+                iteration=it, coordinate=cid, train_seconds=elapsed,
+                validation=rec.get("validation")))
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
                     task, models, done_steps=step,
                     records=history.records, fingerprint=fingerprint,
                     updated=[cid])
 
+    emitter.emit(ev_mod.TrainingFinish(task=TaskType(task).value,
+                                       total_updates=step))
     if checkpoint_manager is not None:
         checkpoint_manager.save(task, models, done_steps=step,
                                 records=history.records, complete=True,

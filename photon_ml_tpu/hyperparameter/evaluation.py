@@ -42,6 +42,10 @@ class GameEvaluationFunction:
     # these a tuned run would silently retrain locked coordinates.
     initial_models: Optional[dict] = None
     locked_coordinates: Optional[set] = None
+    # Best trial seen: (objective, point, results) — lets the driver reuse
+    # the winning trial's already-trained model instead of refitting.
+    _best: Optional[tuple] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False)
 
     def dimensions(self) -> list[SearchDimension]:
         return [SearchDimension(cid, self.reg_weight_range, log_scale=True)
@@ -61,7 +65,16 @@ class GameEvaluationFunction:
         assert len(results) == 1, "tuning trials must fit one config"
         evaluation = results[0].evaluation
         assert evaluation is not None, "tuning requires validation evaluators"
-        return self._sign() * float(evaluation.primary_value)
+        value = self._sign() * float(evaluation.primary_value)
+        if self._best is None or value < self._best[0]:
+            object.__setattr__(self, "_best", (value, np.array(point),
+                                               results))
+        return value
+
+    def best_trial(self) -> Optional[tuple]:
+        """(objective, point, results) of the best trial this function has
+        evaluated, or None if never called."""
+        return self._best
 
     def _with_weights(self, point: np.ndarray):
         import copy

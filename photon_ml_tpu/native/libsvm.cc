@@ -1,0 +1,156 @@
+// Fast LIBSVM text parser (single pass, no per-token Python objects).
+//
+// Reference parity: the reference's data ingestion runs inside JVM
+// executors (AvroDataReader / LIBSVM fixtures parsed natively by Spark);
+// this is the rebuild's native ingestion analog for the text path — the
+// Python fallback in data/libsvm.py implements identical semantics
+// (blank lines and '#' comment lines skipped, "idx:val" tokens, optional
+// 1-based indices).
+//
+// C ABI (ctypes): parse → query sizes → fill caller-allocated numpy
+// buffers → free. Errors are reported per-handle (lsvm_error) so the
+// Python wrapper can raise with the offending line number.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  std::vector<float> labels;
+  std::vector<int64_t> indptr{0};
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  int32_t max_index = -1;
+  std::string error;
+};
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* lsvm_parse(const char* path, int zero_based) {
+  auto* out = new Parsed();
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    out->error = std::string("cannot open ") + path;
+    return out;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (size > 0 && std::fread(&buf[0], 1, size, f) != (size_t)size) {
+    out->error = "short read";
+    std::fclose(f);
+    return out;
+  }
+  std::fclose(f);
+
+  const int off = zero_based ? 0 : 1;
+  const char* p = buf.data();
+  const char* end = p + buf.size();
+  long lineno = 0;
+  while (p < end) {
+    ++lineno;
+    const char* eol = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!eol) eol = end;
+    const char* q = skip_ws(p, eol);
+    if (q == eol || *q == '#') {  // blank / comment line
+      p = eol + 1;
+      continue;
+    }
+    char* next = nullptr;
+    double label = std::strtod(q, &next);
+    if (next == q) {
+      char msg[64];
+      std::snprintf(msg, sizeof msg, "bad label at line %ld", lineno);
+      out->error = msg;
+      return out;
+    }
+    out->labels.push_back(static_cast<float>(label));
+    q = next;
+    while (true) {
+      q = skip_ws(q, eol);
+      if (q >= eol) break;
+      // '#' mid-line is an error, matching the Python fallback (only a
+      // line-initial '#' marks a comment).
+      long idx = (*q == '#') ? (next = const_cast<char*>(q), 0)
+                             : std::strtol(q, &next, 10);
+      if (next == q || next >= eol || *next != ':') {
+        char msg[64];
+        std::snprintf(msg, sizeof msg, "bad token at line %ld", lineno);
+        out->error = msg;
+        return out;
+      }
+      q = next + 1;  // past ':'
+      // The value must start immediately after ':' — strtod would happily
+      // skip whitespace INCLUDING the newline and eat the next row's
+      // label; the fallback raises on "3:" / "3: 0.5" and so must we.
+      if (q >= eol || *q == ' ' || *q == '\t' || *q == '\r') {
+        char msg[64];
+        std::snprintf(msg, sizeof msg, "bad value at line %ld", lineno);
+        out->error = msg;
+        return out;
+      }
+      double val = std::strtod(q, &next);
+      if (next == q || next > eol) {
+        char msg[64];
+        std::snprintf(msg, sizeof msg, "bad value at line %ld", lineno);
+        out->error = msg;
+        return out;
+      }
+      q = next;
+      int32_t col = static_cast<int32_t>(idx - off);
+      if (col > out->max_index) out->max_index = col;
+      out->indices.push_back(col);
+      out->values.push_back(static_cast<float>(val));
+    }
+    out->indptr.push_back(static_cast<int64_t>(out->indices.size()));
+    p = eol + 1;
+  }
+  return out;
+}
+
+long lsvm_num_rows(void* h) {
+  return static_cast<long>(static_cast<Parsed*>(h)->labels.size());
+}
+
+long lsvm_nnz(void* h) {
+  return static_cast<long>(static_cast<Parsed*>(h)->indices.size());
+}
+
+int lsvm_max_index(void* h) {
+  return static_cast<Parsed*>(h)->max_index;
+}
+
+int lsvm_error(void* h, char* buf, int buflen) {
+  auto* p = static_cast<Parsed*>(h);
+  if (p->error.empty()) return 0;
+  std::snprintf(buf, static_cast<size_t>(buflen), "%s", p->error.c_str());
+  return 1;
+}
+
+void lsvm_fill(void* h, float* labels, int64_t* indptr, int32_t* indices,
+               float* values) {
+  auto* p = static_cast<Parsed*>(h);
+  std::memcpy(labels, p->labels.data(), p->labels.size() * sizeof(float));
+  std::memcpy(indptr, p->indptr.data(), p->indptr.size() * sizeof(int64_t));
+  std::memcpy(indices, p->indices.data(),
+              p->indices.size() * sizeof(int32_t));
+  std::memcpy(values, p->values.data(), p->values.size() * sizeof(float));
+}
+
+void lsvm_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,77 @@
+"""Training lifecycle events: emitter + listener registry.
+
+Reference parity: photon-lib ``event/`` (PhotonMLEvent hierarchy +
+EventEmitter trait consumed by the drivers for audit logging and external
+progress reporting). TPU-native shape: plain dataclass events dispatched
+synchronously from the coordinate-descent loop and the estimator — there
+is no executor fan-in to marshal, so a listener is just a callable.
+
+Listeners must be cheap and non-failing; a raising listener is logged and
+detached rather than killing training (the reference swallows listener
+errors the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional
+
+logger = logging.getLogger("photon_ml_tpu.events")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base class for all training events (PhotonMLEvent parity)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStart(Event):
+    task: str
+    update_sequence: tuple
+    iterations: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateUpdate(Event):
+    """One (iteration, coordinate) block update finished
+    (PhotonOptimizationLogEvent parity)."""
+
+    iteration: int
+    coordinate: str
+    train_seconds: float
+    validation: Optional[dict] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinish(Event):
+    task: str
+    total_updates: int
+
+
+class EventEmitter:
+    """Synchronous listener registry (EventEmitter trait parity)."""
+
+    def __init__(self):
+        self._listeners: list[Callable[[Event], None]] = []
+
+    def register(self, listener: Callable[[Event], None]) -> None:
+        self._listeners.append(listener)
+
+    def unregister(self, listener: Callable[[Event], None]) -> None:
+        self._listeners.remove(listener)
+
+    def emit(self, event: Event) -> None:
+        for listener in list(self._listeners):
+            try:
+                listener(event)
+            except Exception:
+                logger.exception(
+                    "event listener %r failed on %r — detaching it",
+                    listener, event)
+                self._listeners.remove(listener)
+
+
+# Process-wide default emitter: drivers and libraries emit here unless
+# handed an explicit one (the reference's driver-scoped emitter analog).
+default_emitter = EventEmitter()
