@@ -102,7 +102,8 @@ class GameEstimator:
                     dataset, cc.data.feature_shard_id, self.loss, opt,
                     self.mesh,
                     norm=self.normalization.get(cc.data.feature_shard_id,
-                                                NormalizationContext()))
+                                                NormalizationContext()),
+                    feature_dtype=cc.data.feature_dtype)
             elif isinstance(cc.data, RandomEffectDataConfiguration):
                 coords[cid] = RandomEffectCoordinate(
                     dataset, cc.data.random_effect_type,

@@ -127,7 +127,8 @@ def run(args) -> dict:
             data = FixedEffectDataConfiguration(
                 kv["shard"],
                 feature_sharded=kv.get("feature_sharded",
-                                       "false").lower() == "true")
+                                       "false").lower() == "true",
+                feature_dtype=kv.get("dtype", "float32"))
         elif kv["type"] == "random":
             data = RandomEffectDataConfiguration(
                 random_effect_type=kv["re"],

@@ -52,8 +52,12 @@ class LabeledBatch:
         weights=None,
         offsets=None,
         dtype=jnp.float32,
+        feature_dtype=None,
     ) -> "LabeledBatch":
-        features = jnp.asarray(features, dtype=dtype)
+        """``feature_dtype`` (default: ``dtype``) sets feature storage only
+        — e.g. bfloat16 to halve HBM traffic; labels/weights/offsets keep
+        ``dtype`` (losses and reductions stay f32)."""
+        features = jnp.asarray(features, dtype=feature_dtype or dtype)
         labels = jnp.asarray(labels, dtype=dtype)
         n = features.shape[-2]
         if weights is None:

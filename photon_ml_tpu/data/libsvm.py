@@ -9,8 +9,12 @@ module.
 Host-side parsing to dense or CSR numpy; the device pipeline consumes the
 arrays via LabeledBatch. The hot path is the native single-pass C++ parser
 (``photon_ml_tpu/native/libsvm.cc``, the rebuild's executor-side ingestion
-analog) with a pure-Python fallback of identical semantics when no
-toolchain is available.
+analog) with a pure-Python fallback when no toolchain is available. Both
+enforce the same structural grammar (comments, idx:val tokens, index
+bounds, strict value placement); the native parser's numeric-literal
+grammar is the locale-independent ``std::from_chars`` one, while the
+fallback inherits Python ``float``'s slightly larger literal set (e.g.
+digit underscores) — well-formed LIBSVM files parse identically.
 """
 
 from __future__ import annotations
@@ -139,6 +143,10 @@ def read_libsvm(
                 for tok in parts[1:]:
                     k, v = tok.split(":")
                     idx = int(k) - offset
+                    if idx < 0 or idx > 2**31 - 1:
+                        raise ValueError(
+                            f"feature index out of range in {path}: "
+                            f"{tok!r}")
                     if idx > max_idx:
                         max_idx = idx
                     indices.append(idx)

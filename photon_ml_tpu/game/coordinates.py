@@ -91,6 +91,7 @@ class FixedEffectCoordinate:
         mesh,
         norm: NormalizationContext = NormalizationContext(),
         down_sampling_seed: int = 0,
+        feature_dtype: str = "float32",
     ):
         self.dataset = dataset
         self.shard_id = shard_id
@@ -101,13 +102,17 @@ class FixedEffectCoordinate:
         self.intercept_index = dataset.intercept_index.get(shard_id)
         self._down_sampling_seed = down_sampling_seed
         self._rng = np.random.default_rng(down_sampling_seed)
+        self.feature_dtype = feature_dtype
         # Stage the full training batch on device ONCE (offsets are a
         # placeholder — they are the per-CD-step input). shard_batch pads to
         # a multiple of the data-axis size with zero-weight rows. Scoring
         # reuses the staged features — no second device copy of X.
+        # feature_dtype="bfloat16" stores X at half width (see
+        # ops/aggregators._matvec for the f32-accumulation contract).
         self._staged = shard_batch(
             LabeledBatch.build(dataset.feature_shards[shard_id],
-                               dataset.response, dataset.weights),
+                               dataset.response, dataset.weights,
+                               feature_dtype=feature_dtype),
             mesh)
         self._build_fits()
 
@@ -237,8 +242,11 @@ class FixedEffectCoordinate:
 
     def score(self, model: FixedEffectModel) -> Array:
         """Raw-space score (identical to the training margins by algebra)."""
+        from photon_ml_tpu.ops.aggregators import scores as agg_scores
+
         n = self.dataset.num_rows
-        return (self._staged.features @ model.coefficients.means)[:n]
+        return agg_scores(self._staged.features,
+                          model.coefficients.means)[:n]
 
     def initial_model(self) -> FixedEffectModel:
         return FixedEffectModel(

@@ -67,8 +67,7 @@ class GameEvaluationFunction:
         assert evaluation is not None, "tuning requires validation evaluators"
         value = self._sign() * float(evaluation.primary_value)
         if self._best is None or value < self._best[0]:
-            object.__setattr__(self, "_best", (value, np.array(point),
-                                               results))
+            self._best = (value, np.array(point), results)
         return value
 
     def best_trial(self) -> Optional[tuple]:

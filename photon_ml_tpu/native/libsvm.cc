@@ -11,6 +11,7 @@
 // buffers → free. Errors are reported per-handle (lsvm_error) so the
 // Python wrapper can raise with the offending line number.
 
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -32,6 +33,28 @@ struct Parsed {
 inline const char* skip_ws(const char* p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
   return p;
+}
+
+// Locale-independent, line-bounded double parse (std::from_chars): never
+// reads past eol (strtod would skip the newline and eat the next row),
+// never honors LC_NUMERIC, rejects hex floats. Optional leading '+' for
+// LIBSVM's "+1" labels.
+inline bool parse_double(const char* q, const char* eol, double* out,
+                         const char** next) {
+  if (q < eol && *q == '+') ++q;
+  auto res = std::from_chars(q, eol, *out);
+  if (res.ec != std::errc()) return false;
+  *next = res.ptr;
+  return true;
+}
+
+inline bool parse_long(const char* q, const char* eol, long* out,
+                       const char** next) {
+  if (q < eol && *q == '+') ++q;
+  auto res = std::from_chars(q, eol, *out, 10);
+  if (res.ec != std::errc()) return false;
+  *next = res.ptr;
+  return true;
 }
 
 }  // namespace
@@ -70,9 +93,9 @@ void* lsvm_parse(const char* path, int zero_based) {
       p = eol + 1;
       continue;
     }
-    char* next = nullptr;
-    double label = std::strtod(q, &next);
-    if (next == q) {
+    const char* next = nullptr;
+    double label;
+    if (!parse_double(q, eol, &label, &next)) {
       char msg[64];
       std::snprintf(msg, sizeof msg, "bad label at line %ld", lineno);
       out->error = msg;
@@ -85,33 +108,32 @@ void* lsvm_parse(const char* path, int zero_based) {
       if (q >= eol) break;
       // '#' mid-line is an error, matching the Python fallback (only a
       // line-initial '#' marks a comment).
-      long idx = (*q == '#') ? (next = const_cast<char*>(q), 0)
-                             : std::strtol(q, &next, 10);
-      if (next == q || next >= eol || *next != ':') {
+      long idx;
+      if (*q == '#' || !parse_long(q, eol, &idx, &next)
+          || next >= eol || *next != ':') {
         char msg[64];
         std::snprintf(msg, sizeof msg, "bad token at line %ld", lineno);
         out->error = msg;
         return out;
       }
       q = next + 1;  // past ':'
-      // The value must start immediately after ':' — strtod would happily
-      // skip whitespace INCLUDING the newline and eat the next row's
-      // label; the fallback raises on "3:" / "3: 0.5" and so must we.
-      if (q >= eol || *q == ' ' || *q == '\t' || *q == '\r') {
-        char msg[64];
-        std::snprintf(msg, sizeof msg, "bad value at line %ld", lineno);
-        out->error = msg;
-        return out;
-      }
-      double val = std::strtod(q, &next);
-      if (next == q || next > eol) {
+      double val;
+      if (!parse_double(q, eol, &val, &next)) {
         char msg[64];
         std::snprintf(msg, sizeof msg, "bad value at line %ld", lineno);
         out->error = msg;
         return out;
       }
       q = next;
-      int32_t col = static_cast<int32_t>(idx - off);
+      long col_l = idx - off;
+      if (col_l < 0 || col_l > INT32_MAX) {
+        char msg[80];
+        std::snprintf(msg, sizeof msg,
+                      "feature index out of range at line %ld", lineno);
+        out->error = msg;
+        return out;
+      }
+      int32_t col = static_cast<int32_t>(col_l);
       if (col > out->max_index) out->max_index = col;
       out->indices.push_back(col);
       out->values.push_back(static_cast<float>(val));

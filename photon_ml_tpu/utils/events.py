@@ -69,7 +69,8 @@ class EventEmitter:
                 logger.exception(
                     "event listener %r failed on %r — detaching it",
                     listener, event)
-                self._listeners.remove(listener)
+                if listener in self._listeners:  # may have self-unregistered
+                    self._listeners.remove(listener)
 
 
 # Process-wide default emitter: drivers and libraries emit here unless

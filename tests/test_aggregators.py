@@ -151,3 +151,44 @@ def test_vmap_batching_matches_per_item(rng):
         v_i, g_i = agg.value_and_gradient(losses.LOGISTIC, means[i], batches[i])
         np.testing.assert_allclose(vals[i], v_i, rtol=1e-5)
         np.testing.assert_allclose(grads[i], g_i, rtol=1e-5, atol=1e-6)
+
+
+def test_bfloat16_feature_storage_close_to_f32(rng):
+    """bf16 feature storage (f32 MXU accumulation) must track the f32 path
+    closely on value/gradient/hvp, and the full fit must land near the f32
+    optimum."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledBatch
+    from photon_ml_tpu.optim import OptimizerConfig, minimize_lbfgs, with_l2
+
+    n, d = 512, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-X @ w_true))).astype(
+        np.float32)
+    b32 = LabeledBatch.build(X, y)
+    b16 = LabeledBatch.build(X, y, feature_dtype=jnp.bfloat16)
+    assert b16.features.dtype == jnp.bfloat16
+    assert b16.labels.dtype == jnp.float32  # only features are narrowed
+
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    v32, g32 = agg.value_and_gradient(losses.LOGISTIC, w, b32)
+    v16, g16 = agg.value_and_gradient(losses.LOGISTIC, w, b16)
+    assert v16.dtype == jnp.float32 and g16.dtype == jnp.float32
+    np.testing.assert_allclose(v16, v32, rtol=2e-2)
+    np.testing.assert_allclose(g16, g32, rtol=5e-2, atol=0.5)
+    hv32 = agg.hessian_vector(losses.LOGISTIC, w, w, b32)
+    hv16 = agg.hessian_vector(losses.LOGISTIC, w, w, b16)
+    np.testing.assert_allclose(hv16, hv32, rtol=5e-2, atol=0.5)
+
+    cfg = OptimizerConfig(max_iterations=100, tolerance=1e-7)
+    w32 = minimize_lbfgs(
+        with_l2(lambda ww: agg.value_and_gradient(losses.LOGISTIC, ww, b32),
+                1.0), jnp.zeros(d), cfg)
+    w16 = minimize_lbfgs(
+        with_l2(lambda ww: agg.value_and_gradient(losses.LOGISTIC, ww, b16),
+                1.0), jnp.zeros(d), cfg)
+    assert bool(w16.converged)
+    np.testing.assert_allclose(np.asarray(w16.w), np.asarray(w32.w),
+                               rtol=5e-2, atol=2e-2)
