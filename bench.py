@@ -108,7 +108,14 @@ def bench_gradient_step(n=1 << 19, d=256):
 
 def bench_optimizer_steps(n=1 << 17, d=256):
     """Per-iteration cost of the FULL compiled optimizers (value+grad +
-    history update + line search / CG), donated warm start."""
+    history update + line search / CG), donated warm start.
+
+    The problem is a deliberately ill-conditioned logistic fit and the
+    tolerance is negative (convergence checks can never fire), so every
+    requested iteration actually executes; the slope denominator uses the
+    EXECUTED iteration counts reported by the solver, guarding against
+    early line-search stalls silently zeroing the measurement.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -120,7 +127,8 @@ def bench_optimizer_steps(n=1 << 17, d=256):
 
     rng = np.random.default_rng(1)
     X = rng.normal(size=(n, d)).astype(np.float32)
-    w_true = rng.normal(size=d)
+    X *= np.logspace(0, 3, d, dtype=np.float32)  # condition ~1e6 in X'X
+    w_true = rng.normal(size=d) / np.logspace(0, 3, d)
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
         np.float32)
     batch = jax.device_put(LabeledBatch.build(X, y))
@@ -132,25 +140,34 @@ def bench_optimizer_steps(n=1 << 17, d=256):
     out = {}
     for name, solver in (
         ("lbfgs", lambda w0, k: minimize_lbfgs(
-            vg, w0, OptimizerConfig(max_iterations=k, tolerance=0.0))),
+            vg, w0, OptimizerConfig(max_iterations=k, tolerance=-1.0))),
         ("tron", lambda w0, k: minimize_tron(
-            vg, hvp, w0, OptimizerConfig(max_iterations=k, tolerance=0.0,
+            vg, hvp, w0, OptimizerConfig(max_iterations=k, tolerance=-1.0,
                                          max_cg_iterations=10))),
     ):
         jitted = {}
 
-        def run(iters, _name=name, _solver=solver, _jitted=jitted):
+        def run(iters, _solver=solver, _jitted=jitted):
             if iters not in _jitted:
                 _jitted[iters] = jax.jit(
-                    lambda w0, _k=iters: _solver(w0, _k).w,
+                    lambda w0, _k=iters: (
+                        lambda r: (r.w, r.iterations))(_solver(w0, _k)),
                     donate_argnums=0)
             t0 = time.perf_counter()
-            w = _jitted[iters](jnp.zeros((d,), jnp.float32))
+            w, it = _jitted[iters](jnp.zeros((d,), jnp.float32))
             np.asarray(w)
-            return time.perf_counter() - t0
+            return time.perf_counter() - t0, int(it)
 
         spans = {"lbfgs": (10, 60), "tron": (8, 32)}[name]
-        out[f"{name}_iteration_ms"] = _slope(run, *spans) * 1e3
+        k_small, k_large = spans
+        run(k_small)  # warm-up / compile BOTH programs before timing
+        run(k_large)
+        t_small, e_small = sorted(run(k_small) for _ in range(3))[1]
+        t_large, e_large = sorted(run(k_large) for _ in range(3))[1]
+        executed = max(e_large - e_small, 1)
+        out[f"{name}_iteration_ms"] = max(t_large - t_small, 0.0) \
+            / executed * 1e3
+        out[f"{name}_executed_iterations"] = (e_small, e_large)
     return out
 
 

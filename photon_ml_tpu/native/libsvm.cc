@@ -38,19 +38,60 @@ inline const char* skip_ws(const char* p, const char* end) {
 // Locale-independent, line-bounded double parse (std::from_chars): never
 // reads past eol (strtod would skip the newline and eat the next row),
 // never honors LC_NUMERIC, rejects hex floats. Optional leading '+' for
-// LIBSVM's "+1" labels.
+// LIBSVM's "+1" labels ('+-1' style double signs rejected, as Python
+// float() does). Out-of-range magnitudes keep strtod/Python semantics:
+// overflow → ±inf, underflow → ±0.
 inline bool parse_double(const char* q, const char* eol, double* out,
                          const char** next) {
-  if (q < eol && *q == '+') ++q;
+  if (q < eol && *q == '+') {
+    ++q;
+    if (q < eol && (*q == '+' || *q == '-')) return false;
+  }
+#if defined(__cpp_lib_to_chars)
   auto res = std::from_chars(q, eol, *out);
-  if (res.ec != std::errc()) return false;
-  *next = res.ptr;
+  if (res.ec == std::errc()) {
+    *next = res.ptr;
+    return true;
+  }
+  if (res.ec == std::errc::result_out_of_range) {
+    // from_chars validated the grammar and consumed the token; re-parse a
+    // NUL-bounded copy with strtod to get the ±inf / ±0 result Python's
+    // float() (and the old strtod path) produce.
+    char buf[128];
+    size_t len = static_cast<size_t>(res.ptr - q);
+    if (len >= sizeof buf) return false;
+    std::memcpy(buf, q, len);
+    buf[len] = '\0';
+    *out = std::strtod(buf, nullptr);
+    *next = res.ptr;
+    return true;
+  }
+  return false;
+#else
+  // libstdc++ < GCC 11 has no floating-point from_chars: strtod on a
+  // NUL-bounded copy keeps the native parser alive (line-bounded; the
+  // LC_NUMERIC caveat applies only on comma-decimal locales).
+  char buf[512];
+  size_t len = static_cast<size_t>(eol - q);
+  if (len >= sizeof buf) len = sizeof buf - 1;
+  std::memcpy(buf, q, len);
+  buf[len] = '\0';
+  if (buf[0] == ' ' || buf[0] == '\t') return false;
+  if (buf[0] == '0' && (buf[1] == 'x' || buf[1] == 'X')) return false;
+  char* e = nullptr;
+  *out = std::strtod(buf, &e);
+  if (e == buf) return false;
+  *next = q + (e - buf);
   return true;
+#endif
 }
 
 inline bool parse_long(const char* q, const char* eol, long* out,
                        const char** next) {
-  if (q < eol && *q == '+') ++q;
+  if (q < eol && *q == '+') {
+    ++q;
+    if (q < eol && (*q == '+' || *q == '-')) return false;
+  }
   auto res = std::from_chars(q, eol, *out, 10);
   if (res.ec != std::errc()) return false;
   *next = res.ptr;
@@ -125,15 +166,15 @@ void* lsvm_parse(const char* path, int zero_based) {
         return out;
       }
       q = next;
-      long col_l = idx - off;
-      if (col_l < 0 || col_l > INT32_MAX) {
+      // idx < off guard first: LONG_MIN - off would be signed-overflow UB.
+      if (idx < off || idx - off > static_cast<long>(INT32_MAX)) {
         char msg[80];
         std::snprintf(msg, sizeof msg,
                       "feature index out of range at line %ld", lineno);
         out->error = msg;
         return out;
       }
-      int32_t col = static_cast<int32_t>(col_l);
+      int32_t col = static_cast<int32_t>(idx - off);
       if (col > out->max_index) out->max_index = col;
       out->indices.push_back(col);
       out->values.push_back(static_cast<float>(val));

@@ -144,13 +144,16 @@ def hessian_diagonal(
     z = margins(batch, means, norm)
     d2 = loss.d2z(z, batch.labels)
     r = _masked(batch.weights, d2)
-    x2 = _tmatvec(batch.features * batch.features, r)
+    # Variances are a once-per-fit path: upcast bf16 storage before the
+    # squaring (bf16² double-rounds), matching hessian_matrix below.
+    Xf = batch.features.astype(jnp.float32)
+    x2 = _tmatvec(Xf * Xf, r)
     if norm.is_identity:
         return x2
     f = norm.factors if norm.factors is not None else jnp.ones_like(means)
     if norm.shifts is None:
         return x2 * f * f
-    x1 = _tmatvec(batch.features, r)
+    x1 = _tmatvec(Xf, r)
     r_sum = jnp.sum(r, axis=-1)
     if x1.ndim > 1:
         r_sum = r_sum[..., None]

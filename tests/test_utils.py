@@ -211,3 +211,38 @@ class TestNativeLibsvm:
             f.write("+1 1:0.5\n-1 2:1.5\n")
         d = lsv.read_libsvm(path, dense=True)
         np.testing.assert_array_equal(d.labels, [1.0, 0.0])  # ±1 -> {0,1}
+
+    def test_numeric_edge_parity(self, tmp_path):
+        """'+-1' labels error in both paths; out-of-range magnitudes keep
+        strtod/Python semantics (overflow -> inf, underflow -> 0) in both."""
+        from photon_ml_tpu.data import libsvm as lsv
+
+        if lsv._load_native() is None:
+            pytest.skip("no native toolchain")
+
+        def both(content, check):
+            path = str(tmp_path / "n.txt")
+            with open(path, "w") as f:
+                f.write(content)
+            check(lambda: lsv.read_libsvm(path, dense=True,
+                                          binary_labels_to_01=False))
+            saved = lsv._native_lib, lsv._native_failed
+            lsv._native_lib, lsv._native_failed = None, True
+            try:
+                check(lambda: lsv.read_libsvm(path, dense=True,
+                                              binary_labels_to_01=False))
+            finally:
+                lsv._native_lib, lsv._native_failed = saved
+
+        def expect_error(f):
+            with pytest.raises(ValueError):
+                f()
+
+        both("+-1 1:0.5\n", expect_error)
+
+        def expect_inf_and_zero(f):
+            d = f()
+            assert np.isinf(d.dense[0, 0])
+            assert d.dense[1, 0] == 0.0
+
+        both("1 1:9e999\n0 1:1e-999\n", expect_inf_and_zero)
