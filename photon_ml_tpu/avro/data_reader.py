@@ -17,7 +17,7 @@ from typing import Optional, Sequence, Union
 import numpy as np
 
 from photon_ml_tpu.avro.container import read_records
-from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
                                           IndexMap, feature_key)
 
@@ -40,10 +40,17 @@ RESPONSE_PREDICTION_FIELDS = FieldNames(response="response")
 @dataclasses.dataclass(frozen=True)
 class FeatureShardConfig:
     """A feature shard = named union of feature bags + intercept flag
-    (FeatureShardConfiguration parity)."""
+    (FeatureShardConfiguration parity).
+
+    ``sparse=True`` materializes the shard as ELL (data/game_data.py
+    SparseShard) instead of a dense (n, d) matrix — the Criteo regime,
+    where d reaches millions and densifying is impossible. Repeated
+    features within a record accumulate (same as the dense path), keeping
+    the ELL rows canonical."""
 
     feature_bags: tuple[str, ...] = ("features",)
     has_intercept: bool = True
+    sparse: bool = False
 
 
 def _record_features(record: dict, bags: Sequence[str]):
@@ -112,7 +119,13 @@ class AvroDataReader:
         uids = np.empty(n, object)
         shard_mats = {
             shard: np.zeros((n, len(index_maps[shard])), np.float32)
-            for shard in feature_shard_configs
+            for shard, cfg in feature_shard_configs.items() if not cfg.sparse
+        }
+        # Sparse shards: one {col: val} accumulator per record, ELL-ified
+        # after the pass (repeated features accumulate like the dense path).
+        sparse_rows: dict[str, list[dict]] = {
+            shard: [dict() for _ in range(n)]
+            for shard, cfg in feature_shard_configs.items() if cfg.sparse
         }
         id_cols = {t: np.zeros(n, np.int32) for t in random_effect_types}
 
@@ -131,7 +144,21 @@ class AvroDataReader:
             uid = rec.get(fields.uid)
             uids[i] = i if uid is None else uid
             for shard, cfg in feature_shard_configs.items():
-                imap, mat = index_maps[shard], shard_mats[shard]
+                imap = index_maps[shard]
+                if cfg.sparse:
+                    row = sparse_rows[shard][i]
+                    for bag in cfg.feature_bags:
+                        for f in rec.get(bag) or ():
+                            j = imap.get_index(feature_key(f["name"],
+                                                           f.get("term", "")))
+                            if j >= 0:
+                                row[j] = row.get(j, 0.0) + f["value"]
+                    if cfg.has_intercept:
+                        j = imap.get_index(INTERCEPT_KEY)
+                        if j >= 0:
+                            row[j] = 1.0
+                    continue
+                mat = shard_mats[shard]
                 for bag in cfg.feature_bags:
                     for f in rec.get(bag) or ():
                         j = imap.get_index(feature_key(f["name"],
@@ -157,11 +184,32 @@ class AvroDataReader:
                     vocab[raw] = len(vocab)
                 id_cols[t][i] = vocab[raw]
 
+        feature_shards: dict = dict(shard_mats)
+        for shard, rows in sparse_rows.items():
+            # CSR triplets → data/sparse.py from_csr, the ONE owner of the
+            # ELL layout contract (padding sentinel, max_nnz policy).
+            from photon_ml_tpu.data.sparse import from_csr
+
+            d = len(index_maps[shard])
+            indptr = np.zeros(n + 1, np.int64)
+            cols: list[int] = []
+            vals: list[float] = []
+            for i, row in enumerate(rows):
+                for j, v in sorted(row.items()):
+                    cols.append(j)
+                    vals.append(v)
+                indptr[i + 1] = len(cols)
+            ell = from_csr(indptr, np.asarray(cols, np.int32),
+                           np.asarray(vals, np.float32), labels=response,
+                           num_features=d)
+            feature_shards[shard] = SparseShard(
+                indices=ell.indices, values=ell.values, num_features=d)
+
         ds = GameDataset(
             response=response,
             offsets=offsets,
             weights=weights,
-            feature_shards=shard_mats,
+            feature_shards=feature_shards,
             entity_ids=id_cols,
             num_entities={t: len(v) for t, v in vocabs.items()},
             intercept_index={

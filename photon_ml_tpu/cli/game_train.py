@@ -49,7 +49,10 @@ def parse_coordinate(spec: str) -> tuple[str, dict]:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--train", required=True,
-                   help="training GameDataset directory (data/io.py format)")
+                   help="training data: a GameDataset directory "
+                        "(data/io.py format) or a LIBSVM text FILE "
+                        "(loaded as one sparse 'global' shard — the "
+                        "Criteo-style fixed-effect-only configuration)")
     p.add_argument("--validation")
     p.add_argument("--task", default="LOGISTIC_REGRESSION",
                    choices=[t.value for t in TaskType])
@@ -99,12 +102,35 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _load_dataset(path: str, num_features=None):
+    """GameDataset directory, or a LIBSVM file → sparse 'global' shard."""
+    if os.path.isdir(path):
+        return load_game_dataset(path)
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.data.libsvm import read_libsvm
+    from photon_ml_tpu.data.sparse import from_libsvm
+
+    data = read_libsvm(path, dense=False, num_features=num_features)
+    return from_sparse_batch(from_libsvm(data))
+
+
 def run(args) -> dict:
     setup_logging()
     t0 = time.time()
     task = TaskType(args.task)
-    train = load_game_dataset(args.train)
-    validation = load_game_dataset(args.validation) if args.validation else None
+    train = _load_dataset(args.train)
+    validation = None
+    if args.validation:
+        nf = None
+        if not os.path.isdir(args.validation):
+            # LIBSVM validation must share the training feature space —
+            # whatever form training was loaded from.
+            if "global" not in train.feature_shards:
+                raise ValueError(
+                    "LIBSVM validation requires a 'global' feature shard "
+                    "in the training data")
+            nf = train.shard_dim("global")
+        validation = _load_dataset(args.validation, num_features=nf)
 
     opt_by_coord: dict[str, GLMOptimizationConfiguration] = {}
     for spec in args.opt_config:

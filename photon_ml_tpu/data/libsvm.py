@@ -157,6 +157,13 @@ def read_libsvm(
         indices_a = np.asarray(indices, np.int32)
         values_a = np.asarray(values, np.float32)
 
+    if num_features is not None and max_idx >= num_features:
+        # A caller-supplied feature space (e.g. "validation must share the
+        # training space") makes out-of-range indices corrupt data, not
+        # padding — the ELL sentinel would silently zero them out.
+        raise ValueError(
+            f"{path} contains feature index {max_idx} outside the declared "
+            f"feature space of {num_features}")
     d = num_features if num_features is not None else max_idx + 1
     if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
         y = (y + 1.0) / 2.0

@@ -55,14 +55,11 @@ inline bool parse_double(const char* q, const char* eol, double* out,
   }
   if (res.ec == std::errc::result_out_of_range) {
     // from_chars validated the grammar and consumed the token; re-parse a
-    // NUL-bounded copy with strtod to get the ±inf / ±0 result Python's
-    // float() (and the old strtod path) produce.
-    char buf[128];
-    size_t len = static_cast<size_t>(res.ptr - q);
-    if (len >= sizeof buf) return false;
-    std::memcpy(buf, q, len);
-    buf[len] = '\0';
-    *out = std::strtod(buf, nullptr);
+    // NUL-terminated copy with strtod to get the ±inf / ±0 result Python's
+    // float() (and the old strtod path) produce. Heap copy: numerals can
+    // be arbitrarily long.
+    std::string tmp(q, res.ptr);
+    *out = std::strtod(tmp.c_str(), nullptr);
     *next = res.ptr;
     return true;
   }
