@@ -53,12 +53,23 @@ class RandomEffectDataConfiguration:
     # INDEX_MAP builds a LinearSubspaceProjector per entity; NONE solves at
     # the full shard dimension).
     projector: str = "NONE"
+    # Cap each entity's subspace at ceil(ratio · num_samples) columns by
+    # |Pearson corr(feature, label)| (reference
+    # RandomEffectDataConfiguration.numFeaturesToSamplesRatio →
+    # LocalDataset.filterFeaturesByPearsonCorrelationScore). Implies
+    # projection.
+    features_to_samples_ratio: Optional[float] = None
 
     def __post_init__(self):
         if self.projector.upper() not in ("NONE", "INDEX_MAP"):
             raise ValueError(
                 f"unknown projector {self.projector!r}; "
                 "expected NONE or INDEX_MAP")
+        if (self.features_to_samples_ratio is not None
+                and not self.features_to_samples_ratio > 0):
+            raise ValueError(
+                f"features_to_samples_ratio must be > 0, got "
+                f"{self.features_to_samples_ratio}")
 
 
 CoordinateDataConfiguration = Union[FixedEffectDataConfiguration,

@@ -112,7 +112,9 @@ class GameEstimator:
                     upper_bound=cc.data.active_data_upper_bound,
                     norm=self.normalization.get(cc.data.feature_shard_id,
                                                 NormalizationContext()),
-                    projection=cc.data.projector.upper() == "INDEX_MAP")
+                    projection=cc.data.projector.upper() == "INDEX_MAP",
+                    features_to_samples_ratio=(
+                        cc.data.features_to_samples_ratio))
             else:  # pragma: no cover
                 raise TypeError(type(cc.data))
         return coords

@@ -8,9 +8,11 @@ library ships in this image, so ``codec``/``container`` implement the Avro
 
 from photon_ml_tpu.avro.codec import BinaryDecoder, BinaryEncoder, parse_schema
 from photon_ml_tpu.avro.container import DataFileReader, DataFileWriter
+from photon_ml_tpu.avro.data_writer import AvroDataWriter
 from photon_ml_tpu.avro import schemas
 
 __all__ = [
+    "AvroDataWriter",
     "BinaryDecoder",
     "BinaryEncoder",
     "parse_schema",

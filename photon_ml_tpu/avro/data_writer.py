@@ -1,0 +1,174 @@
+"""Avro training-data writer: columnar GameDataset -> container files.
+
+Reference parity: photon-client ``data/avro/AvroDataWriter.scala`` — the
+inverse of AvroDataReader: write examples back out as
+``TrainingExampleAvro`` records (label/weight/offset/uid, features as
+name/term/value triples, random-effect ids in ``metadataMap``), so a
+prepared dataset can be persisted and re-read (or handed to the reference
+toolchain) without the original source files.
+
+Conventions mirroring the reader (avro/data_reader.py):
+- the intercept column is NOT written — it is implicit
+  (``FeatureShardConfig.has_intercept`` re-adds it on read);
+- zero-valued features are not written (dense matrices round-trip through
+  their nonzero support, exactly the reference's sparse-vector semantics);
+- entity ids are written as ``metadataMap`` entries keyed by RE type, the
+  reader's fallback location (``_entity_value``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from photon_ml_tpu.avro.container import write_records
+from photon_ml_tpu.avro.data_reader import (FieldNames,
+                                            TRAINING_EXAMPLE_FIELDS)
+from photon_ml_tpu.avro.schemas import TRAINING_EXAMPLE_AVRO
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+from photon_ml_tpu.index.indexmap import INTERCEPT_KEY, IndexMap, split_key
+
+
+class AvroDataWriter:
+    """Write a GameDataset as TrainingExampleAvro container files."""
+
+    def __init__(self, field_names: FieldNames = TRAINING_EXAMPLE_FIELDS):
+        self.fields = field_names
+
+    def write(
+        self,
+        path: str,
+        dataset: GameDataset,
+        index_maps: dict[str, IndexMap],
+        entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
+        uids: Optional[Union[Sequence, np.ndarray]] = None,
+        shards: Optional[Sequence[str]] = None,
+        bag_by_shard: Optional[dict[str, str]] = None,
+        codec: str = "deflate",
+    ) -> int:
+        """Write ``dataset`` to one Avro OCF at ``path``; returns #records.
+
+        ``index_maps`` supplies the column→(name, term) reverse mapping per
+        shard (the maps a read produced or a feature-indexing job built).
+        ``entity_vocabs`` maps RE type → {raw id: row}; entity rows are
+        written back as their raw string ids. When omitted, rows are written
+        as their decimal string (a valid vocabulary for re-reading).
+        ``bag_by_shard`` routes each shard's features into a named bag field
+        (default: every shard into ``"features"``); distinct bags let a
+        multi-shard dataset round-trip through ``FeatureShardConfig``s with
+        disjoint ``feature_bags``.
+        """
+        shards = list(dataset.feature_shards if shards is None else shards)
+        for s in shards:
+            if s not in index_maps:
+                raise ValueError(f"no index map for shard {s!r}")
+        if bag_by_shard is None:
+            bag_by_shard = {s: "features" for s in shards}
+        bags = []  # distinct bag fields, schema order
+        for s in shards:
+            b = bag_by_shard.get(s, "features")
+            if b not in bags:
+                bags.append(b)
+        n = dataset.num_rows
+        fields = self.fields
+        schema = _schema_with_bags(bags)
+
+        # Reverse vocabularies: entity row -> raw id string.
+        rev_vocab: dict[str, dict[int, str]] = {}
+        for t in dataset.entity_ids:
+            if entity_vocabs is not None and t in entity_vocabs:
+                rev_vocab[t] = {row: raw
+                                for raw, row in entity_vocabs[t].items()}
+            else:
+                rev_vocab[t] = {}
+
+        # Per-shard (name, term) tuple per column; None marks the intercept
+        # (skipped on write — implicit on read).
+        name_term: dict[str, list] = {}
+        for s in shards:
+            imap = index_maps[s]
+            d = dataset.shard_dim(s)
+            cols = []
+            for j in range(d):
+                key = imap.get_feature_name(j)
+                if key is None:
+                    raise ValueError(
+                        f"shard {s!r}: index map has no feature for "
+                        f"column {j}")
+                cols.append(None if key == INTERCEPT_KEY else split_key(key))
+            name_term[s] = cols
+
+        def record(i: int) -> dict:
+            feats: dict[str, list] = {b: [] for b in bags}
+            for s in shards:
+                shard = dataset.feature_shards[s]
+                cols = name_term[s]
+                out = feats[bag_by_shard.get(s, "features")]
+                if isinstance(shard, SparseShard):
+                    for j, v in zip(shard.indices[i], shard.values[i]):
+                        j = int(j)
+                        if j >= shard.num_features or v == 0.0:
+                            continue  # ELL padding slot
+                        nt = cols[j]
+                        if nt is None:
+                            continue
+                        out.append({"name": nt[0], "term": nt[1],
+                                    "value": float(v)})
+                else:
+                    for j in np.flatnonzero(shard[i]):
+                        nt = cols[int(j)]
+                        if nt is None:
+                            continue
+                        out.append({"name": nt[0], "term": nt[1],
+                                    "value": float(shard[i, int(j)])})
+            meta = {}
+            for t, ids in dataset.entity_ids.items():
+                row = int(ids[i])
+                meta[t] = rev_vocab[t].get(row, str(row))
+            uid = None
+            if uids is not None:
+                uid = uids[i]
+                # The union encoder picks branches by native Python type.
+                if uid is not None and not isinstance(uid, str):
+                    uid = int(uid)
+            rec = {
+                fields.uid: uid,
+                fields.response: float(dataset.response[i]),
+                fields.weight: float(dataset.weights[i]),
+                fields.offset: float(dataset.offsets[i]),
+                fields.metadata: meta if meta else None,
+            }
+            rec.update(feats)
+            return rec
+
+        write_records(path, schema, (record(i) for i in range(n)),
+                      codec=codec)
+        return n
+
+
+def _schema_with_bags(bags: Sequence[str]) -> dict:
+    """TrainingExampleAvro with one feature-array field per bag.
+
+    With the default single ``"features"`` bag this is exactly
+    TRAINING_EXAMPLE_AVRO; extra bags replace the features field in place
+    (the reference writes generic records with one array field per bag).
+    """
+    if list(bags) == ["features"]:
+        return TRAINING_EXAMPLE_AVRO
+    schema = dict(TRAINING_EXAMPLE_AVRO)
+    fields = []
+    for f in TRAINING_EXAMPLE_AVRO["fields"]:
+        if f["name"] != "features":
+            fields.append(f)
+            continue
+        items = f["type"]["items"]
+        for k, b in enumerate(bags):
+            # Avro named types must be defined once, then referenced.
+            fields.append({
+                "name": b,
+                "type": {"type": "array",
+                         "items": items if k == 0 else items["name"]},
+            })
+    schema["fields"] = fields
+    return schema

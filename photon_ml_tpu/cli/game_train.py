@@ -162,7 +162,10 @@ def run(args) -> dict:
                 active_data_lower_bound=int(kv.get("min_samples", 1)),
                 active_data_upper_bound=(int(kv["max_samples"])
                                          if "max_samples" in kv else None),
-                projector=kv.get("projector", "NONE").upper())
+                projector=kv.get("projector", "NONE").upper(),
+                features_to_samples_ratio=(
+                    float(kv["features_to_samples_ratio"])
+                    if "features_to_samples_ratio" in kv else None))
         else:
             raise ValueError(f"unknown coordinate type {kv['type']!r}")
         opt = opt_by_coord.get(name, GLMOptimizationConfiguration())

@@ -515,6 +515,7 @@ class RandomEffectCoordinate:
         norm: NormalizationContext = NormalizationContext(),
         seed: int = 0,
         projection: bool = False,
+        features_to_samples_ratio: Optional[float] = None,
     ):
         from photon_ml_tpu.data.game_data import SparseShard
         if isinstance(dataset.feature_shards[shard_id], SparseShard):
@@ -539,7 +540,14 @@ class RandomEffectCoordinate:
             rng=np.random.default_rng(seed))
         self._X = jnp.asarray(dataset.feature_shards[shard_id])
         self._ids = jnp.asarray(dataset.entity_ids[re_type])
-        self.projection = bool(projection)
+        # Pearson feature filtering selects per-entity columns, which is
+        # exactly what the projection machinery stages — a ratio implies
+        # projection (reference: filterFeaturesByPearsonCorrelationScore
+        # runs during RandomEffectDataset build when
+        # numFeaturesToSamplesRatio is configured).
+        self.features_to_samples_ratio = features_to_samples_ratio
+        self.projection = bool(projection) or (
+            features_to_samples_ratio is not None)
         # Stage static per-bucket device arrays ONCE: features/labels/weights
         # in (E_b, cap, …) layout plus the gather/scatter index maps. The
         # entity axis is sharded over the mesh's data axis (P2) when the
@@ -568,7 +576,10 @@ class RandomEffectCoordinate:
             ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
             rows = b.entity_rows  # (E_b,) int32; -1 padding
             if self.projection:
-                proj = prj.build_bucket_projection(b, X, self.intercept_index)
+                proj = prj.build_bucket_projection(
+                    b, X, self.intercept_index,
+                    labels=np.asarray(ds.response),
+                    features_to_samples_ratio=self.features_to_samples_ratio)
                 Xb = prj.gather_projected_features(b, proj, X)
                 (yb,) = bkt.gather_bucket_arrays(b, ds.response)
                 f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)

@@ -57,18 +57,49 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, int(np.ceil(np.log2(max(1, x)))))
 
 
+def pearson_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """|Pearson correlation| of each feature column with the labels.
+
+    Reference parity: photon-api ``data/LocalDataset.scala``
+    ``stableComputePearsonCorrelationScore`` — zero-variance columns (and a
+    zero-variance label) score 0 rather than NaN, so constant features are
+    filtered out unless they are the intercept (which the caller always
+    keeps).
+    """
+    y = y.astype(np.float64)
+    Xc = X.astype(np.float64) - X.mean(axis=0, dtype=np.float64)
+    yc = y - y.mean()
+    cov = Xc.T @ yc
+    denom = np.sqrt((Xc * Xc).sum(axis=0) * (yc * yc).sum())
+    out = np.zeros(X.shape[1], np.float64)
+    np.divide(np.abs(cov), denom, out=out, where=denom > 1e-12)
+    return out
+
+
 def build_bucket_projection(
     bucket: EntityBucket,
     X: np.ndarray,
     intercept_index: Optional[int],
     min_dim: int = 8,
+    labels: Optional[np.ndarray] = None,
+    features_to_samples_ratio: Optional[float] = None,
 ) -> BucketProjection:
     """Compute each entity's active feature subspace for one bucket.
 
     A column is active for an entity iff any of the entity's (kept) examples
     has a nonzero value there (reference LinearSubspaceProjector: the index
     set of features present in the entity's data).
+
+    ``features_to_samples_ratio`` additionally caps each entity's subspace
+    at ``ceil(ratio · num_samples)`` columns, keeping the highest
+    |Pearson corr(feature, label)| ones (reference
+    ``LocalDataset.filterFeaturesByPearsonCorrelationScore`` driven by
+    ``RandomEffectDataConfiguration.numFeaturesToSamplesRatio``). The
+    intercept is always kept and counts toward the cap, matching the
+    reference (it assigns the intercept the maximal score).
     """
+    if features_to_samples_ratio is not None and labels is None:
+        raise ValueError("features_to_samples_ratio needs labels")
     d = X.shape[1]
     ex = bucket.example_idx  # (E_b, cap), -1 pad
     live_rows = bucket.entity_rows >= 0
@@ -82,10 +113,22 @@ def build_bucket_projection(
             continue
         idx = ex[e]
         idx = idx[idx >= 0]
-        mask = np.any(X[idx] != 0.0, axis=0)
+        Xe = X[idx]
+        mask = np.any(Xe != 0.0, axis=0)
         if intercept_index is not None:
             mask[intercept_index] = True
         cols_e = np.flatnonzero(mask)
+        if features_to_samples_ratio is not None:
+            keep = int(np.ceil(features_to_samples_ratio * len(idx)))
+            keep = max(1, keep)
+            if len(cols_e) > keep:
+                scores = pearson_scores(Xe[:, cols_e], labels[idx])
+                if intercept_index is not None:
+                    scores[cols_e == intercept_index] = np.inf
+                # Stable top-k: sort by (-score, col) so ties break on the
+                # lower column id deterministically.
+                order_e = np.lexsort((cols_e, -scores))[:keep]
+                cols_e = np.sort(cols_e[order_e])
         if intercept_index is not None:
             # Intercept first: static projected intercept slot 0.
             cols_e = np.concatenate(

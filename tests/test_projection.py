@@ -279,3 +279,116 @@ def test_estimator_projector_config_round_trip(rng, mesh):
     from photon_ml_tpu.evaluation import evaluators as ev
     a = float(ev.auc(model.score(ds), jnp.asarray(ds.response)))
     assert a > 0.6
+
+
+# ------------------------------------------------------- Pearson feature filter
+
+
+def test_pearson_scores_match_numpy_corrcoef(rng):
+    X = rng.normal(size=(50, 6))
+    X[:, 3] = 1.0  # constant column → score 0, not NaN
+    y = rng.normal(size=50)
+    got = prj.pearson_scores(X, y)
+    for j in range(6):
+        if j == 3:
+            assert got[j] == 0.0
+        else:
+            want = abs(np.corrcoef(X[:, j], y)[0, 1])
+            np.testing.assert_allclose(got[j], want, rtol=1e-10)
+
+
+def test_pearson_filter_keeps_informative_columns(rng):
+    """Per-entity top-k by |corr|: the label-generating columns survive,
+    pure-noise columns are dropped, intercept always kept."""
+    n, ne, d = 2000, 4, 12
+    ids = np.repeat(np.arange(ne), n // ne).astype(np.int32)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, d - 1] = 1.0  # intercept
+    # Labels driven ONLY by columns 0 and 1.
+    y = (X[:, 0] * 2.0 - X[:, 1] * 2.0
+         + 0.05 * rng.normal(size=n) > 0).astype(np.float32)
+    b = bkt.build_bucketing(ids, ne)
+    for bucket in b.buckets:
+        proj = prj.build_bucket_projection(
+            bucket, X, intercept_index=d - 1, labels=y,
+            features_to_samples_ratio=4 / (n // ne))
+        for lane, e in enumerate(bucket.entity_rows):
+            if e < 0:
+                continue
+            cols = proj.cols[lane]
+            cols = set(cols[cols >= 0].tolist())
+            assert len(cols) <= 4
+            assert {0, 1, d - 1} <= cols
+            assert proj.cols[lane, 0] == d - 1  # intercept slot 0
+
+
+def test_pearson_filter_cap_respected(rng):
+    ds, _ = _sparse_entity_game(rng)
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    y = ds.response
+    b = bkt.build_bucketing(ids, ds.num_entities["userId"])
+    ii = ds.intercept_index["re_userId"]
+    ratio = 0.1
+    for bucket in b.buckets:
+        proj = prj.build_bucket_projection(
+            bucket, X, ii, labels=y, features_to_samples_ratio=ratio)
+        for lane in range(bucket.num_entities):
+            if bucket.entity_rows[lane] < 0:
+                continue
+            cnt = int(bucket.counts[lane])
+            n_cols = int((proj.cols[lane] >= 0).sum())
+            assert n_cols <= max(1, int(np.ceil(ratio * cnt)))
+
+
+def test_pearson_filter_large_ratio_is_identity(rng, mesh):
+    """ratio big enough to keep everything ⇒ identical fit to plain
+    projection (the filter only ever removes columns)."""
+    ds, _ = _sparse_entity_game(rng)
+    cfg = _config()
+    offsets = jnp.asarray(ds.offsets)
+    plain = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                   losses.LOGISTIC, cfg, mesh,
+                                   projection=True)
+    filt = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, cfg, mesh,
+                                  features_to_samples_ratio=1e6)
+    assert filt.projection  # ratio implies projection
+    W0 = np.asarray(plain.train_model(offsets).means)
+    W1 = np.asarray(filt.train_model(offsets).means)
+    np.testing.assert_allclose(W1, W0, atol=1e-6)
+
+
+def test_pearson_filter_through_estimator(rng, mesh):
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration,
+                                           RandomEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.evaluation import evaluators as ev
+    from photon_ml_tpu.types import TaskType
+
+    ds, _ = _sparse_entity_game(rng, n=700)
+    coords = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=_config()),
+        "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration(
+                "userId", "re_userId", features_to_samples_ratio=0.5),
+            optimization=_config()),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION,
+                        coordinates=coords,
+                        update_sequence=["fixed", "per-user"],
+                        descent_iterations=2, mesh=mesh)
+    model = est.fit(ds)[0].model
+    a = float(ev.auc(model.score(ds), jnp.asarray(ds.response)))
+    assert a > 0.6
+
+
+def test_bad_features_to_samples_ratio_rejected():
+    from photon_ml_tpu.api.configs import RandomEffectDataConfiguration
+
+    with pytest.raises(ValueError, match="features_to_samples_ratio"):
+        RandomEffectDataConfiguration("userId", "re_userId",
+                                      features_to_samples_ratio=0.0)
