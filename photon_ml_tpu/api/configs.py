@@ -72,8 +72,31 @@ class RandomEffectDataConfiguration:
                 f"{self.features_to_samples_ratio}")
 
 
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectDataConfiguration:
+    """Reference: the pre-fork FactoredRandomEffectDataConfiguration +
+    MFOptimizationConfiguration (numLatentFactors → ``rank``,
+    numInnerIterations → ``alternations``): per-entity models constrained
+    to a shared rank-``rank`` subspace (see game/factored.py)."""
+
+    random_effect_type: str
+    feature_shard_id: str
+    rank: int = 4
+    alternations: int = 2
+    active_data_lower_bound: int = 1
+    active_data_upper_bound: Optional[int] = None
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if self.alternations < 1:
+            raise ValueError(
+                f"alternations must be >= 1, got {self.alternations}")
+
+
 CoordinateDataConfiguration = Union[FixedEffectDataConfiguration,
-                                    RandomEffectDataConfiguration]
+                                    RandomEffectDataConfiguration,
+                                    FactoredRandomEffectDataConfiguration]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,6 +20,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
                                        RandomEffectDataConfiguration)
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
@@ -28,6 +29,7 @@ from photon_ml_tpu.game import descent
 from photon_ml_tpu.game.coordinates import (FixedEffectCoordinate,
                                             RandomEffectCoordinate,
                                             SparseFixedEffectCoordinate)
+from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops import losses as losses_mod
@@ -115,6 +117,20 @@ class GameEstimator:
                     projection=cc.data.projector.upper() == "INDEX_MAP",
                     features_to_samples_ratio=(
                         cc.data.features_to_samples_ratio))
+            elif isinstance(cc.data, FactoredRandomEffectDataConfiguration):
+                if cc.data.feature_shard_id in self.normalization:
+                    raise ValueError(
+                        f"normalization is not supported on factored "
+                        f"random-effect shard "
+                        f"{cc.data.feature_shard_id!r} (the latent space "
+                        f"has no per-feature transform)")
+                coords[cid] = FactoredRandomEffectCoordinate(
+                    dataset, cc.data.random_effect_type,
+                    cc.data.feature_shard_id, self.loss, opt, self.mesh,
+                    rank=cc.data.rank,
+                    alternations=cc.data.alternations,
+                    lower_bound=cc.data.active_data_lower_bound,
+                    upper_bound=cc.data.active_data_upper_bound)
             else:  # pragma: no cover
                 raise TypeError(type(cc.data))
         return coords

@@ -65,6 +65,12 @@ class AvroDataWriter:
                 raise ValueError(f"no index map for shard {s!r}")
         if bag_by_shard is None:
             bag_by_shard = {s: "features" for s in shards}
+        else:
+            unknown = set(bag_by_shard) - set(shards)
+            if unknown:
+                raise ValueError(
+                    f"bag_by_shard names unknown shards {sorted(unknown)}; "
+                    f"dataset shards are {sorted(shards)}")
         bags = []  # distinct bag fields, schema order
         for s in shards:
             b = bag_by_shard.get(s, "features")
@@ -72,7 +78,7 @@ class AvroDataWriter:
                 bags.append(b)
         n = dataset.num_rows
         fields = self.fields
-        schema = _schema_with_bags(bags)
+        schema = _schema_with_bags(bags, fields)
 
         # Reverse vocabularies: entity row -> raw id string.
         rev_vocab: dict[str, dict[int, str]] = {}
@@ -147,28 +153,34 @@ class AvroDataWriter:
         return n
 
 
-def _schema_with_bags(bags: Sequence[str]) -> dict:
-    """TrainingExampleAvro with one feature-array field per bag.
+def _schema_with_bags(bags: Sequence[str], fields: FieldNames) -> dict:
+    """TrainingExampleAvro with one feature-array field per bag, its scalar
+    fields renamed per the ``FieldNames`` preset (a non-default preset —
+    e.g. RESPONSE_PREDICTION_FIELDS — must rename the schema too, or the
+    codec rejects records keyed by the preset's names).
 
-    With the default single ``"features"`` bag this is exactly
+    With the default preset and single ``"features"`` bag this is exactly
     TRAINING_EXAMPLE_AVRO; extra bags replace the features field in place
     (the reference writes generic records with one array field per bag).
     """
-    if list(bags) == ["features"]:
+    rename = {"label": fields.response, "offset": fields.offset,
+              "weight": fields.weight, "uid": fields.uid,
+              "metadataMap": fields.metadata}
+    if list(bags) == ["features"] and all(k == v for k, v in rename.items()):
         return TRAINING_EXAMPLE_AVRO
     schema = dict(TRAINING_EXAMPLE_AVRO)
-    fields = []
+    out = []
     for f in TRAINING_EXAMPLE_AVRO["fields"]:
         if f["name"] != "features":
-            fields.append(f)
+            out.append({**f, "name": rename.get(f["name"], f["name"])})
             continue
         items = f["type"]["items"]
         for k, b in enumerate(bags):
             # Avro named types must be defined once, then referenced.
-            fields.append({
+            out.append({
                 "name": b,
                 "type": {"type": "array",
                          "items": items if k == 0 else items["name"]},
             })
-    schema["fields"] = fields
+    schema["fields"] = out
     return schema

@@ -56,6 +56,12 @@ def _ntv_to_vector(ntv: list[dict], imap: IndexMap, dim: int) -> np.ndarray:
     return vec
 
 
+def _is_factored(m) -> bool:
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+    return isinstance(m, FactoredRandomEffectModel)
+
+
 def save_game_model_avro(
     model: GameModel,
     path: str,
@@ -85,6 +91,39 @@ def save_game_model_avro(
                           codec=codec)
             meta["coordinates"][cid] = {"type": "fixed",
                                         "shard": m.shard_id}
+        elif _is_factored(m):
+            # Reference layout: LatentFactorAvro records — per-entity latent
+            # factors plus the shared projection matrix (one record per
+            # feature row, effectId = the feature's name␁term key).
+            sub = os.path.join(path, _RANDOM, cid)
+            vocab = entity_vocabs.get(m.re_type)
+            if vocab is None:
+                vocab = {str(i): i for i in range(m.num_entities)}
+            Z = np.asarray(m.factors)
+            A = np.asarray(m.projection)
+            recs = [{"effectId": ent,
+                     "factors": [float(v) for v in Z[row]]}
+                    for ent, row in sorted(vocab.items(),
+                                           key=lambda kv: kv[1])]
+            write_records(os.path.join(sub, "latent-factors.avro"),
+                          schemas.LATENT_FACTOR_AVRO, recs, codec=codec)
+            proj_recs = []
+            for j in range(A.shape[0]):
+                key = imap.get_feature_name(j)
+                if key is None:
+                    raise KeyError(
+                        f"index map for shard {m.shard_id!r} has no feature "
+                        f"for projection row {j} (map covers {len(imap)} of "
+                        f"{A.shape[0]} columns)")
+                proj_recs.append({"effectId": key,
+                                  "factors": [float(v) for v in A[j]]})
+            write_records(os.path.join(sub, "projection-matrix.avro"),
+                          schemas.LATENT_FACTOR_AVRO, proj_recs, codec=codec)
+            meta["coordinates"][cid] = {
+                "type": "factored", "shard": m.shard_id,
+                "re_type": m.re_type, "num_entities": m.num_entities,
+                "rank": int(m.rank),
+            }
         else:
             sub = os.path.join(path, _RANDOM, cid)
             vocab = entity_vocabs.get(m.re_type)
@@ -140,11 +179,42 @@ def load_game_model_avro(
                     variances=(None if var is None
                                else jnp.asarray(_ntv_to_vector(
                                    var, imap, dim)))))
+        elif info["type"] == "factored":
+            from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+            sub = os.path.join(path, _RANDOM, cid)
+            z_recs = read_records(os.path.join(sub, "latent-factors.avro"))
+            a_recs = read_records(os.path.join(sub,
+                                               "projection-matrix.avro"))
+            rank = int(info["rank"])
+            vocab = entity_vocabs.get(info["re_type"]) or {
+                r["effectId"]: i for i, r in enumerate(z_recs)}
+            # Size by the CALLER's vocabulary too: scoring-time vocabs may
+            # map saved entities to rows beyond the save-time entity count
+            # (new entities get zero factors — the passive-data contract).
+            n_ent = max(info.get("num_entities", 0), len(vocab),
+                        max(vocab.values(), default=-1) + 1)
+            Z = np.zeros((n_ent, rank), np.float32)
+            for rec in z_recs:
+                row = vocab.get(rec["effectId"])
+                if row is not None:
+                    Z[row] = rec["factors"]
+            A = np.zeros((dim, rank), np.float32)
+            for rec in a_recs:
+                j = imap.get_index(rec["effectId"])
+                if j >= 0:
+                    A[j] = rec["factors"]
+            models[cid] = FactoredRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard"],
+                projection=jnp.asarray(A), factors=jnp.asarray(Z))
         else:
             recs = read_records(os.path.join(path, _RANDOM, cid))
             vocab = entity_vocabs.get(info["re_type"]) or {
                 r["modelId"]: i for i, r in enumerate(recs)}
-            n_ent = info.get("num_entities", len(vocab))
+            # Same sizing rule as the factored branch: honor scoring-time
+            # vocabularies whose rows exceed the save-time entity count.
+            n_ent = max(info.get("num_entities", 0), len(vocab),
+                        max(vocab.values(), default=-1) + 1)
             means = np.zeros((n_ent, dim), np.float32)
             variances = None
             for rec in recs:

@@ -25,6 +25,7 @@ import os
 import time
 
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
                                        RandomEffectDataConfiguration,
                                        parse_kv, parse_optimizer_config)
@@ -166,6 +167,15 @@ def run(args) -> dict:
                 features_to_samples_ratio=(
                     float(kv["features_to_samples_ratio"])
                     if "features_to_samples_ratio" in kv else None))
+        elif kv["type"] == "factored":
+            data = FactoredRandomEffectDataConfiguration(
+                random_effect_type=kv["re"],
+                feature_shard_id=kv["shard"],
+                rank=int(kv.get("rank", 4)),
+                alternations=int(kv.get("alternations", 2)),
+                active_data_lower_bound=int(kv.get("min_samples", 1)),
+                active_data_upper_bound=(int(kv["max_samples"])
+                                         if "max_samples" in kv else None))
         else:
             raise ValueError(f"unknown coordinate type {kv['type']!r}")
         opt = opt_by_coord.get(name, GLMOptimizationConfiguration())

@@ -1,0 +1,351 @@
+"""Factored random effects: low-rank per-entity models (matrix factorization).
+
+Reference parity: photon-api ``algorithm/FactoredRandomEffectCoordinate.
+scala`` + ``model/FactoredRandomEffectModel`` / ``LatentFactorAvro`` (the
+pre-fork GLMix matrix-factorization coordinate, removed in late upstream):
+entity e's coefficient vector is constrained to a rank-r subspace,
+``w_e = A z_e`` with a SHARED (d, r) projection matrix A and per-entity
+(r,) latent factors z_e. Training alternates between
+
+- the **latent step**: fix A, project features ``X̃ = X A`` and fit every
+  entity's z_e — exactly a random-effect solve at dimension r; and
+- the **projection step**: fix Z, fit A as one shared GLM whose margin is
+  ``x_iᵀ A z_{e(i)}`` — a fixed-effect-like problem in d·r parameters.
+
+TPU-first design: the whole alternation is ONE jitted program over
+device-resident X/labels/weights/ids. The latent step reuses the entity
+bucketing machinery (vmapped masked-lane solves per padded bucket; the
+projected features ``X̃[ex]`` are gathered on device from the current A, so
+nothing is re-staged between alternations). The projection step never
+materializes the (n, d·r) Kronecker design matrix the reference's math
+implies — its value/gradient are two matmuls:
+
+    margin = einsum(nd,dr,nr->n)(X, A, Z[ids])
+    grad_A = Xᵀ ((w ∘ dl)[:, None] * Z[ids])        # (d, r)
+
+which is the whole point of running it on the MXU.
+
+Variances are not supported (the reference factored coordinate predates
+variance computation and never supported it either).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledBatch
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+from photon_ml_tpu.game import buckets as bkt
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim import optimize
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         make_objective,
+                                         resolve_optimizer_config)
+from photon_ml_tpu.optim.regularization import RegularizationType
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, data_sharded, replicated
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectModel:
+    """Low-rank per-entity models: ``w_e = projection @ factors[e]``.
+
+    ``projection`` is the shared (d, r) matrix A; ``factors`` is the
+    (num_entities, r) latent table Z (untrained entities hold zero rows, so
+    their implied coefficients — and scores — are exactly zero, preserving
+    the passive-data semantics of the full-rank RandomEffectModel).
+    """
+
+    re_type: str
+    shard_id: str
+    projection: Array  # (d, r)
+    factors: Array  # (num_entities, r)
+
+    @property
+    def num_entities(self) -> int:
+        return self.factors.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.projection.shape[0]
+
+    @property
+    def rank(self) -> int:
+        return self.projection.shape[1]
+
+    def score(self, dataset: GameDataset) -> Array:
+        X = jnp.asarray(dataset.feature_shards[self.shard_id])
+        ids = jnp.asarray(dataset.entity_ids[self.re_type])
+        # x_i · (A z_e): contract the small rank axis last.
+        return jnp.einsum("nr,nr->n", X @ self.projection,
+                          self.factors[ids])
+
+    def to_random_effect_model(self):
+        """Materialize the implied full-rank (E, d) table (reference:
+        RandomEffectModel conversion used for scoring/persistence)."""
+        from photon_ml_tpu.game.models import RandomEffectModel
+
+        return RandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            means=self.factors @ self.projection.T)
+
+
+class FactoredRandomEffectCoordinate:
+    """Alternating matrix-factorization coordinate (reference:
+    FactoredRandomEffectCoordinate.trainModel's update loop).
+
+    ``config`` drives the projection (A) step; ``latent_config`` drives the
+    per-entity latent (Z) solves and defaults to ``config``; ``rank`` and
+    ``alternations`` mirror the reference's MFOptimizationConfiguration
+    (numLatentFactors, numInnerIterations).
+    """
+
+    def __init__(
+        self,
+        dataset: GameDataset,
+        re_type: str,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        mesh,
+        rank: int = 4,
+        alternations: int = 2,
+        latent_config: Optional[GLMOptimizationConfiguration] = None,
+        lower_bound: int = 1,
+        upper_bound: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if isinstance(dataset.feature_shards[shard_id], SparseShard):
+            raise TypeError(
+                f"factored random-effect shard {shard_id!r} is sparse; "
+                f"densify it (the latent step stages (E_b, cap, r) blocks "
+                f"from X @ A, which needs a dense X)")
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        if alternations < 1:
+            raise ValueError(f"alternations must be >= 1, got {alternations}")
+        self.dataset = dataset
+        self.re_type = re_type
+        self.shard_id = shard_id
+        self.loss = loss
+        self.config = config
+        self.latent_config = latent_config if latent_config is not None \
+            else config
+        self._latent_explicit = latent_config is not None
+        self.mesh = mesh
+        self.rank = int(rank)
+        self.alternations = int(alternations)
+        self.num_entities = dataset.num_entities[re_type]
+        self.seed = seed
+        self.bucketing = bkt.build_bucketing(
+            dataset.entity_ids[re_type], self.num_entities,
+            lower_bound=lower_bound, upper_bound=upper_bound,
+            entity_pad_multiple=max(8,
+                                    int(np.prod(list(mesh.shape.values())))),
+            rng=np.random.default_rng(seed))
+
+        # Stage device-resident arrays once (rows sharded over the data axis
+        # when divisible — the projection step is the data-parallel half).
+        n_data = mesh.shape[DATA_AXIS]
+
+        def put(a):
+            if a.shape[0] % n_data == 0:
+                return jax.device_put(a, data_sharded(mesh, a.ndim))
+            return jnp.asarray(a)
+
+        X = np.asarray(dataset.feature_shards[shard_id], np.float32)
+        self._X = put(X)
+        self._y = put(np.asarray(dataset.response, np.float32))
+        self._w = put(np.asarray(dataset.weights, np.float32))
+        self._ids = put(np.asarray(dataset.entity_ids[re_type], np.int32))
+        self._bucket_data = []
+        for b in self.bucketing.buckets:
+            wb = bkt.bucket_weights(b, np.asarray(dataset.weights))
+            (yb,) = bkt.gather_bucket_arrays(b, np.asarray(dataset.response))
+            ex = b.example_idx.astype(np.int32)
+            rows = b.entity_rows
+            self._bucket_data.append(tuple(
+                put(np.asarray(a)) for a in (yb, wb, ex, rows)))
+        self._build_fit()
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shard_dim(self.shard_id)
+
+    # -- jitted alternation ------------------------------------------------
+
+    def _build_fit(self):
+        # Guard here, not only in __init__: with_optimization_config swaps
+        # configs on a copy (the estimator grid/tuning path) and must hit
+        # the same rejection instead of silently dropping the penalty.
+        reg_kind = RegularizationType(self.config.regularization.reg_type)
+        if reg_kind in (RegularizationType.L1,
+                        RegularizationType.ELASTIC_NET):
+            raise ValueError(
+                "L1/elastic-net on the projection matrix is not supported "
+                "(no per-coordinate orthant structure on a shared (d, r) "
+                "matrix); use L2 or NONE for the factored coordinate")
+        loss = self.loss
+        d, r = self.dim, self.rank
+        num_entities = self.num_entities
+        l2 = self.config.regularization.l2_weight()
+        latent_cfg = self.latent_config
+        proj_opt_cfg = resolve_optimizer_config(self.config.optimizer, False)
+        # L2 skips the intercept feature's ROW of A (the same intercept_mask
+        # convention every other coordinate applies): the implied per-entity
+        # intercept (A z_e)[intercept] must not be shrunk by the matrix step.
+        ii = self.dataset.intercept_index.get(self.shard_id)
+        reg_mask = np.ones((d, r), np.float32)
+        if ii is not None:
+            reg_mask[ii, :] = 0.0
+        reg_mask = jnp.asarray(reg_mask.reshape(-1))
+
+        def solve_z_one(Xp_e, y_e, w_e, o_e, z0):
+            """One entity's latent solve at dimension r (no intercept — the
+            latent space has no distinguished column; the feature-space
+            intercept lives in A's rows like every other feature)."""
+            batch = LabeledBatch(Xp_e, y_e, w_e, o_e)
+            vg, hvp, l1w = make_objective(
+                loss, batch, NormalizationContext(),
+                latent_cfg.regularization, None, r)
+            opt_cfg = resolve_optimizer_config(latent_cfg.optimizer,
+                                               l1w is not None)
+            return optimize(vg, z0, opt_cfg, hvp=hvp, l1_weights=l1w).w
+
+        vsolve_z = jax.vmap(solve_z_one)
+
+        def z_step(A, Z, offsets):
+            Xp = self._X @ A  # (n_pad, r)
+            for yb, wb, ex, rows in self._bucket_data:
+                safe_ex = jnp.maximum(ex, 0)
+                Xb = Xp[safe_ex] * (ex >= 0)[..., None]
+                ob = offsets[safe_ex]
+                z0 = Z[jnp.maximum(rows, 0)]
+                z_fit = vsolve_z(Xb, yb, wb, ob, z0)
+                safe_rows = jnp.where(rows >= 0, rows, num_entities)
+                Z = Z.at[safe_rows].set(z_fit, mode="drop")
+            return Z
+
+        def a_step(A, Z, offsets):
+            Zg = Z[self._ids]  # (n_pad, r); padded rows have weight 0
+
+            def vg(a_flat):
+                Am = a_flat.reshape(d, r)
+                margin = jnp.einsum("nr,nr->n", self._X @ Am, Zg) + offsets
+                l, dl = loss.loss_and_dz(margin, self._y)
+                value = jnp.sum(self._w * l) \
+                    + 0.5 * l2 * jnp.sum(reg_mask * a_flat * a_flat)
+                g = self._X.T @ ((self._w * dl)[:, None] * Zg)
+                return value, g.reshape(-1) + l2 * reg_mask * a_flat
+
+            def hvp(a_flat, v_flat):
+                # Gauss-Newton-exact HVP (the objective is a GLM in vec(A)):
+                # H·v = Kᵀ diag(w·d2l) K v + l2·v with K v computable as
+                # einsum without materializing K = X ⊗ Z rows.
+                Am = a_flat.reshape(d, r)
+                Vm = v_flat.reshape(d, r)
+                margin = jnp.einsum("nr,nr->n", self._X @ Am, Zg) + offsets
+                d2 = loss.d2z(margin, self._y) * self._w
+                kv = jnp.einsum("nr,nr->n", self._X @ Vm, Zg)
+                hv = self._X.T @ ((d2 * kv)[:, None] * Zg)
+                return hv.reshape(-1) + l2 * reg_mask * v_flat
+
+            res = optimize(vg, A.reshape(-1), proj_opt_cfg, hvp=hvp)
+            return res.w.reshape(d, r)
+
+        def fit(A, Z, offsets):
+            for _ in range(self.alternations):
+                Z = z_step(A, Z, offsets)
+                A = a_step(A, Z, offsets)
+            # One closing latent pass so Z is optimal for the returned A
+            # (reference: the latent step is the last inner update).
+            Z = z_step(A, Z, offsets)
+            return A, Z
+
+        self._fit = jax.jit(fit)
+        self._score = jax.jit(
+            lambda A, Z: jnp.einsum("nr,nr->n", self._X @ A, Z[self._ids]))
+
+    # -- coordinate contract ----------------------------------------------
+
+    def _padded_offsets(self, offsets: Array) -> Array:
+        offsets = jnp.asarray(offsets)
+        n_pad = self._X.shape[0]
+        if offsets.shape[0] != n_pad:
+            offsets = jnp.zeros((n_pad,), offsets.dtype
+                                ).at[: offsets.shape[0]].set(offsets)
+        # Canonical sharding: the descent loop hands offsets with whatever
+        # sharding the last score update produced, which changes between
+        # the first and later CD iterations — without this, each distinct
+        # input sharding would recompile the (large) alternation program.
+        if offsets.shape[0] % self.mesh.shape[DATA_AXIS] == 0:
+            offsets = jax.device_put(offsets, data_sharded(self.mesh, 1))
+        return offsets
+
+    def initial_model(self) -> FactoredRandomEffectModel:
+        """Seeded random projection + zero factors (zero initial scores,
+        like every other coordinate's initial model)."""
+        rng = np.random.default_rng(self.seed)
+        A = (rng.normal(size=(self.dim, self.rank)) /
+             np.sqrt(self.dim)).astype(np.float32)
+        return FactoredRandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            projection=jnp.asarray(A),
+            factors=jnp.zeros((self.num_entities, self.rank), jnp.float32))
+
+    def train_model(
+        self,
+        offsets: Array,
+        initial: Optional[FactoredRandomEffectModel] = None,
+    ) -> FactoredRandomEffectModel:
+        if initial is None:
+            initial = self.initial_model()
+        if initial.rank != self.rank:
+            raise ValueError(
+                f"warm start has rank {initial.rank}, coordinate has rank "
+                f"{self.rank}")
+        # Canonical (replicated) placement for the warm start — like the
+        # offsets, its sharding otherwise varies between the first and later
+        # CD iterations (host arrays vs previous fit outputs) and every
+        # variant would recompile the alternation program.
+        rep = replicated(self.mesh)
+        A, Z = self._fit(jax.device_put(jnp.asarray(initial.projection), rep),
+                         jax.device_put(jnp.asarray(initial.factors), rep),
+                         self._padded_offsets(offsets))
+        return FactoredRandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            projection=A, factors=Z)
+
+    def score(self, model: FactoredRandomEffectModel) -> Array:
+        n = self.dataset.num_rows
+        return self._score(jnp.asarray(model.projection),
+                           jnp.asarray(model.factors))[:n]
+
+    def compute_model_variances(self, model, offsets):
+        """Factored models carry no variances (reference parity: the
+        factored coordinate predates and never supported computeVariances);
+        returned unchanged."""
+        return model
+
+    def with_optimization_config(
+        self, config: GLMOptimizationConfiguration
+    ) -> "FactoredRandomEffectCoordinate":
+        """Cheap copy for the estimator's reg-weight grid: the new config
+        drives the projection step and — unless a distinct latent config was
+        given at construction — the latent step too."""
+        import copy
+
+        c = copy.copy(self)
+        c.config = config
+        if not self._latent_explicit:
+            c.latent_config = config
+        c._build_fit()
+        return c

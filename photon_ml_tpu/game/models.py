@@ -79,6 +79,9 @@ class RandomEffectModel:
         return jnp.einsum("nd,nd->n", X, self.means[ids])
 
 
+# FactoredRandomEffectModel (game/factored.py) also satisfies this contract
+# (score(dataset) + re_type/shard_id); kept out of the Union to avoid an
+# import cycle — use duck typing where models are dispatched.
 CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
 
 

@@ -30,6 +30,8 @@ _METADATA = "metadata.json"
 
 def coordinate_meta(m) -> dict:
     """Metadata entry for one coordinate model (no file writes)."""
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
     if isinstance(m, FixedEffectModel):
         return {"type": "fixed", "shard_id": m.shard_id,
                 "dim": int(m.coefficients.dim)}
@@ -37,6 +39,10 @@ def coordinate_meta(m) -> dict:
         return {"type": "random", "shard_id": m.shard_id,
                 "re_type": m.re_type, "num_entities": int(m.num_entities),
                 "dim": int(m.dim)}
+    if isinstance(m, FactoredRandomEffectModel):
+        return {"type": "factored", "shard_id": m.shard_id,
+                "re_type": m.re_type, "num_entities": int(m.num_entities),
+                "dim": int(m.dim), "rank": int(m.rank)}
     raise TypeError(type(m))  # pragma: no cover
 
 
@@ -53,6 +59,11 @@ def save_coordinate(path: str, cid: str, m) -> dict:
         payload = {"means": np.asarray(m.coefficients.means)}
         if m.coefficients.variances is not None:
             payload["variances"] = np.asarray(m.coefficients.variances)
+    elif meta["type"] == "factored":
+        # Reference layout note: latent factors + projection matrix (the
+        # LatentFactorAvro pair) rather than materialized coefficients.
+        payload = {"projection": np.asarray(m.projection),
+                   "factors": np.asarray(m.factors)}
     else:
         payload = {"means": np.asarray(m.means)}
         if m.variances is not None:
@@ -96,6 +107,15 @@ def load_game_model(path: str) -> GameModel:
                            if "variances" in z else None))
             models[cid] = FixedEffectModel(shard_id=info["shard_id"],
                                            coefficients=coef)
+        elif info["type"] == "factored":
+            from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+            z = np.load(os.path.join(path, "random-effect", cid,
+                                     "coefficients.npz"))
+            models[cid] = FactoredRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                projection=jnp.asarray(z["projection"]),
+                factors=jnp.asarray(z["factors"]))
         else:
             z = np.load(os.path.join(path, "random-effect", cid,
                                      "coefficients.npz"))

@@ -382,3 +382,73 @@ class TestDataWriter:
         ds, meta = self._read(path, cfgs)
         with pytest.raises(ValueError, match="no index map"):
             AvroDataWriter().write(str(tmp_path / "x.avro"), ds, {})
+
+
+def test_writer_honors_field_names_preset(tmp_path):
+    """A non-default FieldNames preset renames the schema's scalar fields
+    (response/offset/weight/uid/metadata) so write→read round-trips."""
+    from photon_ml_tpu.avro.data_reader import (AvroDataReader,
+                                                FeatureShardConfig,
+                                                RESPONSE_PREDICTION_FIELDS)
+    from photon_ml_tpu.avro.data_writer import AvroDataWriter
+    from photon_ml_tpu.data.game_data import GameDataset
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+
+    rng = np.random.default_rng(11)
+    n = 15
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    ds = GameDataset(
+        response=rng.integers(0, 2, n).astype(np.float32),
+        offsets=rng.normal(size=n).astype(np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"global": X},
+        entity_ids={}, num_entities={}, intercept_index={},
+    )
+    imap = DefaultIndexMap.from_keys(["a", "b"], add_intercept=False)
+    out = str(tmp_path / "preset.avro")
+    AvroDataWriter(RESPONSE_PREDICTION_FIELDS).write(
+        out, ds, {"global": imap})
+    ds2, _ = AvroDataReader(RESPONSE_PREDICTION_FIELDS).read(
+        out, {"global": FeatureShardConfig(("features",), False)},
+        index_maps={"global": imap})
+    np.testing.assert_allclose(ds2.response, ds.response)
+    np.testing.assert_allclose(ds2.offsets, ds.offsets, atol=1e-6)
+    np.testing.assert_allclose(ds2.feature_shards["global"], X, atol=1e-6)
+
+
+def test_model_load_with_larger_scoring_vocab(tmp_path):
+    """Scoring-time vocabularies can map saved entities past the save-time
+    entity count; unseen entities get zero rows (passive contract)."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+    from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+    from photon_ml_tpu.types import TaskType
+
+    imap = DefaultIndexMap.from_keys(["f0", "f1"], add_intercept=False)
+    rng = np.random.default_rng(13)
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "re": RandomEffectModel(
+            re_type="userId", shard_id="s",
+            means=jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))),
+        "mf": FactoredRandomEffectModel(
+            re_type="userId", shard_id="s",
+            projection=jnp.asarray(rng.normal(size=(2, 2)).astype(
+                np.float32)),
+            factors=jnp.asarray(rng.normal(size=(2, 2)).astype(
+                np.float32))),
+    })
+    save_vocab = {"uA": 0, "uB": 1}
+    path = str(tmp_path / "m")
+    save_game_model_avro(gm, path, {"s": imap},
+                         entity_vocabs={"userId": save_vocab})
+    score_vocab = {"uNew1": 0, "uA": 1, "uB": 2, "uNew2": 3}
+    loaded = load_game_model_avro(path, {"s": imap},
+                                  entity_vocabs={"userId": score_vocab})
+    re, mf = loaded.models["re"], loaded.models["mf"]
+    assert re.means.shape[0] == 4 and mf.factors.shape[0] == 4
+    np.testing.assert_allclose(np.asarray(re.means)[1],
+                               np.asarray(gm.models["re"].means)[0])
+    np.testing.assert_allclose(np.asarray(mf.factors)[2],
+                               np.asarray(gm.models["mf"].factors)[1])
+    assert np.all(np.asarray(re.means)[[0, 3]] == 0.0)
+    assert np.all(np.asarray(mf.factors)[[0, 3]] == 0.0)

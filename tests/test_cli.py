@@ -198,3 +198,35 @@ def test_resume_flag_contradiction_rejected(rng, tmp_path):
             "--output-dir", str(tmp_path / "o"),
             "--no-checkpoint", "--resume",
         ]))
+
+
+# -- factored random effects through game_train + game_score ---------------
+
+def test_game_train_factored_coordinate(rng, tmp_path):
+    train_dir, val_dir = _write_game_data(
+        tmp_path, rng, re_specs={"userId": (20, 8)})
+    out = str(tmp_path / "out-mf")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", val_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=mf,type=factored,shard=re_userId,"
+                        "re=userId,rank=2,alternations=2",
+        "--update-sequence", "fixed,mf",
+        "--iterations", "2",
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "mf:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.65
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+    model = model_io.load_game_model(os.path.join(out, "best"))
+    assert isinstance(model.models["mf"], FactoredRandomEffectModel)
+    assert model.models["mf"].rank == 2
+    score_out = str(tmp_path / "scores-mf")
+    score_summary = game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", os.path.join(out, "best"),
+        "--output-dir", score_out, "--evaluators", "AUC",
+    ]))
+    assert score_summary["metrics"]["AUC"] > 0.65

@@ -1,0 +1,341 @@
+"""Factored (matrix-factorization) random-effect tests.
+
+Mirrors the reference's FactoredRandomEffectCoordinateIntegTest lineage
+(SURVEY §2.2 [LOW]): score algebra (w_e = A z_e), alternation convergence,
+low-rank recovery versus the full-rank coordinate, persistence round trips,
+and the estimator/descent integration.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.game.factored import (FactoredRandomEffectCoordinate,
+                                         FactoredRandomEffectModel)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _config(l2=1.0, max_iter=60):
+    return GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=max_iter, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, l2))
+
+
+def _nll(loss, scores, offsets, y, w):
+    l, _ = loss.loss_and_dz(scores + offsets, y)
+    return float(jnp.sum(w * l))
+
+
+def _low_rank_game(rng, n=4000, ne=40, d=12, rank=2):
+    """GAME data whose per-entity random-effect coefficients live EXACTLY
+    in a rank-``rank`` subspace: W = Z A^T with planted A, Z."""
+    syn = synthetic.game_data(rng, n=n, d_global=4,
+                              re_specs={"userId": (ne, d)})
+    ds = from_synthetic(syn)
+    A = rng.normal(size=(d, rank)).astype(np.float32)
+    Z = rng.normal(size=(ne, rank)).astype(np.float32)
+    W = Z @ A.T  # (ne, d)
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    margin = np.einsum("nd,nd->n", X, W[ids]).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-margin))
+    ds.response = (rng.uniform(size=n) < p).astype(np.float32)
+    ds.offsets = np.zeros(n, np.float32)
+    return ds
+
+
+# ------------------------------------------------------------------ model math
+
+
+def test_model_score_is_low_rank_dot(rng):
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=300, d_global=4, re_specs={"userId": (10, 8)}))
+    A = rng.normal(size=(8, 3)).astype(np.float32)
+    Z = rng.normal(size=(10, 3)).astype(np.float32)
+    m = FactoredRandomEffectModel(re_type="userId", shard_id="re_userId",
+                                  projection=jnp.asarray(A),
+                                  factors=jnp.asarray(Z))
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    want = np.einsum("nd,nd->n", X, (Z @ A.T)[ids])
+    np.testing.assert_allclose(np.asarray(m.score(ds)), want, rtol=1e-5,
+                               atol=1e-5)
+    # Materialized full-rank model scores identically.
+    re = m.to_random_effect_model()
+    np.testing.assert_allclose(np.asarray(re.score(ds)), want, rtol=1e-5,
+                               atol=1e-5)
+    assert re.means.shape == (10, 8)
+
+
+def test_untrained_entities_score_zero(rng):
+    """Zero latent rows (untrained/passive entities) contribute nothing."""
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=200, d_global=4, re_specs={"userId": (6, 8)}))
+    A = rng.normal(size=(8, 2)).astype(np.float32)
+    Z = np.zeros((6, 2), np.float32)
+    Z[0] = rng.normal(size=2)
+    m = FactoredRandomEffectModel(re_type="userId", shard_id="re_userId",
+                                  projection=jnp.asarray(A),
+                                  factors=jnp.asarray(Z))
+    s = np.asarray(m.score(ds))
+    other = ds.entity_ids["userId"] != 0
+    assert np.all(s[other] == 0.0)
+    assert np.any(s[~other] != 0.0)
+
+
+# ------------------------------------------------------------------- training
+
+
+def test_alternations_reduce_training_loss(rng, mesh):
+    ds = _low_rank_game(rng)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+        rank=2, alternations=2)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    m0, m1 = coord.initial_model(), None
+    m1 = coord.train_model(offsets)
+    nll0 = _nll(losses.LOGISTIC, coord.score(m0), offsets, y, w)
+    nll1 = _nll(losses.LOGISTIC, coord.score(m1), offsets, y, w)
+    assert nll1 < nll0 - 10.0
+    # Warm restart never degrades (monotone block-coordinate descent).
+    m2 = coord.train_model(offsets, initial=m1)
+    nll2 = _nll(losses.LOGISTIC, coord.score(m2), offsets, y, w)
+    assert nll2 <= nll1 + 1e-3 * abs(nll1)
+
+
+def test_low_rank_recovers_planted_structure(rng, mesh):
+    """With the truth exactly rank-2, the rank-2 factored fit must match
+    the full-rank coordinate's training-loss (within a small margin) while
+    using far fewer parameters."""
+    ds = _low_rank_game(rng)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    cfg = _config()
+    full = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, cfg, mesh)
+    fact = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, cfg, mesh,
+        rank=2, alternations=4)
+    nll_full = _nll(losses.LOGISTIC, full.score(full.train_model(offsets)),
+                    offsets, y, w)
+    nll_fact = _nll(losses.LOGISTIC, fact.score(fact.train_model(offsets)),
+                    offsets, y, w)
+    # The factored fit sees the same signal through 1/4 the parameters.
+    assert nll_fact < nll_full * 1.10
+
+
+def test_score_contract_matches_model_score(rng, mesh):
+    ds = _low_rank_game(rng, n=1000, ne=12)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=2)
+    m = coord.train_model(jnp.asarray(ds.offsets))
+    np.testing.assert_allclose(np.asarray(coord.score(m)),
+                               np.asarray(m.score(ds)), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_tron_projection_step(rng, mesh):
+    """The matrix step's Gauss-Newton HVP drives TRON correctly."""
+    ds = _low_rank_game(rng, n=1500, ne=15)
+    from photon_ml_tpu.optim import OptimizerType
+
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.TRON,
+                                  max_iterations=30, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, cfg, mesh,
+        rank=2, alternations=2)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    m = coord.train_model(offsets)
+    nll0 = _nll(losses.LOGISTIC, coord.score(coord.initial_model()),
+                offsets, y, w)
+    assert _nll(losses.LOGISTIC, coord.score(m), offsets, y, w) < nll0 - 10.0
+
+
+# ------------------------------------------------------------------ validation
+
+
+def test_config_validation(rng, mesh):
+    ds = _low_rank_game(rng, n=300, ne=6)
+    with pytest.raises(ValueError, match="rank"):
+        FactoredRandomEffectCoordinate(
+            ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+            rank=0)
+    with pytest.raises(ValueError, match="alternations"):
+        FactoredRandomEffectCoordinate(
+            ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+            alternations=0)
+    l1 = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=10),
+        regularization=RegularizationContext(RegularizationType.L1, 0.1))
+    with pytest.raises(ValueError, match="L1"):
+        FactoredRandomEffectCoordinate(
+            ds, "userId", "re_userId", losses.LOGISTIC, l1, mesh)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=3)
+    bad = FactoredRandomEffectModel(
+        re_type="userId", shard_id="re_userId",
+        projection=jnp.zeros((coord.dim, 2)), factors=jnp.zeros((6, 2)))
+    with pytest.raises(ValueError, match="rank"):
+        coord.train_model(jnp.asarray(ds.offsets), initial=bad)
+
+    from photon_ml_tpu.api.configs import FactoredRandomEffectDataConfiguration
+    with pytest.raises(ValueError, match="rank"):
+        FactoredRandomEffectDataConfiguration("userId", "re_userId", rank=0)
+
+
+# ----------------------------------------------------------------- persistence
+
+
+def test_npz_round_trip(tmp_path, rng, mesh):
+    from photon_ml_tpu.game.models import GameModel
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.types import TaskType
+
+    ds = _low_rank_game(rng, n=500, ne=8)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=2)
+    m = coord.train_model(jnp.asarray(ds.offsets))
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={"mf": m})
+    path = str(tmp_path / "model")
+    model_io.save_game_model(gm, path)
+    loaded = model_io.load_game_model(path)
+    lm = loaded.models["mf"]
+    assert isinstance(lm, FactoredRandomEffectModel)
+    np.testing.assert_allclose(np.asarray(lm.projection),
+                               np.asarray(m.projection))
+    np.testing.assert_allclose(np.asarray(lm.factors),
+                               np.asarray(m.factors))
+
+
+def test_avro_round_trip(tmp_path, rng, mesh):
+    from photon_ml_tpu.avro.model_io import (load_game_model_avro,
+                                             save_game_model_avro)
+    from photon_ml_tpu.game.models import GameModel
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+    from photon_ml_tpu.types import TaskType
+
+    ds = _low_rank_game(rng, n=500, ne=8)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=2)
+    m = coord.train_model(jnp.asarray(ds.offsets))
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={"mf": m})
+    imap = DefaultIndexMap.from_keys(
+        [f"f{j}" for j in range(coord.dim)], add_intercept=False)
+    vocab = {f"u{i}": i for i in range(8)}
+    path = str(tmp_path / "avro-model")
+    save_game_model_avro(gm, path, {"re_userId": imap},
+                         entity_vocabs={"userId": vocab})
+    loaded = load_game_model_avro(path, {"re_userId": imap},
+                                  entity_vocabs={"userId": vocab})
+    lm = loaded.models["mf"]
+    assert isinstance(lm, FactoredRandomEffectModel)
+    np.testing.assert_allclose(np.asarray(lm.projection),
+                               np.asarray(m.projection), rtol=1e-6,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(lm.factors),
+                               np.asarray(m.factors), rtol=1e-6, atol=1e-7)
+
+
+# ----------------------------------------------------------------- integration
+
+
+def test_estimator_with_factored_coordinate(rng, mesh):
+    from photon_ml_tpu.api.configs import (
+        CoordinateConfiguration, FactoredRandomEffectDataConfiguration,
+        FixedEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.evaluation import evaluators as ev
+    from photon_ml_tpu.types import TaskType
+
+    ds = _low_rank_game(rng, n=2500, ne=25)
+    coords = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=_config()),
+        "mf": CoordinateConfiguration(
+            data=FactoredRandomEffectDataConfiguration(
+                "userId", "re_userId", rank=2, alternations=2),
+            optimization=_config()),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION,
+                        coordinates=coords,
+                        update_sequence=["fixed", "mf"],
+                        descent_iterations=2, mesh=mesh)
+    fits = est.fit(ds)
+    model = fits[0].model
+    a = float(ev.auc(model.score(ds), jnp.asarray(ds.response)))
+    assert a > 0.75
+    assert isinstance(model.models["mf"], FactoredRandomEffectModel)
+
+
+def test_grid_swaps_config_cheaply(rng, mesh):
+    """with_optimization_config keeps staged data; new reg weight applies
+    to both steps when no explicit latent config was given."""
+    ds = _low_rank_game(rng, n=800, ne=10)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(l2=1.0), mesh,
+        rank=2)
+    strong = coord.with_optimization_config(_config(l2=500.0))
+    assert strong.latent_config.regularization.reg_weight == 500.0
+    offsets = jnp.asarray(ds.offsets)
+    m_weak = coord.train_model(offsets)
+    m_strong = strong.train_model(offsets)
+    # Heavier L2 shrinks the learned factors.
+    assert (float(jnp.linalg.norm(m_strong.factors))
+            < float(jnp.linalg.norm(m_weak.factors)))
+
+
+def test_config_swap_rejects_l1(rng, mesh):
+    """The estimator's config-swap path must hit the same L1 rejection as
+    the constructor (it rebuilds the fit programs on a copy)."""
+    ds = _low_rank_game(rng, n=300, ne=6)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=2)
+    l1 = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=10),
+        regularization=RegularizationContext(RegularizationType.L1, 0.1))
+    with pytest.raises(ValueError, match="L1"):
+        coord.with_optimization_config(l1)
+
+
+def test_projection_step_does_not_shrink_intercept_row(rng, mesh):
+    """L2 on the matrix step must skip the intercept feature's row of A
+    (the intercept_mask convention of every other coordinate)."""
+    ds = _low_rank_game(rng, n=1500, ne=10, d=8)
+    # Mark the last column as the intercept and make it constant 1.
+    ds.feature_shards["re_userId"][:, -1] = 1.0
+    ds.intercept_index["re_userId"] = 7
+    # Shift labels so a big per-entity intercept is needed.
+    ds.response = np.where(rng.uniform(size=ds.num_rows) < 0.9, 1.0,
+                           ds.response).astype(np.float32)
+    strong = _config(l2=300.0)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, strong, mesh,
+        rank=2, alternations=3)
+    m = coord.train_model(jnp.asarray(ds.offsets))
+    W = np.asarray(m.to_random_effect_model().means)
+    # The implied intercepts stay materially positive (unshrunk A row lets
+    # the model absorb the 90% positive base rate); non-intercept weights
+    # are crushed by the strong L2.
+    trained = coord.bucketing.trained_entities
+    assert np.median(W[trained, 7]) > 0.5
+    assert np.abs(W[trained][:, :7]).max() < np.median(W[trained, 7])
