@@ -50,9 +50,12 @@ class RandomEffectDataConfiguration:
     active_data_lower_bound: int = 1
     active_data_upper_bound: Optional[int] = None
     # Per-entity feature-subspace projection (reference projectorType:
-    # INDEX_MAP builds a LinearSubspaceProjector per entity; NONE solves at
+    # INDEX_MAP builds a LinearSubspaceProjector per entity; RANDOM solves
+    # every entity in one shared ``projected_dimension``-dim Gaussian
+    # random-projection space (ProjectionMatrixBroadcast); NONE solves at
     # the full shard dimension).
     projector: str = "NONE"
+    projected_dimension: Optional[int] = None  # RANDOM only
     # Cap each entity's subspace at ceil(ratio · num_samples) columns by
     # |Pearson corr(feature, label)| (reference
     # RandomEffectDataConfiguration.numFeaturesToSamplesRatio →
@@ -61,10 +64,23 @@ class RandomEffectDataConfiguration:
     features_to_samples_ratio: Optional[float] = None
 
     def __post_init__(self):
-        if self.projector.upper() not in ("NONE", "INDEX_MAP"):
+        if self.projector.upper() not in ("NONE", "INDEX_MAP", "RANDOM"):
             raise ValueError(
                 f"unknown projector {self.projector!r}; "
-                "expected NONE or INDEX_MAP")
+                "expected NONE, INDEX_MAP, or RANDOM")
+        if self.projector.upper() == "RANDOM":
+            if self.projected_dimension is None \
+                    or self.projected_dimension < 1:
+                raise ValueError(
+                    "projector=RANDOM needs projected_dimension >= 1")
+            if self.features_to_samples_ratio is not None:
+                raise ValueError(
+                    "features_to_samples_ratio composes with INDEX_MAP "
+                    "projection, not RANDOM (the random projection space "
+                    "has no per-feature identity to filter)")
+        elif self.projected_dimension is not None:
+            raise ValueError(
+                "projected_dimension only applies to projector=RANDOM")
         if (self.features_to_samples_ratio is not None
                 and not self.features_to_samples_ratio > 0):
             raise ValueError(

@@ -107,6 +107,23 @@ class GameEstimator:
                                                 NormalizationContext()),
                     feature_dtype=cc.data.feature_dtype)
             elif isinstance(cc.data, RandomEffectDataConfiguration):
+                if cc.data.projector.upper() == "RANDOM":
+                    # Gaussian random projection = a factored coordinate
+                    # with a frozen seeded projection matrix
+                    # (ProjectionMatrixBroadcast parity).
+                    if cc.data.feature_shard_id in self.normalization:
+                        raise ValueError(
+                            f"normalization is not supported with "
+                            f"projector=RANDOM on shard "
+                            f"{cc.data.feature_shard_id!r}")
+                    coords[cid] = FactoredRandomEffectCoordinate(
+                        dataset, cc.data.random_effect_type,
+                        cc.data.feature_shard_id, self.loss, opt, self.mesh,
+                        rank=cc.data.projected_dimension,
+                        learn_projection=False,
+                        lower_bound=cc.data.active_data_lower_bound,
+                        upper_bound=cc.data.active_data_upper_bound)
+                    continue
                 coords[cid] = RandomEffectCoordinate(
                     dataset, cc.data.random_effect_type,
                     cc.data.feature_shard_id, self.loss, opt, self.mesh,

@@ -164,6 +164,8 @@ def run(args) -> dict:
                 active_data_upper_bound=(int(kv["max_samples"])
                                          if "max_samples" in kv else None),
                 projector=kv.get("projector", "NONE").upper(),
+                projected_dimension=(int(kv["projected_dim"])
+                                     if "projected_dim" in kv else None),
                 features_to_samples_ratio=(
                     float(kv["features_to_samples_ratio"])
                     if "features_to_samples_ratio" in kv else None))

@@ -106,6 +106,13 @@ class FactoredRandomEffectCoordinate:
     per-entity latent (Z) solves and defaults to ``config``; ``rank`` and
     ``alternations`` mirror the reference's MFOptimizationConfiguration
     (numLatentFactors, numInnerIterations).
+
+    ``learn_projection=False`` freezes A at its seeded Gaussian draw and
+    runs a single latent pass — this IS the reference's random-projection
+    projector (``projector/ProjectionMatrixBroadcast.scala``,
+    projectorType=RANDOM): every entity solves in the same k-dim randomly
+    projected feature space, and the returned model's implied coefficients
+    ``A z_e`` live back in the original space.
     """
 
     def __init__(
@@ -122,6 +129,7 @@ class FactoredRandomEffectCoordinate:
         lower_bound: int = 1,
         upper_bound: Optional[int] = None,
         seed: int = 0,
+        learn_projection: bool = True,
     ):
         if isinstance(dataset.feature_shards[shard_id], SparseShard):
             raise TypeError(
@@ -143,6 +151,7 @@ class FactoredRandomEffectCoordinate:
         self.mesh = mesh
         self.rank = int(rank)
         self.alternations = int(alternations)
+        self.learn_projection = bool(learn_projection)
         self.num_entities = dataset.num_entities[re_type]
         self.seed = seed
         self.bucketing = bkt.build_bucketing(
@@ -185,10 +194,12 @@ class FactoredRandomEffectCoordinate:
     def _build_fit(self):
         # Guard here, not only in __init__: with_optimization_config swaps
         # configs on a copy (the estimator grid/tuning path) and must hit
-        # the same rejection instead of silently dropping the penalty.
+        # the same rejection instead of silently dropping the penalty. With
+        # a frozen projection (projector=RANDOM) the matrix step never runs
+        # and the latent solves fully support L1 — no rejection there.
         reg_kind = RegularizationType(self.config.regularization.reg_type)
-        if reg_kind in (RegularizationType.L1,
-                        RegularizationType.ELASTIC_NET):
+        if self.learn_projection and reg_kind in (
+                RegularizationType.L1, RegularizationType.ELASTIC_NET):
             raise ValueError(
                 "L1/elastic-net on the projection matrix is not supported "
                 "(no per-coordinate orthant structure on a shared (d, r) "
@@ -262,6 +273,10 @@ class FactoredRandomEffectCoordinate:
             return res.w.reshape(d, r)
 
         def fit(A, Z, offsets):
+            if not self.learn_projection:
+                # Random-projection mode: A is frozen; one latent pass is
+                # exact (each entity's solve is convex given A).
+                return A, z_step(A, Z, offsets)
             for _ in range(self.alternations):
                 Z = z_step(A, Z, offsets)
                 A = a_step(A, Z, offsets)
@@ -312,6 +327,15 @@ class FactoredRandomEffectCoordinate:
             raise ValueError(
                 f"warm start has rank {initial.rank}, coordinate has rank "
                 f"{self.rank}")
+        if initial.num_entities != self.num_entities \
+                or initial.dim != self.dim:
+            # An oversized factors table (e.g. loaded under a larger
+            # scoring vocabulary) would make the padding-lane scatter index
+            # num_entities IN bounds and silently corrupt that row.
+            raise ValueError(
+                f"warm start shape ({initial.num_entities} entities, dim "
+                f"{initial.dim}) does not match coordinate "
+                f"({self.num_entities} entities, dim {self.dim})")
         # Canonical (replicated) placement for the warm start — like the
         # offsets, its sharding otherwise varies between the first and later
         # CD iterations (host arrays vs previous fit outputs) and every

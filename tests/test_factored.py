@@ -339,3 +339,115 @@ def test_projection_step_does_not_shrink_intercept_row(rng, mesh):
     trained = coord.bucketing.trained_entities
     assert np.median(W[trained, 7]) > 0.5
     assert np.abs(W[trained][:, :7]).max() < np.median(W[trained, 7])
+
+
+# ------------------------------------------------------ random projection mode
+
+
+def test_random_projection_freezes_matrix(rng, mesh):
+    """learn_projection=False: A stays at its seeded draw; the single
+    latent pass still cuts the training loss."""
+    ds = _low_rank_game(rng, n=2000, ne=20, d=12)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+        rank=6, learn_projection=False)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    m0 = coord.initial_model()
+    m1 = coord.train_model(offsets)
+    np.testing.assert_array_equal(np.asarray(m1.projection),
+                                  np.asarray(m0.projection))
+    nll0 = _nll(losses.LOGISTIC, coord.score(m0), offsets, y, w)
+    nll1 = _nll(losses.LOGISTIC, coord.score(m1), offsets, y, w)
+    assert nll1 < nll0 - 10.0
+
+
+def test_random_projection_full_dim_matches_unprojected(rng, mesh):
+    """A square Gaussian A is (a.s.) invertible, so solving in the rotated
+    space with matched ridge-free objectives spans the same model class —
+    training loss parity with the full-rank coordinate at tiny L2."""
+    ds = _low_rank_game(rng, n=2500, ne=12, d=8)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    cfg = _config(l2=1e-4, max_iter=200)
+    full = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, cfg, mesh)
+    rp = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, cfg, mesh,
+        rank=8, learn_projection=False)
+    nll_full = _nll(losses.LOGISTIC, full.score(full.train_model(offsets)),
+                    offsets, y, w)
+    nll_rp = _nll(losses.LOGISTIC, rp.score(rp.train_model(offsets)),
+                  offsets, y, w)
+    assert nll_rp < nll_full * 1.05 + 1.0
+
+
+def test_random_projector_through_estimator(rng, mesh):
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration,
+                                           RandomEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.evaluation import evaluators as ev
+    from photon_ml_tpu.types import TaskType
+
+    ds = _low_rank_game(rng, n=2000, ne=20, d=12)
+    coords = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=_config()),
+        "rp": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration(
+                "userId", "re_userId", projector="RANDOM",
+                projected_dimension=6),
+            optimization=_config()),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION,
+                        coordinates=coords,
+                        update_sequence=["fixed", "rp"],
+                        descent_iterations=2, mesh=mesh)
+    model = est.fit(ds)[0].model
+    a = float(ev.auc(model.score(ds), jnp.asarray(ds.response)))
+    assert a > 0.7
+    assert isinstance(model.models["rp"], FactoredRandomEffectModel)
+
+
+def test_random_projector_config_validation():
+    from photon_ml_tpu.api.configs import RandomEffectDataConfiguration
+
+    with pytest.raises(ValueError, match="projected_dimension"):
+        RandomEffectDataConfiguration("u", "s", projector="RANDOM")
+    with pytest.raises(ValueError, match="projected_dimension"):
+        RandomEffectDataConfiguration("u", "s", projected_dimension=4)
+    with pytest.raises(ValueError, match="RANDOM"):
+        RandomEffectDataConfiguration("u", "s", projector="RANDOM",
+                                      projected_dimension=4,
+                                      features_to_samples_ratio=0.5)
+
+
+def test_random_projection_supports_l1_latent(rng, mesh):
+    """projector=RANDOM never runs the matrix step, so L1 on the latent
+    solves is legal (the full-rank coordinate allows L1 too)."""
+    ds = _low_rank_game(rng, n=1200, ne=10, d=8)
+    l1 = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L1, 0.1))
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, l1, mesh,
+        rank=4, learn_projection=False)
+    offsets = jnp.asarray(ds.offsets)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    m = coord.train_model(offsets)
+    nll0 = _nll(losses.LOGISTIC, coord.score(coord.initial_model()),
+                offsets, y, w)
+    assert _nll(losses.LOGISTIC, coord.score(m), offsets, y, w) < nll0
+
+
+def test_oversized_warm_start_rejected(rng, mesh):
+    ds = _low_rank_game(rng, n=300, ne=6, d=8)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh, rank=2)
+    big = FactoredRandomEffectModel(
+        re_type="userId", shard_id="re_userId",
+        projection=jnp.zeros((8, 2)), factors=jnp.zeros((9, 2)))
+    with pytest.raises(ValueError, match="entities"):
+        coord.train_model(jnp.asarray(ds.offsets), initial=big)
