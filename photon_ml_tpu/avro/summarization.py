@@ -1,0 +1,55 @@
+"""Feature-summary Avro output.
+
+Reference parity: photon-client writes per-feature
+``FeatureSummarizationResultAvro`` records (name/term, min/max/mean/
+variance/numNonzeros/count) beside the model when feature summarization
+runs (``Driver`` INIT stage / GameTrainingDriver summarization output) —
+the human-auditable record of the statistics that fed normalization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.container import read_records, write_records
+from photon_ml_tpu.data.statistics import FeatureDataStatistics
+from photon_ml_tpu.index.indexmap import IndexMap, split_key
+
+
+def write_feature_summaries(
+    path: str,
+    stats: FeatureDataStatistics,
+    index_map: IndexMap,
+    codec: str = "deflate",
+) -> int:
+    """Write one FeatureSummarizationResultAvro record per feature column;
+    returns the record count."""
+    mean = np.asarray(stats.mean)
+    var = np.asarray(stats.variance)
+    mn = np.asarray(stats.min)
+    mx = np.asarray(stats.max)
+    nnz = np.asarray(stats.num_nonzeros)
+    count = int(np.asarray(stats.count))
+    recs = []
+    for j in range(stats.dim):
+        key = index_map.get_feature_name(j)
+        if key is None:
+            raise KeyError(
+                f"index map has no feature for column {j} "
+                f"(map covers {len(index_map)} of {stats.dim} columns)")
+        name, term = split_key(key)
+        recs.append({
+            "name": name, "term": term,
+            "max": float(mx[j]), "min": float(mn[j]),
+            "mean": float(mean[j]), "variance": float(var[j]),
+            "numNonzeros": float(nnz[j]), "count": count,
+        })
+    write_records(path, schemas.FEATURE_SUMMARIZATION_RESULT_AVRO, recs,
+                  codec=codec)
+    return len(recs)
+
+
+def read_feature_summaries(path: str) -> list[dict]:
+    """Read back the records written by :func:`write_feature_summaries`."""
+    return read_records(path)

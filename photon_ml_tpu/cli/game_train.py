@@ -31,6 +31,8 @@ from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        parse_kv, parse_optimizer_config)
 from photon_ml_tpu.api.estimator import GameEstimator
 from photon_ml_tpu.data.io import load_game_dataset
+from photon_ml_tpu.data.validators import (DataValidationLevel,
+                                           validate_game_dataset)
 from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
 from photon_ml_tpu.parallel.mesh import make_mesh
@@ -95,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler trace of the fit into this "
                         "directory (TensorBoard/Perfetto viewable)")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationLevel],
+                   help="input sanity checks (reference DataValidators: "
+                        "task-valid labels, finite features/offsets, "
+                        "non-negative weights)")
     p.add_argument("--distributed", action="store_true",
                    help="join the multi-host world before building the "
                         "mesh (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
@@ -132,6 +139,10 @@ def run(args) -> dict:
                     "in the training data")
             nf = train.shard_dim("global")
         validation = _load_dataset(args.validation, num_features=nf)
+    vlevel = DataValidationLevel(args.data_validation)
+    validate_game_dataset(task, train, level=vlevel)
+    if validation is not None:
+        validate_game_dataset(task, validation, level=vlevel)
 
     opt_by_coord: dict[str, GLMOptimizationConfiguration] = {}
     for spec in args.opt_config:

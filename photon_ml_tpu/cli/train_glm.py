@@ -28,6 +28,9 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.libsvm import read_libsvm
 from photon_ml_tpu.data.statistics import (normalization_from_statistics,
                                            summarize)
+from photon_ml_tpu.data.validators import (DataValidationLevel,
+                                           validate_arrays,
+                                           validate_features)
 from photon_ml_tpu.evaluation import evaluators as ev
 from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
@@ -78,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL"])
     p.add_argument("--num-features", type=int,
                    help="fixed feature-space size (else inferred)")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationLevel],
+                   help="input sanity checks (reference DataValidators)")
+    p.add_argument("--summarization-output-dir",
+                   help="write per-feature FeatureSummarizationResultAvro "
+                        "records here (reference summarization output)")
     return p
 
 
@@ -93,12 +102,35 @@ def run(args) -> dict:
     if not args.no_intercept:
         X = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
         intercept_index = X.shape[1] - 1
+    # INIT-stage sanity checks (reference: DataValidators.sanityCheckData).
+    vlevel = DataValidationLevel(args.data_validation)
+    validate_arrays(task, train.labels, level=vlevel)
+    validate_features("train", X, level=vlevel)
+
     batch = LabeledBatch.build(X, train.labels)
     logger.info("read %d x %d training examples", *X.shape)
 
     stats = summarize(batch)
     norm = normalization_from_statistics(
         stats, NormalizationType(args.normalization), intercept_index)
+    if args.summarization_output_dir:
+        from photon_ml_tpu.avro.summarization import write_feature_summaries
+        from photon_ml_tpu.index.indexmap import (INTERCEPT_KEY,
+                                                  DefaultIndexMap)
+
+        # LIBSVM columns carry no names; synthesize the reference's
+        # name-per-column form (column index as the name).
+        keys = [str(j) for j in range(X.shape[1]
+                                      - (0 if args.no_intercept else 1))]
+        imap = DefaultIndexMap.from_keys(keys,
+                                         add_intercept=not args.no_intercept)
+        os.makedirs(args.summarization_output_dir, exist_ok=True)
+        write_feature_summaries(
+            os.path.join(args.summarization_output_dir,
+                         "feature-summaries.avro"),
+            stats, imap)
+        logger.info("wrote feature summaries to %s",
+                    args.summarization_output_dir)
 
     mesh = make_mesh()
     reg_weights = [float(w) for w in args.reg_weights.split(",") if w]
@@ -112,6 +144,11 @@ def run(args) -> dict:
         Xv = val.to_dense()
         if not args.no_intercept:
             Xv = np.concatenate([Xv, np.ones((Xv.shape[0], 1), np.float32)], 1)
+        # Validation data gets the same sanity checks: a NaN here would
+        # otherwise turn every candidate's metric into NaN and make
+        # select-best arbitrary.
+        validate_arrays(task, val.labels, level=vlevel)
+        validate_features("validation", Xv, level=vlevel)
         val_batch = (Xv, val.labels)
 
     candidates = []

@@ -1,0 +1,207 @@
+"""Data-validation + feature-summarization-output tests.
+
+Mirrors the reference's DataValidators coverage (per-task label checks,
+finite features/offsets, weight sign) and the FeatureSummarizationResultAvro
+round trip.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.validators import (DataValidationLevel,
+                                           validate_arrays,
+                                           validate_features,
+                                           validate_game_dataset,
+                                           validate_labels)
+from photon_ml_tpu.types import TaskType
+
+
+class TestLabelValidation:
+    def test_binary_ok(self):
+        validate_labels(TaskType.LOGISTIC_REGRESSION,
+                        np.array([0.0, 1.0, 1.0]))
+
+    def test_binary_rejects_other_values(self):
+        with pytest.raises(ValueError, match="binary"):
+            validate_labels(TaskType.LOGISTIC_REGRESSION,
+                            np.array([0.0, 2.0]))
+        with pytest.raises(ValueError, match="binary"):
+            validate_labels(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+                            np.array([-1.0, 1.0]))  # {0,1} convention
+
+    def test_poisson_rejects_negative(self):
+        validate_labels(TaskType.POISSON_REGRESSION, np.array([0.0, 3.0]))
+        with pytest.raises(ValueError, match="non-negative"):
+            validate_labels(TaskType.POISSON_REGRESSION, np.array([-1.0]))
+
+    def test_linear_rejects_nan(self):
+        with pytest.raises(ValueError, match="non-finite"):
+            validate_labels(TaskType.LINEAR_REGRESSION,
+                            np.array([1.0, np.nan]))
+
+
+class TestArrayValidation:
+    def test_negative_weight_rejected(self):
+        with pytest.raises(ValueError, match="weights"):
+            validate_arrays(TaskType.LINEAR_REGRESSION,
+                            np.array([1.0, 2.0]),
+                            weights=np.array([1.0, -0.5]))
+
+    def test_nonfinite_offset_rejected(self):
+        with pytest.raises(ValueError, match="offsets"):
+            validate_arrays(TaskType.LINEAR_REGRESSION, np.array([1.0]),
+                            offsets=np.array([np.inf]))
+
+    def test_disabled_skips_everything(self):
+        validate_arrays(TaskType.LOGISTIC_REGRESSION, np.array([5.0]),
+                        level=DataValidationLevel.DISABLED)
+
+    def test_sample_level_catches_dense_corruption(self):
+        labels = np.full(50_000, 2.0)  # all invalid: any sample catches it
+        with pytest.raises(ValueError, match="binary"):
+            validate_arrays(TaskType.LOGISTIC_REGRESSION, labels,
+                            level=DataValidationLevel.VALIDATE_SAMPLE)
+
+
+class TestFeatureValidation:
+    def test_dense_nan_rejected(self):
+        X = np.ones((4, 3), np.float32)
+        X[2, 1] = np.nan
+        with pytest.raises(ValueError, match="feature shard 'g'"):
+            validate_features("g", X)
+
+    def test_sparse_shard_values_checked(self):
+        from photon_ml_tpu.data.game_data import SparseShard
+
+        shard = SparseShard(indices=np.zeros((3, 2), np.int32),
+                            values=np.array([[1, 2], [np.inf, 0], [0, 0]],
+                                            np.float32),
+                            num_features=5)
+        with pytest.raises(ValueError, match="feature shard"):
+            validate_features("s", shard)
+
+
+def test_game_dataset_validation(rng):
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=200, d_global=4, re_specs={"userId": (5, 4)}))
+    validate_game_dataset(TaskType.LOGISTIC_REGRESSION, ds)
+    ds.feature_shards["global"][7, 1] = np.nan
+    with pytest.raises(ValueError, match="global"):
+        validate_game_dataset(TaskType.LOGISTIC_REGRESSION, ds)
+
+
+def test_driver_rejects_bad_labels(tmp_path, rng):
+    """The GLM driver fails fast at INIT (reference Driver behavior)."""
+    from photon_ml_tpu.cli import train_glm
+
+    path = str(tmp_path / "bad.libsvm")
+    with open(path, "w") as f:
+        f.write("3.0 1:0.5 2:0.25\n0 1:1.0\n")  # label 3.0 invalid
+    with pytest.raises(ValueError, match="binary"):
+        train_glm.run(train_glm.build_parser().parse_args([
+            "--train", path, "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out")]))
+
+
+def test_feature_summaries_roundtrip(tmp_path, rng):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.avro.summarization import (read_feature_summaries,
+                                                  write_feature_summaries)
+    from photon_ml_tpu.data.batch import LabeledBatch
+    from photon_ml_tpu.data.statistics import summarize
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    X[:, 2] = 1.0
+    batch = LabeledBatch.build(X, np.ones(100, np.float32))
+    stats = summarize(batch)
+    imap = DefaultIndexMap.from_keys(["age", "clicks\x01day7"],
+                                     add_intercept=True)
+    path = str(tmp_path / "summ.avro")
+    n = write_feature_summaries(path, stats, imap)
+    assert n == 3
+    recs = read_feature_summaries(path)
+    by_name = {(r["name"], r["term"]): r for r in recs}
+    assert ("clicks", "day7") in by_name
+    r = by_name[("age", "")]
+    np.testing.assert_allclose(r["mean"], float(X[:, 0].mean()), atol=1e-5)
+    np.testing.assert_allclose(r["variance"], float(X[:, 0].var()),
+                               atol=1e-4)
+    assert r["count"] == 100
+    assert by_name[("(INTERCEPT)", "")]["numNonzeros"] == 100.0
+
+
+def test_glm_driver_writes_summaries(tmp_path, rng):
+    from photon_ml_tpu.avro.summarization import read_feature_summaries
+    from photon_ml_tpu.cli import train_glm
+
+    path = str(tmp_path / "ok.libsvm")
+    with open(path, "w") as f:
+        for i in range(60):
+            x1, x2 = rng.normal(), rng.normal()
+            y = 1 if x1 + x2 > 0 else 0
+            f.write(f"{y} 1:{x1:.4f} 2:{x2:.4f}\n")
+    summ_dir = str(tmp_path / "summ")
+    train_glm.run(train_glm.build_parser().parse_args([
+        "--train", path, "--task", "LOGISTIC_REGRESSION",
+        "--output-dir", str(tmp_path / "out"),
+        "--summarization-output-dir", summ_dir]))
+    recs = read_feature_summaries(
+        str(tmp_path / "summ" / "feature-summaries.avro"))
+    assert len(recs) == 3  # two features + intercept
+    names = {r["name"] for r in recs}
+    assert names == {"0", "1", "(INTERCEPT)"}
+
+
+def test_sample_error_reports_original_row():
+    """VALIDATE_SAMPLE diagnostics must name dataset rows, not positions
+    inside the drawn sample."""
+    labels = np.zeros(60_000)
+    labels[37_123] = 5.0
+    # Full pass: exact row named.
+    with pytest.raises(ValueError, match=r"labels\[37123\]"):
+        validate_labels(TaskType.LOGISTIC_REGRESSION, labels)
+    # Sampled pass on all-bad data: whatever row is reported must be a REAL
+    # bad row index (here: any of the poisoned ones).
+    labels = np.full(60_000, 2.0)
+    try:
+        validate_arrays(TaskType.LOGISTIC_REGRESSION, labels,
+                        level=DataValidationLevel.VALIDATE_SAMPLE)
+        raise AssertionError("expected rejection")
+    except ValueError as e:
+        import re
+
+        row = int(re.search(r"labels\[(\d+)\]", str(e)).group(1))
+        assert labels[row] == 2.0
+
+
+def test_full_level_does_not_copy():
+    """VALIDATE_FULL checks arrays in place (idx is None → no gather)."""
+    from photon_ml_tpu.data.validators import _rows
+
+    rng = np.random.default_rng(0)
+    assert _rows(10**8, DataValidationLevel.VALIDATE_FULL, rng) is None
+    idx = _rows(10**8, DataValidationLevel.VALIDATE_SAMPLE, rng)
+    assert idx is not None and len(idx) <= 10_000
+
+
+def test_glm_driver_rejects_bad_validation_file(tmp_path, rng):
+    from photon_ml_tpu.cli import train_glm
+
+    train = str(tmp_path / "t.libsvm")
+    with open(train, "w") as f:
+        for i in range(40):
+            x = rng.normal()
+            f.write(f"{1 if x > 0 else 0} 1:{x:.4f}\n")
+    bad_val = str(tmp_path / "v.libsvm")
+    with open(bad_val, "w") as f:
+        f.write("2 1:0.5\n")  # invalid label for logistic
+    with pytest.raises(ValueError, match="binary"):
+        train_glm.run(train_glm.build_parser().parse_args([
+            "--train", train, "--validation", bad_val,
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out")]))
