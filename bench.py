@@ -28,9 +28,22 @@ Prints ONE JSON line.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
+
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def _progress(msg: str) -> None:
+    """Stderr progress marker (stdout stays one JSON line). Compiles over
+    the remote tunnel can take minutes each; without these markers a slow
+    run is indistinguishable from a hung one."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def _numpy_value_grad(X, y, w):
@@ -282,11 +295,17 @@ def bench_game_iteration():
 
 
 def main():
+    _progress("gradient step")
     grad = bench_gradient_step()
+    _progress("optimizer iterations")
     opt = bench_optimizer_steps()
+    _progress("sparse 1M-feature step")
     sparse = bench_sparse()
+    _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
+    _progress("GAME coordinate-descent sweep")
     game_iter_s = bench_game_iteration()
+    _progress("done")
     print(json.dumps({
         "metric": "glm_gradient_step_samples_per_sec_per_chip",
         "value": round(grad["samples_per_sec"]),

@@ -1,0 +1,98 @@
+"""Factored (matrix-factorization) random effects walkthrough.
+
+When entities are many and their per-entity signal is low-rank — the
+classic recommender regime — constraining every per-entity model to a
+shared rank-r subspace (``w_e = A z_e``) cuts parameters from E·d to
+E·r + d·r and regularizes heavily-sparse entities through the shared
+projection. This script compares three per-user coordinates on data with
+planted rank-2 structure:
+
+- full-rank random effects (one d-dim model per user),
+- factored random effects at rank 2 (alternating latent/matrix steps),
+- a frozen Gaussian random projection at dimension 4 (projector=RANDOM).
+
+Run: python examples/factored_random_effects.py
+"""
+
+import numpy as np
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FactoredRandomEffectDataConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+
+def make_low_rank_data(rng, n=20_000, n_users=200, d=16, rank=2):
+    """Train + held-out datasets sharing one planted rank-2 W = Z Aᵀ.
+
+    Held-out evaluation is the point of the comparison: on train AUC the
+    full-rank coordinate can only win (it nests the factored model class);
+    generalization is where the shared-subspace regularization shows."""
+    syn = synthetic.game_data(rng, n=n, d_global=6,
+                              re_specs={"userId": (n_users, d)})
+    ds = from_synthetic(syn)
+    A = rng.normal(size=(d, rank)).astype(np.float32)
+    Z = rng.normal(size=(n_users, rank)).astype(np.float32)
+    W = Z @ A.T
+    X = ds.feature_shards["re_userId"]
+    ids = ds.entity_ids["userId"]
+    margin = np.einsum("nd,nd->n", X, W[ids])
+    p = 1.0 / (1.0 + np.exp(-margin))
+    ds.response = (rng.uniform(size=n) < p).astype(np.float32)
+    ds.offsets = np.zeros(n, np.float32)
+    split = int(0.8 * n)
+    perm = rng.permutation(n)
+    return ds.subset(perm[:split]), ds.subset(perm[split:])
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train, heldout = make_low_rank_data(rng)
+    mesh = make_mesh()
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+
+    variants = {
+        "full-rank": RandomEffectDataConfiguration("userId", "re_userId"),
+        "factored-r2": FactoredRandomEffectDataConfiguration(
+            "userId", "re_userId", rank=2, alternations=3),
+        "random-proj-4": RandomEffectDataConfiguration(
+            "userId", "re_userId", projector="RANDOM",
+            projected_dimension=4),
+    }
+    for name, data_cfg in variants.items():
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates={
+                "fixed": CoordinateConfiguration(
+                    data=FixedEffectDataConfiguration("global"),
+                    optimization=opt),
+                "per-user": CoordinateConfiguration(data=data_cfg,
+                                                    optimization=opt),
+            },
+            update_sequence=["fixed", "per-user"],
+            descent_iterations=2,
+            mesh=mesh,
+            validation_evaluators=["AUC"],
+        )
+        result = est.fit(train, validation_data=heldout)[0]
+        auc = result.evaluation.primary_value
+        m = result.model.models["per-user"]
+        n_params = (m.factors.size + m.projection.size
+                    if hasattr(m, "factors") else m.means.size)
+        print(f"{name:>14}: held-out AUC {auc:.4f}  "
+              f"({n_params:,} RE parameters)")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ import numpy as np
 from photon_ml_tpu.api.transformer import GameTransformer
 from photon_ml_tpu.data.io import load_game_dataset
 from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 from photon_ml_tpu.utils.logging import setup_logging
 
 logger = logging.getLogger("photon_ml_tpu.cli")
@@ -37,6 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(args) -> dict:
     setup_logging()
+    enable_compilation_cache()
     t0 = time.time()
     data = load_game_dataset(args.data)
     model = model_io.load_game_model(args.model_dir)

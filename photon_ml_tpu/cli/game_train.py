@@ -37,6 +37,7 @@ from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
 from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 from photon_ml_tpu.utils.logging import setup_logging
 
 logger = logging.getLogger("photon_ml_tpu.cli")
@@ -124,6 +125,7 @@ def _load_dataset(path: str, num_features=None):
 
 def run(args) -> dict:
     setup_logging()
+    enable_compilation_cache()
     t0 = time.time()
     task = TaskType(args.task)
     train = _load_dataset(args.train)

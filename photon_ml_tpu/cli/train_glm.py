@@ -45,6 +45,7 @@ from photon_ml_tpu.optim.regularization import (RegularizationContext,
 from photon_ml_tpu.parallel import problem as dist_problem
 from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 from photon_ml_tpu.utils.logging import setup_logging
 
 logger = logging.getLogger("photon_ml_tpu.cli")
@@ -92,6 +93,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(args) -> dict:
     setup_logging()
+    enable_compilation_cache()
     task = TaskType(args.task)
     loss = losses_mod.loss_for_task(task)
     t0 = time.time()

@@ -36,6 +36,14 @@ if not _env_ok() and os.environ.get("_PHOTON_TEST_REEXEC") != "1":
 import numpy as np
 import pytest
 
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+# Persist compiled executables across test processes (separate cache from
+# the TPU one — the cache keys include the platform, so sharing a directory
+# is safe, but a distinct dir keeps CI caches prunable independently).
+enable_compilation_cache(os.path.join(os.path.dirname(__file__), os.pardir,
+                                      ".jax_cache_cpu"))
+
 
 @pytest.fixture
 def rng():
