@@ -249,7 +249,43 @@ def bench_pallas_scatter(n=1 << 17, k=32, d=512):
     return out
 
 
-def bench_game_iteration():
+def bench_avro_ingest(n=20_000, nnz=20):
+    """Native C++ Avro block decoder vs the pure-Python codec (host-side
+    ingestion, records/sec through AvroDataReader.read)."""
+    import os
+    import tempfile
+
+    from photon_ml_tpu.avro import native_decode, schemas
+    from photon_ml_tpu.avro.container import write_records
+    from photon_ml_tpu.avro.data_reader import (AvroDataReader,
+                                                FeatureShardConfig)
+
+    if not native_decode.native_available():
+        return {}
+    rng = np.random.default_rng(7)
+    recs = [{
+        "uid": i, "label": float(rng.integers(0, 2)),
+        "weight": 1.0, "offset": 0.0,
+        "features": [{"name": f"f{rng.integers(0, 500)}", "term": "t",
+                      "value": float(rng.normal())} for _ in range(nnz)],
+        "metadataMap": {"userId": f"u{rng.integers(0, 500)}"},
+    } for i in range(n)]
+    cfgs = {"global": FeatureShardConfig(("features",), True, sparse=True)}
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "ingest.avro")
+        write_records(p, schemas.TRAINING_EXAMPLE_AVRO, recs,
+                      codec="deflate")
+        for name, use_native in (("native", True), ("python", False)):
+            t0 = time.perf_counter()
+            AvroDataReader().read(p, cfgs, random_effect_types=["userId"],
+                                  use_native=use_native)
+            out[f"avro_{name}_records_per_sec"] = round(
+                n / (time.perf_counter() - t0))
+    return out
+
+
+def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
     """One GAME coordinate-descent sweep (fixed + per-user + per-item),
     steady-state, by the slope between 1- and 3-iteration runs."""
     from photon_ml_tpu.data import synthetic
@@ -267,8 +303,8 @@ def bench_game_iteration():
 
     rng = np.random.default_rng(0)
     ds = from_synthetic(synthetic.game_data(
-        rng, n=100_000, d_global=32,
-        re_specs={"userId": (2000, 8), "itemId": (500, 8)}))
+        rng, n=n, d_global=32,
+        re_specs={"userId": (n_users, 8), "itemId": (n_items, 8)}))
     mesh = make_mesh()
     cfg = GLMOptimizationConfiguration(
         optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-7),
@@ -303,6 +339,8 @@ def main():
     sparse = bench_sparse()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
+    _progress("avro ingestion")
+    ingest = bench_avro_ingest()  # {} without a native toolchain
     _progress("GAME coordinate-descent sweep")
     game_iter_s = bench_game_iteration()
     _progress("done")
@@ -323,6 +361,7 @@ def main():
                 sparse["sparse_samples_per_sec"]),
             "sparse_gnnz_per_sec": round(sparse["sparse_gnnz_per_sec"], 3),
             **{key: round(v, 1) for key, v in scatter.items()},
+            **ingest,
             "game_cd_iteration_seconds": round(game_iter_s, 3),
             "cpu_numpy_baseline_samples_per_sec": round(
                 grad["cpu_numpy_samples_per_sec"]),

@@ -87,10 +87,23 @@ class AvroDataReader:
         random_effect_types: Sequence[str] = (),
         index_maps: Optional[dict[str, IndexMap]] = None,
         entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
+        use_native: bool = True,
     ):
-        """Returns (GameDataset, ReadMeta)."""
+        """Returns (GameDataset, ReadMeta).
+
+        ``use_native=True`` (default) decodes supported schemas through the
+        C++ block decoder (native/avro_decode.cc) with vectorized columnar
+        assembly — identical results to the pure-Python path, which remains
+        the fallback for exotic schemas or when no toolchain is available.
+        """
         if isinstance(paths, str):
             paths = [paths]
+        if use_native:
+            out = self._read_native(paths, feature_shard_configs,
+                                    random_effect_types, index_maps,
+                                    entity_vocabs)
+            if out is not None:
+                return out
         records: list[dict] = []
         for p in paths:
             records.extend(read_records(p))
@@ -204,6 +217,223 @@ class AvroDataReader:
                            num_features=d)
             feature_shards[shard] = SparseShard(
                 indices=ell.indices, values=ell.values, num_features=d)
+
+        ds = GameDataset(
+            response=response,
+            offsets=offsets,
+            weights=weights,
+            feature_shards=feature_shards,
+            entity_ids=id_cols,
+            num_entities={t: len(v) for t, v in vocabs.items()},
+            intercept_index={
+                shard: (index_maps[shard].get_index(INTERCEPT_KEY)
+                        if cfg.has_intercept else None)
+                for shard, cfg in feature_shard_configs.items()
+            },
+        )
+        return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
+                            uids=uids)
+
+
+    # -- native fast path --------------------------------------------------
+
+    def _read_native(self, paths, feature_shard_configs,
+                     random_effect_types, index_maps, entity_vocabs):
+        """Vectorized read over native/avro_decode.cc columns; None →
+        caller falls back to the per-record Python loop. Semantics are
+        kept IDENTICAL to that loop: encounter-order index maps,
+        first-occurrence entity vocabularies, accumulate-then-set-intercept
+        feature assembly, and the same error conditions."""
+        import os
+
+        from photon_ml_tpu.avro import native_decode as nd
+
+        if not nd.native_available():
+            return None
+        files: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                files.extend(os.path.join(p, name)
+                             for name in sorted(os.listdir(p))
+                             if name.endswith(".avro"))
+            elif os.path.exists(p):
+                files.append(p)
+            else:
+                return None  # let the Python path raise its own error
+        if not files:
+            raise ValueError(f"no records under {list(paths)}")
+
+        fields = self.fields
+        bag_names = list(dict.fromkeys(
+            b for cfg in feature_shard_configs.values()
+            for b in cfg.feature_bags))
+        captures = {
+            fields.response: (nd.CAP_RESPONSE, 0),
+            fields.offset: (nd.CAP_OFFSET, 0),
+            fields.weight: (nd.CAP_WEIGHT, 0),
+            fields.uid: (nd.CAP_UID, 0),
+            fields.metadata: (nd.CAP_META, 0),
+        }
+        if len(captures) != 5:
+            return None  # colliding field-name preset: fall back
+        for k, b in enumerate(bag_names):
+            if b in captures:
+                return None
+            captures[b] = (nd.CAP_BAG, k)
+        decoded = []
+        for f in files:
+            d = nd.decode_file(f, captures, n_bags=len(bag_names),
+                               forbidden_fields=frozenset(
+                                   random_effect_types))
+            if d is None:
+                return None
+            decoded.append(d)
+        n = sum(d.num_records for d in decoded)
+        if n == 0:
+            raise ValueError(f"no records under {list(paths)}")
+        bag_pos = {b: k for k, b in enumerate(bag_names)}
+
+        # Index maps: DefaultIndexMap.from_keys SORTS its keys, so the
+        # union of each shard's bag key tables is all that matters (the
+        # tables already deduplicate per bag per file).
+        if index_maps is None:
+            index_maps = {}
+            for shard, cfg in feature_shard_configs.items():
+                keys: set[str] = set()
+                for d in decoded:
+                    for b in cfg.feature_bags:
+                        keys.update(d.bags[bag_pos[b]].key_strings)
+                index_maps[shard] = DefaultIndexMap.from_keys(
+                    keys, add_intercept=cfg.has_intercept)
+
+        # Scalars + uids.
+        response = np.concatenate(
+            [d.response for d in decoded]).astype(np.float32)
+        offsets = np.concatenate(
+            [d.offsets for d in decoded]).astype(np.float32)
+        weights = np.concatenate(
+            [d.weights for d in decoded]).astype(np.float32)
+        # uids: default to the GLOBAL record index; overwrite only where a
+        # record carried one (vectorized — no per-record Python work in the
+        # common all-default or all-long cases).
+        uids = np.arange(n).astype(object)
+        base = 0
+        for d in decoded:
+            present = d.uid_kind != 0
+            if present.any():
+                for i in np.flatnonzero(present):
+                    uids[base + int(i)] = d.uids[i]
+            base += d.num_records
+
+        # Feature shards.
+        feature_shards: dict = {}
+        for shard, cfg in feature_shard_configs.items():
+            imap = index_maps[shard]
+            dcols = len(imap)
+            ji = imap.get_index(INTERCEPT_KEY) if cfg.has_intercept else -1
+            rows_l, cols_l, vals_l = [], [], []
+            base = 0
+            for d in decoded:
+                for b in cfg.feature_bags:
+                    bag = d.bags[bag_pos[b]]
+                    if not len(bag.rows):
+                        continue
+                    lut = np.asarray([imap.get_index(s)
+                                      for s in bag.key_strings], np.int64)
+                    cols = lut[bag.keys]
+                    keep = cols >= 0
+                    rows_l.append(bag.rows[keep] + base)
+                    cols_l.append(cols[keep])
+                    vals_l.append(bag.values[keep])
+                base += d.num_records
+            rows = (np.concatenate(rows_l) if rows_l
+                    else np.zeros(0, np.int64))
+            cols = (np.concatenate(cols_l) if cols_l
+                    else np.zeros(0, np.int64))
+            vals = (np.concatenate(vals_l) if vals_l
+                    else np.zeros(0, np.float64))
+            if not cfg.sparse:
+                mat = np.zeros((n, dcols), np.float32)
+                np.add.at(mat, (rows, cols), vals.astype(np.float32))
+                if ji >= 0:
+                    mat[:, ji] = 1.0
+                feature_shards[shard] = mat
+                continue
+            # Sparse (ELL via CSR): accumulate duplicates, then SET the
+            # intercept (the per-record dict semantics of the slow path).
+            if ji >= 0:
+                keep = cols != ji
+                rows, cols, vals = rows[keep], cols[keep], vals[keep]
+            pair = rows * dcols + cols
+            uniq, inv = np.unique(pair, return_inverse=True)
+            sums = np.bincount(inv, weights=vals,
+                               minlength=len(uniq)).astype(np.float32)
+            urows, ucols = uniq // dcols, uniq % dcols
+            if ji >= 0:
+                urows = np.concatenate([urows, np.arange(n)])
+                ucols = np.concatenate(
+                    [ucols, np.full(n, ji, np.int64)])
+                sums = np.concatenate([sums, np.ones(n, np.float32)])
+                order = np.lexsort((ucols, urows))
+                urows, ucols, sums = (urows[order], ucols[order],
+                                      sums[order])
+            from photon_ml_tpu.data.sparse import from_csr
+
+            indptr = np.zeros(n + 1, np.int64)
+            np.cumsum(np.bincount(urows, minlength=n), out=indptr[1:])
+            ell = from_csr(indptr, ucols.astype(np.int32), sums,
+                           labels=response, num_features=dcols)
+            feature_shards[shard] = SparseShard(
+                indices=ell.indices, values=ell.values,
+                num_features=dcols)
+
+        # Entity ids (from metadataMap; direct-field layouts fell back).
+        frozen = entity_vocabs is not None
+        vocabs: dict[str, dict[str, int]] = (
+            {t: dict(v) for t, v in entity_vocabs.items()} if frozen
+            else {t: {} for t in random_effect_types})
+        id_cols = {}
+        for t in random_effect_types:
+            col = np.zeros(n, np.int64)
+            base = 0
+            for d in decoded:
+                try:
+                    key_id = d.meta_key_strings.index(t)
+                    sel = d.meta_keys == key_id
+                except ValueError:
+                    sel = np.zeros(len(d.meta_keys), bool)
+                rows_t = d.meta_rows[sel]
+                val_ids = d.meta_vals[sel]
+                if (len(rows_t) != d.num_records
+                        or not np.array_equal(
+                            rows_t, np.arange(d.num_records))):
+                    present = np.zeros(d.num_records, bool)
+                    present[rows_t] = True
+                    missing = np.flatnonzero(~present)
+                    if len(missing):
+                        raise ValueError(
+                            f"record {base + int(missing[0])} missing "
+                            f"random-effect id {t!r}")
+                    # Wire-level duplicate map keys: keep the LAST value
+                    # per record, the Python dict-decode semantics.
+                    last = np.full(d.num_records, -1, np.int64)
+                    last[rows_t] = np.arange(len(rows_t))
+                    val_ids = val_ids[last]
+                lut = np.full(len(d.meta_val_strings), -1, np.int64)
+                uniq_vids, first = np.unique(val_ids, return_index=True)
+                for vid in uniq_vids[np.argsort(first)]:
+                    raw = d.meta_val_strings[vid]
+                    if raw not in vocabs[t]:
+                        if frozen:
+                            raise KeyError(
+                                f"unseen entity {raw!r} for {t!r} under a "
+                                f"frozen vocabulary (scoring with unseen "
+                                f"entities must map them explicitly)")
+                        vocabs[t][raw] = len(vocabs[t])
+                    lut[vid] = vocabs[t][raw]
+                col[base: base + d.num_records] = lut[val_ids]
+                base += d.num_records
+            id_cols[t] = col.astype(np.int32)
 
         ds = GameDataset(
             response=response,

@@ -1,0 +1,353 @@
+"""Native Avro container decoding: plan compiler + ctypes wrapper.
+
+Reference parity: the reference ingests Avro through JVM-generated record
+classes inside Spark executors (photon-client
+``data/avro/AvroDataReader.scala``); this module is the rebuild's native
+data-loader for the Avro path. The file's WRITER SCHEMA is compiled into a
+flat int32 plan that ``native/avro_decode.cc`` interprets per record; any
+schema outside the supported family (TrainingExample-shaped records:
+primitive scalars, unions of them, ``map<string>`` metadata, feature bags
+as ``array<{name, term?, value: double}>``) yields ``None`` and callers
+fall back to the pure-Python codec, whose semantics the native decoder
+mirrors exactly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+_PRIMS = {"null": 0, "boolean": 1, "int": 2, "long": 3, "float": 4,
+          "double": 5, "string": 6, "bytes": 7}
+CAP_SKIP, CAP_RESPONSE, CAP_OFFSET, CAP_WEIGHT, CAP_UID, CAP_META, \
+    CAP_BAG = range(7)
+_T_MAP_STRING = 8
+_T_NTV = 9
+
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    import os
+
+    if os.environ.get("PHOTON_TPU_NO_NATIVE_AVRO") == "1":
+        _lib_failed = True
+        return None
+    try:
+        from photon_ml_tpu.native import build_library
+
+        lib = ctypes.CDLL(build_library("avro_decode", link=("-lz",)))
+        lib.pavro_open.restype = ctypes.c_void_p
+        lib.pavro_open.argtypes = [ctypes.c_char_p]
+        lib.pavro_error.restype = ctypes.c_int
+        lib.pavro_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+        lib.pavro_schema_len.restype = ctypes.c_long
+        lib.pavro_schema_len.argtypes = [ctypes.c_void_p]
+        lib.pavro_schema.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pavro_decode.restype = ctypes.c_long
+        lib.pavro_decode.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_long, ctypes.c_int]
+        lib.pavro_num_records.restype = ctypes.c_long
+        lib.pavro_num_records.argtypes = [ctypes.c_void_p]
+        lib.pavro_fill_scalars.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.pavro_uid_strs_len.restype = ctypes.c_long
+        lib.pavro_uid_strs_len.argtypes = [ctypes.c_void_p]
+        lib.pavro_fill_uid_strs.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        for fn in ("pavro_bag_nnz", "pavro_bag_nkeys",
+                   "pavro_bag_keys_len"):
+            getattr(lib, fn).restype = ctypes.c_long
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pavro_fill_bag.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+        lib.pavro_fill_bag_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.pavro_meta_count.restype = ctypes.c_long
+        lib.pavro_meta_count.argtypes = [ctypes.c_void_p]
+        lib.pavro_fill_meta.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+        for fn in ("pavro_meta_table_nkeys", "pavro_meta_table_len"):
+            getattr(lib, fn).restype = ctypes.c_long
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pavro_fill_meta_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.pavro_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ----------------------------------------------------------------- plan
+
+
+def _resolve(schema, names: dict):
+    """Resolve a schema node: register/lookup named types, normalize
+    {"type": "x"} wrappers."""
+    if isinstance(schema, str):
+        if schema in _PRIMS:
+            return schema
+        return names.get(schema)
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed") and "name" in schema:
+            names[schema["name"]] = schema
+        if isinstance(t, str) and t in _PRIMS and len(schema) == 1:
+            return t
+        return schema
+    return schema  # unions stay lists
+
+
+def _ntv_arg(items, names) -> Optional[int]:
+    """If ``items`` is a {name, term?, value: double} record, return the
+    plan arg (bit0 = has term), else None."""
+    items = _resolve(items, names)
+    if not isinstance(items, dict) or items.get("type") != "record":
+        return None
+    fields = items.get("fields", [])
+    fnames = [f["name"] for f in fields]
+    if fnames == ["name", "term", "value"]:
+        has_term = True
+    elif fnames == ["name", "value"]:
+        has_term = False
+    else:
+        return None
+    for f in fields:
+        want = "double" if f["name"] == "value" else "string"
+        ft = _resolve(f["type"], names)
+        if ft != want:
+            return None
+    return 1 if has_term else 0
+
+
+def _branch(schema, capture: int, arg: int, names) -> Optional[tuple]:
+    """(type, capture, arg) for one non-union schema node, or None."""
+    schema = _resolve(schema, names)
+    if isinstance(schema, str) and schema in _PRIMS:
+        t = _PRIMS[schema]
+        if capture in (CAP_RESPONSE, CAP_OFFSET, CAP_WEIGHT):
+            if t not in (0, 1, 2, 3, 4, 5):
+                return None  # numeric captures need numeric branches
+        if capture == CAP_UID and t not in (0, 2, 3, 6):
+            return None
+        if capture == CAP_META and t != 0:
+            return None
+        if capture == CAP_BAG and t != 0:
+            return None
+        return (t, CAP_SKIP if t == 0 and capture == CAP_UID else capture,
+                arg)
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t == "map":
+            if _resolve(schema.get("values"), names) != "string":
+                return None
+            if capture not in (CAP_SKIP, CAP_META):
+                return None
+            return (_T_MAP_STRING, capture, 0)
+        if t == "array":
+            ntv = _ntv_arg(schema.get("items"), names)
+            if ntv is None:
+                return None
+            if capture not in (CAP_SKIP, CAP_BAG):
+                return None
+            return (_T_NTV, capture,
+                    (arg << 1) | ntv if capture == CAP_BAG else ntv)
+    return None
+
+
+def compile_plan(schema, captures: dict[str, tuple[int, int]]
+                 ) -> Optional[np.ndarray]:
+    """Compile a writer schema into the int32 plan.
+
+    ``captures`` maps field name → (capture, arg). Returns None when any
+    field cannot be expressed (callers fall back to the Python codec).
+    """
+    names: dict = {}
+    schema = _resolve(schema, names)
+    if not isinstance(schema, dict) or schema.get("type") != "record":
+        return None
+    plan: list[int] = []
+    for field in schema.get("fields", []):
+        cap, arg = captures.get(field["name"], (CAP_SKIP, 0))
+        ftype = field["type"]
+        branches = ftype if isinstance(ftype, list) else [ftype]
+        entries = []
+        for b in branches:
+            e = _branch(b, cap, arg, names)
+            if e is None:
+                return None
+            entries.append(e)
+        plan.append(len(entries))
+        for e in entries:
+            plan.extend(e)
+    return np.asarray(plan, np.int32)
+
+
+# ----------------------------------------------------------------- decode
+
+
+@dataclasses.dataclass
+class BagColumns:
+    rows: np.ndarray  # (nnz,) int64 record rows
+    keys: np.ndarray  # (nnz,) int32 ids into key_strings
+    values: np.ndarray  # (nnz,) float64
+    key_strings: list[str]  # "name\x01term" (or bare name without term)
+
+
+@dataclasses.dataclass
+class DecodedFile:
+    num_records: int
+    response: np.ndarray  # float64
+    offsets: np.ndarray
+    weights: np.ndarray
+    uids: np.ndarray  # object: int (row or long uid) or str
+    uid_kind: np.ndarray  # uint8: 0 absent/null (uids[i] = LOCAL row i)
+    bags: list[BagColumns]
+    # metadataMap entries
+    meta_rows: np.ndarray
+    meta_keys: np.ndarray
+    meta_vals: np.ndarray
+    meta_key_strings: list[str]
+    meta_val_strings: list[str]
+
+
+def _strings(n_keys: int, total: int, fill) -> list[str]:
+    buf = ctypes.create_string_buffer(max(1, total))
+    offsets = np.zeros(max(1, n_keys), np.int64)
+    if n_keys:
+        fill(buf, offsets)
+    out = []
+    prev = 0
+    raw = buf.raw
+    for i in range(n_keys):
+        end = int(offsets[i])
+        out.append(raw[prev:end].decode("utf-8"))
+        prev = end
+    return out
+
+
+def decode_file(path: str, captures: dict[str, tuple[int, int]],
+                n_bags: int,
+                forbidden_fields: frozenset = frozenset(),
+                ) -> Optional[DecodedFile]:
+    """Decode one container file natively; None → caller must fall back
+    (unsupported schema / no toolchain / a ``forbidden_fields`` name is a
+    top-level record field — e.g. an entity id read directly rather than
+    from the metadata map). Raises ValueError on corrupt or semantically
+    invalid data (same failure mode as the Python reader)."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.pavro_open(path.encode())
+    try:
+        err = ctypes.create_string_buffer(512)
+        if lib.pavro_error(h, err, 512):
+            raise ValueError(f"{path}: {err.value.decode()}")
+        slen = lib.pavro_schema_len(h)
+        sbuf = ctypes.create_string_buffer(slen + 1)
+        lib.pavro_schema(h, sbuf)
+        import json
+
+        schema = json.loads(sbuf.raw[:slen].decode("utf-8"))
+        if isinstance(schema, dict) and any(
+                f.get("name") in forbidden_fields
+                for f in schema.get("fields", ())):
+            return None
+        plan = compile_plan(schema, captures)
+        if plan is None:
+            return None
+        n = lib.pavro_decode(h, plan, len(plan), n_bags)
+        if n < 0:
+            lib.pavro_error(h, err, 512)
+            raise ValueError(f"{path}: {err.value.decode()}")
+        n = int(n)
+        response = np.zeros(max(1, n), np.float64)
+        offsets = np.zeros(max(1, n), np.float64)
+        weights = np.zeros(max(1, n), np.float64)
+        uid_kind = np.zeros(max(1, n), np.uint8)
+        uid_long = np.zeros(max(1, n), np.int64)
+        if n:
+            lib.pavro_fill_scalars(h, response, offsets, weights, uid_kind,
+                                   uid_long)
+        # uids: local row index by default; touch only the records that
+        # actually carried one (the common all-default / all-long cases do
+        # no per-record string work).
+        uids = np.arange(n).astype(object)
+        has_long = np.flatnonzero(uid_kind[:n] == 2)
+        for i in has_long:
+            uids[i] = int(uid_long[i])
+        has_str = np.flatnonzero(uid_kind[:n] == 1)
+        if len(has_str):
+            uid_strs = _strings(
+                n, int(lib.pavro_uid_strs_len(h)),
+                lambda b, o: lib.pavro_fill_uid_strs(h, b, o))
+            for i in has_str:
+                uids[i] = uid_strs[i]
+        bags = []
+        for b in range(n_bags):
+            nnz = int(lib.pavro_bag_nnz(h, b))
+            rows = np.zeros(max(1, nnz), np.int64)
+            keys = np.zeros(max(1, nnz), np.int32)
+            values = np.zeros(max(1, nnz), np.float64)
+            if nnz:
+                lib.pavro_fill_bag(h, b, rows, keys, values)
+            key_strings = _strings(
+                int(lib.pavro_bag_nkeys(h, b)),
+                int(lib.pavro_bag_keys_len(h, b)),
+                lambda bb, oo, _b=b: lib.pavro_fill_bag_keys(h, _b, bb, oo))
+            bags.append(BagColumns(rows[:nnz], keys[:nnz], values[:nnz],
+                                   key_strings))
+        mcount = int(lib.pavro_meta_count(h))
+        meta_rows = np.zeros(max(1, mcount), np.int64)
+        meta_keys = np.zeros(max(1, mcount), np.int32)
+        meta_vals = np.zeros(max(1, mcount), np.int32)
+        if mcount:
+            lib.pavro_fill_meta(h, meta_rows, meta_keys, meta_vals)
+        meta_key_strings = _strings(
+            int(lib.pavro_meta_table_nkeys(h, 0)),
+            int(lib.pavro_meta_table_len(h, 0)),
+            lambda b, o: lib.pavro_fill_meta_table(h, 0, b, o))
+        meta_val_strings = _strings(
+            int(lib.pavro_meta_table_nkeys(h, 1)),
+            int(lib.pavro_meta_table_len(h, 1)),
+            lambda b, o: lib.pavro_fill_meta_table(h, 1, b, o))
+        return DecodedFile(
+            num_records=n,
+            response=response[:n], offsets=offsets[:n], weights=weights[:n],
+            uids=uids, uid_kind=uid_kind[:n].copy(),
+            bags=bags,
+            meta_rows=meta_rows[:mcount], meta_keys=meta_keys[:mcount],
+            meta_vals=meta_vals[:mcount],
+            meta_key_strings=meta_key_strings,
+            meta_val_strings=meta_val_strings)
+    finally:
+        lib.pavro_free(h)

@@ -16,9 +16,10 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 _LOCK = threading.Lock()
 
 
-def build_library(name: str) -> str:
+def build_library(name: str, link: tuple[str, ...] = ()) -> str:
     """Compile ``<name>.cc`` into ``_build/lib<name>.so`` (once) and return
-    the path. Rebuilds when the source is newer than the cached object."""
+    the path. Rebuilds when the source is newer than the cached object.
+    ``link`` appends linker flags (e.g. ``("-lz",)``)."""
     src = os.path.join(_HERE, f"{name}.cc")
     out = os.path.join(_BUILD_DIR, f"lib{name}.so")
     with _LOCK:
@@ -28,7 +29,7 @@ def build_library(name: str) -> str:
             tmp = out + ".tmp"
             subprocess.run(
                 ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
-                 "-o", tmp, src],
+                 "-o", tmp, src, *link],
                 check=True, capture_output=True)
             os.replace(tmp, out)
     return out

@@ -1,0 +1,733 @@
+// Native Avro container-file decoder for TrainingExample-shaped records.
+//
+// Reference parity: the reference's ingestion runs as JVM Avro decoding
+// inside Spark executors (photon-client data/avro/AvroDataReader.scala);
+// this is the rebuild's native data-loader for the Avro path — the hot
+// per-record decode loop in C++ instead of pure Python. The Python side
+// (avro/native_decode.py) compiles the file's WRITER SCHEMA into a flat
+// int32 "plan" that this interpreter executes per record; any schema
+// outside the supported family falls back to the Python codec, whose
+// semantics this decoder mirrors exactly (block structure, zigzag varints,
+// deflate codec, sync-marker checks, fail-fast on truncation).
+//
+// Plan format (int32 stream), one entry per top-level record field:
+//   [n_branches, (type, capture, arg) x n_branches]
+// A non-union field is a 1-branch entry. Types:
+//   0 null, 1 boolean, 2 int, 3 long, 4 float, 5 double, 6 string,
+//   7 bytes, 8 map<string>, 9 array<{name,term?,value}> (arg bit0: has
+//   term)
+// Captures: 0 skip, 1 response, 2 offset, 3 weight, 4 uid, 5 metadataMap,
+//   6 feature bag (arg = bag id; for type 9 the bag id is arg >> 1).
+//
+// Columnar outputs: per-record scalars (response/offset/weight, uid kind +
+// long + string), per-bag COO triples (row, key-id, value) with a
+// deduplicated "name\x01term" string table, and metadataMap entries as
+// (row, key-id, value-id) over two string tables.
+//
+// C ABI (ctypes): open -> schema -> decode(plan) -> query sizes -> fill
+// caller-allocated numpy buffers -> free. Errors are per-handle strings.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kTypeNull = 0, kTypeBoolean = 1, kTypeInt = 2, kTypeLong = 3,
+              kTypeFloat = 4, kTypeDouble = 5, kTypeString = 6,
+              kTypeBytes = 7, kTypeMapString = 8, kTypeNtvArray = 9;
+constexpr int kCapSkip = 0, kCapResponse = 1, kCapOffset = 2, kCapWeight = 3,
+              kCapUid = 4, kCapMeta = 5, kCapBag = 6;
+
+struct Branch {
+  int type;
+  int capture;
+  int arg;
+};
+
+struct Field {
+  std::vector<Branch> branches;
+};
+
+struct StringTable {
+  std::unordered_map<std::string, int32_t> ids;
+  std::vector<std::string> strs;
+
+  int32_t intern(const std::string& s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strs.size());
+    ids.emplace(s, id);
+    strs.push_back(s);
+    return id;
+  }
+
+  int64_t total_bytes() const {
+    int64_t n = 0;
+    for (const auto& s : strs) n += static_cast<int64_t>(s.size());
+    return n;
+  }
+};
+
+struct Bag {
+  std::vector<int64_t> rows;
+  std::vector<int32_t> keys;
+  std::vector<double> values;
+  StringTable table;
+};
+
+struct Handle {
+  std::vector<uint8_t> file;
+  std::string schema_json;
+  std::string codec = "null";
+  uint8_t sync[16];
+  size_t blocks_start = 0;
+  std::string error;
+
+  // decode outputs
+  int64_t n_records = 0;
+  std::vector<double> response, offset, weight;
+  std::vector<uint8_t> uid_kind;  // 0 none/null, 1 string, 2 long
+  std::vector<int64_t> uid_long;
+  std::vector<std::string> uid_str;
+  std::vector<Bag> bags;
+  std::vector<int64_t> meta_rows;
+  std::vector<int32_t> meta_keys, meta_vals;
+  StringTable meta_key_table, meta_val_table;
+};
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+};
+
+bool need(Handle* h, Cursor* c, size_t n, const char* what) {
+  if (static_cast<size_t>(c->end - c->p) < n) {
+    h->error = std::string("truncated input while reading ") + what;
+    return false;
+  }
+  return true;
+}
+
+bool read_long(Handle* h, Cursor* c, int64_t* out, const char* what) {
+  uint64_t acc = 0;
+  int shift = 0;
+  while (true) {
+    if (c->p >= c->end) {
+      h->error = std::string("truncated varint while reading ") + what;
+      return false;
+    }
+    uint8_t b = *c->p++;
+    if (shift >= 64) {
+      h->error = std::string("varint too long while reading ") + what;
+      return false;
+    }
+    acc |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  // zigzag
+  *out = static_cast<int64_t>((acc >> 1) ^ (~(acc & 1) + 1));
+  return true;
+}
+
+bool read_bytes_span(Handle* h, Cursor* c, const uint8_t** data, int64_t* len,
+                     const char* what) {
+  if (!read_long(h, c, len, what)) return false;
+  if (*len < 0) {
+    h->error = std::string("negative length while reading ") + what;
+    return false;
+  }
+  if (!need(h, c, static_cast<size_t>(*len), what)) return false;
+  *data = c->p;
+  c->p += *len;
+  return true;
+}
+
+bool skip_value(Handle* h, Cursor* c, int type);
+
+bool read_double_of(Handle* h, Cursor* c, int type, double* out,
+                    const char* what) {
+  switch (type) {
+    case kTypeInt:
+    case kTypeLong: {
+      int64_t v;
+      if (!read_long(h, c, &v, what)) return false;
+      *out = static_cast<double>(v);
+      return true;
+    }
+    case kTypeFloat: {
+      if (!need(h, c, 4, what)) return false;
+      float f;
+      std::memcpy(&f, c->p, 4);
+      c->p += 4;
+      *out = f;
+      return true;
+    }
+    case kTypeDouble: {
+      if (!need(h, c, 8, what)) return false;
+      std::memcpy(out, c->p, 8);
+      c->p += 8;
+      return true;
+    }
+    case kTypeBoolean: {
+      if (!need(h, c, 1, what)) return false;
+      *out = (*c->p++ != 0) ? 1.0 : 0.0;
+      return true;
+    }
+    default:
+      h->error = std::string("type is not numeric: ") + what;
+      return false;
+  }
+}
+
+// Avro block-count header for arrays/maps: negative count is followed by a
+// byte size (ignored here); 0 terminates.
+bool read_block_count(Handle* h, Cursor* c, int64_t* count,
+                      const char* what) {
+  if (!read_long(h, c, count, what)) return false;
+  if (*count < 0) {
+    int64_t byte_size;
+    if (!read_long(h, c, &byte_size, what)) return false;
+    *count = -*count;
+  }
+  return true;
+}
+
+bool skip_value(Handle* h, Cursor* c, int type) {
+  switch (type) {
+    case kTypeNull:
+      return true;
+    case kTypeBoolean:
+      return need(h, c, 1, "boolean") && (c->p += 1, true);
+    case kTypeInt:
+    case kTypeLong: {
+      int64_t v;
+      return read_long(h, c, &v, "int/long");
+    }
+    case kTypeFloat:
+      return need(h, c, 4, "float") && (c->p += 4, true);
+    case kTypeDouble:
+      return need(h, c, 8, "double") && (c->p += 8, true);
+    case kTypeString:
+    case kTypeBytes: {
+      const uint8_t* d;
+      int64_t n;
+      return read_bytes_span(h, c, &d, &n, "string/bytes");
+    }
+    case kTypeMapString: {
+      int64_t count;
+      while (true) {
+        if (!read_block_count(h, c, &count, "map")) return false;
+        if (count == 0) return true;
+        for (int64_t i = 0; i < count; ++i) {
+          const uint8_t* d;
+          int64_t n;
+          if (!read_bytes_span(h, c, &d, &n, "map key")) return false;
+          if (!read_bytes_span(h, c, &d, &n, "map value")) return false;
+        }
+      }
+    }
+    default:
+      h->error = "cannot skip unsupported type";
+      return false;
+  }
+}
+
+bool decode_ntv_array(Handle* h, Cursor* c, bool has_term, Bag* bag,
+                      int64_t row) {
+  int64_t count;
+  std::string key;
+  while (true) {
+    if (!read_block_count(h, c, &count, "feature array")) return false;
+    if (count == 0) return true;
+    for (int64_t i = 0; i < count; ++i) {
+      const uint8_t* name;
+      int64_t name_len;
+      if (!read_bytes_span(h, c, &name, &name_len, "feature name"))
+        return false;
+      // Key layout mirrors index/indexmap.py feature_key: bare name when
+      // the term is empty, "name\x01term" otherwise.
+      key.assign(reinterpret_cast<const char*>(name),
+                 static_cast<size_t>(name_len));
+      if (has_term) {
+        const uint8_t* term;
+        int64_t term_len;
+        if (!read_bytes_span(h, c, &term, &term_len, "feature term"))
+          return false;
+        if (term_len > 0) {
+          key.push_back('\x01');
+          key.append(reinterpret_cast<const char*>(term),
+                     static_cast<size_t>(term_len));
+        }
+      }
+      double value;
+      if (!need(h, c, 8, "feature value")) return false;
+      std::memcpy(&value, c->p, 8);
+      c->p += 8;
+      if (bag != nullptr) {
+        bag->rows.push_back(row);
+        bag->keys.push_back(bag->table.intern(key));
+        bag->values.push_back(value);
+      }
+    }
+  }
+}
+
+bool decode_map_meta(Handle* h, Cursor* c, bool capture, int64_t row) {
+  int64_t count;
+  std::string key, val;
+  while (true) {
+    if (!read_block_count(h, c, &count, "metadata map")) return false;
+    if (count == 0) return true;
+    for (int64_t i = 0; i < count; ++i) {
+      const uint8_t* kd;
+      int64_t kn;
+      if (!read_bytes_span(h, c, &kd, &kn, "metadata key")) return false;
+      const uint8_t* vd;
+      int64_t vn;
+      if (!read_bytes_span(h, c, &vd, &vn, "metadata value")) return false;
+      if (capture) {
+        key.assign(reinterpret_cast<const char*>(kd),
+                   static_cast<size_t>(kn));
+        val.assign(reinterpret_cast<const char*>(vd),
+                   static_cast<size_t>(vn));
+        h->meta_rows.push_back(row);
+        h->meta_keys.push_back(h->meta_key_table.intern(key));
+        h->meta_vals.push_back(h->meta_val_table.intern(val));
+      }
+    }
+  }
+}
+
+bool decode_record(Handle* h, Cursor* c, const std::vector<Field>& fields,
+                   int64_t row) {
+  bool response_seen = false;
+  for (const Field& f : fields) {
+    int bi = 0;
+    if (f.branches.size() > 1) {
+      int64_t b;
+      if (!read_long(h, c, &b, "union branch")) return false;
+      if (b < 0 || static_cast<size_t>(b) >= f.branches.size()) {
+        h->error = "union branch out of range";
+        return false;
+      }
+      bi = static_cast<int>(b);
+    }
+    const Branch& br = f.branches[bi];
+    switch (br.capture) {
+      case kCapSkip:
+        if (br.type == kTypeNtvArray) {
+          if (!decode_ntv_array(h, c, br.arg & 1, nullptr, row))
+            return false;
+        } else if (!skip_value(h, c, br.type)) {
+          return false;
+        }
+        break;
+      case kCapResponse: {
+        if (br.type == kTypeNull) break;  // stays unseen -> error below
+        double v;
+        if (!read_double_of(h, c, br.type, &v, "response")) return false;
+        h->response[row] = v;
+        response_seen = true;
+        break;
+      }
+      case kCapOffset: {
+        if (br.type == kTypeNull) break;  // keep default 0.0
+        double v;
+        if (!read_double_of(h, c, br.type, &v, "offset")) return false;
+        h->offset[row] = v;
+        break;
+      }
+      case kCapWeight: {
+        if (br.type == kTypeNull) break;  // keep default 1.0
+        double v;
+        if (!read_double_of(h, c, br.type, &v, "weight")) return false;
+        h->weight[row] = v;
+        break;
+      }
+      case kCapUid: {
+        if (br.type == kTypeNull) {
+          h->uid_kind[row] = 0;
+        } else if (br.type == kTypeString) {
+          const uint8_t* d;
+          int64_t n;
+          if (!read_bytes_span(h, c, &d, &n, "uid")) return false;
+          h->uid_kind[row] = 1;
+          h->uid_str[row].assign(reinterpret_cast<const char*>(d),
+                                 static_cast<size_t>(n));
+        } else if (br.type == kTypeInt || br.type == kTypeLong) {
+          int64_t v;
+          if (!read_long(h, c, &v, "uid")) return false;
+          h->uid_kind[row] = 2;
+          h->uid_long[row] = v;
+        } else {
+          h->error = "uid branch type unsupported";
+          return false;
+        }
+        break;
+      }
+      case kCapMeta:
+        if (br.type == kTypeNull) break;
+        if (br.type != kTypeMapString) {
+          h->error = "metadata capture needs map<string>";
+          return false;
+        }
+        if (!decode_map_meta(h, c, true, row)) return false;
+        break;
+      case kCapBag: {
+        if (br.type == kTypeNull) break;
+        if (br.type != kTypeNtvArray) {
+          h->error = "bag capture needs an array of name/term/value";
+          return false;
+        }
+        int bag_id = br.arg >> 1;
+        if (bag_id < 0 ||
+            static_cast<size_t>(bag_id) >= h->bags.size()) {
+          h->error = "bag id out of range";
+          return false;
+        }
+        if (!decode_ntv_array(h, c, br.arg & 1, &h->bags[bag_id], row))
+          return false;
+        break;
+      }
+      default:
+        h->error = "unknown capture";
+        return false;
+    }
+  }
+  if (!response_seen) {
+    char buf[96];
+    std::snprintf(buf, sizeof(buf),
+                  "record %lld is missing required response field",
+                  static_cast<long long>(row));
+    h->error = buf;
+    return false;
+  }
+  return true;
+}
+
+bool inflate_raw(Handle* h, const uint8_t* src, size_t n,
+                 std::vector<uint8_t>* out) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK) {
+    h->error = "zlib init failed";
+    return false;
+  }
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = static_cast<uInt>(n);
+  out->clear();
+  uint8_t buf[1 << 16];
+  int rc = Z_OK;
+  while (rc != Z_STREAM_END) {
+    zs.next_out = buf;
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      h->error = "deflate block is corrupt";
+      return false;
+    }
+    out->insert(out->end(), buf, buf + (sizeof(buf) - zs.avail_out));
+    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) {
+      inflateEnd(&zs);
+      h->error = "deflate block is truncated";
+      return false;
+    }
+  }
+  inflateEnd(&zs);
+  return true;
+}
+
+bool parse_header(Handle* h) {
+  Cursor c{h->file.data(), h->file.data() + h->file.size()};
+  if (!need(h, &c, 4, "magic")) return false;
+  if (std::memcmp(c.p, "Obj\x01", 4) != 0) {
+    h->error = "not an Avro object container file (bad magic)";
+    return false;
+  }
+  c.p += 4;
+  int64_t count;
+  while (true) {
+    if (!read_block_count(h, &c, &count, "file metadata")) return false;
+    if (count == 0) break;
+    for (int64_t i = 0; i < count; ++i) {
+      const uint8_t* kd;
+      int64_t kn;
+      if (!read_bytes_span(h, &c, &kd, &kn, "metadata key")) return false;
+      const uint8_t* vd;
+      int64_t vn;
+      if (!read_bytes_span(h, &c, &vd, &vn, "metadata value")) return false;
+      std::string key(reinterpret_cast<const char*>(kd),
+                      static_cast<size_t>(kn));
+      if (key == "avro.schema") {
+        h->schema_json.assign(reinterpret_cast<const char*>(vd),
+                              static_cast<size_t>(vn));
+      } else if (key == "avro.codec") {
+        h->codec.assign(reinterpret_cast<const char*>(vd),
+                        static_cast<size_t>(vn));
+      }
+    }
+  }
+  if (!need(h, &c, 16, "sync marker")) return false;
+  std::memcpy(h->sync, c.p, 16);
+  c.p += 16;
+  h->blocks_start = static_cast<size_t>(c.p - h->file.data());
+  if (h->schema_json.empty()) {
+    h->error = "container file has no avro.schema";
+    return false;
+  }
+  if (h->codec != "null" && h->codec != "deflate") {
+    h->error = "unsupported codec: " + h->codec;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pavro_open(const char* path) {
+  Handle* h = new Handle();
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) {
+    h->error = std::string("cannot open ") + path;
+    return h;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  h->file.resize(static_cast<size_t>(size < 0 ? 0 : size));
+  if (size > 0 &&
+      std::fread(h->file.data(), 1, h->file.size(), f) != h->file.size()) {
+    h->error = std::string("short read on ") + path;
+    std::fclose(f);
+    return h;
+  }
+  std::fclose(f);
+  parse_header(h);
+  return h;
+}
+
+int pavro_error(void* hv, char* buf, int cap) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (h->error.empty()) return 0;
+  std::snprintf(buf, static_cast<size_t>(cap), "%s", h->error.c_str());
+  return 1;
+}
+
+long pavro_schema_len(void* hv) {
+  return static_cast<long>(static_cast<Handle*>(hv)->schema_json.size());
+}
+
+void pavro_schema(void* hv, char* buf) {
+  Handle* h = static_cast<Handle*>(hv);
+  std::memcpy(buf, h->schema_json.data(), h->schema_json.size());
+}
+
+long pavro_decode(void* hv, const int32_t* plan, long plan_len,
+                  int n_bags) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h->error.empty()) return -1;
+  std::vector<Field> fields;
+  long i = 0;
+  while (i < plan_len) {
+    int nb = plan[i++];
+    if (nb < 1 || i + 3L * nb > plan_len) {
+      h->error = "malformed decode plan";
+      return -1;
+    }
+    Field f;
+    for (int b = 0; b < nb; ++b) {
+      f.branches.push_back(Branch{plan[i], plan[i + 1], plan[i + 2]});
+      i += 3;
+    }
+    fields.push_back(std::move(f));
+  }
+  h->bags.assign(static_cast<size_t>(n_bags), Bag());
+
+  // Pass 1: count records across blocks (cheap varint scan of headers).
+  std::vector<std::pair<size_t, int64_t>> block_spans;  // (offset, count)
+  {
+    Cursor c{h->file.data() + h->blocks_start,
+             h->file.data() + h->file.size()};
+    while (c.p < c.end) {
+      int64_t count, byte_size;
+      if (!read_long(h, &c, &count, "block count")) return -1;
+      if (!read_long(h, &c, &byte_size, "block size")) return -1;
+      if (count < 0 || byte_size < 0 ||
+          !need(h, &c, static_cast<size_t>(byte_size) + 16, "block")) {
+        if (h->error.empty()) h->error = "corrupt block header";
+        return -1;
+      }
+      block_spans.emplace_back(
+          static_cast<size_t>(c.p - h->file.data()), count);
+      c.p += byte_size;
+      if (std::memcmp(c.p, h->sync, 16) != 0) {
+        h->error = "sync marker mismatch (corrupt block)";
+        return -1;
+      }
+      c.p += 16;
+      h->n_records += count;
+    }
+  }
+
+  h->response.assign(static_cast<size_t>(h->n_records), 0.0);
+  h->offset.assign(static_cast<size_t>(h->n_records), 0.0);
+  h->weight.assign(static_cast<size_t>(h->n_records), 1.0);
+  h->uid_kind.assign(static_cast<size_t>(h->n_records), 0);
+  h->uid_long.assign(static_cast<size_t>(h->n_records), 0);
+  h->uid_str.assign(static_cast<size_t>(h->n_records), std::string());
+
+  int64_t row = 0;
+  std::vector<uint8_t> scratch;
+  (void)block_spans;  // pass 1's product is n_records + validation
+
+  // Decode pass (single traversal, mirrors pass 1).
+  {
+    Cursor c{h->file.data() + h->blocks_start,
+             h->file.data() + h->file.size()};
+    while (c.p < c.end) {
+      int64_t count, byte_size;
+      if (!read_long(h, &c, &count, "block count")) return -1;
+      if (!read_long(h, &c, &byte_size, "block size")) return -1;
+      const uint8_t* payload = c.p;
+      size_t payload_len = static_cast<size_t>(byte_size);
+      c.p += byte_size + 16;  // validated in pass 1
+      Cursor rc{payload, payload + payload_len};
+      if (h->codec == "deflate") {
+        if (!inflate_raw(h, payload, payload_len, &scratch)) return -1;
+        rc = Cursor{scratch.data(), scratch.data() + scratch.size()};
+      }
+      for (int64_t k = 0; k < count; ++k, ++row) {
+        if (!decode_record(h, &rc, fields, row)) return -1;
+      }
+      if (rc.p != rc.end) {
+        h->error = "trailing bytes after the block's records";
+        return -1;
+      }
+    }
+  }
+  return static_cast<long>(h->n_records);
+}
+
+long pavro_num_records(void* hv) {
+  return static_cast<long>(static_cast<Handle*>(hv)->n_records);
+}
+
+void pavro_fill_scalars(void* hv, double* response, double* offset,
+                        double* weight, uint8_t* uid_kind,
+                        int64_t* uid_long) {
+  Handle* h = static_cast<Handle*>(hv);
+  size_t n = static_cast<size_t>(h->n_records);
+  std::memcpy(response, h->response.data(), n * sizeof(double));
+  std::memcpy(offset, h->offset.data(), n * sizeof(double));
+  std::memcpy(weight, h->weight.data(), n * sizeof(double));
+  std::memcpy(uid_kind, h->uid_kind.data(), n);
+  std::memcpy(uid_long, h->uid_long.data(), n * sizeof(int64_t));
+}
+
+long pavro_uid_strs_len(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  int64_t total = 0;
+  for (const auto& s : h->uid_str) total += static_cast<int64_t>(s.size());
+  return static_cast<long>(total);
+}
+
+void pavro_fill_uid_strs(void* hv, char* buf, int64_t* offsets) {
+  Handle* h = static_cast<Handle*>(hv);
+  int64_t pos = 0;
+  int64_t i = 0;
+  for (const auto& s : h->uid_str) {
+    std::memcpy(buf + pos, s.data(), s.size());
+    pos += static_cast<int64_t>(s.size());
+    offsets[i++] = pos;
+  }
+}
+
+long pavro_bag_nnz(void* hv, int bag) {
+  return static_cast<long>(
+      static_cast<Handle*>(hv)->bags[static_cast<size_t>(bag)].rows.size());
+}
+
+long pavro_bag_nkeys(void* hv, int bag) {
+  return static_cast<long>(static_cast<Handle*>(hv)
+                               ->bags[static_cast<size_t>(bag)]
+                               .table.strs.size());
+}
+
+long pavro_bag_keys_len(void* hv, int bag) {
+  return static_cast<long>(static_cast<Handle*>(hv)
+                               ->bags[static_cast<size_t>(bag)]
+                               .table.total_bytes());
+}
+
+void pavro_fill_bag(void* hv, int bag, int64_t* rows, int32_t* keys,
+                    double* values) {
+  Bag& b = static_cast<Handle*>(hv)->bags[static_cast<size_t>(bag)];
+  std::memcpy(rows, b.rows.data(), b.rows.size() * sizeof(int64_t));
+  std::memcpy(keys, b.keys.data(), b.keys.size() * sizeof(int32_t));
+  std::memcpy(values, b.values.data(), b.values.size() * sizeof(double));
+}
+
+void pavro_fill_bag_keys(void* hv, int bag, char* buf, int64_t* offsets) {
+  Bag& b = static_cast<Handle*>(hv)->bags[static_cast<size_t>(bag)];
+  int64_t pos = 0;
+  int64_t i = 0;
+  for (const auto& s : b.table.strs) {
+    std::memcpy(buf + pos, s.data(), s.size());
+    pos += static_cast<int64_t>(s.size());
+    offsets[i++] = pos;
+  }
+}
+
+long pavro_meta_count(void* hv) {
+  return static_cast<long>(static_cast<Handle*>(hv)->meta_rows.size());
+}
+
+void pavro_fill_meta(void* hv, int64_t* rows, int32_t* keys,
+                     int32_t* vals) {
+  Handle* h = static_cast<Handle*>(hv);
+  std::memcpy(rows, h->meta_rows.data(),
+              h->meta_rows.size() * sizeof(int64_t));
+  std::memcpy(keys, h->meta_keys.data(),
+              h->meta_keys.size() * sizeof(int32_t));
+  std::memcpy(vals, h->meta_vals.data(),
+              h->meta_vals.size() * sizeof(int32_t));
+}
+
+long pavro_meta_table_nkeys(void* hv, int which) {
+  Handle* h = static_cast<Handle*>(hv);
+  StringTable& t = which == 0 ? h->meta_key_table : h->meta_val_table;
+  return static_cast<long>(t.strs.size());
+}
+
+long pavro_meta_table_len(void* hv, int which) {
+  Handle* h = static_cast<Handle*>(hv);
+  StringTable& t = which == 0 ? h->meta_key_table : h->meta_val_table;
+  return static_cast<long>(t.total_bytes());
+}
+
+void pavro_fill_meta_table(void* hv, int which, char* buf,
+                           int64_t* offsets) {
+  Handle* h = static_cast<Handle*>(hv);
+  StringTable& t = which == 0 ? h->meta_key_table : h->meta_val_table;
+  int64_t pos = 0;
+  int64_t i = 0;
+  for (const auto& s : t.strs) {
+    std::memcpy(buf + pos, s.data(), s.size());
+    pos += static_cast<int64_t>(s.size());
+    offsets[i++] = pos;
+  }
+}
+
+void pavro_free(void* hv) { delete static_cast<Handle*>(hv); }
+
+}  // extern "C"

@@ -1,0 +1,301 @@
+"""Native Avro decoder parity tests.
+
+The C++ block decoder (native/avro_decode.cc + avro/native_decode.py) must
+be observationally IDENTICAL to the pure-Python codec path through
+AvroDataReader.read: same index-map orderings, same entity vocabularies,
+same matrices, same errors. Every test reads twice — use_native=True and
+use_native=False — and compares.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.container import write_records
+from photon_ml_tpu.avro.data_reader import (AvroDataReader,
+                                            FeatureShardConfig)
+from photon_ml_tpu.avro import native_decode as nd
+from photon_ml_tpu.data.game_data import SparseShard
+
+pytestmark = pytest.mark.skipif(not nd.native_available(),
+                                reason="no native toolchain")
+
+
+def _records(rng, n=60, n_users=6, bags=("features",), sparse_noise=False):
+    recs = []
+    for i in range(n):
+        rec = {
+            "name": "ex",
+            "uid": (i if i % 3 == 0 else f"u{i}" if i % 3 == 1 else None),
+            "label": float(rng.integers(0, 2)),
+            "weight": float(rng.uniform(0.5, 2.0)),
+            "offset": float(rng.normal()),
+            "metadataMap": {"userId": f"u{rng.integers(0, n_users)}",
+                            "itemId": f"i{rng.integers(0, 3)}"},
+        }
+        for b in bags:
+            feats = [{"name": f"x{rng.integers(0, 8)}",
+                      "term": rng.choice(["", "a", "b"]),
+                      "value": float(rng.normal())}
+                     for _ in range(rng.integers(1, 5))]
+            if sparse_noise and rng.random() < 0.3:
+                # Duplicate feature within a record: accumulates.
+                feats.append(dict(feats[0]))
+            rec[b] = feats
+        recs.append(rec)
+    return recs
+
+
+def _schema_with_bags(bags):
+    if list(bags) == ["features"]:
+        return schemas.TRAINING_EXAMPLE_AVRO
+    schema = dict(schemas.TRAINING_EXAMPLE_AVRO)
+    fields = []
+    for f in schemas.TRAINING_EXAMPLE_AVRO["fields"]:
+        if f["name"] != "features":
+            fields.append(f)
+            continue
+        items = f["type"]["items"]
+        for k, b in enumerate(bags):
+            fields.append({"name": b,
+                           "type": {"type": "array",
+                                    "items": items if k == 0
+                                    else items["name"]}})
+    schema["fields"] = fields
+    return schema
+
+
+def _compare(ds_n, meta_n, ds_p, meta_p):
+    np.testing.assert_array_equal(ds_n.response, ds_p.response)
+    np.testing.assert_array_equal(ds_n.offsets, ds_p.offsets)
+    np.testing.assert_array_equal(ds_n.weights, ds_p.weights)
+    assert set(ds_n.feature_shards) == set(ds_p.feature_shards)
+    for s in ds_p.feature_shards:
+        a, b = ds_n.feature_shards[s], ds_p.feature_shards[s]
+        if isinstance(b, SparseShard):
+            assert isinstance(a, SparseShard)
+            np.testing.assert_array_equal(a.indices, b.indices)
+            np.testing.assert_allclose(a.values, b.values, rtol=1e-6)
+            assert a.num_features == b.num_features
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    assert ds_n.intercept_index == ds_p.intercept_index
+    assert ds_n.num_entities == ds_p.num_entities
+    for t in ds_p.entity_ids:
+        np.testing.assert_array_equal(ds_n.entity_ids[t],
+                                      ds_p.entity_ids[t])
+        assert meta_n.entity_vocabs[t] == meta_p.entity_vocabs[t]
+    for s, imap in meta_p.index_maps.items():
+        other = meta_n.index_maps[s]
+        assert len(other) == len(imap)
+        for j in range(len(imap)):
+            assert other.get_feature_name(j) == imap.get_feature_name(j)
+    assert list(meta_n.uids) == list(meta_p.uids)
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_parity_single_bag(tmp_path, rng, codec):
+    recs = _records(rng)
+    path = str(tmp_path / "t.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    r = AvroDataReader()
+    out_n = r.read(path, cfgs, random_effect_types=["userId", "itemId"],
+                   use_native=True)
+    out_p = r.read(path, cfgs, random_effect_types=["userId", "itemId"],
+                   use_native=False)
+    _compare(*out_n, *out_p)
+
+
+def test_parity_multi_bag_multi_shard_multi_file(tmp_path, rng):
+    bags = ("globalFeatures", "userFeatures")
+    schema = _schema_with_bags(bags)
+    for part in range(3):
+        write_records(str(tmp_path / f"part-{part}.avro"), schema,
+                      _records(rng, n=30, bags=bags))
+    cfgs = {
+        "global": FeatureShardConfig(("globalFeatures",), True),
+        "re_user": FeatureShardConfig(("userFeatures",), False),
+        "both": FeatureShardConfig(bags, True),
+    }
+    r = AvroDataReader()
+    out_n = r.read(str(tmp_path), cfgs, random_effect_types=["userId"],
+                   use_native=True)
+    out_p = r.read(str(tmp_path), cfgs, random_effect_types=["userId"],
+                   use_native=False)
+    _compare(*out_n, *out_p)
+
+
+def test_parity_sparse_shard_with_duplicates(tmp_path, rng):
+    recs = _records(rng, n=40, sparse_noise=True)
+    path = str(tmp_path / "s.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+    cfgs = {"global": FeatureShardConfig(("features",), True, sparse=True)}
+    r = AvroDataReader()
+    out_n = r.read(path, cfgs, use_native=True)
+    out_p = r.read(path, cfgs, use_native=False)
+    _compare(*out_n, *out_p)
+
+
+def test_parity_frozen_maps_and_vocab(tmp_path, rng):
+    recs = _records(rng)
+    path = str(tmp_path / "t.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    r = AvroDataReader()
+    _, meta = r.read(path, cfgs, random_effect_types=["userId"],
+                     use_native=False)
+    out_n = r.read(path, cfgs, random_effect_types=["userId"],
+                   index_maps=meta.index_maps,
+                   entity_vocabs=meta.entity_vocabs, use_native=True)
+    out_p = r.read(path, cfgs, random_effect_types=["userId"],
+                   index_maps=meta.index_maps,
+                   entity_vocabs=meta.entity_vocabs, use_native=False)
+    _compare(*out_n, *out_p)
+
+
+def test_native_errors_match_python(tmp_path, rng):
+    # Missing response: both paths raise ValueError mentioning the record.
+    nullable = dict(schemas.TRAINING_EXAMPLE_AVRO)
+    nullable["fields"] = [
+        {**f, "type": ["null", "double"]} if f["name"] == "label" else f
+        for f in schemas.TRAINING_EXAMPLE_AVRO["fields"]]
+    path = str(tmp_path / "bad.avro")
+    write_records(path, nullable, [
+        {"label": 1.0, "features": []},
+        {"label": None, "features": []},
+    ])
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    r = AvroDataReader()
+    with pytest.raises(ValueError, match="response"):
+        r.read(path, cfgs, use_native=True)
+    with pytest.raises(ValueError, match="response"):
+        r.read(path, cfgs, use_native=False)
+    # Unseen entity under a frozen vocabulary: KeyError both ways.
+    path2 = str(tmp_path / "t.avro")
+    write_records(path2, schemas.TRAINING_EXAMPLE_AVRO, _records(rng, n=10))
+    for un in (True, False):
+        with pytest.raises(KeyError, match="unseen entity"):
+            r.read(path2, cfgs, random_effect_types=["userId"],
+                   entity_vocabs={"userId": {"only": 0}}, use_native=un)
+    # Missing entity id.
+    path3 = str(tmp_path / "noid.avro")
+    write_records(path3, schemas.TRAINING_EXAMPLE_AVRO, [
+        {"label": 1.0, "features": [], "metadataMap": {"other": "x"}}])
+    for un in (True, False):
+        with pytest.raises(ValueError, match="missing random-effect id"):
+            r.read(path3, cfgs, random_effect_types=["userId"],
+                   use_native=un)
+
+
+def test_truncated_file_rejected(tmp_path, rng):
+    path = str(tmp_path / "t.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, _records(rng, n=20))
+    data = open(path, "rb").read()
+    cut = str(tmp_path / "cut.avro")
+    with open(cut, "wb") as f:
+        f.write(data[:len(data) - 7])
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    with pytest.raises((ValueError, EOFError)):
+        AvroDataReader().read(cut, cfgs, use_native=True)
+
+
+def test_unsupported_schema_falls_back(tmp_path):
+    """A schema outside the supported family silently uses the Python
+    path (here: a feature value of type long breaks the NTV contract)."""
+    schema = {
+        "type": "record", "name": "Odd", "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "features",
+             "type": {"type": "array", "items": {
+                 "type": "record", "name": "F", "fields": [
+                     {"name": "name", "type": "string"},
+                     {"name": "term", "type": "string"},
+                     {"name": "value", "type": "long"}]}}},
+        ]}
+    path = str(tmp_path / "odd.avro")
+    write_records(path, schema, [
+        {"label": 1.0,
+         "features": [{"name": "a", "term": "", "value": 3}]}])
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    ds, meta = AvroDataReader().read(path, cfgs, use_native=True)
+    assert ds.num_rows == 1
+    j = meta.index_maps["global"].get_index("a")
+    assert ds.feature_shards["global"][0, j] == 3.0
+
+
+def test_direct_entity_field_falls_back(tmp_path):
+    """A top-level field named like the RE type must use the Python path
+    (the reader takes rec[re_type] directly there)."""
+    schema = {
+        "type": "record", "name": "Direct", "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "userId", "type": "string"},
+            {"name": "features",
+             "type": {"type": "array",
+                      "items": schemas.FEATURE_AVRO}},
+        ]}
+    path = str(tmp_path / "direct.avro")
+    write_records(path, schema, [
+        {"label": 1.0, "userId": "uX",
+         "features": [{"name": "a", "term": "", "value": 1.0}]}])
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    ds, meta = AvroDataReader().read(
+        path, cfgs, random_effect_types=["userId"], use_native=True)
+    assert meta.entity_vocabs["userId"] == {"uX": 0}
+
+
+def test_duplicate_metadata_key_last_wins(tmp_path):
+    """The Avro wire format permits duplicate map keys across blocks; the
+    Python path dict-decodes them (last value wins) and the native path
+    must match instead of crashing."""
+    import json
+    import struct
+
+    def zz(v):  # zigzag varint
+        u = (v << 1) ^ (v >> 63)
+        out = b""
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            if u:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    def avstr(s):
+        b = s.encode()
+        return zz(len(b)) + b
+
+    # One record: uid=null, label=1.0, weight=null, offset=null,
+    # features=[], metadataMap with DUPLICATE "userId" entries.
+    rec = b"".join([
+        zz(0),                      # uid: union branch 0 (null)
+        struct.pack("<d", 1.0),     # label
+        zz(0), zz(0),               # weight, offset: null branches
+        zz(0),                      # features: empty array
+        zz(1),                      # metadataMap: union branch 1 (map)
+        zz(2),                      # map block: 2 entries
+        avstr("userId"), avstr("first"),
+        avstr("userId"), avstr("second"),
+        zz(0),                      # map terminator
+    ])
+    sync = bytes(range(16))
+    header = b"Obj\x01" + zz(2) \
+        + avstr("avro.schema") \
+        + avstr(json.dumps(schemas.TRAINING_EXAMPLE_AVRO)) \
+        + avstr("avro.codec") + avstr("null") \
+        + zz(0) + sync
+    block = zz(1) + zz(len(rec)) + rec + sync
+    path = str(tmp_path / "dup.avro")
+    with open(path, "wb") as f:
+        f.write(header + block)
+
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    r = AvroDataReader()
+    out_n = r.read(path, cfgs, random_effect_types=["userId"],
+                   use_native=True)
+    out_p = r.read(path, cfgs, random_effect_types=["userId"],
+                   use_native=False)
+    assert out_p[1].entity_vocabs["userId"] == {"second": 0}
+    _compare(*out_n, *out_p)
