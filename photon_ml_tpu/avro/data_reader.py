@@ -314,15 +314,14 @@ class AvroDataReader:
         weights = np.concatenate(
             [d.weights for d in decoded]).astype(np.float32)
         # uids: default to the GLOBAL record index; overwrite only where a
-        # record carried one (vectorized — no per-record Python work in the
-        # common all-default or all-long cases).
+        # record carried one (vectorized fancy-index assignment).
         uids = np.arange(n).astype(object)
         base = 0
         for d in decoded:
             present = d.uid_kind != 0
             if present.any():
-                for i in np.flatnonzero(present):
-                    uids[base + int(i)] = d.uids[i]
+                seg = uids[base: base + d.num_records]
+                seg[present] = d.uids[present]
             base += d.num_records
 
         # Feature shards.

@@ -298,20 +298,19 @@ def decode_file(path: str, captures: dict[str, tuple[int, int]],
         if n:
             lib.pavro_fill_scalars(h, response, offsets, weights, uid_kind,
                                    uid_long)
-        # uids: local row index by default; touch only the records that
-        # actually carried one (the common all-default / all-long cases do
-        # no per-record string work).
+        # uids: local row index by default; vectorized fancy-index
+        # assignment for the records that carried one (no per-record
+        # interpreter loop on the hot ingestion path).
         uids = np.arange(n).astype(object)
-        has_long = np.flatnonzero(uid_kind[:n] == 2)
-        for i in has_long:
-            uids[i] = int(uid_long[i])
-        has_str = np.flatnonzero(uid_kind[:n] == 1)
-        if len(has_str):
+        has_long = uid_kind[:n] == 2
+        if has_long.any():
+            uids[has_long] = uid_long[:n][has_long].tolist()
+        has_str = uid_kind[:n] == 1
+        if has_str.any():
             uid_strs = _strings(
                 n, int(lib.pavro_uid_strs_len(h)),
                 lambda b, o: lib.pavro_fill_uid_strs(h, b, o))
-            for i in has_str:
-                uids[i] = uid_strs[i]
+            uids[has_str] = np.asarray(uid_strs, object)[has_str]
         bags = []
         for b in range(n_bags):
             nnz = int(lib.pavro_bag_nnz(h, b))

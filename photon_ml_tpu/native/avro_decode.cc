@@ -123,8 +123,13 @@ bool read_long(Handle* h, Cursor* c, int64_t* out, const char* what) {
       return false;
     }
     uint8_t b = *c->p++;
-    if (shift >= 64) {
-      h->error = std::string("varint too long while reading ") + what;
+    // A 64-bit zigzag varint uses at most 10 bytes; the 10th (shift 63)
+    // may only carry the final bit. Anything longer/larger is corrupt —
+    // reject it like the Python codec's OverflowError instead of silently
+    // wrapping the accumulator.
+    if (shift > 63 || (shift == 63 && (b & 0x7f) > 1)) {
+      h->error = std::string("varint overflows 64 bits while reading ") +
+                 what;
       return false;
     }
     acc |= static_cast<uint64_t>(b & 0x7f) << shift;
@@ -194,6 +199,10 @@ bool read_block_count(Handle* h, Cursor* c, int64_t* count,
   if (*count < 0) {
     int64_t byte_size;
     if (!read_long(h, c, &byte_size, what)) return false;
+    if (*count == INT64_MIN) {  // -INT64_MIN is signed-overflow UB
+      h->error = std::string("absurd block count while reading ") + what;
+      return false;
+    }
     *count = -*count;
   }
   return true;
@@ -553,8 +562,8 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
   }
   h->bags.assign(static_cast<size_t>(n_bags), Bag());
 
-  // Pass 1: count records across blocks (cheap varint scan of headers).
-  std::vector<std::pair<size_t, int64_t>> block_spans;  // (offset, count)
+  // Pass 1: count records across blocks (cheap varint scan of headers,
+  // validating sizes and sync markers before any allocation).
   {
     Cursor c{h->file.data() + h->blocks_start,
              h->file.data() + h->file.size()};
@@ -567,8 +576,6 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
         if (h->error.empty()) h->error = "corrupt block header";
         return -1;
       }
-      block_spans.emplace_back(
-          static_cast<size_t>(c.p - h->file.data()), count);
       c.p += byte_size;
       if (std::memcmp(c.p, h->sync, 16) != 0) {
         h->error = "sync marker mismatch (corrupt block)";
@@ -588,7 +595,6 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
 
   int64_t row = 0;
   std::vector<uint8_t> scratch;
-  (void)block_spans;  // pass 1's product is n_records + validation
 
   // Decode pass (single traversal, mirrors pass 1).
   {
@@ -609,10 +615,8 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
       for (int64_t k = 0; k < count; ++k, ++row) {
         if (!decode_record(h, &rc, fields, row)) return -1;
       }
-      if (rc.p != rc.end) {
-        h->error = "trailing bytes after the block's records";
-        return -1;
-      }
+      // Trailing payload bytes after the declared records are ignored —
+      // the Python DataFileReader accepts such files too (parity).
     }
   }
   return static_cast<long>(h->n_records);

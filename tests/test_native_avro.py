@@ -299,3 +299,75 @@ def test_duplicate_metadata_key_last_wins(tmp_path):
                    use_native=False)
     assert out_p[1].entity_vocabs["userId"] == {"second": 0}
     _compare(*out_n, *out_p)
+
+
+def _handrolled_file(tmp_path, name, rec_payloads, schema=None, count=None):
+    import json
+    import struct
+
+    def zz(v):
+        u = (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1
+        out = b""
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            if u:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    def avstr(s):
+        b = s.encode()
+        return zz(len(b)) + b
+
+    sync = bytes(range(16))
+    header = b"Obj\x01" + zz(2) \
+        + avstr("avro.schema") \
+        + avstr(json.dumps(schema or schemas.TRAINING_EXAMPLE_AVRO)) \
+        + avstr("avro.codec") + avstr("null") \
+        + zz(0) + sync
+    payload = b"".join(rec_payloads)
+    block = zz(count if count is not None else len(rec_payloads)) \
+        + zz(len(payload)) + payload + sync
+    path = str(tmp_path / name)
+    with open(path, "wb") as f:
+        f.write(header + block)
+    return path
+
+
+def _minimal_record(label=1.0):
+    import struct
+
+    return b"".join([
+        b"\x00",                 # uid: null branch
+        struct.pack("<d", label),
+        b"\x00", b"\x00",        # weight, offset: null
+        b"\x00",                 # features: empty
+        b"\x00",                 # metadataMap: null branch
+    ])
+
+
+def test_trailing_block_padding_accepted(tmp_path):
+    """Python's DataFileReader ignores payload bytes past the declared
+    record count; the native path must too."""
+    path = _handrolled_file(tmp_path, "pad.avro",
+                            [_minimal_record(), b"\x00\x00\x00"], count=1)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    for un in (True, False):
+        ds, _ = AvroDataReader().read(path, cfgs, use_native=un)
+        assert ds.num_rows == 1 and ds.response[0] == 1.0
+
+
+def test_overlong_varint_rejected(tmp_path):
+    """A >64-bit varint is corrupt: Python raises, native must too (not
+    silently wrap into plausible data)."""
+    bad = b"\xff" * 10 + b"\x7f"  # 11-byte varint
+    path = _handrolled_file(tmp_path, "ovf.avro",
+                            [bad + _minimal_record()[1:]], count=1)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    with pytest.raises(ValueError, match="varint"):
+        AvroDataReader().read(path, cfgs, use_native=True)
+    # The Python codec also rejects it (an index/overflow error deep in
+    # the union-branch decode).
+    with pytest.raises((ValueError, OverflowError, IndexError)):
+        AvroDataReader().read(path, cfgs, use_native=False)
