@@ -153,9 +153,8 @@ def run(args) -> dict:
         validate_features("validation", Xv, level=vlevel)
         val_batch = (Xv, val.labels)
 
-    candidates = []
-    for lam in reg_weights:
-        cfg = GLMOptimizationConfiguration(
+    def make_cfg(lam):
+        return GLMOptimizationConfiguration(
             optimizer=OptimizerConfig(
                 optimizer_type=OptimizerType(args.optimizer),
                 max_iterations=args.max_iterations,
@@ -164,9 +163,40 @@ def run(args) -> dict:
                 RegularizationType(args.reg_type), lam,
                 args.elastic_net_alpha),
             variance_computation=VarianceComputationType(args.variance))
-        coef, result = dist_problem.run(
-            loss, batch, mesh, cfg, norm=norm,
-            intercept_index=intercept_index)
+
+    # vmap-over-λ: an eligible L2 grid solves every weight in ONE compiled
+    # program (SURVEY P5); L1/elastic-net grids and variance computation
+    # stay on the sequential path.
+    grid_eligible = (
+        len(reg_weights) > 1
+        and RegularizationType(args.reg_type) == RegularizationType.L2
+        and VarianceComputationType(args.variance)
+        == VarianceComputationType.NONE
+        and OptimizerType(args.optimizer) != OptimizerType.OWLQN)
+    fits = []
+    if grid_eligible:
+        W, results = dist_problem.run_grid(
+            loss, batch, mesh, make_cfg(reg_weights[0]), reg_weights,
+            norm=norm, intercept_index=intercept_index)
+        logger.info("solved %d-point reg grid in one vmapped program",
+                    len(reg_weights))
+        for k, lam in enumerate(reg_weights):
+            fits.append((lam, Coefficients(W[k]),
+                         {"converged": bool(results.converged[k]),
+                          "iterations": int(results.iterations[k]),
+                          "final_loss": float(results.value[k])}))
+    else:
+        for lam in reg_weights:
+            coef, result = dist_problem.run(
+                loss, batch, mesh, make_cfg(lam), norm=norm,
+                intercept_index=intercept_index)
+            fits.append((lam, coef,
+                         {"converged": bool(result.converged),
+                          "iterations": int(result.iterations),
+                          "final_loss": float(result.value)}))
+
+    candidates = []
+    for lam, coef, fit_stats in fits:
         # Export coefficients in the ORIGINAL feature space (reference:
         # models are transformed back before writing).
         raw_means = norm.model_to_original_space(coef.means)
@@ -175,12 +205,7 @@ def run(args) -> dict:
             raw_vars = norm.variances_to_original_space(raw_vars)
         model = GeneralizedLinearModel(
             task=task, coefficients=Coefficients(raw_means, raw_vars))
-        record = {
-            "reg_weight": lam,
-            "converged": bool(result.converged),
-            "iterations": int(result.iterations),
-            "final_loss": float(result.value),
-        }
+        record = {"reg_weight": lam, **fit_stats}
         if val_batch is not None:
             scores = model.compute_score(jnp.asarray(val_batch[0]))
             record[evaluator] = float(ev.evaluate(

@@ -75,3 +75,59 @@ def run(
             l2, mask)
 
     return Coefficients(means=result.w, variances=variances), result
+
+
+def run_grid(
+    loss: PointwiseLoss,
+    batch: LabeledBatch,
+    mesh: Mesh,
+    config: GLMOptimizationConfiguration,
+    lambdas,
+    initial: Optional[Coefficients] = None,
+    norm: NormalizationContext = NormalizationContext(),
+    intercept_index: Optional[int] = None,
+    already_sharded: bool = False,
+) -> tuple[Array, OptResult]:
+    """Fit the SAME GLM at every L2 weight in ``lambdas`` as ONE compiled
+    program — the whole solver ``vmap``-ped over the regularization axis
+    (SURVEY §2.5 P5's optional vmap-over-λ; the reference loops its
+    reg-weight grid sequentially through Spark jobs).
+
+    Returns ``(W, results)`` with ``W`` of shape (len(lambdas), dim) and a
+    stacked OptResult (per-λ leaves). L2/NONE regularization with
+    L-BFGS/TRON only — L1 grids (OWL-QN's per-λ orthant sets) and variance
+    computation stay on the sequential :func:`run` path.
+    """
+    reg = config.regularization
+    if reg.l1_weight() > 0.0:
+        raise ValueError("run_grid handles L2/NONE grids; L1 grids use "
+                         "sequential run() (OWL-QN per-λ orthant sets)")
+    if VarianceComputationType(config.variance_computation) != \
+            VarianceComputationType.NONE:
+        raise ValueError("run_grid does not compute variances; evaluate "
+                         "them per selected model via run()")
+    if not already_sharded:
+        batch = shard_batch(batch, mesh)
+    dim = batch.dim
+    mask = jnp.asarray(intercept_mask(dim, intercept_index))
+    base_vg = dobj.make_value_and_gradient(loss, mesh, batch, norm)
+    base_hvp = dobj.make_hvp(loss, mesh, batch, norm)
+    opt_cfg = resolve_optimizer_config(config.optimizer, False)
+    w0 = initial.means if initial is not None else jnp.zeros(
+        (dim,), batch.features.dtype)
+
+    def solve(lam):
+        # λ is a traced vmap lane — fold it inline (with_l2's zero-weight
+        # shortcut cannot branch on a tracer).
+        def vg(w):
+            f, g = base_vg(w)
+            wm = w * mask
+            return f + 0.5 * lam * jnp.sum(wm * wm), g + lam * wm
+
+        def hvp(w, v):
+            return base_hvp(w, v) + lam * (v * mask)
+
+        return optimize(vg, w0, opt_cfg, hvp=hvp)
+
+    results = jax.vmap(solve)(jnp.asarray(lambdas, jnp.float32))
+    return results.w, results

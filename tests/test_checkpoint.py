@@ -71,9 +71,12 @@ class _KillSwitch:
 def _model_arrays(model):
     out = {}
     for cid, m in model.models.items():
-        out[cid] = np.asarray(getattr(m, "means", None)
-                              if hasattr(m, "means")
-                              else m.coefficients.means)
+        if hasattr(m, "factors"):  # factored: compare implied (E, d) table
+            out[cid] = np.asarray(m.to_random_effect_model().means)
+        elif hasattr(m, "means"):
+            out[cid] = np.asarray(m.means)
+        else:
+            out[cid] = np.asarray(m.coefficients.means)
     return out
 
 
@@ -205,3 +208,49 @@ def test_kill_and_resume_with_down_sampling(rng, mesh, tmp_path):
         np.asarray(resumed_model.models["fixed"].coefficients.means),
         np.asarray(clean_model.models["fixed"].coefficients.means),
         rtol=1e-4, atol=1e-5)
+
+
+def test_kill_and_resume_with_factored_coordinate(rng, mesh, tmp_path):
+    """The checkpoint machinery is coordinate-type agnostic: a factored
+    coordinate's (projection, factors) state survives kill-and-resume and
+    reproduces the uninterrupted model."""
+    from photon_ml_tpu.api.configs import (
+        FactoredRandomEffectDataConfiguration)
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+    syn = synthetic.game_data(rng, n=600, d_global=6,
+                              re_specs={"userId": (12, 6)})
+    ds = from_synthetic(syn)
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-7))
+    cc = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"), optimization=opt),
+        "mf": CoordinateConfiguration(
+            data=FactoredRandomEffectDataConfiguration(
+                "userId", "re_userId", rank=2, alternations=1),
+            optimization=opt),
+    }
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc, ["fixed", "mf"],
+                        mesh, descent_iterations=2)
+    coords = est._build_coordinates(
+        ds, {cid: c.optimization for cid, c in cc.items()})
+    cfg = descent.CoordinateDescentConfig(["fixed", "mf"], iterations=2)
+
+    ref_model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, dict(coords),
+                               cfg)
+    ref = _model_arrays(ref_model)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    killed = dict(coords)
+    killed["mf"] = _KillSwitch(coords["mf"], allow=1)
+    with pytest.raises(KeyboardInterrupt):
+        descent.run(TaskType.LOGISTIC_REGRESSION, killed, cfg,
+                    checkpoint_manager=CheckpointManager(ckpt_dir))
+    model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, dict(coords), cfg,
+                           checkpoint_manager=CheckpointManager(ckpt_dir))
+    assert isinstance(model.models["mf"], FactoredRandomEffectModel)
+    got = _model_arrays(model)
+    for cid in ref:
+        np.testing.assert_allclose(got[cid], ref[cid], rtol=1e-3,
+                                   atol=1e-4)
