@@ -33,6 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optional comma-separated evaluators")
     p.add_argument("--as-mean", action="store_true",
                    help="apply the inverse link (probabilities/rates)")
+    p.add_argument("--output-format", default="NPZ",
+                   choices=["NPZ", "AVRO", "BOTH"],
+                   help="AVRO writes the reference's ScoringResultAvro "
+                        "container (scores.avro)")
     return p
 
 
@@ -53,10 +57,18 @@ def run(args) -> dict:
         summary["metrics"] = evaluation.metrics
     else:
         result = transformer.transform(data, as_mean=args.as_mean)
-    np.savez_compressed(
-        os.path.join(args.output_dir, "scores.npz"),
-        uid=result.uids, score=result.scores, label=result.labels,
-        offset=result.offsets, weight=result.weights)
+    if args.output_format in ("NPZ", "BOTH"):
+        np.savez_compressed(
+            os.path.join(args.output_dir, "scores.npz"),
+            uid=result.uids, score=result.scores, label=result.labels,
+            offset=result.offsets, weight=result.weights)
+    if args.output_format in ("AVRO", "BOTH"):
+        from photon_ml_tpu.avro.scoring import write_scoring_results
+
+        write_scoring_results(
+            os.path.join(args.output_dir, "scores.avro"),
+            result.scores, uids=result.uids, labels=result.labels,
+            weights=result.weights, offsets=result.offsets)
     summary["wall_seconds"] = time.time() - t0
     with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)

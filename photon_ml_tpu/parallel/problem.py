@@ -98,10 +98,15 @@ def run_grid(
     L-BFGS/TRON only — L1 grids (OWL-QN's per-λ orthant sets) and variance
     computation stay on the sequential :func:`run` path.
     """
+    from photon_ml_tpu.optim import OptimizerType
+
     reg = config.regularization
     if reg.l1_weight() > 0.0:
         raise ValueError("run_grid handles L2/NONE grids; L1 grids use "
                          "sequential run() (OWL-QN per-λ orthant sets)")
+    if OptimizerType(config.optimizer.optimizer_type) == OptimizerType.OWLQN:
+        raise ValueError("run_grid supports L-BFGS/TRON; OWL-QN exists for "
+                         "L1 objectives, which run_grid does not handle")
     if VarianceComputationType(config.variance_computation) != \
             VarianceComputationType.NONE:
         raise ValueError("run_grid does not compute variances; evaluate "

@@ -41,6 +41,10 @@ from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 # Persist compiled executables across test processes (separate cache from
 # the TPU one — the cache keys include the platform, so sharing a directory
 # is safe, but a distinct dir keeps CI caches prunable independently).
+# NOTE: loading cached CPU AOT artifacts logs a cpu_aot_loader
+# machine-feature warning per program; it is benign here — compilation and
+# execution happen on the same host (the mismatch is XLA tuning
+# pseudo-features, not real ISA features).
 enable_compilation_cache(os.path.join(os.path.dirname(__file__), os.pardir,
                                       ".jax_cache_cpu"))
 

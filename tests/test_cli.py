@@ -230,3 +230,29 @@ def test_game_train_factored_coordinate(rng, tmp_path):
         "--output-dir", score_out, "--evaluators", "AUC",
     ]))
     assert score_summary["metrics"]["AUC"] > 0.65
+
+
+def test_game_score_avro_output(rng, tmp_path):
+    """--output-format AVRO writes the reference's ScoringResultAvro."""
+    from photon_ml_tpu.avro.scoring import read_scoring_results
+
+    train_dir, val_dir = _write_game_data(tmp_path, rng, n=600)
+    out = str(tmp_path / "out")
+    game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--update-sequence", "fixed",
+        "--output-dir", out,
+    ]))
+    score_out = str(tmp_path / "scores-avro")
+    s = game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", os.path.join(out, "best"),
+        "--output-dir", score_out, "--output-format", "BOTH",
+    ]))
+    recs = read_scoring_results(os.path.join(score_out, "scores.avro"))
+    npz = np.load(os.path.join(score_out, "scores.npz"))
+    assert len(recs) == s["num_rows"] == npz["score"].shape[0]
+    np.testing.assert_allclose(
+        [r["predictionScore"] for r in recs[:10]], npz["score"][:10],
+        rtol=1e-6)
+    assert recs[0]["label"] == float(npz["label"][0])

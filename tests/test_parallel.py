@@ -233,3 +233,21 @@ def test_run_grid_rejects_l1_and_variances(rng, mesh):
         variance_computation=VarianceComputationType.SIMPLE)
     with pytest.raises(ValueError, match="variance"):
         dp.run_grid(losses.LOGISTIC, batch, mesh, var, [0.1, 1.0])
+
+
+def test_run_grid_rejects_owlqn(rng, mesh):
+    from photon_ml_tpu.optim import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel import problem as dp
+
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 64).astype(np.float32)
+    batch = LabeledBatch.build(X, y)
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.OWLQN,
+                                  max_iterations=5),
+        regularization=RegularizationContext(RegularizationType.L2, 0.1))
+    with pytest.raises(ValueError, match="OWL-QN"):
+        dp.run_grid(losses.LOGISTIC, batch, mesh, cfg, [0.1, 1.0])
