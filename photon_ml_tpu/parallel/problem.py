@@ -20,8 +20,9 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.optim import (OptResult, l1_weights_vector, optimize,
-                                 with_l2, with_l2_hvp)
+from photon_ml_tpu.optim import (OptResult, OptimizerType,
+                                 l1_weights_vector, optimize, with_l2,
+                                 with_l2_hvp)
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType,
                                          resolve_optimizer_config,
@@ -98,8 +99,6 @@ def run_grid(
     L-BFGS/TRON only — L1 grids (OWL-QN's per-λ orthant sets) and variance
     computation stay on the sequential :func:`run` path.
     """
-    from photon_ml_tpu.optim import OptimizerType
-
     reg = config.regularization
     if reg.l1_weight() > 0.0:
         raise ValueError("run_grid handles L2/NONE grids; L1 grids use "

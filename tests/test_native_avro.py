@@ -371,3 +371,43 @@ def test_overlong_varint_rejected(tmp_path):
     # the union-branch decode).
     with pytest.raises((ValueError, OverflowError, IndexError)):
         AvroDataReader().read(path, cfgs, use_native=False)
+
+
+# --------------------------------------------------------------- fuzz (parity)
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+_name = st.text(
+    alphabet=st.characters(min_codepoint=32, max_codepoint=0x2FF,
+                           exclude_characters="\x7f"),
+    min_size=0, max_size=8)
+_finite = st.floats(allow_nan=False, allow_infinity=False, width=32)
+_feature = st.fixed_dictionaries({
+    "name": _name, "term": _name, "value": _finite})
+_record = st.fixed_dictionaries({
+    "uid": st.one_of(st.none(), st.integers(-2**40, 2**40), _name),
+    "label": _finite,
+    "weight": st.one_of(st.none(), _finite),
+    "offset": st.one_of(st.none(), _finite),
+    "features": st.lists(_feature, max_size=6),
+    "metadataMap": st.one_of(
+        st.none(), st.dictionaries(_name, _name, max_size=3)),
+})
+
+
+@settings(max_examples=40, deadline=None)
+@given(recs=st.lists(_record, min_size=1, max_size=12),
+       codec=st.sampled_from(["null", "deflate"]))
+def test_fuzz_native_python_parity(tmp_path_factory, recs, codec):
+    """Arbitrary spec-valid TrainingExample records decode identically
+    through the C++ and Python paths (no RE types: metadata keys are
+    arbitrary strings that need not cover every record)."""
+    td = tmp_path_factory.mktemp("fuzz")
+    path = str(td / "f.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    r = AvroDataReader()
+    out_n = r.read(path, cfgs, use_native=True)
+    out_p = r.read(path, cfgs, use_native=False)
+    _compare(*out_n, *out_p)
