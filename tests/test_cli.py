@@ -256,3 +256,74 @@ def test_game_score_avro_output(rng, tmp_path):
         [r["predictionScore"] for r in recs[:10]], npz["score"][:10],
         rtol=1e-6)
     assert recs[0]["label"] == float(npz["label"][0])
+
+
+def test_game_train_warm_start_improves_or_matches(rng, tmp_path):
+    """Reference GameTrainingDriverIntegTest: an incremental run warm-started
+    from a prior model must match or beat that model (and land close to an
+    equally-long cold run)."""
+    train_dir, val_dir = _write_game_data(
+        tmp_path, rng, re_specs={"userId": (15, 4)})
+    base_args = [
+        "--train", train_dir, "--validation", val_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId,min_samples=2",
+        "--update-sequence", "fixed,per-user",
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+    ]
+    out1 = str(tmp_path / "cold1")
+    s1 = game_train.run(game_train.build_parser().parse_args(
+        base_args + ["--iterations", "1", "--output-dir", out1]))
+    out_warm = str(tmp_path / "warm")
+    s_warm = game_train.run(game_train.build_parser().parse_args(
+        base_args + ["--iterations", "1", "--output-dir", out_warm,
+                     "--model-input-dir", os.path.join(out1, "best")]))
+    out2 = str(tmp_path / "cold2")
+    s2 = game_train.run(game_train.build_parser().parse_args(
+        base_args + ["--iterations", "2", "--output-dir", out2]))
+    auc1 = s1["best_metrics"]["AUC"]
+    auc_warm = s_warm["best_metrics"]["AUC"]
+    auc2 = s2["best_metrics"]["AUC"]
+    assert auc_warm >= auc1 - 1e-3  # never worse than its starting model
+    assert abs(auc_warm - auc2) < 0.02  # ≈ an equally-long cold run
+
+
+def test_game_train_partial_retraining_locks_coordinate(rng, tmp_path):
+    """Reference partial retraining: --locked-coordinates keeps the listed
+    coordinate's model EXACTLY as loaded while the rest retrain."""
+    train_dir, val_dir = _write_game_data(
+        tmp_path, rng, re_specs={"userId": (15, 4)})
+    base_args = [
+        "--train", train_dir, "--validation", val_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId,min_samples=2",
+        "--update-sequence", "fixed,per-user",
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+    ]
+    out1 = str(tmp_path / "stage1")
+    game_train.run(game_train.build_parser().parse_args(
+        base_args + ["--iterations", "1", "--output-dir", out1,
+                     "--opt-config",
+                     "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0"]))
+    m1 = model_io.load_game_model(os.path.join(out1, "best"))
+    # Stage 2 retrains per-user under a DIFFERENT regularization weight, so
+    # its optimum must move for a principled reason (not merely because an
+    # unconverged solve drifted), while the locked coordinate stays put.
+    out2 = str(tmp_path / "stage2")
+    game_train.run(game_train.build_parser().parse_args(
+        base_args + ["--iterations", "2", "--output-dir", out2,
+                     "--opt-config",
+                     "per-user:optimizer=LBFGS,reg=L2,reg_weight=50.0",
+                     "--model-input-dir", os.path.join(out1, "best"),
+                     "--locked-coordinates", "fixed"]))
+    m2 = model_io.load_game_model(os.path.join(out2, "best"))
+    np.testing.assert_array_equal(
+        np.asarray(m2.models["fixed"].coefficients.means),
+        np.asarray(m1.models["fixed"].coefficients.means))
+    assert not np.allclose(np.asarray(m2.models["per-user"].means),
+                           np.asarray(m1.models["per-user"].means))
