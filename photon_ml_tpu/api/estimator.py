@@ -198,12 +198,30 @@ class GameEstimator:
             # guarantee this; here it must be asserted).
             for t, n_train in data.num_entities.items():
                 n_val = validation_data.num_entities.get(t)
-                if n_val is not None and n_val != n_train:
+                # An EXTENSION of the training vocabulary is legal
+                # (allow_unseen_entities: unseen ids get rows past the
+                # frozen range and score with zero RE contribution); a
+                # smaller/reshuffled vocabulary is silent id misalignment.
+                if n_val is not None and n_val < n_train:
                     raise ValueError(
                         f"validation entity vocabulary for {t!r} has size "
-                        f"{n_val} != training {n_train}; read validation "
+                        f"{n_val} < training {n_train}; read validation "
                         f"with the training vocabularies "
                         f"(AvroDataReader entity_vocabs=...)")
+                if n_val is not None and n_val > n_train:
+                    # Counts cannot distinguish a true extension from an
+                    # unrelated larger vocabulary — make the assumption
+                    # loud so an independently-built validation set is
+                    # noticed (ids 0..n_train-1 MUST mean the same
+                    # entities in both datasets).
+                    logger.warning(
+                        "validation %s vocabulary (%d) extends training "
+                        "(%d): assuming shared ids for the first %d "
+                        "entities — unseen ones score with zero "
+                        "random-effect contribution. Read validation with "
+                        "the training vocabularies "
+                        "(allow_unseen_entities=True) to guarantee this.",
+                        t, n_val, n_train, n_train)
 
         cids = list(self.coordinate_configs)
         grids = [self.coordinate_configs[c].expand_grid() for c in cids]

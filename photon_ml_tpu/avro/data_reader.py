@@ -88,6 +88,7 @@ class AvroDataReader:
         index_maps: Optional[dict[str, IndexMap]] = None,
         entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
         use_native: bool = True,
+        allow_unseen_entities: bool = False,
     ):
         """Returns (GameDataset, ReadMeta).
 
@@ -95,13 +96,20 @@ class AvroDataReader:
         C++ block decoder (native/avro_decode.cc) with vectorized columnar
         assembly — identical results to the pure-Python path, which remains
         the fallback for exotic schemas or when no toolchain is available.
+
+        ``allow_unseen_entities=True`` makes a frozen ``entity_vocabs``
+        EXTENSIBLE: ids absent from it get fresh rows appended after the
+        frozen range instead of raising. Scoring-time semantics match the
+        reference — a random-effect model has no row for those ids, and
+        model scoring contributes exactly zero for them (fixed effect
+        only).
         """
         if isinstance(paths, str):
             paths = [paths]
         if use_native:
             out = self._read_native(paths, feature_shard_configs,
                                     random_effect_types, index_maps,
-                                    entity_vocabs)
+                                    entity_vocabs, allow_unseen_entities)
             if out is not None:
                 return out
         records: list[dict] = []
@@ -189,11 +197,12 @@ class AvroDataReader:
                         f"record {i} missing random-effect id {t!r}")
                 vocab = vocabs[t]
                 if raw not in vocab:
-                    if frozen_vocab:
+                    if frozen_vocab and not allow_unseen_entities:
                         raise KeyError(
                             f"unseen entity {raw!r} for {t!r} under a frozen "
                             f"vocabulary (scoring with unseen entities must "
-                            f"map them explicitly)")
+                            f"map them explicitly, or pass "
+                            f"allow_unseen_entities=True)")
                     vocab[raw] = len(vocab)
                 id_cols[t][i] = vocab[raw]
 
@@ -238,7 +247,8 @@ class AvroDataReader:
     # -- native fast path --------------------------------------------------
 
     def _read_native(self, paths, feature_shard_configs,
-                     random_effect_types, index_maps, entity_vocabs):
+                     random_effect_types, index_maps, entity_vocabs,
+                     allow_unseen_entities=False):
         """Vectorized read over native/avro_decode.cc columns; None →
         caller falls back to the per-record Python loop. Semantics are
         kept IDENTICAL to that loop: encounter-order index maps,
@@ -423,11 +433,12 @@ class AvroDataReader:
                 for vid in uniq_vids[np.argsort(first)]:
                     raw = d.meta_val_strings[vid]
                     if raw not in vocabs[t]:
-                        if frozen:
+                        if frozen and not allow_unseen_entities:
                             raise KeyError(
                                 f"unseen entity {raw!r} for {t!r} under a "
                                 f"frozen vocabulary (scoring with unseen "
-                                f"entities must map them explicitly)")
+                                f"entities must map them explicitly, or "
+                                f"pass allow_unseen_entities=True)")
                         vocabs[t][raw] = len(vocabs[t])
                     lut[vid] = vocabs[t][raw]
                 col[base: base + d.num_records] = lut[val_ids]

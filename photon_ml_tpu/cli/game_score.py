@@ -26,8 +26,28 @@ logger = logging.getLogger("photon_ml_tpu.cli")
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--data", required=True, help="GameDataset directory")
+    p.add_argument("--data", required=True,
+                   help="GameDataset directory, or Avro container "
+                        "file(s)/directory when --avro-feature-shard is "
+                        "given")
     p.add_argument("--model-dir", required=True, help="GameModel directory")
+    p.add_argument("--avro-feature-shard", action="append", default=[],
+                   help="Avro-input shard spec (same mini-DSL as "
+                        "game_train); switches --data to Avro input")
+    p.add_argument("--avro-re-types", default="",
+                   help="comma-separated random-effect id keys (Avro "
+                        "input)")
+    p.add_argument("--feature-index-dir",
+                   help="REQUIRED with Avro input: the training run's "
+                        "saved index maps (e.g. <train-out>/best-avro/"
+                        "index-maps); entity vocabularies load from the "
+                        "sibling entity-vocabs.json. Unseen entities "
+                        "score with the fixed effect only")
+    p.add_argument("--model-format", default="NPZ",
+                   choices=["NPZ", "AVRO"],
+                   help="AVRO loads the BayesianLinearModelAvro layout "
+                        "(e.g. a best-avro directory) through the same "
+                        "index maps")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--evaluators", default="",
                    help="optional comma-separated evaluators")
@@ -44,8 +64,59 @@ def run(args) -> dict:
     setup_logging()
     enable_compilation_cache()
     t0 = time.time()
-    data = load_game_dataset(args.data)
-    model = model_io.load_game_model(args.model_dir)
+    imaps = vocabs = None
+    if args.avro_feature_shard:
+        from photon_ml_tpu.avro.data_reader import AvroDataReader
+        from photon_ml_tpu.avro.model_io import load_index_maps
+        from photon_ml_tpu.cli.game_train import _parse_avro_shards
+
+        if not args.feature_index_dir:
+            raise ValueError(
+                "Avro scoring input needs --feature-index-dir (the "
+                "training run's saved index maps — scoring must use the "
+                "SAME feature space the model was trained in)")
+        imaps = load_index_maps(args.feature_index_dir)
+        re_types = [t for t in args.avro_re_types.split(",") if t]
+        vocab_path = os.path.join(
+            os.path.dirname(args.feature_index_dir.rstrip("/")),
+            "entity-vocabs.json")
+        vocabs = None
+        if os.path.exists(vocab_path):
+            vocabs = json.load(open(vocab_path))
+        elif re_types:
+            # Without the training vocabularies, entity ids would be
+            # assigned in scoring-data encounter order and every
+            # random-effect row gather would silently hit the wrong
+            # entity.
+            raise ValueError(
+                f"scoring with random-effect types {re_types} needs the "
+                f"training entity vocabularies; expected {vocab_path} "
+                f"(written beside the index maps by game_train "
+                f"--model-output-format AVRO)")
+        data, read_meta = AvroDataReader().read(
+            args.data, _parse_avro_shards(args.avro_feature_shard),
+            random_effect_types=re_types,
+            index_maps=imaps, entity_vocabs=vocabs,
+            allow_unseen_entities=True)
+    else:
+        for flag, value in (("--avro-re-types", args.avro_re_types),
+                            ("--feature-index-dir",
+                             args.feature_index_dir)):
+            if value:
+                raise ValueError(f"{flag} applies to Avro inputs "
+                                 f"(--avro-feature-shard)")
+        data = load_game_dataset(args.data)
+    if args.model_format == "AVRO":
+        from photon_ml_tpu.avro.model_io import load_game_model_avro
+
+        if imaps is None:
+            raise ValueError(
+                "--model-format AVRO needs Avro input with "
+                "--feature-index-dir (the model's feature space)")
+        model = load_game_model_avro(args.model_dir, imaps,
+                                     entity_vocabs=vocabs)
+    else:
+        model = model_io.load_game_model(args.model_dir)
     evaluators = [e for e in args.evaluators.split(",") if e]
     transformer = GameTransformer(model, evaluators)
 
@@ -57,10 +128,22 @@ def run(args) -> dict:
         summary["metrics"] = evaluation.metrics
     else:
         result = transformer.transform(data, as_mean=args.as_mean)
+    if args.avro_feature_shard:
+        # Preserve the input records' real uids (ReadMeta) so downstream
+        # joins of the scoring output back to the source data hold — the
+        # transformer only knows row indices.
+        import dataclasses
+
+        result = dataclasses.replace(result, uids=read_meta.uids)
     if args.output_format in ("NPZ", "BOTH"):
+        uids = result.uids
+        if uids.dtype == object:
+            # Mixed int/str uids (Avro input): store as strings so the
+            # npz needs no pickle to load.
+            uids = np.asarray([str(u) for u in uids])
         np.savez_compressed(
             os.path.join(args.output_dir, "scores.npz"),
-            uid=result.uids, score=result.scores, label=result.labels,
+            uid=uids, score=result.scores, label=result.labels,
             offset=result.offsets, weight=result.weights)
     if args.output_format in ("AVRO", "BOTH"):
         from photon_ml_tpu.avro.scoring import write_scoring_results

@@ -103,6 +103,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="input sanity checks (reference DataValidators: "
                         "task-valid labels, finite features/offsets, "
                         "non-negative weights)")
+    p.add_argument("--avro-feature-shard", action="append", default=[],
+                   help='Avro-input shard spec '
+                        '"name=global,bags=features[+moreBags],'
+                        'intercept=true,sparse=false" (repeatable). Any '
+                        "spec switches --train/--validation to Avro "
+                        "container files/directories, the reference's "
+                        "AvroDataReader flow")
+    p.add_argument("--avro-re-types", default="",
+                   help="comma-separated random-effect id keys read from "
+                        "the Avro records' metadataMap")
+    p.add_argument("--feature-index-dir",
+                   help="saved index maps (<shard>.json) freezing the "
+                        "feature space (reference PalDB feature maps); "
+                        "built from the data when omitted")
+    p.add_argument("--date-range",
+                   help="yyyyMMdd-yyyyMMdd or ISO a:b — expand --train as "
+                        "daily partitions <root>/yyyy/mm/dd (reference "
+                        "inputDataDateRange)")
+    p.add_argument("--model-output-format", default="NPZ",
+                   choices=["NPZ", "AVRO", "BOTH"],
+                   help="AVRO additionally writes the reference's "
+                        "BayesianLinearModelAvro layout under "
+                        "<output-dir>/best-avro, together with the index "
+                        "maps and entity vocabularies needed to reload it "
+                        "(requires Avro input via --avro-feature-shard)")
     p.add_argument("--distributed", action="store_true",
                    help="join the multi-host world before building the "
                         "mesh (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
@@ -123,24 +148,107 @@ def _load_dataset(path: str, num_features=None):
     return from_sparse_batch(from_libsvm(data))
 
 
+def _parse_avro_shards(specs):
+    """--avro-feature-shard mini-DSL → {shard: FeatureShardConfig}."""
+    from photon_ml_tpu.avro.data_reader import FeatureShardConfig
+
+    out = {}
+    for spec in specs:
+        kv = parse_kv(spec)
+        if "name" not in kv:
+            raise ValueError(f"avro shard spec needs name=: {spec!r}")
+        out[kv["name"]] = FeatureShardConfig(
+            feature_bags=tuple(
+                b for b in kv.get("bags", "features").split("+") if b),
+            has_intercept=kv.get("intercept", "true").lower() == "true",
+            sparse=kv.get("sparse", "false").lower() == "true")
+    return out
+
+
+def _load_avro_inputs(args):
+    """The reference GameTrainingDriver flow: feature maps → AvroDataReader
+    → (train, validation) GameDatasets sharing one feature space."""
+    from photon_ml_tpu.avro.data_reader import AvroDataReader
+    from photon_ml_tpu.avro.model_io import load_index_maps
+    from photon_ml_tpu.utils.ranges import (DateRange,
+                                            input_paths_within_date_range)
+
+    shard_cfgs = _parse_avro_shards(args.avro_feature_shard)
+    re_types = [t for t in args.avro_re_types.split(",") if t]
+    index_maps = (load_index_maps(args.feature_index_dir)
+                  if args.feature_index_dir else None)
+    train_paths = [args.train]
+    if args.date_range:
+        train_paths = input_paths_within_date_range(
+            args.train, DateRange.parse(args.date_range))
+        if not train_paths:
+            raise ValueError(
+                f"no daily partitions under {args.train} within "
+                f"{args.date_range}")
+        logger.info("date range %s: %d daily partitions", args.date_range,
+                    len(train_paths))
+    reader = AvroDataReader()
+    train, meta = reader.read(train_paths, shard_cfgs,
+                              random_effect_types=re_types,
+                              index_maps=index_maps)
+    validation = None
+    if args.validation:
+        # Frozen feature space + entity vocabulary from training
+        # (reference: validation reads through the same index maps).
+        # Unseen validation entities are routine (new users appear every
+        # day) — they get rows past the frozen range and score with the
+        # fixed effect only, the reference's unseen-entity semantics.
+        validation, val_meta = reader.read(
+            args.validation, shard_cfgs, random_effect_types=re_types,
+            index_maps=meta.index_maps, entity_vocabs=meta.entity_vocabs,
+            allow_unseen_entities=True)
+        for t in re_types:
+            unseen = (len(val_meta.entity_vocabs[t])
+                      - len(meta.entity_vocabs[t]))
+            if unseen:
+                logger.info(
+                    "validation has %d unseen %s entities (scored with "
+                    "the fixed effect only)", unseen, t)
+    return train, validation, meta
+
+
 def run(args) -> dict:
     setup_logging()
     enable_compilation_cache()
     t0 = time.time()
     task = TaskType(args.task)
-    train = _load_dataset(args.train)
-    validation = None
-    if args.validation:
-        nf = None
-        if not os.path.isdir(args.validation):
-            # LIBSVM validation must share the training feature space —
-            # whatever form training was loaded from.
-            if "global" not in train.feature_shards:
+    if (args.model_output_format in ("AVRO", "BOTH")
+            and not args.avro_feature_shard):
+        # Fail at argument time, not after an hours-long fit.
+        raise ValueError(
+            "--model-output-format AVRO needs Avro input "
+            "(--avro-feature-shard) to supply feature index maps and "
+            "entity vocabularies")
+    avro_meta = None
+    if args.avro_feature_shard:
+        train, validation, avro_meta = _load_avro_inputs(args)
+    else:
+        for flag, value in (("--date-range", args.date_range),
+                            ("--avro-re-types", args.avro_re_types),
+                            ("--feature-index-dir",
+                             args.feature_index_dir)):
+            if value:
                 raise ValueError(
-                    "LIBSVM validation requires a 'global' feature shard "
-                    "in the training data")
-            nf = train.shard_dim("global")
-        validation = _load_dataset(args.validation, num_features=nf)
+                    f"{flag} applies to Avro inputs "
+                    f"(--avro-feature-shard)")
+        train = _load_dataset(args.train)
+        validation = None
+        if args.validation:
+            nf = None
+            if not os.path.isdir(args.validation):
+                # LIBSVM validation must share the training feature space —
+                # whatever form training was loaded from.
+                if "global" not in train.feature_shards:
+                    raise ValueError(
+                        "LIBSVM validation requires a 'global' feature "
+                        "shard in the training data")
+                nf = train.shard_dim("global")
+            validation = _load_dataset(args.validation, num_features=nf)
     vlevel = DataValidationLevel(args.data_validation)
     validate_game_dataset(task, train, level=vlevel)
     if validation is not None:
@@ -310,8 +418,25 @@ def run(args) -> dict:
             for i, r in enumerate(results):
                 model_io.save_game_model(
                     r.model, os.path.join(args.output_dir, f"model-{i}"))
-        model_io.save_game_model(best.model,
-                                 os.path.join(args.output_dir, "best"))
+        if args.model_output_format in ("NPZ", "BOTH"):
+            model_io.save_game_model(best.model,
+                                     os.path.join(args.output_dir, "best"))
+        if args.model_output_format in ("AVRO", "BOTH"):
+            from photon_ml_tpu.avro.model_io import (save_game_model_avro,
+                                                     save_index_maps)
+
+            avro_dir = os.path.join(args.output_dir, "best-avro")
+            save_game_model_avro(
+                best.model, avro_dir, avro_meta.index_maps,
+                entity_vocabs=avro_meta.entity_vocabs)
+            # Make the directory self-contained: reloading needs the same
+            # index maps and vocabularies that wrote it, not a re-read of
+            # the training data.
+            save_index_maps(avro_meta.index_maps,
+                            os.path.join(avro_dir, "index-maps"))
+            with open(os.path.join(avro_dir, "entity-vocabs.json"),
+                      "w") as f:
+                json.dump(avro_meta.entity_vocabs, f)
     summary = {
         "task": task.value,
         "candidates": [

@@ -84,9 +84,13 @@ class FactoredRandomEffectModel:
     def score(self, dataset: GameDataset) -> Array:
         X = jnp.asarray(dataset.feature_shards[self.shard_id])
         ids = jnp.asarray(dataset.entity_ids[self.re_type])
-        # x_i · (A z_e): contract the small rank axis last.
-        return jnp.einsum("nr,nr->n", X @ self.projection,
-                          self.factors[ids])
+        # x_i · (A z_e): contract the small rank axis last. Ids beyond the
+        # factor table (unseen scoring entities) contribute exactly zero —
+        # the same passive semantics as RandomEffectModel.score.
+        safe = jnp.minimum(ids, self.factors.shape[0] - 1)
+        contrib = jnp.einsum("nr,nr->n", X @ self.projection,
+                             self.factors[safe])
+        return jnp.where(ids < self.factors.shape[0], contrib, 0.0)
 
     def to_random_effect_model(self):
         """Materialize the implied full-rank (E, d) table (reference:

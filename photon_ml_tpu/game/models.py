@@ -76,7 +76,12 @@ class RandomEffectModel:
         X = jnp.asarray(dataset.feature_shards[self.shard_id])
         ids = jnp.asarray(dataset.entity_ids[self.re_type])
         # Row-gather then fused rowwise dot: score_i = x_i · W[e_i].
-        return jnp.einsum("nd,nd->n", X, self.means[ids])
+        # Ids beyond the model's entity table (validation/scoring data read
+        # with allow_unseen_entities=True) contribute exactly zero — the
+        # reference's passive/unseen-entity semantics (fixed effect only).
+        safe = jnp.minimum(ids, self.means.shape[0] - 1)
+        contrib = jnp.einsum("nd,nd->n", X, self.means[safe])
+        return jnp.where(ids < self.means.shape[0], contrib, 0.0)
 
 
 # FactoredRandomEffectModel (game/factored.py) also satisfies this contract

@@ -327,3 +327,231 @@ def test_game_train_partial_retraining_locks_coordinate(rng, tmp_path):
         np.asarray(m1.models["fixed"].coefficients.means))
     assert not np.allclose(np.asarray(m2.models["per-user"].means),
                            np.asarray(m1.models["per-user"].means))
+
+
+def test_game_train_avro_input_end_to_end(rng, tmp_path):
+    """The reference GameTrainingDriver flow: daily-partitioned Avro input
+    (--date-range) → AvroDataReader with frozen validation feature space →
+    GAME fit → BayesianLinearModelAvro model output → reload → identical
+    scores."""
+    from photon_ml_tpu.avro import schemas
+    from photon_ml_tpu.avro.container import write_records
+    from photon_ml_tpu.avro.model_io import load_game_model_avro
+    from photon_ml_tpu.avro.data_reader import (AvroDataReader,
+                                                FeatureShardConfig)
+
+    def make_records(n, seed):
+        r = np.random.default_rng(seed)
+        recs = []
+        for i in range(n):
+            feats = [{"name": f"x{j}", "term": "", "value": float(r.normal())}
+                     for j in range(4)]
+            margin = feats[0]["value"] + feats[1]["value"] \
+                - feats[2]["value"] - feats[3]["value"]
+            recs.append({
+                "uid": i,
+                "label": float(r.uniform() < 1 / (1 + np.exp(-margin))),
+                "weight": 1.0, "offset": 0.0, "features": feats,
+                "metadataMap": {"userId": f"u{r.integers(0, 8)}"},
+            })
+        return recs
+
+    # Three daily partitions + a validation file.
+    root = tmp_path / "daily"
+    for day, seed in (("2026/07/01", 1), ("2026/07/02", 2),
+                      ("2026/07/03", 3)):
+        d = root / day
+        d.mkdir(parents=True)
+        write_records(str(d / "part-0.avro"), schemas.TRAINING_EXAMPLE_AVRO,
+                      make_records(300, seed))
+    val_path = str(tmp_path / "val.avro")
+    write_records(val_path, schemas.TRAINING_EXAMPLE_AVRO,
+                  make_records(300, 9))
+
+    out = str(tmp_path / "out-avro")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", str(root), "--validation", val_path,
+        "--date-range", "20260701-20260703",
+        "--avro-feature-shard", "name=global,bags=features,intercept=true",
+        "--avro-re-types", "userId",
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=global,re=userId",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", "2", "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=5.0",
+        "--model-output-format", "BOTH",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.75
+
+    # Reload using ONLY the persisted artifacts (the model dir must be
+    # self-contained — no re-read of the training data).
+    import json as _json
+
+    from photon_ml_tpu.avro.model_io import load_index_maps
+
+    avro_dir = os.path.join(out, "best-avro")
+    imaps = load_index_maps(os.path.join(avro_dir, "index-maps"))
+    with open(os.path.join(avro_dir, "entity-vocabs.json")) as f:
+        vocabs = _json.load(f)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    val_ds, _ = AvroDataReader().read(
+        val_path, cfgs, random_effect_types=["userId"],
+        index_maps=imaps, entity_vocabs=vocabs,
+        allow_unseen_entities=True)
+    m_npz = model_io.load_game_model(os.path.join(out, "best"))
+    m_avro = load_game_model_avro(avro_dir, imaps, entity_vocabs=vocabs)
+    np.testing.assert_allclose(np.asarray(m_avro.score(val_ds)),
+                               np.asarray(m_npz.score(val_ds)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_avro_model_output_requires_avro_input(rng, tmp_path):
+    train_dir, _ = _write_game_data(tmp_path, rng, n=300)
+    with pytest.raises(ValueError, match="AVRO"):
+        game_train.run(game_train.build_parser().parse_args([
+            "--train", train_dir,
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--update-sequence", "fixed",
+            "--model-output-format", "AVRO",
+            "--output-dir", str(tmp_path / "x"),
+        ]))
+
+
+def test_avro_validation_with_unseen_entities(rng, tmp_path):
+    """New entities in validation are routine: they score with the fixed
+    effect only (zero random-effect contribution) instead of aborting."""
+    from photon_ml_tpu.avro import schemas
+    from photon_ml_tpu.avro.container import write_records
+
+    def recs(n, seed, user_base):
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            feats = [{"name": f"x{j}", "term": "",
+                      "value": float(r.normal())} for j in range(3)]
+            margin = feats[0]["value"] - feats[1]["value"]
+            out.append({
+                "label": float(r.uniform() < 1 / (1 + np.exp(-margin))),
+                "features": feats,
+                "metadataMap": {"userId": f"{user_base}{r.integers(0, 5)}"},
+            })
+        return out
+
+    train_path = str(tmp_path / "t.avro")
+    val_path = str(tmp_path / "v.avro")
+    write_records(train_path, schemas.TRAINING_EXAMPLE_AVRO,
+                  recs(400, 1, "seen"))
+    # HALF the validation users are brand new.
+    write_records(val_path, schemas.TRAINING_EXAMPLE_AVRO,
+                  recs(200, 2, "seen") + recs(200, 3, "new"))
+    out = str(tmp_path / "out")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_path, "--validation", val_path,
+        "--avro-feature-shard", "name=global,bags=features,intercept=true",
+        "--avro-re-types", "userId",
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=global,re=userId",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", "1", "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=5.0",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.6
+
+
+def test_game_score_avro_everything(rng, tmp_path):
+    """Pure-Avro loop: train on Avro, score NEW Avro data with the Avro
+    model through the saved index maps — no npz artifacts involved."""
+    from photon_ml_tpu.avro import schemas
+    from photon_ml_tpu.avro.container import write_records
+
+    def recs(n, seed, base="u"):
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            feats = [{"name": f"x{j}", "term": "",
+                      "value": float(r.normal())} for j in range(4)]
+            margin = feats[0]["value"] - feats[1]["value"]
+            out.append({
+                "label": float(r.uniform() < 1 / (1 + np.exp(-margin))),
+                "features": feats,
+                "metadataMap": {"userId": f"{base}{r.integers(0, 6)}"},
+            })
+        return out
+
+    train_path = str(tmp_path / "t.avro")
+    score_path = str(tmp_path / "s.avro")
+    write_records(train_path, schemas.TRAINING_EXAMPLE_AVRO, recs(500, 1))
+    write_records(score_path, schemas.TRAINING_EXAMPLE_AVRO,
+                  recs(200, 2) + recs(100, 3, base="brandnew"))
+    out = str(tmp_path / "out")
+    game_train.run(game_train.build_parser().parse_args([
+        "--train", train_path,
+        "--avro-feature-shard", "name=global,bags=features,intercept=true",
+        "--avro-re-types", "userId",
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=global,re=userId",
+        "--update-sequence", "fixed,per-user", "--iterations", "1",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=5.0",
+        "--model-output-format", "BOTH", "--output-dir", out,
+    ]))
+    score_out = str(tmp_path / "scored")
+    s = game_score.run(game_score.build_parser().parse_args([
+        "--data", score_path,
+        "--model-dir", os.path.join(out, "best-avro"),
+        "--model-format", "AVRO",
+        "--avro-feature-shard", "name=global,bags=features,intercept=true",
+        "--avro-re-types", "userId",
+        "--feature-index-dir", os.path.join(out, "best-avro",
+                                            "index-maps"),
+        "--output-dir", score_out, "--evaluators", "AUC",
+    ]))
+    assert s["num_rows"] == 300
+    assert np.isfinite(s["metrics"]["AUC"])
+    # Input records carry no uid field -> reader defaults to row indices;
+    # the npz stores them unpickled.
+    npz = np.load(os.path.join(score_out, "scores.npz"))
+    assert npz["uid"].shape == (300,)
+    # Same data scored via the npz model must agree.
+    s2 = game_score.run(game_score.build_parser().parse_args([
+        "--data", score_path,
+        "--model-dir", os.path.join(out, "best"),
+        "--avro-feature-shard", "name=global,bags=features,intercept=true",
+        "--avro-re-types", "userId",
+        "--feature-index-dir", os.path.join(out, "best-avro",
+                                            "index-maps"),
+        "--output-dir", str(tmp_path / "scored2"),
+        "--evaluators", "AUC",
+    ]))
+    assert abs(s["metrics"]["AUC"] - s2["metrics"]["AUC"]) < 1e-5
+
+
+def test_avro_scoring_requires_vocabs_for_re_types(rng, tmp_path):
+    """Missing entity-vocabs.json + random-effect types must fail loudly
+    (silent encounter-order vocabularies would misalign every RE row)."""
+    from photon_ml_tpu.avro import schemas
+    from photon_ml_tpu.avro.container import write_records
+    from photon_ml_tpu.avro.model_io import save_index_maps
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+
+    path = str(tmp_path / "d.avro")
+    write_records(path, schemas.TRAINING_EXAMPLE_AVRO, [{
+        "label": 1.0,
+        "features": [{"name": "a", "term": "", "value": 1.0}],
+        "metadataMap": {"userId": "u1"}}])
+    maps_dir = str(tmp_path / "maps" / "index-maps")
+    save_index_maps(
+        {"global": DefaultIndexMap.from_keys(["a"], add_intercept=True)},
+        maps_dir)
+    with pytest.raises(ValueError, match="entity vocabularies"):
+        game_score.run(game_score.build_parser().parse_args([
+            "--data", path, "--model-dir", str(tmp_path / "nomodel"),
+            "--avro-feature-shard",
+            "name=global,bags=features,intercept=true",
+            "--avro-re-types", "userId",
+            "--feature-index-dir", maps_dir,
+            "--output-dir", str(tmp_path / "o")]))

@@ -147,13 +147,20 @@ def test_parse_optimizer_config():
 
 
 def test_mismatched_validation_vocab_rejected(rng, mesh):
+    """A SMALLER validation vocabulary is silent id misalignment and must
+    be rejected; an EXTENSION is legal (allow_unseen_entities: new ids get
+    rows past the frozen range and score with zero RE contribution)."""
     train, val = _datasets(rng, n=400)
-    val = dataclasses.replace(
-        val, num_entities={"userId": val.num_entities["userId"] + 5})
+    smaller = dataclasses.replace(
+        val, num_entities={"userId": val.num_entities["userId"] - 2})
     est = GameEstimator(
         task=TaskType.LOGISTIC_REGRESSION,
         coordinates=_coordinates(),
         update_sequence=["fixed", "per-user"],
         mesh=mesh, validation_evaluators=["AUC"])
     with pytest.raises(ValueError, match="vocabulary"):
-        est.fit(train, validation_data=val)
+        est.fit(train, validation_data=smaller)
+    extended = dataclasses.replace(
+        val, num_entities={"userId": val.num_entities["userId"] + 5})
+    result = est.fit(train, validation_data=extended)[0]
+    assert np.isfinite(result.evaluation.primary_value)
