@@ -1,0 +1,117 @@
+"""The reference's full Avro pipeline, end to end.
+
+Mirrors photon-client's production flow: daily-partitioned
+TrainingExampleAvro input → feature maps built from the data → GAME fit →
+a self-contained BayesianLinearModelAvro model directory (model + index
+maps + entity vocabularies) → scoring NEW Avro data (with never-seen
+entities) through those artifacts alone.
+
+Everything runs through the real CLI drivers; ingestion uses the native
+C++ Avro block decoder when a toolchain is available.
+
+Run: python examples/avro_pipeline.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from photon_ml_tpu.avro import schemas
+from photon_ml_tpu.avro.container import write_records
+from photon_ml_tpu.cli import game_score, game_train
+
+
+def make_records(rng, n, user_effects, user_base="u"):
+    """Labels carry a REAL per-user effect (user_effects[uid] added to the
+    margin) so the random-effect coordinate has signal to learn — and so
+    unseen users at scoring time visibly lose that signal."""
+    recs = []
+    for _ in range(n):
+        uid = int(rng.integers(0, len(user_effects)))
+        feats = [{"name": f"x{j}", "term": "", "value": float(rng.normal())}
+                 for j in range(6)]
+        margin = (feats[0]["value"] + feats[1]["value"] - feats[2]["value"]
+                  + user_effects[uid])
+        recs.append({
+            "label": float(rng.uniform() < 1 / (1 + np.exp(-margin))),
+            "features": feats,
+            "metadataMap": {"userId": f"{user_base}{uid}"},
+        })
+    return recs
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # Strong planted per-user effects; "new" users get their own (never
+    # observed in training, so only the fixed effect can score them).
+    seen_fx = rng.normal(scale=2.0, size=25)
+    new_fx = rng.normal(scale=2.0, size=25)
+    with tempfile.TemporaryDirectory() as td:
+        # Daily-partitioned training data (three days).
+        for day in ("2026/07/01", "2026/07/02", "2026/07/03"):
+            os.makedirs(f"{td}/daily/{day}")
+            write_records(f"{td}/daily/{day}/part-0.avro",
+                          schemas.TRAINING_EXAMPLE_AVRO,
+                          make_records(rng, 1500, seen_fx))
+        write_records(f"{td}/val.avro", schemas.TRAINING_EXAMPLE_AVRO,
+                      make_records(rng, 1000, seen_fx))
+        # Scoring data: half the users were never seen in training — they
+        # score with the fixed effect only (reference semantics).
+        write_records(f"{td}/score.avro", schemas.TRAINING_EXAMPLE_AVRO,
+                      make_records(rng, 500, seen_fx)
+                      + make_records(rng, 500, new_fx, user_base="new"))
+
+        summary = game_train.run(game_train.build_parser().parse_args([
+            "--train", f"{td}/daily", "--validation", f"{td}/val.avro",
+            "--date-range", "20260701-20260703",
+            "--avro-feature-shard",
+            "name=global,bags=features,intercept=true",
+            "--avro-re-types", "userId",
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--coordinate",
+            "name=per-user,type=random,shard=global,re=userId",
+            "--update-sequence", "fixed,per-user",
+            "--iterations", "2", "--evaluators", "AUC",
+            "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--opt-config",
+            "per-user:optimizer=LBFGS,reg=L2,reg_weight=5.0",
+            "--model-output-format", "AVRO",
+            "--output-dir", f"{td}/out",
+        ]))
+        print(f"validation AUC: {summary['best_metrics']['AUC']:.4f}")
+
+        scored = game_score.run(game_score.build_parser().parse_args([
+            "--data", f"{td}/score.avro",
+            "--model-dir", f"{td}/out/best-avro",
+            "--model-format", "AVRO",
+            "--avro-feature-shard",
+            "name=global,bags=features,intercept=true",
+            "--avro-re-types", "userId",
+            "--feature-index-dir", f"{td}/out/best-avro/index-maps",
+            "--output-dir", f"{td}/scored",
+            "--output-format", "BOTH",
+            "--evaluators", "AUC",
+        ]))
+        print(f"scored {scored['num_rows']} rows "
+              f"(half with unseen users), AUC {scored['metrics']['AUC']:.4f}")
+        print("outputs:", sorted(os.listdir(f"{td}/scored")))
+
+        # The unseen-entity contrast, made visible: seen users keep their
+        # learned per-user effects; unseen ones fall back to the fixed
+        # effect alone and lose that accuracy.
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.evaluation.evaluators import auc
+
+        npz = np.load(f"{td}/scored/scores.npz")
+        seen_auc = float(auc(jnp.asarray(npz["score"][:500]),
+                             jnp.asarray(npz["label"][:500])))
+        unseen_auc = float(auc(jnp.asarray(npz["score"][500:]),
+                               jnp.asarray(npz["label"][500:])))
+        print(f"seen users AUC {seen_auc:.4f} (random effects active)  vs  "
+              f"unseen users AUC {unseen_auc:.4f} (fixed effect only)")
+
+
+if __name__ == "__main__":
+    main()
