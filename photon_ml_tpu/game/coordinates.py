@@ -518,12 +518,21 @@ class RandomEffectCoordinate:
         features_to_samples_ratio: Optional[float] = None,
     ):
         from photon_ml_tpu.data.game_data import SparseShard
-        if isinstance(dataset.feature_shards[shard_id], SparseShard):
-            raise TypeError(
-                f"random-effect shard {shard_id!r} is sparse; large-d "
-                f"sparse per-entity features are the subspace-projection "
-                f"regime — densify the shard and use projection=True "
-                f"(stages dense d_active buckets)")
+        self.is_sparse = isinstance(dataset.feature_shards[shard_id],
+                                    SparseShard)
+        if self.is_sparse:
+            # Large-d per-entity sparse features are exactly the
+            # subspace-projection regime (reference: RandomEffectDataset
+            # keeps per-entity sparse Breeze rows and projects them via
+            # IndexMapProjectorRDD) — projection is implied; the dense
+            # (n, d) shard never exists, buckets stage at d_active ≪ d
+            # straight from the ELL triplets.
+            projection = True
+            if norm.factors is not None or norm.shifts is not None:
+                raise ValueError(
+                    f"normalization is not supported on sparse random-"
+                    f"effect shard {shard_id!r} (scaling sparse values "
+                    f"would densify shift terms)")
         self.dataset = dataset
         self.re_type = re_type
         self.shard_id = shard_id
@@ -538,7 +547,13 @@ class RandomEffectCoordinate:
             lower_bound=lower_bound, upper_bound=upper_bound,
             entity_pad_multiple=max(8, int(np.prod(list(mesh.shape.values())))),
             rng=np.random.default_rng(seed))
-        self._X = jnp.asarray(dataset.feature_shards[shard_id])
+        if self.is_sparse:
+            shard = dataset.feature_shards[shard_id]
+            self._sp_indices = jnp.asarray(shard.indices)
+            self._sp_values = jnp.asarray(shard.values)
+            self._X = None
+        else:
+            self._X = jnp.asarray(dataset.feature_shards[shard_id])
         self._ids = jnp.asarray(dataset.entity_ids[re_type])
         # Pearson feature filtering selects per-entity columns, which is
         # exactly what the projection machinery stages — a ratio implies
@@ -571,16 +586,20 @@ class RandomEffectCoordinate:
         if s_full is not None and f_full is None:
             f_full = np.ones_like(s_full)
 
+        coo = prj.shard_coo(X) if self.projection else None
         for b in self.bucketing.buckets:
             wb = bkt.bucket_weights(b, ds.weights)
             ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
             rows = b.entity_rows  # (E_b,) int32; -1 padding
             if self.projection:
+                trip = prj.bucket_triplets(b, X, coo)
                 proj = prj.build_bucket_projection(
                     b, X, self.intercept_index,
                     labels=np.asarray(ds.response),
-                    features_to_samples_ratio=self.features_to_samples_ratio)
-                Xb = prj.gather_projected_features(b, proj, X)
+                    features_to_samples_ratio=self.features_to_samples_ratio,
+                    triplets=trip)
+                Xb = prj.gather_projected_features(b, proj, X,
+                                                   triplets=trip)
                 (yb,) = bkt.gather_bucket_arrays(b, ds.response)
                 f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
                 extra = [proj.cols]
@@ -807,6 +826,13 @@ class RandomEffectCoordinate:
         return dataclasses.replace(model, variances=V)
 
     def score(self, model: RandomEffectModel) -> Array:
+        if self.is_sparse:
+            # Σ_k v_ik · W[e_i, idx_ik]; the sentinel column (== d) of ELL
+            # padding gathers the zero pad column.
+            W_pad = jnp.pad(jnp.asarray(model.means), ((0, 0), (0, 1)))
+            return jnp.sum(
+                self._sp_values * W_pad[self._ids[:, None],
+                                        self._sp_indices], axis=-1)
         return jnp.einsum("nd,nd->n", self._X, model.means[self._ids])
 
     def initial_model(self) -> RandomEffectModel:

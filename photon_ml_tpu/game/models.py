@@ -73,14 +73,25 @@ class RandomEffectModel:
         return self.means.shape[1]
 
     def score(self, dataset: GameDataset) -> Array:
-        X = jnp.asarray(dataset.feature_shards[self.shard_id])
+        from photon_ml_tpu.data.game_data import SparseShard
+
+        shard = dataset.feature_shards[self.shard_id]
         ids = jnp.asarray(dataset.entity_ids[self.re_type])
         # Row-gather then fused rowwise dot: score_i = x_i · W[e_i].
         # Ids beyond the model's entity table (validation/scoring data read
         # with allow_unseen_entities=True) contribute exactly zero — the
         # reference's passive/unseen-entity semantics (fixed effect only).
         safe = jnp.minimum(ids, self.means.shape[0] - 1)
-        contrib = jnp.einsum("nd,nd->n", X, self.means[safe])
+        if isinstance(shard, SparseShard):
+            # Element gather through the zero-padded (E, d+1) table: the
+            # ELL sentinel column (== d) lands on the pad column.
+            W_pad = jnp.pad(jnp.asarray(self.means), ((0, 0), (0, 1)))
+            contrib = jnp.sum(
+                jnp.asarray(shard.values)
+                * W_pad[safe[:, None], jnp.asarray(shard.indices)], axis=-1)
+        else:
+            contrib = jnp.einsum("nd,nd->n", jnp.asarray(shard),
+                                 self.means[safe])
         return jnp.where(ids < self.means.shape[0], contrib, 0.0)
 
 

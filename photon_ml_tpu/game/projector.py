@@ -12,11 +12,19 @@ entities carries ONE (E_b, d_active) int32 column-index matrix ``cols``:
     cols[e, j] = global column of entity e's j-th active feature (−1 pad)
 
 Features are gathered straight into projected bucket layout on the host —
-``X[example_idx[:, :, None], cols[:, None, :]]`` — so the dense
+dense shards via ``X[example_idx[:, :, None], cols[:, None, :]]``, sparse
+(ELL) shards via an O(nnz) scatter of their stored triplets — so the dense
 (E_b, cap, d) block is never materialized; solves run at d_active ≪ d.
-Coefficients live in the full space (the (E, d) table) and are
-gathered/scattered through ``cols`` on device (projectForward /
-projectBackward).
+For sparse shards not even the (n, d) matrix ever exists: the ELL indices
+ARE the per-entity active sets (the reference's RandomEffectDataset keeps
+per-entity sparse Breeze rows for exactly this reason). Coefficients live
+in the full space (the (E, d) table) and are gathered/scattered through
+``cols`` on device (projectForward / projectBackward).
+
+Everything here is vectorized numpy over nonzero triplets — one sort +
+segment pass per bucket, no per-entity Python loops — so staging scales to
+10⁶ entities (SURVEY §2.1: RandomEffectDatasetPartitioner runs over every
+entity; this is the one-time host cost that must not dominate).
 
 Conventions:
 - If the shard has an intercept column it is ALWAYS active and is placed at
@@ -76,19 +84,105 @@ def pearson_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
     return out
 
 
+def shard_coo(X) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(rows, cols, vals) nonzero triplets of a dense matrix or SparseShard.
+
+    For sparse shards this reads straight off the ELL arrays in O(nnz) —
+    no dense (n, d) scan ever happens; padding slots (index == d, value 0)
+    and explicit zeros are dropped. Callers staging several buckets compute
+    this once per shard and pass it down.
+    """
+    from photon_ml_tpu.data.game_data import SparseShard
+
+    if isinstance(X, SparseShard):
+        idx = np.asarray(X.indices)
+        val = np.asarray(X.values)
+        valid = (idx < X.num_features) & (val != 0.0)
+        rows = np.broadcast_to(
+            np.arange(idx.shape[0], dtype=np.int32)[:, None],
+            idx.shape)[valid]
+        return rows, idx[valid].astype(np.int32), val[valid]
+    X = np.asarray(X)
+    rows, cols = np.nonzero(X)
+    # Values keep the shard's own dtype (f32 shards stay compact; f64
+    # inputs keep full precision for the Pearson moments).
+    return rows.astype(np.int32), cols.astype(np.int32), X[rows, cols]
+
+
+def _shard_shape(X) -> tuple[int, int]:
+    from photon_ml_tpu.data.game_data import SparseShard
+
+    if isinstance(X, SparseShard):
+        return X.shape
+    return int(X.shape[0]), int(X.shape[1])
+
+
+@dataclasses.dataclass
+class BucketTriplets:
+    """One bucket's slice of a shard's nonzero triplets plus the reverse
+    example-row maps — computed once per bucket and shared by
+    ``build_bucket_projection`` and ``gather_projected_features`` so the
+    O(n_rows) map build and O(nnz) filtering run once, not twice."""
+
+    lane_of: np.ndarray  # (n_rows,) int32 bucket lane; -1 outside
+    cappos_of: np.ndarray  # (n_rows,) int32 slot within the lane's cap
+    rows: np.ndarray  # filtered triplet rows (this bucket's kept examples)
+    cols: np.ndarray  # int64 global columns
+    vals: np.ndarray  # shard-dtype values
+    lanes: np.ndarray  # int64 lane per triplet
+
+
+def bucket_triplets(
+    bucket: EntityBucket,
+    X,
+    coo: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> BucketTriplets:
+    n_rows, _ = _shard_shape(X)
+    if coo is None:
+        coo = shard_coo(X)
+    rows_nz, cols_nz, vals_nz = coo
+    lane_of, cappos_of = _lane_maps(bucket, n_rows)
+    sel = lane_of[rows_nz] >= 0
+    r = rows_nz[sel]
+    return BucketTriplets(
+        lane_of=lane_of, cappos_of=cappos_of, rows=r,
+        cols=cols_nz[sel].astype(np.int64), vals=vals_nz[sel],
+        lanes=lane_of[r].astype(np.int64))
+
+
+def _lane_maps(bucket: EntityBucket, n_rows: int
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """Reverse maps example row → (bucket lane, slot within cap); −1 lane
+    for rows outside this bucket (other buckets / dropped by upper_bound)."""
+    ex = bucket.example_idx
+    kept = ex >= 0
+    lane_of = np.full(n_rows, -1, np.int32)
+    cappos_of = np.zeros(n_rows, np.int32)
+    lane_of[ex[kept]] = np.broadcast_to(
+        np.arange(ex.shape[0], dtype=np.int32)[:, None], ex.shape)[kept]
+    cappos_of[ex[kept]] = np.broadcast_to(
+        np.arange(ex.shape[1], dtype=np.int32)[None, :], ex.shape)[kept]
+    return lane_of, cappos_of
+
+
 def build_bucket_projection(
     bucket: EntityBucket,
-    X: np.ndarray,
+    X,
     intercept_index: Optional[int],
     min_dim: int = 8,
     labels: Optional[np.ndarray] = None,
     features_to_samples_ratio: Optional[float] = None,
+    coo: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    triplets: Optional[BucketTriplets] = None,
 ) -> BucketProjection:
     """Compute each entity's active feature subspace for one bucket.
 
     A column is active for an entity iff any of the entity's (kept) examples
     has a nonzero value there (reference LinearSubspaceProjector: the index
-    set of features present in the entity's data).
+    set of features present in the entity's data). ``X`` may be a dense
+    (n, d) matrix or a SparseShard; pass ``coo=shard_coo(X)`` to reuse the
+    triplet extraction across buckets, and ``triplets`` to additionally
+    share the per-bucket filtering with ``gather_projected_features``.
 
     ``features_to_samples_ratio`` additionally caps each entity's subspace
     at ``ceil(ratio · num_samples)`` columns, keeping the highest
@@ -96,71 +190,178 @@ def build_bucket_projection(
     ``LocalDataset.filterFeaturesByPearsonCorrelationScore`` driven by
     ``RandomEffectDataConfiguration.numFeaturesToSamplesRatio``). The
     intercept is always kept and counts toward the cap, matching the
-    reference (it assigns the intercept the maximal score).
+    reference (it assigns the intercept the maximal score). Pearson moments
+    come from the same nonzero triplets (zeros contribute only to counts),
+    identical in exact arithmetic to ``pearson_scores`` on dense columns.
+
+    One sort + segment-reduce pass over the bucket's nonzeros — no
+    per-entity loops.
     """
     if features_to_samples_ratio is not None and labels is None:
         raise ValueError("features_to_samples_ratio needs labels")
-    d = X.shape[1]
+    _, d = _shard_shape(X)
     ex = bucket.example_idx  # (E_b, cap), -1 pad
-    live_rows = bucket.entity_rows >= 0
-    # (E_b, cap, d) boolean would be large; go entity-by-entity (one-time
-    # host staging cost, ~O(nnz)).
-    active_sets: list[np.ndarray] = []
-    max_active = 1
-    for e in range(ex.shape[0]):
-        if not live_rows[e]:
-            active_sets.append(np.empty((0,), np.int64))
-            continue
-        idx = ex[e]
-        idx = idx[idx >= 0]
-        Xe = X[idx]
-        mask = np.any(Xe != 0.0, axis=0)
-        if intercept_index is not None:
-            mask[intercept_index] = True
-        cols_e = np.flatnonzero(mask)
-        if features_to_samples_ratio is not None:
-            keep = int(np.ceil(features_to_samples_ratio * len(idx)))
-            keep = max(1, keep)
-            if len(cols_e) > keep:
-                scores = pearson_scores(Xe[:, cols_e], labels[idx])
-                if intercept_index is not None:
-                    scores[cols_e == intercept_index] = np.inf
-                # Stable top-k: sort by (-score, col) so ties break on the
-                # lower column id deterministically.
-                order_e = np.lexsort((cols_e, -scores))[:keep]
-                cols_e = np.sort(cols_e[order_e])
-        if intercept_index is not None:
-            # Intercept first: static projected intercept slot 0.
-            cols_e = np.concatenate(
-                [[intercept_index], cols_e[cols_e != intercept_index]])
-        active_sets.append(cols_e)
-        max_active = max(max_active, len(cols_e))
+    E_b = ex.shape[0]
+    kept = ex >= 0
+    if triplets is None:
+        triplets = bucket_triplets(bucket, X, coo)
+    rows_b, c, v, l = (triplets.rows, triplets.cols, triplets.vals,
+                       triplets.lanes)
+    live = np.flatnonzero(np.asarray(bucket.entity_rows) >= 0).astype(
+        np.int64)
+    if intercept_index is not None:
+        # Force the intercept active for every live entity via synthetic
+        # zero-valued entries (harmless: the intercept's Pearson score is
+        # overridden to +inf below, so its moments are never consulted).
+        l = np.concatenate([l, live])
+        c = np.concatenate(
+            [c, np.full(live.shape, intercept_index, np.int64)])
+        v = np.concatenate([v, np.zeros(live.shape, np.float32)])
+        rows_b = np.concatenate([rows_b, np.zeros(live.shape, np.int32)])
 
+    # Unique (lane, col) pairs in (lane, col)-ascending order; key_s is
+    # already sorted, so run boundaries replace a second sort in unique().
+    key = l * np.int64(d + 1) + c
+    order = np.argsort(key, kind="stable")
+    key_s = key[order]
+    newrun_k = np.ones(key_s.shape, bool)
+    if key_s.size:
+        newrun_k[1:] = key_s[1:] != key_s[:-1]
+    first = np.flatnonzero(newrun_k)
+    uniq = key_s[first]
+    u_lane = (uniq // (d + 1)).astype(np.int64)
+    u_col = (uniq % (d + 1)).astype(np.int64)
+
+    if features_to_samples_ratio is not None and uniq.size:
+        # Centered (two-pass) Pearson moments, the stable computation the
+        # reference's stableComputePearsonCorrelationScore / the dense
+        # ``pearson_scores`` use: every accumulated term is a centered
+        # square or product, so a column with a huge mean and small
+        # variance cannot cancel to zero. Zero entries of a column enter
+        # the centered sums analytically: Σ_all (x−mx)² =
+        # Σ_nz (x−mx)² + n_zero·mx², and Σ_all (x−mx)(y−my) =
+        # Σ_nz (x−mx)(y−my) − mx·(Σ_zero y − n_zero·my).
+        y = np.asarray(labels, np.float64)
+        inv = np.cumsum(newrun_k) - 1  # sorted entry -> pair id
+        v_s = v[order].astype(np.float64)
+        y_s = y[rows_b[order]]
+        cnt = np.diff(np.append(first, key_s.shape[0])).astype(np.float64)
+        yb = y[np.maximum(ex, 0)]
+        yb[~kept] = 0.0
+        n_e = kept.sum(axis=1).astype(np.float64)
+        ne_safe = np.maximum(n_e, 1.0)
+        sy = yb.sum(axis=1)
+        my = sy / ne_safe
+        dyb = np.where(kept, yb - my[:, None], 0.0)
+        vary_lane = (dyb * dyb).sum(axis=1)
+        sx = np.add.reduceat(v_s, first)
+        ne_u = ne_safe[u_lane]
+        mx = sx / ne_u
+        dx = v_s - mx[inv]
+        dy = y_s - my[u_lane][inv]
+        n_zero = ne_u - cnt
+        varx = np.add.reduceat(dx * dx, first) + n_zero * mx * mx
+        sy_nz = np.add.reduceat(y_s, first)
+        cov = np.add.reduceat(dx * dy, first) \
+            - mx * ((sy[u_lane] - sy_nz) - n_zero * my[u_lane])
+        vary = vary_lane[u_lane]
+        denom = np.sqrt(np.maximum(varx * vary, 0.0))
+        score = np.zeros(uniq.shape, np.float64)
+        np.divide(np.abs(cov), denom, out=score, where=denom > 1e-12)
+        if intercept_index is not None:
+            score[u_col == intercept_index] = np.inf
+        keep_e = np.maximum(
+            1, np.ceil(features_to_samples_ratio * n_e)).astype(np.int64)
+        # Within each lane order by (-score, col) — ties break on the lower
+        # column id deterministically — and keep the first keep_e.
+        ordr = np.lexsort((u_col, -score, u_lane))
+        lane_o = u_lane[ordr]
+        newrun = np.ones(lane_o.shape, bool)
+        newrun[1:] = lane_o[1:] != lane_o[:-1]
+        run_starts = np.flatnonzero(newrun)
+        start_of = np.repeat(
+            run_starts, np.diff(np.append(run_starts, lane_o.shape[0])))
+        rank = np.arange(lane_o.shape[0]) - start_of
+        kept_idx = np.sort(ordr[rank < keep_e[lane_o]])
+        u_lane = u_lane[kept_idx]
+        u_col = u_col[kept_idx]
+
+    seg_counts = np.bincount(u_lane, minlength=E_b) if uniq.size else \
+        np.zeros(E_b, np.int64)
+    max_active = max(1, int(seg_counts.max()) if seg_counts.size else 1)
     d_active = min(d, max(min_dim, _next_pow2(max_active)))
     # An entity with more active columns than d_active cannot be truncated —
     # widen (can only happen via min() capping above, where d_active == d).
-    cols = np.full((ex.shape[0], d_active), -1, np.int32)
-    for e, cols_e in enumerate(active_sets):
-        cols[e, : len(cols_e)] = cols_e
-    return BucketProjection(cols=cols, d_active=d_active)
+    starts = np.concatenate([[0], np.cumsum(seg_counts)[:-1]])
+    pos = np.arange(u_lane.shape[0]) - starts[u_lane]
+    if intercept_index is not None and u_lane.size:
+        # Intercept first: static projected intercept slot 0; columns below
+        # the intercept's sorted position shift up by one.
+        is_int = u_col == intercept_index
+        p_lane = np.zeros(E_b, np.int64)
+        p_lane[u_lane[is_int]] = pos[is_int]
+        slot = np.where(is_int, 0,
+                        np.where(pos < p_lane[u_lane], pos + 1, pos))
+    else:
+        slot = pos
+    cols = np.full((E_b, d_active), -1, np.int32)
+    cols[u_lane, slot] = u_col.astype(np.int32)
+    return BucketProjection(cols=cols, d_active=int(d_active))
 
 
 def gather_projected_features(
     bucket: EntityBucket,
     projection: BucketProjection,
-    X: np.ndarray,
+    X,
+    coo: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    triplets: Optional[BucketTriplets] = None,
 ) -> np.ndarray:
     """Project features forward into (E_b, cap, d_active) bucket layout.
 
     Padded example rows and padded column slots are zeroed (inert under the
-    zero-weight / zero-feature contracts).
+    zero-weight / zero-feature contracts). Dense shards use one fancy
+    gather; SparseShards scatter their O(nnz) triplets straight into the
+    projected block — the dense (n, d) matrix never exists. Entries whose
+    column was filtered out of the subspace (the Pearson cap) are dropped,
+    exactly as the dense gather reads only the kept columns.
     """
-    ex = np.maximum(bucket.example_idx, 0)  # (E_b, cap)
-    cols = np.maximum(projection.cols, 0)  # (E_b, d_active)
-    Xp = X[ex[:, :, None], cols[:, None, :]].astype(X.dtype, copy=False)
-    Xp = np.where(projection.cols[:, None, :] < 0, 0.0, Xp)
-    Xp = np.where(bucket.example_idx[:, :, None] < 0, 0.0, Xp)
-    return np.ascontiguousarray(Xp)
+    from photon_ml_tpu.data.game_data import SparseShard
+
+    if not isinstance(X, SparseShard):
+        ex = np.maximum(bucket.example_idx, 0)  # (E_b, cap)
+        cols = np.maximum(projection.cols, 0)  # (E_b, d_active)
+        Xp = X[ex[:, :, None], cols[:, None, :]].astype(X.dtype, copy=False)
+        Xp = np.where(projection.cols[:, None, :] < 0, 0.0, Xp)
+        Xp = np.where(bucket.example_idx[:, :, None] < 0, 0.0, Xp)
+        return np.ascontiguousarray(Xp)
+
+    _, d = X.shape
+    E_b, cap = bucket.example_idx.shape
+    d_active = projection.d_active
+    if triplets is None:
+        triplets = bucket_triplets(bucket, X, coo)
+    cappos_of = triplets.cappos_of
+    r, c, v, l = (triplets.rows, triplets.cols, triplets.vals,
+                  triplets.lanes)
+    # Map (lane, global col) → projected slot through each lane's SORTED
+    # active set: the flattened (lane-major, within-lane ascending) key
+    # array is globally sorted, so one searchsorted resolves every entry;
+    # ``perm`` carries sorted position → actual slot (intercept-first
+    # reordering included, since it permutes ``projection.cols`` itself).
+    cw = np.where(projection.cols < 0, d + 1, projection.cols).astype(
+        np.int64)
+    perm = np.argsort(cw, axis=1, kind="stable")
+    sorted_cols = np.take_along_axis(cw, perm, axis=1)
+    flat_keys = (np.arange(E_b, dtype=np.int64)[:, None] * (d + 2)
+                 + sorted_cols).reshape(-1)
+    want = l * np.int64(d + 2) + c
+    gpos = np.searchsorted(flat_keys, want)
+    inset = flat_keys[np.minimum(gpos, flat_keys.size - 1)] == want
+    r, v, l, gpos = r[inset], v[inset], l[inset], gpos[inset]
+    slot = perm[l, gpos - l * d_active]
+    Xp = np.zeros((E_b, cap, d_active), np.float32)
+    Xp[l, cappos_of[r], slot] = v.astype(np.float32)
+    return Xp
 
 
 def project_norm_arrays(

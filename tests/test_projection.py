@@ -322,6 +322,24 @@ def test_pearson_filter_keeps_informative_columns(rng):
             assert proj.cols[lane, 0] == d - 1  # intercept slot 0
 
 
+def test_pearson_filter_stable_under_large_column_mean(rng):
+    """Centered-moment regression: a hugely offset but informative column
+    must survive the cap (raw-moment varx = Σx² − (Σx)²/n cancels to 0 at
+    mean ~1e8 and would silently drop it)."""
+    n, d = 400, 6
+    ids = np.zeros(n, np.int32)
+    X = rng.normal(size=(n, d)).astype(np.float64)
+    X[:, 2] += 1e8  # informative column on a huge pedestal
+    y = (X[:, 2] - 1e8 > 0).astype(np.float64)
+    b = bkt.build_bucketing(ids, 1)
+    (bucket,) = b.buckets
+    proj = prj.build_bucket_projection(
+        bucket, X, intercept_index=None, labels=y,
+        features_to_samples_ratio=2 / n)
+    cols = proj.cols[0]
+    assert 2 in set(cols[cols >= 0].tolist())
+
+
 def test_pearson_filter_cap_respected(rng):
     ds, _ = _sparse_entity_game(rng)
     X = ds.feature_shards["re_userId"]
