@@ -185,31 +185,118 @@ def bench_optimizer_steps(n=1 << 17, d=256):
 
 
 def bench_sparse(n=1 << 17, d=1_000_000, nnz=32):
-    """Criteo-regime sparse gradient step (BASELINE config 5)."""
+    """Criteo-regime sparse gradient step (BASELINE config 5).
+
+    Three layouts of the SAME objective: the ELL gather/scatter pipeline
+    (the multi-chip shard_map path), and the hybrid hot-dense/cold-class
+    layout (ops/hybrid_sparse.py — the single-chip default) in f32 and
+    bf16. The ELL figure documents the XLA random-access wall the hybrid
+    split exists to avoid.
+    """
     import jax
     import jax.numpy as jnp
 
     from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.ops import hybrid_sparse as hs
     from photon_ml_tpu.ops import losses, sparse_aggregators as sagg
 
     batch, _ = sp.synthetic_sparse(n, d, nnz, seed=2)
-    batch = jax.device_put(batch)
-    step = jax.jit(lambda ww, bb: sagg.value_and_gradient(
+    out = {}
+
+    b_dev = jax.device_put(batch)
+    ell_step = jax.jit(lambda ww, bb: sagg.value_and_gradient(
         losses.LOGISTIC, ww, bb))
 
-    def run(iters):
+    def run_ell(iters):
         w = jnp.zeros((d,), jnp.float32)
         t0 = time.perf_counter()
         for _ in range(iters):
-            _, g = step(w, batch)
+            _, g = ell_step(w, b_dev)
             w = w - 1e-9 * g
         np.asarray(w[:8])
         return time.perf_counter() - t0
 
-    dt = _slope(run, 3, 23)
+    dt_ell = _slope(run_ell, 3, 23)
+    out["sparse_ell_samples_per_sec"] = round(n / dt_ell)
+
+    hyb_step = jax.jit(lambda ww, hb: hs.value_and_gradient(
+        losses.LOGISTIC, ww, hb))
+    for name, dtype in (("", jnp.float32), ("bf16_", jnp.bfloat16)):
+        t0 = time.perf_counter()
+        hb = hs.build_hybrid(batch, feature_dtype=dtype)
+        if not name:
+            out["sparse_hybrid_staging_seconds"] = round(
+                time.perf_counter() - t0, 2)
+            out["sparse_hybrid_hot_cols"] = hb.num_hot
+
+        def run_hyb(iters, _hb=hb):
+            w = jnp.zeros((d,), jnp.float32)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _, g = hyb_step(w, _hb)
+                w = w - 1e-9 * g
+            np.asarray(w[:8])
+            return time.perf_counter() - t0
+
+        dt = _slope(run_hyb, 3, 23)
+        out[f"sparse_{name}samples_per_sec"] = n / dt
+        out[f"sparse_{name}gnnz_per_sec"] = n * nnz / dt / 1e9
+    return out
+
+
+def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
+                               nnz=8):
+    """Sparse random-effect fit at large d (SURVEY §2.1 sparse RE): staging
+    time (COO → per-entity subspace buckets, never densifying (n, d)) and
+    the steady-state per-train_model time."""
+    from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, num_entities, n).astype(np.int32)
+    pools = rng.integers(0, d, (num_entities, 64)).astype(np.int32)
+    idx = np.sort(pools[ids[:, None], rng.integers(0, 64, (n, nnz))],
+                  axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    idx[dup] = d
+    vals[dup] = 0.0
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    ds = GameDataset(
+        response=y, offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"re": SparseShard(idx, vals, d)},
+        entity_ids={"userId": ids}, num_entities={"userId": num_entities},
+        intercept_index={})
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=15, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    t0 = time.perf_counter()
+    coord = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
+                                   cfg, make_mesh())
+    staging = time.perf_counter() - t0
+    off = np.zeros(n, np.float32)
+
+    def run(iters):
+        t0 = time.perf_counter()
+        model = None
+        for _ in range(iters):
+            model = coord.train_model(off, initial=model)
+        np.asarray(model.means[:1])
+        return time.perf_counter() - t0
+
+    dt = _slope(run, 1, 4)
     return {
-        "sparse_samples_per_sec": n / dt,
-        "sparse_gnnz_per_sec": n * nnz / dt / 1e9,
+        "sparse_re_staging_seconds": round(staging, 2),
+        "sparse_re_fit_seconds": round(dt, 3),
+        "sparse_re_config": f"n={n} d={d} entities={num_entities}",
     }
 
 
@@ -340,6 +427,8 @@ def main():
     opt = bench_optimizer_steps()
     _progress("sparse 1M-feature step")
     sparse = bench_sparse()
+    _progress("sparse random effect")
+    sparse_re = bench_sparse_random_effect()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
     _progress("avro ingestion")
@@ -363,6 +452,14 @@ def main():
             "sparse_1m_feature_samples_per_sec": round(
                 sparse["sparse_samples_per_sec"]),
             "sparse_gnnz_per_sec": round(sparse["sparse_gnnz_per_sec"], 3),
+            "sparse_bf16_samples_per_sec": round(
+                sparse["sparse_bf16_samples_per_sec"]),
+            "sparse_ell_samples_per_sec":
+                sparse["sparse_ell_samples_per_sec"],
+            "sparse_hybrid_hot_cols": sparse["sparse_hybrid_hot_cols"],
+            "sparse_hybrid_staging_seconds":
+                sparse["sparse_hybrid_staging_seconds"],
+            **sparse_re,
             **{key: round(v, 1) for key, v in scatter.items()},
             **ingest,
             "game_cd_iteration_seconds": round(game_iter_s, 3),

@@ -29,15 +29,22 @@ class FixedEffectDataConfiguration:
     coefficient dimension over the mesh's ``model`` axis (P3, the Criteo
     regime where the feature space is too large to replicate).
 
-    ``feature_dtype``: on-device storage dtype for DENSE shards.
-    ``"bfloat16"`` halves HBM traffic on the bandwidth-bound GLM hot loop
-    (margins/gradients accumulate in f32 on the MXU); optimizer state and
-    coefficients stay f32. Expect coefficient deltas ~1e-2 relative —
-    opt in when throughput matters more than the last two digits."""
+    ``feature_dtype``: on-device storage dtype for DENSE shards and for
+    the hybrid layout's hot block on sparse shards. ``"bfloat16"`` halves
+    HBM traffic on the bandwidth-bound GLM hot loop (margins/gradients
+    accumulate in f32 on the MXU); optimizer state and coefficients stay
+    f32. Expect coefficient deltas ~1e-2 relative — opt in when
+    throughput matters more than the last two digits.
+
+    ``hybrid`` (sparse shards only): the hot-dense / cold-class layout of
+    ops/hybrid_sparse.py. ``None`` = automatic (on when the mesh has a
+    single data shard and the shard is not feature_sharded); True/False
+    force it."""
 
     feature_shard_id: str
     feature_sharded: bool = False
     feature_dtype: str = "float32"
+    hybrid: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -98,7 +98,9 @@ class GameEstimator:
                     coords[cid] = SparseFixedEffectCoordinate(
                         dataset, cc.data.feature_shard_id, self.loss, opt,
                         self.mesh,
-                        feature_sharded=cc.data.feature_sharded)
+                        feature_sharded=cc.data.feature_sharded,
+                        hybrid=cc.data.hybrid,
+                        feature_dtype=cc.data.feature_dtype)
                     continue
                 coords[cid] = FixedEffectCoordinate(
                     dataset, cc.data.feature_shard_id, self.loss, opt,

@@ -272,11 +272,18 @@ def run(args) -> dict:
     for spec in args.coordinate:
         name, kv = parse_coordinate(spec)
         if kv["type"] == "fixed":
+            hybrid_kv = kv.get("hybrid", "auto").lower()
+            if hybrid_kv not in ("auto", "true", "false"):
+                raise ValueError(
+                    f"hybrid= must be auto, true, or false "
+                    f"(got {hybrid_kv!r})")
             data = FixedEffectDataConfiguration(
                 kv["shard"],
                 feature_sharded=kv.get("feature_sharded",
                                        "false").lower() == "true",
-                feature_dtype=kv.get("dtype", "float32"))
+                feature_dtype=kv.get("dtype", "float32"),
+                hybrid=(None if hybrid_kv == "auto"
+                        else hybrid_kv == "true"))
         elif kv["type"] == "random":
             data = RandomEffectDataConfiguration(
                 random_effect_type=kv["re"],

@@ -83,12 +83,13 @@ class RandomEffectModel:
         # reference's passive/unseen-entity semantics (fixed effect only).
         safe = jnp.minimum(ids, self.means.shape[0] - 1)
         if isinstance(shard, SparseShard):
-            # Element gather through the zero-padded (E, d+1) table: the
-            # ELL sentinel column (== d) lands on the pad column.
-            W_pad = jnp.pad(jnp.asarray(self.means), ((0, 0), (0, 1)))
+            # ELL padding slots carry value 0 by contract, so clamping
+            # their sentinel index (== d) into range is exact — no
+            # (E, d+1) padded copy of the table.
+            W = jnp.asarray(self.means)
+            idx = jnp.minimum(jnp.asarray(shard.indices), W.shape[1] - 1)
             contrib = jnp.sum(
-                jnp.asarray(shard.values)
-                * W_pad[safe[:, None], jnp.asarray(shard.indices)], axis=-1)
+                jnp.asarray(shard.values) * W[safe[:, None], idx], axis=-1)
         else:
             contrib = jnp.einsum("nd,nd->n", jnp.asarray(shard),
                                  self.means[safe])

@@ -1,0 +1,358 @@
+"""Hybrid hot-dense / cold-class sparse GLM aggregates (the Criteo path).
+
+Reference parity: the same ``ValueAndGradientAggregator`` /
+``HessianVectorAggregator`` contracts as ops/sparse_aggregators.py — but
+restructured around how a TPU actually moves data.
+
+Why: measured on one v5e chip, XLA's random 4M-element gather runs at
+~0.14 Gelem/s and its scatter-add at ~0.16 G-updates/s, and a Mosaic
+(8, 128)-window vector shuffle tops out at ~0.84 Gelem/s — so ANY exact
+ELL step at d=1e6 pays two ~26 ms random crossings (expand w→entries,
+reduce entries→gradient) and lands near 60 ms regardless of formulation
+(plain scatter, pre-sorted segment-sum, one-hot matmul tiles, and
+butterfly-routed permutations all measured within 1.1× of each other;
+see docs/PARITY.md "sparse wall" notes). The only real lever is moving
+fewer elements through the random path.
+
+CTR feature spaces are Zipf-distributed: on the benchmark's zipf(1.3)
+synthetic, the hottest ~1–2k of 1M columns carry ~85% of all nonzeros.
+The hybrid split exploits that:
+
+- **Hot columns** (count ≥ ``hot_threshold``, at most ``max_hot``) are
+  densified into an (n, k) matrix: margins and gradient contributions are
+  plain MXU matmuls (X_hot @ w, X_hotᵀ r) — the 85% of entries ride the
+  365 M-samples/s dense path, with the multiply-by-zero waste costing
+  bandwidth, not random access.
+- **Cold columns** are relabeled into count-descending order (a static
+  permutation of the feature space — the GLM objective is permutation-
+  equivariant, so the solve happens in permuted space and maps back once
+  per fit) and their entries stored column-contiguous in power-of-two
+  count classes, padded (C, L) blocks:
+  * margins: w broadcast per column (NO gather — columns are contiguous
+    slices), one scatter-add of products by row — the only remaining
+    crossing, now ~15% of the volume;
+  * gradient: one gather r[rowids] (second crossing, same reduced
+    volume), then padded row-sums per class and CONTIGUOUS writes into
+    the permuted gradient — no scatter at all.
+
+Pad slots carry rowid == n (a zero sentinel lane) and value 0, so they
+are inert in every pass without masks. All layout arrays are static
+(computed once at staging from the CSR/ELL structure); per optimizer
+iteration only w changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.sparse import SparseBatch
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HybridSparseBatch:
+    """Hot-dense + cold-class layout of one sparse example batch.
+
+    The feature space is PERMUTED: new column order is
+    [hot columns (count desc) | cold present columns (count desc) |
+    absent columns]. ``perm`` maps new → original column ids;
+    ``inv_perm`` maps original → new. Coefficient vectors seen by the
+    ops here live in the permuted space.
+    """
+
+    X_hot: Array  # (n, k) dense hot block (k may be 0)
+    cold_rowids: tuple[Array, ...]  # per class: (C, L) int32, pad == n
+    cold_vals: tuple[Array, ...]  # per class: (C, L) f32, pad == 0
+    labels: Array  # (n,)
+    weights: Array  # (n,)
+    offsets: Array  # (n,)
+    perm: Array  # (d,) int32: new col -> original col
+    inv_perm: Array  # (d,) int32: original col -> new col
+    num_features: int = dataclasses.field(metadata=dict(static=True))
+    num_hot: int = dataclasses.field(metadata=dict(static=True))
+    # Per class: first permuted column id (hot block excluded) and count.
+    class_starts: tuple[int, ...] = dataclasses.field(
+        metadata=dict(static=True))
+
+    @property
+    def num_rows(self) -> int:
+        return self.X_hot.shape[0] if self.num_hot else self.labels.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.num_features
+
+    @property
+    def num_cold_present(self) -> int:
+        return sum(int(r.shape[0]) for r in self.cold_rowids)
+
+
+def build_hybrid(
+    batch: SparseBatch,
+    hot_threshold: Optional[int] = None,
+    max_hot: int = 4096,
+    feature_dtype=jnp.float32,
+) -> HybridSparseBatch:
+    """Stage an ELL SparseBatch into the hybrid layout (host-side, once).
+
+    ``hot_threshold``: columns with at least this many nonzeros densify
+    (default: max(8, n/4096) — measured optimum on the zipf(1.3) bench
+    config, where it covers ~90% of nonzeros at ~3k hot columns; the
+    dense block's bandwidth cost crosses the cold path's random-access
+    saving beyond that). ``max_hot`` caps the dense block's memory
+    (4096 f32 columns at n=131072 is ~2 GB HBM).
+    """
+    indices = np.asarray(batch.indices)
+    values = np.asarray(batch.values)
+    n = indices.shape[0]
+    d = int(batch.num_features)
+    if hot_threshold is None:
+        hot_threshold = max(8, n // 4096)
+
+    flat_col = indices.reshape(-1)
+    flat_row = np.repeat(np.arange(n, dtype=np.int32),
+                         indices.shape[1])
+    flat_val = values.reshape(-1)
+    live = (flat_col < d) & (flat_val != 0.0)
+    counts = np.bincount(flat_col[live], minlength=d)
+
+    # Permuted order: count-descending (stable → ties break on column id).
+    order_desc = np.argsort(-counts, kind="stable").astype(np.int32)
+    num_hot = int(min(max_hot, (counts >= hot_threshold).sum()))
+    k = num_hot
+
+    inv_perm = np.empty(d, np.int32)
+    inv_perm[order_desc] = np.arange(d, dtype=np.int32)
+
+    # Hot block: dense (n, k) via one scatter into the new column ids.
+    X_hot = np.zeros((n, max(k, 1)), np.float32)
+    new_col = inv_perm[np.minimum(flat_col, d - 1)]
+    hot_sel = live & (new_col < k)
+    if k:
+        X_hot[flat_row[hot_sel], new_col[hot_sel]] = flat_val[hot_sel]
+    X_hot = X_hot[:, :k]
+
+    # Cold entries, column-contiguous in permuted order.
+    cold_sel = live & (new_col >= k)
+    c_new = new_col[cold_sel] - k
+    c_row = flat_row[cold_sel]
+    c_val = flat_val[cold_sel]
+    order = np.argsort(c_new, kind="stable")
+    c_new, c_row, c_val = c_new[order], c_row[order], c_val[order]
+    cold_counts = counts[order_desc][k:]  # descending
+    present = int((cold_counts > 0).sum())
+    col_start = np.concatenate(
+        [[0], np.cumsum(cold_counts[:present])[:-1]]).astype(np.int64)
+
+    # Power-of-two count classes over the present cold columns; counts are
+    # descending, so each class is one contiguous slice of columns.
+    rowids_cls: list[np.ndarray] = []
+    vals_cls: list[np.ndarray] = []
+    class_starts: list[int] = []
+    if present:
+        # Counts are descending, so equal-class columns are contiguous and
+        # padding is < 2x within each power-of-two class.
+        cls = np.ceil(np.log2(np.maximum(
+            cold_counts[:present], 1))).astype(np.int64)
+        # Descending class order == the permuted column layout, so the
+        # per-class gradient slices concatenate back in place.
+        for kk in np.unique(cls)[::-1]:
+            sel = np.flatnonzero(cls == kk)
+            L = 1 << int(kk)
+            C = sel.size
+            rp = np.full((C, L), n, np.int32)
+            vp = np.zeros((C, L), np.float32)
+            # Vectorized fill: position of each entry within its column.
+            starts = col_start[sel]
+            cnts = cold_counts[sel].astype(np.int64)
+            total = int(cnts.sum())
+            colpos = np.arange(total) - np.repeat(
+                np.concatenate([[0], np.cumsum(cnts)[:-1]]), cnts)
+            src = np.repeat(starts, cnts) + colpos
+            crow = np.repeat(np.arange(C, dtype=np.int64), cnts)
+            rp[crow, colpos] = c_row[src]
+            vp[crow, colpos] = c_val[src]
+            rowids_cls.append(rp)
+            vals_cls.append(vp)
+            class_starts.append(int(sel[0]))
+
+    if feature_dtype == jnp.bfloat16:
+        # Cast on host: halves the host→device transfer (which dominates
+        # staging when the device sits behind a network tunnel).
+        import ml_dtypes
+
+        X_hot = X_hot.astype(ml_dtypes.bfloat16)
+    return HybridSparseBatch(
+        X_hot=jnp.asarray(X_hot).astype(feature_dtype),
+        cold_rowids=tuple(jnp.asarray(a) for a in rowids_cls),
+        cold_vals=tuple(jnp.asarray(a) for a in vals_cls),
+        labels=jnp.asarray(np.asarray(batch.labels)),
+        weights=jnp.asarray(np.asarray(batch.weights)),
+        offsets=jnp.asarray(np.asarray(batch.offsets)),
+        perm=jnp.asarray(order_desc),
+        inv_perm=jnp.asarray(inv_perm),
+        num_features=d,
+        num_hot=k,
+        class_starts=tuple(class_starts),
+    )
+
+
+def to_permuted_space(hb: HybridSparseBatch, w: Array) -> Array:
+    """Original-space (d,) vector → permuted space (once per fit)."""
+    return w[hb.perm]
+
+
+def to_original_space(hb: HybridSparseBatch, w_perm: Array) -> Array:
+    """Permuted-space (d,) vector → original space (once per fit)."""
+    return w_perm[hb.inv_perm]
+
+
+def _hot_matvec(X: Array, w: Array) -> Array:
+    """X_hot @ w with f32 MXU accumulation under bf16 storage (same
+    contract as ops/aggregators._matvec)."""
+    if X.dtype == jnp.bfloat16:
+        return jnp.einsum("nd,d->n", X, w.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return X @ w
+
+
+def _hot_rmatvec(X: Array, r: Array) -> Array:
+    if X.dtype == jnp.bfloat16:
+        return jnp.einsum("n,nd->d", r.astype(jnp.bfloat16), X,
+                          preferred_element_type=jnp.float32)
+    return r @ X
+
+
+def _cold_products(hb: HybridSparseBatch, w_perm: Array,
+                   cold_vals: tuple[Array, ...]) -> Array:
+    """Flat per-entry w[col]·value products over all classes.
+
+    Column coefficients arrive by contiguous SLICE broadcast (no gather):
+    each class's columns are one run of the permuted space.
+    """
+    parts = []
+    for start, rows, vals in zip(hb.class_starts, hb.cold_rowids,
+                                 cold_vals):
+        C = rows.shape[0]
+        w_c = w_perm[hb.num_hot + start: hb.num_hot + start + C]
+        parts.append((w_c[:, None] * vals).reshape(-1))
+    return jnp.concatenate(parts)
+
+
+def _cold_flat_rowids(hb: HybridSparseBatch) -> Array:
+    return jnp.concatenate([r.reshape(-1) for r in hb.cold_rowids])
+
+
+def margins(hb: HybridSparseBatch, w_perm: Array) -> Array:
+    """(n,) wᵀx + offset. Hot: one MXU matvec. Cold: contiguous-slice
+    broadcast products + ONE fused scatter-add by row (the only random
+    crossing in this direction)."""
+    n = hb.labels.shape[0]
+    z = hb.offsets
+    if hb.num_hot:
+        z = z + _hot_matvec(hb.X_hot, w_perm[:hb.num_hot])
+    if hb.cold_rowids:
+        prods = _cold_products(hb, w_perm, hb.cold_vals)
+        acc = jnp.zeros((n + 1,), jnp.float32).at[
+            _cold_flat_rowids(hb)].add(prods)
+        z = z + acc[:n]
+    return z
+
+
+def _masked(weights: Array, term: Array) -> Array:
+    return jnp.where(weights > 0.0, weights * term, 0.0)
+
+
+def _cold_grad(hb: HybridSparseBatch, r: Array,
+               cold_vals: tuple[Array, ...]) -> list[Array]:
+    """Per class, (C,) gradient slice: ONE fused gather r[rowids] (the
+    second random crossing), then padded row-sums and contiguous writes."""
+    if not hb.cold_rowids:
+        return []
+    r_pad = jnp.concatenate([r, jnp.zeros((1,), r.dtype)])
+    gathered = r_pad[_cold_flat_rowids(hb)]
+    out = []
+    off = 0
+    for rows, vals in zip(hb.cold_rowids, cold_vals):
+        C, L = rows.shape
+        ru = gathered[off: off + C * L].reshape(C, L)
+        out.append(jnp.sum(ru * vals, axis=1))
+        off += C * L
+    return out
+
+
+def _assemble_grad(hb: HybridSparseBatch, g_hot: Optional[Array],
+                   g_cold: list[Array]) -> Array:
+    parts = []
+    if hb.num_hot:
+        parts.append(g_hot.astype(jnp.float32))
+    parts.extend(g_cold)
+    if not parts:
+        return jnp.zeros((hb.num_features,), jnp.float32)
+    dense = jnp.concatenate(parts)
+    d = hb.num_features
+    if dense.shape[0] == d:
+        return dense
+    # Absent (zero-count) columns sit at the permuted tail: gradient 0.
+    return jnp.zeros((d,), jnp.float32).at[:dense.shape[0]].set(dense)
+
+
+def _rowterm_gradient(hb: HybridSparseBatch, r: Array) -> Array:
+    """Σ_i r_i·x_i in PERMUTED space: hot matvec + cold class sums."""
+    g_hot = None
+    if hb.num_hot:
+        g_hot = _hot_rmatvec(hb.X_hot, r)
+    return _assemble_grad(hb, g_hot, _cold_grad(hb, r, hb.cold_vals))
+
+
+def value_and_gradient(
+    loss: PointwiseLoss,
+    w_perm: Array,
+    hb: HybridSparseBatch,
+) -> tuple[Array, Array]:
+    """(Σ w·l, Σ w·dl·x) in permuted space — the fused hot/cold pass."""
+    z = margins(hb, w_perm)
+    l, dl = loss.loss_and_dz(z, hb.labels)
+    value = jnp.sum(_masked(hb.weights, l), axis=-1)
+    r = _masked(hb.weights, dl)
+    return value, _rowterm_gradient(hb, r)
+
+
+def hessian_vector(
+    loss: PointwiseLoss,
+    w_perm: Array,
+    v_perm: Array,
+    hb: HybridSparseBatch,
+) -> Array:
+    """Σ w·d2l·(x·v)·x in permuted space (TRON's H·v)."""
+    z = margins(hb, w_perm)
+    xv = margins(hb, v_perm) - hb.offsets
+    d2 = loss.d2z(z, hb.labels)
+    r = _masked(hb.weights, d2) * xv
+    return _rowterm_gradient(hb, r)
+
+
+def hessian_diagonal(
+    loss: PointwiseLoss,
+    w_perm: Array,
+    hb: HybridSparseBatch,
+) -> Array:
+    """diag(H) = Σ w·d2l·x² in permuted space (SIMPLE variances)."""
+    z = margins(hb, w_perm)
+    d2 = loss.d2z(z, hb.labels)
+    r = _masked(hb.weights, d2)
+    g_hot = None
+    if hb.num_hot:
+        # Squares upcast to f32: x² underflows/quantizes harshly in bf16.
+        Xsq = hb.X_hot.astype(jnp.float32) ** 2
+        g_hot = r @ Xsq
+    return _assemble_grad(
+        hb, g_hot, _cold_grad(hb, r, tuple(v * v for v in hb.cold_vals)))

@@ -67,6 +67,62 @@ def _pad_features(batch: SparseBatch, d_pad: int) -> SparseBatch:
         weights=batch.weights, offsets=batch.offsets, num_features=d_pad)
 
 
+def run_hybrid(
+    loss: PointwiseLoss,
+    hb,
+    config: GLMOptimizationConfiguration,
+    initial: Optional[Coefficients] = None,
+    intercept_index_permuted: Optional[int] = None,
+) -> tuple[Coefficients, OptResult]:
+    """Fit one GLM over a HybridSparseBatch (ops/hybrid_sparse.py) —
+    the single-device Criteo fast path.
+
+    The whole solve runs in the hybrid layout's PERMUTED feature space
+    (count-descending relabeling): the L2 fold, L1 weights, intercept
+    mask, optimizer state, and variance diagonal all live there, and only
+    the returned Coefficients are mapped back. L2/L1 are permutation-
+    equivariant, so this is exact. ``intercept_index_permuted`` is the
+    intercept's PERMUTED column (callers map it once at staging).
+    """
+    from photon_ml_tpu.ops import hybrid_sparse as hybrid
+
+    dim = hb.num_features
+    mask = jnp.asarray(intercept_mask(dim, intercept_index_permuted))
+    reg = config.regularization
+    l2 = reg.l2_weight()
+
+    vg = with_l2(
+        lambda w: hybrid.value_and_gradient(loss, w, hb), l2, mask)
+    hvp = with_l2_hvp(
+        lambda w, v: hybrid.hessian_vector(loss, w, v, hb), l2, mask)
+
+    l1 = reg.l1_weight()
+    l1w = (jnp.asarray(l1 * intercept_mask(dim, intercept_index_permuted))
+           if l1 > 0.0 else None)
+    opt_cfg = resolve_optimizer_config(config.optimizer, l1w is not None)
+
+    if initial is not None:
+        w0 = hybrid.to_permuted_space(hb, jnp.asarray(initial.means))
+    else:
+        w0 = jnp.zeros((dim,), jnp.float32)
+
+    result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+
+    variances = None
+    kind = VarianceComputationType(config.variance_computation)
+    if kind == VarianceComputationType.SIMPLE:
+        diag = hybrid.hessian_diagonal(loss, result.w, hb)
+        variances = hybrid.to_original_space(
+            hb, variances_from_diagonal(diag, l2, mask))
+    elif kind == VarianceComputationType.FULL:
+        raise NotImplementedError(
+            "FULL variance needs the dense d×d Hessian — not available at "
+            "sparse/Criteo scale (use SIMPLE, as the reference does)")
+
+    means = hybrid.to_original_space(hb, result.w)
+    return Coefficients(means=means, variances=variances), result
+
+
 def run(
     loss: PointwiseLoss,
     batch: SparseBatch,
@@ -100,8 +156,9 @@ def run(
 
     l1 = reg.l1_weight()
     if l1 > 0.0:
+        # Host-built (jit-safe: no device array ever crosses back to np).
         l1w = np.zeros(d_pad, np.float32)
-        l1w[:dim] = np.asarray(l1_weights_vector(l1, dim, intercept_index))
+        l1w[:dim] = l1 * intercept_mask(dim, intercept_index)
         l1w = jnp.asarray(l1w)
     else:
         l1w = None

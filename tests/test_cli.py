@@ -163,6 +163,54 @@ def test_game_train_sparse_shard(rng, tmp_path):
     assert summary["best_metrics"]["AUC"] > 0.75
 
 
+def test_game_train_sparse_random_effect(rng, tmp_path):
+    """Sparse (ELL) shard as a RANDOM effect through the CLI — the driver
+    path for large-d per-entity feature spaces (never densified)."""
+    from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+
+    n, d, E, nnz = 1600, 512, 20, 4
+    ids = rng.integers(0, E, n).astype(np.int32)
+    idx = np.sort(rng.integers(0, d, (n, nnz)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    idx[dup] = d
+    vals[dup] = 0.0
+    W = rng.normal(size=(E, d)).astype(np.float32)
+    margin = np.einsum(
+        "nk,nk->n", vals,
+        np.where(idx < d, W[ids[:, None], np.minimum(idx, d - 1)], 0.0))
+    y = (rng.random(n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    ds = GameDataset(
+        response=y, offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"re_userId": SparseShard(idx, vals, d)},
+        entity_ids={"userId": ids}, num_entities={"userId": E},
+        intercept_index={})
+    train_dir = str(tmp_path / "train")
+    save_game_dataset(ds, train_dir)
+    out = str(tmp_path / "out")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", train_dir,
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId",
+        "--update-sequence", "per-user",
+        "--evaluators", "AUC",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out,
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.8
+    # The saved model scores through game_score against the sparse shard.
+    score_out = str(tmp_path / "scores")
+    game_score.run(game_score.build_parser().parse_args([
+        "--data", train_dir, "--model-dir", os.path.join(out, "best"),
+        "--output-dir", score_out, "--evaluators", "AUC",
+    ]))
+    score_summary = json.loads(
+        open(os.path.join(score_out, "summary.json")).read())
+    assert score_summary["metrics"]["AUC"] > 0.8
+
+
 # -- tuning mode (VERDICT round-1 item 9) ----------------------------------
 
 @pytest.mark.parametrize("mode", ["RANDOM", "BAYESIAN"])

@@ -12,6 +12,7 @@ Coverage:
 
 import dataclasses
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -292,6 +293,175 @@ def test_sparse_random_effect_through_estimator(mesh):
                         mesh, validation_evaluators=["AUC"])
     results = est.fit(sparse_ds, validation_data=sparse_ds)
     assert results[0].evaluation.metrics["AUC"] > 0.75
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    """Single-device mesh: the hybrid fast path's regime."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                ("data", "model"))
+
+
+def _intercepted(batch):
+    """Append an all-ones intercept column (id = d) to an ELL batch."""
+    d = batch.num_features
+    idx = np.concatenate(
+        [np.asarray(batch.indices),
+         np.full((batch.num_rows, 1), d, np.int32)], axis=1)
+    vals = np.concatenate(
+        [np.asarray(batch.values),
+         np.ones((batch.num_rows, 1), np.float32)], axis=1)
+    return dataclasses.replace(batch, indices=idx, values=vals,
+                               num_features=d + 1)
+
+
+def _ell_objective(batch, w, l2=0.0, l1=0.0, intercept=None,
+                   weights=None):
+    """Reference regularized objective evaluated through the ELL ops
+    (both layouts must minimize this same function)."""
+    from photon_ml_tpu.ops import sparse_aggregators as sagg
+
+    b = batch if weights is None else dataclasses.replace(
+        batch, weights=weights)
+    v, _ = sagg.value_and_gradient(losses.LOGISTIC, jnp.asarray(w), b)
+    mask = np.ones(len(w), np.float32)
+    if intercept is not None:
+        mask[intercept] = 0.0
+    return (float(v) + 0.5 * l2 * float(np.sum((w * mask) ** 2))
+            + l1 * float(np.sum(np.abs(w * mask))))
+
+
+def test_hybrid_coordinate_matches_ell(mesh1):
+    """The hybrid hot/cold layout minimizes the SAME objective as the ELL
+    pipeline (values equal at both solutions; coefficients agree up to
+    optimizer path sensitivity) and the SIMPLE variance computation is
+    exact at a shared model."""
+    batch, _ = sp.synthetic_sparse(2048, 256, 8, seed=4)  # zipf head
+    batch = _intercepted(batch)
+    ds = from_sparse_batch(batch)
+    ds = dataclasses.replace(ds, intercept_index={"global": 256})
+    cfg = dataclasses.replace(
+        _opt(), variance_computation=VarianceComputationType.SIMPLE)
+    ell = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh1, hybrid=False)
+    hyb = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh1)
+    assert hyb.hybrid and not ell.hybrid
+    off = np.zeros(batch.num_rows, np.float32)
+    m_ell = ell.train_model(off)
+    m_hyb = hyb.train_model(off)
+    w_e = np.asarray(m_ell.coefficients.means)
+    w_h = np.asarray(m_hyb.coefficients.means)
+    f_e = _ell_objective(batch, w_e, l2=1.0, intercept=256)
+    f_h = _ell_objective(batch, w_h, l2=1.0, intercept=256)
+    assert abs(f_e - f_h) < 1e-5 * abs(f_e), (f_e, f_h)
+    np.testing.assert_allclose(w_h, w_e, rtol=0.1, atol=1e-3)
+    # Scores at the SAME model agree exactly (scoring-path equivalence).
+    np.testing.assert_allclose(np.asarray(hyb.score(m_ell)),
+                               np.asarray(ell.score(m_ell)),
+                               rtol=1e-4, atol=1e-4)
+    # Variances at the SAME model: exact path equivalence.
+    v_ell = ell.compute_model_variances(m_ell, off)
+    v_hyb = hyb.compute_model_variances(m_ell, off)
+    np.testing.assert_allclose(
+        np.asarray(v_hyb.coefficients.variances),
+        np.asarray(v_ell.coefficients.variances), rtol=1e-4, atol=1e-7)
+
+
+def test_hybrid_matches_ell_owlqn_l1(mesh1):
+    """L1/OWL-QN in the permuted space: the intercept's exemption follows
+    the permutation and both layouts reach the same L1 objective."""
+    from photon_ml_tpu.optim import OptimizerType
+
+    batch, _ = sp.synthetic_sparse(1024, 128, 6, seed=6)
+    batch = _intercepted(batch)
+    ds = from_sparse_batch(batch)
+    ds = dataclasses.replace(ds, intercept_index={"global": 128})
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.OWLQN,
+                                  max_iterations=80, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L1, 0.5))
+    off = np.zeros(batch.num_rows, np.float32)
+    w_ell = np.asarray(SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh1,
+        hybrid=False).train_model(off).coefficients.means)
+    w_hyb = np.asarray(SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh1,
+        hybrid=True).train_model(off).coefficients.means)
+    f_e = _ell_objective(batch, w_ell, l1=0.5, intercept=128)
+    f_h = _ell_objective(batch, w_hyb, l1=0.5, intercept=128)
+    assert abs(f_e - f_h) < 1e-4 * abs(f_e), (f_e, f_h)
+    # L1 actually sparsified (sanity that the orthant path ran).
+    assert (np.abs(w_hyb) < 1e-8).sum() > 0
+
+
+def test_hybrid_down_sampling_matches_ell(mesh1):
+    """Weight-masked down-sampling == the ELL path's row-gathered subsets
+    (same seed ⇒ same draws ⇒ identical subsampled objective)."""
+    batch, _ = sp.synthetic_sparse(2048, 64, 6, seed=7)
+    ds = from_sparse_batch(batch)
+    cfg = dataclasses.replace(_opt(), down_sampling_rate=0.5)
+    off = np.zeros(batch.num_rows, np.float32)
+    coords = {
+        name: SparseFixedEffectCoordinate(
+            ds, "global", losses.LOGISTIC, cfg, mesh1, hybrid=h,
+            down_sampling_seed=9)
+        for name, h in (("ell", False), ("hyb", True))}
+    w = {k: np.asarray(c.train_model(off).coefficients.means)
+         for k, c in coords.items()}
+    # Reconstruct the draw both coordinates made (same seed, same order).
+    from photon_ml_tpu.game.sampling import binary_classification_down_sample
+    idx, mult = binary_classification_down_sample(
+        np.random.default_rng(9), ds.response, 0.5)
+    w_mask = np.zeros(ds.num_rows, np.float32)
+    w_mask[idx] = np.asarray(ds.weights)[idx] * np.asarray(mult)
+    f_e = _ell_objective(batch, w["ell"], l2=1.0, weights=jnp.asarray(w_mask))
+    f_h = _ell_objective(batch, w["hyb"], l2=1.0, weights=jnp.asarray(w_mask))
+    assert abs(f_e - f_h) < 1e-5 * abs(f_e), (f_e, f_h)
+
+
+def test_hybrid_auto_selection(mesh, mesh1):
+    """auto: on for single-data-shard meshes, off (ELL shard_map) when the
+    data axis is sharded; explicit True on a sharded mesh is rejected."""
+    batch, _ = _sparse_data(n=256, d=32)
+    ds = from_sparse_batch(batch)
+    assert SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, _opt(), mesh1).hybrid
+    assert not SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, _opt(), mesh).hybrid
+    with pytest.raises(ValueError, match="single-data-shard"):
+        SparseFixedEffectCoordinate(
+            ds, "global", losses.LOGISTIC, _opt(), mesh, hybrid=True)
+    with pytest.raises(ValueError, match="feature_sharded"):
+        SparseFixedEffectCoordinate(
+            ds, "global", losses.LOGISTIC, _opt(), mesh1,
+            feature_sharded=True, hybrid=True)
+
+
+def test_hybrid_layout_roundtrip():
+    """build_hybrid partitions every nonzero exactly once and the permuted
+    margins/gradient match a dense reference."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import hybrid_sparse as hs
+
+    rng = np.random.default_rng(11)
+    batch, _ = sp.synthetic_sparse(512, 96, 5, seed=11)
+    hb = hs.build_hybrid(batch, hot_threshold=20)
+    X = _densify(batch)
+    w = rng.normal(size=96).astype(np.float32)
+    wp = hs.to_permuted_space(hb, jnp.asarray(w))
+    np.testing.assert_allclose(
+        np.asarray(hs.to_original_space(hb, wp)), w, rtol=0, atol=0)
+    z = np.asarray(hs.margins(hb, wp))
+    np.testing.assert_allclose(z, X @ w, rtol=1e-4, atol=1e-4)
+    r = rng.normal(size=512).astype(np.float32)
+    from photon_ml_tpu.ops.hybrid_sparse import _rowterm_gradient
+    g = np.asarray(hs.to_original_space(hb, _rowterm_gradient(hb, jnp.asarray(r))))
+    np.testing.assert_allclose(g, r @ X, rtol=1e-3, atol=1e-3)
 
 
 def test_pallas_scatter_matches_xla():
