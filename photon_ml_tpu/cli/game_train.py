@@ -359,6 +359,8 @@ def run(args) -> dict:
             # Fresh run: stale checkpoints must not silently short-circuit
             # training (resume is an explicit opt-in).
             import shutil
+            logger.info("fresh run: removing stale checkpoints at %s",
+                        checkpoint_dir)
             shutil.rmtree(checkpoint_dir)
         if jax.process_count() > 1:
             # All ranks load checkpoints inside fit; none may read before

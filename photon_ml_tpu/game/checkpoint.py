@@ -119,6 +119,8 @@ class CheckpointManager:
             }, f, indent=2)
         os.replace(tmp, os.path.join(self.directory, _STATE))
         self._full_snapshot_written = True
+        logger.info("checkpoint committed: %d step(s) -> %s", done_steps,
+                    self.directory)
 
     # -- read --------------------------------------------------------------
 

@@ -1,0 +1,226 @@
+"""Two-process multi-host integration: the DCN seam carrying real traffic.
+
+Reference parity: photon-test-utils ``SparkTestUtils.scala`` runs the REAL
+distributed code paths in local mode (SURVEY §4); this extends that
+discipline to the process dimension — two OS processes, four virtual CPU
+devices each, joined by ``jax.distributed.initialize`` on a localhost
+coordinator into one 8-device world. Everything the multi-host story
+claims is asserted against actual execution:
+
+- both ranks see 8 global / 4 local devices and finish rank-consistent
+  (identical best-model metrics from the same SPMD programs);
+- only rank 0 writes shared artifacts (model dir, summary, checkpoints);
+- a killed run restarts with ``--resume`` and completes from the
+  checkpoint (the lineage-free recovery model of parallel/mesh.py).
+
+These tests spawn subprocesses with their own JAX runtime (the parent's
+backend is irrelevant) and are the slowest in the suite (~1-2 min).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.data.io import save_game_dataset
+
+_WRAPPER = """
+import json, os, sys
+sys.argv = sys.argv[:1] + sys.argv[2:]
+out_dir = sys.argv[sys.argv.index("--output-dir") + 1]
+from photon_ml_tpu.cli import game_train
+summary = game_train.run(game_train.build_parser().parse_args(sys.argv[1:]))
+import jax
+info = {
+    "rank": jax.process_index(),
+    "process_count": jax.process_count(),
+    "global_devices": jax.device_count(),
+    "local_devices": jax.local_device_count(),
+    "metrics": summary["best_metrics"],
+}
+with open(os.path.join(out_dir, f"rankinfo-{jax.process_index()}.json"),
+          "w") as f:
+    json.dump(info, f)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, port: int, wrapper: str, cli_args: list[str],
+           log_path: str) -> subprocess.Popen:
+    """Launch one rank. Output goes to a FILE, never a pipe: XLA's CPU AOT
+    warnings alone overflow a 64 KB pipe buffer, and an undrained pipe
+    blocks the child mid-training (observed as multi-minute stalls)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                        "JAX_PLATFORMS")}
+    repo_root = os.path.dirname(os.path.dirname(__file__))
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+        "JAX_PROCESS_ID": str(rank),
+        "PYTHONPATH": repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""),
+    })
+    log = open(log_path, "w")
+    p = subprocess.Popen(
+        [sys.executable, wrapper, f"rank{rank}"] + cli_args,
+        env=env, cwd=repo_root, stdout=log, stderr=subprocess.STDOUT,
+        text=True)
+    p._log_path = log_path
+    p._log_file = log
+    return p
+
+
+def _log_tail(p: subprocess.Popen, n: int = 500_000) -> str:
+    p._log_file.close()
+    with open(p._log_path) as f:
+        return f.read()[-n:]
+
+
+def _write_inputs(tmp_path):
+    rng = np.random.default_rng(0)
+    syn = synthetic.game_data(rng, n=512, d_global=6,
+                              re_specs={"userId": (8, 3)})
+    ds = from_synthetic(syn)
+    train_dir = str(tmp_path / "train")
+    save_game_dataset(ds, train_dir)
+    wrapper = str(tmp_path / "mp_wrapper.py")
+    with open(wrapper, "w") as f:
+        f.write(_WRAPPER)
+    return train_dir, wrapper
+
+
+def _cli_args(train_dir: str, out: str, iterations: int = 1) -> list[str]:
+    return [
+        "--train", train_dir, "--validation", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", str(iterations),
+        "--evaluators", "AUC",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out,
+        "--distributed",
+    ]
+
+
+def _run_pair(tmp_path, port, wrapper, cli_args, tag="run", timeout=420):
+    procs = [_spawn(r, port, wrapper, cli_args,
+                    str(tmp_path / f"{tag}-rank{r}.log")) for r in (0, 1)]
+    deadline = time.time() + timeout
+    try:
+        for p in procs:
+            p.wait(timeout=max(5.0, deadline - time.time()))
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+            q.wait(timeout=30)
+        pytest.fail("multi-process run timed out; rank logs:\n"
+                    + "\n=== next rank ===\n".join(
+                        _log_tail(q, 3000) for q in procs))
+    return procs, [_log_tail(p) for p in procs]
+
+
+def test_two_process_training_agrees_and_rank0_writes(tmp_path):
+    train_dir, wrapper = _write_inputs(tmp_path)
+    out = str(tmp_path / "out")
+    procs, outs = _run_pair(tmp_path, _free_port(), wrapper,
+                            _cli_args(train_dir, out))
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{o[-4000:]}"
+
+    infos = {}
+    for r in (0, 1):
+        with open(os.path.join(out, f"rankinfo-{r}.json")) as f:
+            infos[r] = json.load(f)
+    for r in (0, 1):
+        assert infos[r]["process_count"] == 2
+        assert infos[r]["global_devices"] == 8
+        assert infos[r]["local_devices"] == 4
+    # Rank agreement: the same SPMD programs must yield the same model.
+    a, b = infos[0]["metrics"]["AUC"], infos[1]["metrics"]["AUC"]
+    assert abs(a - b) < 1e-6, (a, b)
+    assert a > 0.6
+    # Rank-0-only writes: model + summary exist exactly once (the output
+    # dir is the shared filesystem both ranks point at).
+    assert os.path.isdir(os.path.join(out, "best"))
+    assert os.path.exists(os.path.join(out, "summary.json"))
+
+
+def _poll_for(path, procs, timeout=420):
+    """Wait for ``path`` to appear; returns once it exists or when every
+    process has exited (whichever first)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        if all(p.poll() is not None for p in procs):
+            return os.path.exists(path)
+        time.sleep(0.5)
+    return os.path.exists(path)
+
+
+def test_two_process_kill_then_resume(tmp_path):
+    train_dir, wrapper = _write_inputs(tmp_path)
+    out = str(tmp_path / "out")
+    ckpt_state = os.path.join(out, "checkpoints", "grid-0", "state.json")
+    cli = _cli_args(train_dir, out, iterations=3)
+    port = _free_port()
+    procs = [_spawn(r, port, wrapper, cli,
+                    str(tmp_path / f"phase1-rank{r}.log")) for r in (0, 1)]
+    # Wait for the first per-coordinate checkpoint commit, then kill both
+    # ranks hard (the lost-host failure model). On a loaded single-core
+    # host the tiny run may finish before the poll catches it mid-flight —
+    # then the relaunch below still exercises --resume from the completed
+    # checkpoint state (and asserts it was read, not recomputed).
+    landed = _poll_for(ckpt_state, procs)
+    if not landed:
+        pytest.fail("no checkpoint ever landed; rank0 output:\n"
+                    + _log_tail(procs[0], 3000))
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=120)
+    assert os.path.exists(ckpt_state)
+    with open(ckpt_state) as f:
+        state_before = json.load(f)
+
+    # Relaunch with --resume on a fresh coordinator port.
+    procs, outs = _run_pair(tmp_path, _free_port(), wrapper,
+                            cli + ["--resume"], tag="resume")
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"resume rank failed:\n{o[-4000:]}"
+    with open(os.path.join(out, "rankinfo-0.json")) as f:
+        info = json.load(f)
+    assert info["metrics"]["AUC"] > 0.6
+    assert os.path.isdir(os.path.join(out, "best"))
+    # The relaunch actually CONSUMED the checkpoint: it finished all
+    # 3 iterations x 2 coordinates, and trained exactly the steps the
+    # pre-kill run had not yet committed (each training step logs one
+    # "CD iter" line; resumed steps are skipped before training).
+    assert state_before.get("done_steps", 0) >= 1, state_before
+    with open(ckpt_state) as f:
+        state_after = json.load(f)
+    assert state_after["complete"] and state_after["done_steps"] == 6, \
+        state_after
+    trained_after_resume = outs[0].count("CD iter")
+    assert trained_after_resume == 6 - state_before["done_steps"], (
+        trained_after_resume, state_before["done_steps"])
