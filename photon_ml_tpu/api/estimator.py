@@ -47,6 +47,8 @@ class GameResult:
     configs: dict[str, GLMOptimizationConfiguration]
 
 
+
+
 class GameEstimator:
     """Train GAME models over a device mesh (reference: GameEstimator)."""
 
@@ -156,6 +158,38 @@ class GameEstimator:
 
     # -- evaluation --------------------------------------------------------
 
+    @staticmethod
+    def _stage_dataset(dataset: GameDataset) -> GameDataset:
+        """Device-resident copy of a dataset for repeated scoring.
+
+        Validation scoring runs once per coordinate-descent step; with
+        host numpy shards every ``jnp.asarray`` inside the score/evaluate
+        paths would re-upload the whole validation set each step. Staging
+        once per fit makes those conversions no-ops — per-step validation
+        then adds no host→device traffic at all.
+        """
+        import jax.numpy as jnp
+
+        def _put_shard(shard):
+            if isinstance(shard, SparseShard):
+                return SparseShard(indices=jnp.asarray(shard.indices),
+                                   values=jnp.asarray(shard.values),
+                                   num_features=shard.num_features)
+            return jnp.asarray(shard)
+
+        staged = dataclasses.replace(
+            dataset,
+            response=jnp.asarray(dataset.response),
+            offsets=jnp.asarray(dataset.offsets),
+            weights=jnp.asarray(dataset.weights),
+            feature_shards={k: _put_shard(v)
+                            for k, v in dataset.feature_shards.items()},
+            entity_ids={k: jnp.asarray(v)
+                        for k, v in dataset.entity_ids.items()})
+        if getattr(dataset, "_content_digest", None) is not None:
+            staged._content_digest = dataset._content_digest
+        return staged
+
     def _evaluate(self, model: GameModel, dataset: GameDataset
                   ) -> Optional[ev.EvaluationResults]:
         if not self.validation_evaluators:
@@ -225,6 +259,11 @@ class GameEstimator:
                         "(allow_unseen_entities=True) to guarantee this.",
                         t, n_val, n_train, n_train)
 
+        if validation_data is not None and self.validation_evaluators:
+            # Without evaluators validation_data is only consulted for the
+            # vocabulary checks above — don't hold it in device memory.
+            validation_data = self._stage_dataset(validation_data)
+
         cids = list(self.coordinate_configs)
         grids = [self.coordinate_configs[c].expand_grid() for c in cids]
         results: list[GameResult] = []
@@ -237,16 +276,26 @@ class GameEstimator:
                 # dataset, e.g. tuning trials — swap only the optimization
                 # config (reference: datasets built once, configs looped).
                 # Key everything that shapes coordinate construction: the
-                # dataset identity, per-coordinate data configs, the task
-                # (picks the loss), and the normalization contexts.
-                # Rebinding any of these attributes between fits invalidates
-                # the cache. Identity keys (id(data), id(ctx)) do NOT detect
-                # in-place mutation of array contents — datasets and
-                # normalization contexts must be treated as immutable.
+                # dataset CONTENT (descent._dataset_digest — so a fresh
+                # dataset object with identical content hits the cache,
+                # and a same-id object rebuilt with different content
+                # cannot poison it), per-coordinate data configs, the task
+                # (picks the loss), and the normalization array contents.
+                # The digest is memoized on the dataset object, so arrays
+                # mutated IN PLACE on a previously-fitted dataset are
+                # still not detected — datasets remain immutable by
+                # contract once fitted.
                 cache_key = (
-                    id(data), self.task,
-                    tuple(sorted((s, id(ctx))
-                                 for s, ctx in self.normalization.items())),
+                    descent._dataset_digest(data),
+                    # Metadata the array digest cannot see but that shapes
+                    # construction: entity-table sizes (bucketing, model
+                    # row counts) and intercept columns (reg masks).
+                    tuple(sorted(data.num_entities.items())),
+                    tuple(sorted(data.intercept_index.items())),
+                    self.task,
+                    tuple(sorted(
+                        (s, descent.normalization_digest(ctx))
+                        for s, ctx in self.normalization.items())),
                     tuple((cid, self.coordinate_configs[cid].data)
                           for cid in cids))
                 cached = self._coord_cache.get("last")

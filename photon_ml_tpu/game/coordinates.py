@@ -882,11 +882,23 @@ class RandomEffectCoordinate:
         c._build_fits()
         return c
 
+    def adapt_initial(self, initial):
+        """Accept a factored warm start by materializing its implied
+        full-rank (E, d) table (reference: the factored coordinate hands
+        RandomEffectModels to neighboring coordinate updates)."""
+        from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+        if isinstance(initial, FactoredRandomEffectModel):
+            return initial.to_random_effect_model()
+        return initial
+
     def train_model(
         self,
         offsets: Array,
         initial: Optional[RandomEffectModel] = None,
     ) -> RandomEffectModel:
+        if initial is not None:
+            initial = self.adapt_initial(initial)
         # Warm starts arrive in original space. Unprojected path: the W table
         # is transformed once at entry and mapped back once at exit.
         # Projected path: transforms are per-entity inside the bucket fit, so

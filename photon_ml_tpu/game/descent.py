@@ -126,7 +126,12 @@ def run(
     for cid in seq:
         coord = coordinates[cid]
         if initial_models and cid in initial_models:
-            models[cid] = initial_models[cid]
+            # Cross-type warm starts (full-rank ↔ factored random effects)
+            # convert here so scoring and training see the coordinate's
+            # own model type.
+            adapt = getattr(coord, "adapt_initial", None)
+            models[cid] = (adapt(initial_models[cid]) if adapt
+                           else initial_models[cid])
         else:
             models[cid] = coord.initial_model()
         s = coord.score(models[cid])
@@ -196,7 +201,7 @@ def _dataset_digest(ds) -> str:
     h = hashlib.sha1()
 
     def _feed(arr):
-        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+        _feed_array(h, arr)
 
     for arr in (ds.response, ds.offsets, ds.weights):
         _feed(arr)
@@ -215,6 +220,26 @@ def _dataset_digest(ds) -> str:
     except Exception:  # frozen/slotted datasets: just recompute next time
         pass
     return digest
+
+
+def _feed_array(h, arr) -> None:
+    """The ONE array-content hashing convention (None gets a marker so
+    (None, x) never collides with (x, None)) — shared by the dataset
+    digest, the checkpoint fingerprint, and normalization_digest."""
+    if arr is None:
+        h.update(b"\x00none")
+    else:
+        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+
+
+def normalization_digest(ctx) -> str:
+    """Content digest of a NormalizationContext — pairs with
+    ``_dataset_digest`` as the estimator's coordinate-cache key."""
+    h = hashlib.sha1()
+    _feed_array(h, ctx.factors)
+    _feed_array(h, ctx.shifts)
+    h.update(repr(ctx.intercept_index).encode())
+    return h.hexdigest()
 
 
 def _jsonable(obj):
@@ -249,11 +274,8 @@ def _fingerprint(task, coordinates, seq, config, locked, n) -> dict:
     for cid in seq:
         norm = getattr(coordinates[cid], "norm", None)
         if norm is not None:
-            for leaf in (getattr(norm, "factors", None),
-                         getattr(norm, "shifts", None)):
-                if leaf is not None:
-                    h.update(
-                        np.ascontiguousarray(np.asarray(leaf)).tobytes())
+            _feed_array(h, getattr(norm, "factors", None))
+            _feed_array(h, getattr(norm, "shifts", None))
     return {
         "task": TaskType(task).value,
         "sequence": list(seq),

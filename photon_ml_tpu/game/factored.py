@@ -102,6 +102,29 @@ class FactoredRandomEffectModel:
             means=self.factors @ self.projection.T)
 
 
+def from_random_effect_model(model, rank: int) -> FactoredRandomEffectModel:
+    """Best rank-``rank`` factored initialization of a full-rank model.
+
+    Truncated SVD of the (E, d) coefficient table: ``W ≈ (U_r S_r) V_rᵀ``
+    gives factors ``Z = U_r S_r`` and projection ``A = V_r`` — the closest
+    rank-r model in Frobenius norm, so a factored coordinate warm-started
+    from a trained RandomEffectModel begins at the best low-rank view of
+    it (reference: FactoredRandomEffectCoordinate initializes from and
+    materializes to RandomEffectModels across coordinate updates).
+    """
+    W = np.asarray(model.means, np.float32)
+    E, d = W.shape
+    U, S, Vt = np.linalg.svd(W, full_matrices=False)
+    r = min(rank, S.shape[0])
+    A = np.zeros((d, rank), np.float32)
+    Z = np.zeros((E, rank), np.float32)
+    A[:, :r] = Vt[:r].T
+    Z[:, :r] = U[:, :r] * S[:r]
+    return FactoredRandomEffectModel(
+        re_type=model.re_type, shard_id=model.shard_id,
+        projection=jnp.asarray(A), factors=jnp.asarray(Z))
+
+
 class FactoredRandomEffectCoordinate:
     """Alternating matrix-factorization coordinate (reference:
     FactoredRandomEffectCoordinate.trainModel's update loop).
@@ -320,6 +343,28 @@ class FactoredRandomEffectCoordinate:
             projection=jnp.asarray(A),
             factors=jnp.zeros((self.num_entities, self.rank), jnp.float32))
 
+    def adapt_initial(self, initial):
+        """Accept a full-rank RandomEffectModel warm start.
+
+        ``learn_projection=True``: truncated-SVD initialization (the best
+        rank-r view of the trained table; both A and Z then train).
+        ``learn_projection=False`` (projector=RANDOM): the projection is a
+        frozen seeded draw that must survive, so the warm start is instead
+        least-squares-projected INTO that fixed subspace
+        (``z_e = A⁺ w_e``).
+        """
+        from photon_ml_tpu.game.models import RandomEffectModel
+
+        if not isinstance(initial, RandomEffectModel):
+            return initial
+        if self.learn_projection:
+            return from_random_effect_model(initial, self.rank)
+        frozen = self.initial_model()
+        A = np.asarray(frozen.projection)
+        Z = np.asarray(initial.means, np.float32) @ np.linalg.pinv(A).T
+        return dataclasses.replace(frozen, factors=jnp.asarray(
+            Z.astype(np.float32)))
+
     def train_model(
         self,
         offsets: Array,
@@ -327,6 +372,7 @@ class FactoredRandomEffectCoordinate:
     ) -> FactoredRandomEffectModel:
         if initial is None:
             initial = self.initial_model()
+        initial = self.adapt_initial(initial)
         if initial.rank != self.rank:
             raise ValueError(
                 f"warm start has rank {initial.rank}, coordinate has rank "

@@ -163,6 +163,43 @@ def test_game_train_sparse_shard(rng, tmp_path):
     assert summary["best_metrics"]["AUC"] > 0.75
 
 
+def test_cli_warm_start_crosses_full_rank_and_factored(rng, tmp_path):
+    """--model-input-dir round trip across coordinate types: a full-rank
+    random-effect model warm-starts a type=factored retrain (SVD init),
+    whose output warm-starts a full-rank retrain again (materialized
+    table) — the reference's factored coordinate interop."""
+    train_dir, val_dir = _write_game_data(
+        tmp_path, rng, re_specs={"userId": (16, 4)})
+
+    def _run(out, coord_spec, model_in=None):
+        args = [
+            "--train", train_dir, "--validation", val_dir,
+            "--coordinate", coord_spec,
+            "--update-sequence", "per-user",
+            "--evaluators", "AUC",
+            "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--output-dir", out,
+        ]
+        if model_in:
+            args += ["--model-input-dir", model_in]
+        return game_train.run(game_train.build_parser().parse_args(args))
+
+    out1 = str(tmp_path / "full1")
+    s1 = _run(out1, "name=per-user,type=random,shard=re_userId,re=userId")
+    out2 = str(tmp_path / "fact")
+    s2 = _run(out2, "name=per-user,type=factored,shard=re_userId,"
+                    "re=userId,rank=2",
+              model_in=os.path.join(out1, "best"))
+    out3 = str(tmp_path / "full2")
+    s3 = _run(out3, "name=per-user,type=random,shard=re_userId,re=userId",
+              model_in=os.path.join(out2, "best"))
+    for s in (s1, s2, s3):
+        assert s["best_metrics"]["AUC"] > 0.6
+    # The final full-rank model is at least as good as the factored one it
+    # started from (rank-2 is a constraint; lifting it cannot hurt).
+    assert s3["best_metrics"]["AUC"] >= s2["best_metrics"]["AUC"] - 0.02
+
+
 def test_game_train_sparse_random_effect(rng, tmp_path):
     """Sparse (ELL) shard as a RANDOM effect through the CLI — the driver
     path for large-d per-entity feature spaces (never densified)."""

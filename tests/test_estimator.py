@@ -131,6 +131,63 @@ def test_warm_start_through_estimator(rng, mesh):
         np.asarray(first.model.models["fixed"].coefficients.means))
 
 
+def test_staged_validation_scores_exactly(rng, mesh):
+    """_stage_dataset is a pure device-residency change: scoring the
+    staged copy equals scoring the host dataset, dense and sparse shards
+    alike, and staged arrays are device arrays (repeat evaluations add no
+    host→device transfer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+
+    n, d_sparse = 512, 64
+    syn = synthetic.game_data(rng, n=n, d_global=6,
+                              re_specs={"userId": (10, 3)})
+    ds = from_synthetic(syn)
+    idx = np.sort(rng.integers(0, d_sparse, (n, 4)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, 4)).astype(np.float32)
+    idx[dup] = d_sparse
+    vals[dup] = 0.0
+    shards = dict(ds.feature_shards)
+    shards["sp"] = SparseShard(idx, vals, d_sparse)
+    ds = dataclasses.replace(ds, feature_shards=shards)
+
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, _coordinates(),
+                        ["fixed", "per-user"], mesh)
+    staged = est._stage_dataset(ds)
+    assert isinstance(staged.response, jax.Array)
+    assert isinstance(staged.feature_shards["global"], jax.Array)
+    assert isinstance(staged.feature_shards["sp"].indices, jax.Array)
+    model = est.fit(ds)[0].model
+    np.testing.assert_allclose(np.asarray(model.score(staged)),
+                               np.asarray(model.score(ds)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_coordinate_cache_is_content_keyed(rng, mesh):
+    """An identical fresh dataset object HITS the coordinate cache (device
+    staging reused); changed content MISSES and rebuilds — the cache keys
+    on what the data IS, not which Python object carries it."""
+    train, val = _datasets(rng, n=800)
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, _coordinates(),
+                        ["fixed", "per-user"], mesh,
+                        validation_evaluators=["AUC"])
+    est.fit(train, val)
+    staged_first = est._coord_cache["last"][1]["fixed"]._staged
+
+    same_content = dataclasses.replace(
+        train, response=train.response.copy())
+    est.fit(same_content, val)
+    assert est._coord_cache["last"][1]["fixed"]._staged is staged_first
+
+    mutated = dataclasses.replace(train, response=1.0 - train.response)
+    est.fit(mutated, val)
+    assert est._coord_cache["last"][1]["fixed"]._staged is not staged_first
+
+
 def test_parse_optimizer_config():
     cfg = parse_optimizer_config(
         "optimizer=TRON,max_iter=17,tolerance=1e-5,reg=L2,reg_weight=3.5,"

@@ -98,6 +98,92 @@ def test_untrained_entities_score_zero(rng):
     assert np.any(s[~other] != 0.0)
 
 
+def test_svd_init_reproduces_low_rank_table_exactly(rng):
+    """from_random_effect_model at the table's true rank is lossless:
+    materializing the factored init gives back the same (E, d) table."""
+    from photon_ml_tpu.game.factored import from_random_effect_model
+    from photon_ml_tpu.game.models import RandomEffectModel
+
+    A = rng.normal(size=(8, 2)).astype(np.float32)
+    Z = rng.normal(size=(10, 2)).astype(np.float32)
+    W = Z @ A.T
+    m = RandomEffectModel(re_type="userId", shard_id="re_userId",
+                          means=jnp.asarray(W))
+    f = from_random_effect_model(m, rank=2)
+    np.testing.assert_allclose(
+        np.asarray(f.to_random_effect_model().means), W,
+        rtol=1e-4, atol=1e-5)
+    # Requested rank beyond min(E, d): extra columns are zero padding.
+    f4 = from_random_effect_model(m, rank=4)
+    assert f4.rank == 4
+    np.testing.assert_allclose(
+        np.asarray(f4.to_random_effect_model().means), W,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_full_rank_warm_start_into_factored(rng, mesh):
+    """A trained full-rank RandomEffectModel warm-starts the factored
+    coordinate (SVD init) and the first alternation starts from its best
+    low-rank view — the fit is at least as good as a cold start."""
+    ds = _low_rank_game(rng)
+    off = np.zeros(ds.num_rows, np.float32)
+    full = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, _config(), mesh)
+    m_full = full.train_model(off)
+    fact = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+        rank=2, alternations=1)
+    warm = fact.adapt_initial(m_full)
+    assert warm.rank == 2
+    m_warm = fact.train_model(off, initial=m_full)  # accepts full-rank
+    m_cold = fact.train_model(off)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    nll_warm = _nll(losses.LOGISTIC, fact.score(m_warm), 0.0, y, w)
+    nll_cold = _nll(losses.LOGISTIC, fact.score(m_cold), 0.0, y, w)
+    assert nll_warm <= nll_cold * 1.02
+
+
+def test_factored_warm_start_into_full_rank(rng, mesh):
+    """The reverse hand-off: a factored model warm-starts the full-rank
+    coordinate via its materialized table."""
+    ds = _low_rank_game(rng)
+    off = np.zeros(ds.num_rows, np.float32)
+    fact = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+        rank=2, alternations=2)
+    m_fact = fact.train_model(off)
+    full = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, _config(), mesh)
+    m = full.train_model(off, initial=m_fact)
+    assert np.asarray(m.means).shape == (40, 12)
+    y, w = jnp.asarray(ds.response), jnp.asarray(ds.weights)
+    assert _nll(losses.LOGISTIC, full.score(m), 0.0, y, w) <= \
+        _nll(losses.LOGISTIC, fact.score(m_fact), 0.0, y, w) + 1e-3
+
+
+def test_random_projector_warm_start_keeps_frozen_matrix(rng, mesh):
+    """projector=RANDOM: a full-rank warm start is least-squares-projected
+    into the FROZEN seeded subspace — the projection matrix must not be
+    replaced by the warm start's SVD basis."""
+    ds = _low_rank_game(rng)
+    coord = FactoredRandomEffectCoordinate(
+        ds, "userId", "re_userId", losses.LOGISTIC, _config(), mesh,
+        rank=4, learn_projection=False)
+    full = RandomEffectCoordinate(ds, "userId", "re_userId",
+                                  losses.LOGISTIC, _config(), mesh)
+    m_full = full.train_model(np.zeros(ds.num_rows, np.float32))
+    adapted = coord.adapt_initial(m_full)
+    np.testing.assert_array_equal(
+        np.asarray(adapted.projection),
+        np.asarray(coord.initial_model().projection))
+    # z_e = A⁺ w_e: materializing back approximates the original table as
+    # well as the frozen subspace allows (not exactly, but correlated).
+    W0 = np.asarray(m_full.means)
+    W1 = np.asarray(adapted.to_random_effect_model().means)
+    corr = np.corrcoef(W0.ravel(), W1.ravel())[0, 1]
+    assert corr > 0.5
+
+
 # ------------------------------------------------------------------- training
 
 
