@@ -168,27 +168,9 @@ class GameEstimator:
         once per fit makes those conversions no-ops — per-step validation
         then adds no host→device traffic at all.
         """
-        import jax.numpy as jnp
+        from photon_ml_tpu.data.prefetch import stage_dataset
 
-        def _put_shard(shard):
-            if isinstance(shard, SparseShard):
-                return SparseShard(indices=jnp.asarray(shard.indices),
-                                   values=jnp.asarray(shard.values),
-                                   num_features=shard.num_features)
-            return jnp.asarray(shard)
-
-        staged = dataclasses.replace(
-            dataset,
-            response=jnp.asarray(dataset.response),
-            offsets=jnp.asarray(dataset.offsets),
-            weights=jnp.asarray(dataset.weights),
-            feature_shards={k: _put_shard(v)
-                            for k, v in dataset.feature_shards.items()},
-            entity_ids={k: jnp.asarray(v)
-                        for k, v in dataset.entity_ids.items()})
-        if getattr(dataset, "_content_digest", None) is not None:
-            staged._content_digest = dataset._content_digest
-        return staged
+        return stage_dataset(dataset)
 
     def _evaluate(self, model: GameModel, dataset: GameDataset
                   ) -> Optional[ev.EvaluationResults]:

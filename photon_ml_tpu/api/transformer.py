@@ -59,14 +59,50 @@ class GameTransformer:
             weights=data.weights,
         )
 
-    def transform_and_evaluate(self, data: GameDataset, as_mean: bool = False
+    def transform_batched(self, data: GameDataset, batch_rows: int,
+                          as_mean: bool = False,
+                          prefetch_depth: int = 2) -> ScoringResult:
+        """Score in bounded device batches with host→device prefetch.
+
+        The scoring-time analogue of the reader's chunked ingestion
+        (SURVEY §0): only ``prefetch_depth`` row-chunks are ever device-
+        resident, and the next chunk's transfer overlaps the current
+        chunk's scoring — large inputs score with flat device memory at
+        the same throughput as one-shot staging. Results are identical to
+        ``transform`` (same scores, order, passthrough fields).
+        """
+        from photon_ml_tpu.data.prefetch import (device_prefetch,
+                                                 iter_row_chunks,
+                                                 stage_dataset)
+
+        parts = [self.model.score(staged)
+                 for staged in device_prefetch(
+                     iter_row_chunks(data, batch_rows),
+                     depth=prefetch_depth, place=stage_dataset)]
+        scores = np.concatenate([np.asarray(p) for p in parts]) \
+            if parts else np.zeros(0, np.float32)
+        if as_mean:
+            loss = losses_mod.loss_for_task(self.model.task)
+            scores = np.asarray(loss.mean(jnp.asarray(scores)))
+        return ScoringResult(
+            scores=scores,
+            uids=np.arange(data.num_rows, dtype=np.int64),
+            labels=data.response,
+            offsets=data.offsets,
+            weights=data.weights,
+        )
+
+    def transform_and_evaluate(self, data: GameDataset, as_mean: bool = False,
+                               batch_rows: Optional[int] = None
                                ) -> tuple[ScoringResult, ev.EvaluationResults]:
         """Score + evaluate. Metrics are always computed on raw linear
         scores (AUC is link-invariant; the loss evaluators expect margins);
-        the returned ScoringResult honors ``as_mean``."""
+        the returned ScoringResult honors ``as_mean``. ``batch_rows``
+        scores through the bounded-memory prefetch pipeline."""
         if not self.evaluators:
             raise ValueError("no evaluators configured")
-        result = self.transform(data)
+        result = (self.transform_batched(data, batch_rows)
+                  if batch_rows else self.transform(data))
         gids = {name: jnp.asarray(ids)
                 for name, ids in data.entity_ids.items()}
         evaluation = ev.evaluation_suite(

@@ -89,6 +89,7 @@ class AvroDataReader:
         entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
         use_native: bool = True,
         allow_unseen_entities: bool = False,
+        chunk_rows: int = 65536,
     ):
         """Returns (GameDataset, ReadMeta).
 
@@ -103,6 +104,19 @@ class AvroDataReader:
         reference — a random-effect model has no row for those ids, and
         model scoring contributes exactly zero for them (fixed effect
         only).
+
+        Bounded-memory streaming (reference: executors stream HDFS
+        partitions through ``AvroDataReader.scala``; SURVEY §0 "host-side
+        readers feeding a device-prefetch pipeline"): the Python path
+        decodes at most ``chunk_rows`` record dicts at a time (decoded
+        records cost ~50× their columnar size, so this bounds the
+        dominant transient); the native path frees each file's decoded
+        columns as soon as they are folded in whenever ``index_maps`` is
+        given — the production flow (frozen feature space over daily
+        partitions) never holds more than one partition's columns beyond
+        the output arrays. Without ``index_maps`` the feature space is
+        discovered in a separate streaming pass first, trading one extra
+        read of the input for flat memory.
         """
         if isinstance(paths, str):
             paths = [paths]
@@ -112,17 +126,25 @@ class AvroDataReader:
                                     entity_vocabs, allow_unseen_entities)
             if out is not None:
                 return out
-        records: list[dict] = []
-        for p in paths:
-            records.extend(read_records(p))
-        if not records:
-            raise ValueError(f"no records under {paths}")
+
+        def stream():
+            for p in paths:
+                yield from read_records(p)
 
         if index_maps is None:
+            # Discovery pass: ONE extra stream over the input collects
+            # every shard's key set simultaneously (bounded by vocabulary
+            # size, not input size), then assembly streams again.
+            keys_by_shard: dict[str, dict] = {
+                s: {} for s in feature_shard_configs}
+            for r in stream():
+                for shard, cfg in feature_shard_configs.items():
+                    sk = keys_by_shard[shard]
+                    for k in _record_features(r, cfg.feature_bags):
+                        sk[k] = None
             index_maps = {
                 shard: DefaultIndexMap.from_keys(
-                    (k for r in records
-                     for k in _record_features(r, cfg.feature_bags)),
+                    keys_by_shard[shard],
                     add_intercept=cfg.has_intercept)
                 for shard, cfg in feature_shard_configs.items()
             }
@@ -132,114 +154,20 @@ class AvroDataReader:
             {t: dict(v) for t, v in entity_vocabs.items()} if frozen_vocab
             else {t: {} for t in random_effect_types})
 
-        n = len(records)
-        fields = self.fields
-        response = np.zeros(n, np.float32)
-        offsets = np.zeros(n, np.float32)
-        weights = np.ones(n, np.float32)
-        uids = np.empty(n, object)
-        shard_mats = {
-            shard: np.zeros((n, len(index_maps[shard])), np.float32)
-            for shard, cfg in feature_shard_configs.items() if not cfg.sparse
-        }
-        # Sparse shards: one {col: val} accumulator per record, ELL-ified
-        # after the pass (repeated features accumulate like the dense path).
-        sparse_rows: dict[str, list[dict]] = {
-            shard: [dict() for _ in range(n)]
-            for shard, cfg in feature_shard_configs.items() if cfg.sparse
-        }
-        id_cols = {t: np.zeros(n, np.int32) for t in random_effect_types}
-
-        for i, rec in enumerate(records):
-            # Reference AvroDataReader fails fast on a missing response
-            # column; defaulting would silently train on all-zero labels.
-            if rec.get(fields.response) is None:
-                raise ValueError(
-                    f"record {i} is missing required response field "
-                    f"{fields.response!r}")
-            response[i] = rec[fields.response]
-            off = rec.get(fields.offset)
-            offsets[i] = 0.0 if off is None else off
-            w = rec.get(fields.weight)
-            weights[i] = 1.0 if w is None else w
-            uid = rec.get(fields.uid)
-            uids[i] = i if uid is None else uid
-            for shard, cfg in feature_shard_configs.items():
-                imap = index_maps[shard]
-                if cfg.sparse:
-                    row = sparse_rows[shard][i]
-                    for bag in cfg.feature_bags:
-                        for f in rec.get(bag) or ():
-                            j = imap.get_index(feature_key(f["name"],
-                                                           f.get("term", "")))
-                            if j >= 0:
-                                row[j] = row.get(j, 0.0) + f["value"]
-                    if cfg.has_intercept:
-                        j = imap.get_index(INTERCEPT_KEY)
-                        if j >= 0:
-                            row[j] = 1.0
-                    continue
-                mat = shard_mats[shard]
-                for bag in cfg.feature_bags:
-                    for f in rec.get(bag) or ():
-                        j = imap.get_index(feature_key(f["name"],
-                                                       f.get("term", "")))
-                        if j >= 0:
-                            mat[i, j] += f["value"]
-                if cfg.has_intercept:
-                    j = imap.get_index(INTERCEPT_KEY)
-                    if j >= 0:
-                        mat[i, j] = 1.0
-            for t in random_effect_types:
-                raw = _entity_value(rec, t, fields.metadata)
-                if raw is None:
-                    raise ValueError(
-                        f"record {i} missing random-effect id {t!r}")
-                vocab = vocabs[t]
-                if raw not in vocab:
-                    if frozen_vocab and not allow_unseen_entities:
-                        raise KeyError(
-                            f"unseen entity {raw!r} for {t!r} under a frozen "
-                            f"vocabulary (scoring with unseen entities must "
-                            f"map them explicitly, or pass "
-                            f"allow_unseen_entities=True)")
-                    vocab[raw] = len(vocab)
-                id_cols[t][i] = vocab[raw]
-
-        feature_shards: dict = dict(shard_mats)
-        for shard, rows in sparse_rows.items():
-            # CSR triplets → data/sparse.py from_csr, the ONE owner of the
-            # ELL layout contract (padding sentinel, max_nnz policy).
-            from photon_ml_tpu.data.sparse import from_csr
-
-            d = len(index_maps[shard])
-            indptr = np.zeros(n + 1, np.int64)
-            cols: list[int] = []
-            vals: list[float] = []
-            for i, row in enumerate(rows):
-                for j, v in sorted(row.items()):
-                    cols.append(j)
-                    vals.append(v)
-                indptr[i + 1] = len(cols)
-            ell = from_csr(indptr, np.asarray(cols, np.int32),
-                           np.asarray(vals, np.float32), labels=response,
-                           num_features=d)
-            feature_shards[shard] = SparseShard(
-                indices=ell.indices, values=ell.values, num_features=d)
-
-        ds = GameDataset(
-            response=response,
-            offsets=offsets,
-            weights=weights,
-            feature_shards=feature_shards,
-            entity_ids=id_cols,
-            num_entities={t: len(v) for t, v in vocabs.items()},
-            intercept_index={
-                shard: (index_maps[shard].get_index(INTERCEPT_KEY)
-                        if cfg.has_intercept else None)
-                for shard, cfg in feature_shard_configs.items()
-            },
-        )
+        acc = _ChunkAccumulator(self.fields, feature_shard_configs,
+                                index_maps, random_effect_types, vocabs,
+                                frozen_vocab, allow_unseen_entities)
+        chunk: list[dict] = []
+        for rec in stream():
+            chunk.append(rec)
+            if len(chunk) >= max(1, chunk_rows):
+                acc.add_chunk(chunk)
+                chunk = []
+        if chunk:
+            acc.add_chunk(chunk)
+        if acc.num_rows == 0:
+            raise ValueError(f"no records under {paths}")
+        ds, uids = acc.finalize()
         return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
                             uids=uids)
 
@@ -290,18 +218,68 @@ class AvroDataReader:
             if b in captures:
                 return None
             captures[b] = (nd.CAP_BAG, k)
-        decoded = []
+        bag_pos = {b: k for k, b in enumerate(bag_names)}
+
+        # Decode. With ``index_maps`` given (the production frozen-feature-
+        # space flow), each file's decoded columns are folded into compact
+        # accumulators and FREED before the next file is touched — peak
+        # memory is the output arrays plus one partition. Without maps the
+        # feature space must be known before columns can be mapped, so all
+        # files stay decoded until the union key tables are built (the
+        # one-pass trade; pass index_maps to bound memory).
+        incremental = index_maps is not None
+        decoded: list = []
+        scal_chunks: list[tuple] = []  # (response, offsets, weights, uids)
+        coo_chunks: dict[str, list[tuple]] = {
+            s: [] for s in feature_shard_configs}
+        n = 0
+
+        def fold_scalars(d, base):
+            uid_seg = np.arange(base, base + d.num_records).astype(object)
+            present = d.uid_kind != 0
+            if present.any():
+                uid_seg[present] = d.uids[present]
+            scal_chunks.append((d.response.astype(np.float32),
+                                d.offsets.astype(np.float32),
+                                d.weights.astype(np.float32), uid_seg))
+
+        def fold_features(d, base):
+            for shard, cfg in feature_shard_configs.items():
+                imap = index_maps[shard]
+                for b in cfg.feature_bags:
+                    bag = d.bags[bag_pos[b]]
+                    if not len(bag.rows):
+                        continue
+                    lut = np.asarray([imap.get_index(s)
+                                      for s in bag.key_strings], np.int64)
+                    cols = lut[bag.keys]
+                    keep = cols >= 0
+                    coo_chunks[shard].append(
+                        (bag.rows[keep] + base, cols[keep],
+                         bag.values[keep]))
+
         for f in files:
             d = nd.decode_file(f, captures, n_bags=len(bag_names),
                                forbidden_fields=frozenset(
                                    random_effect_types))
             if d is None:
+                if incremental and n:
+                    return None  # fall back cleanly before any output
                 return None
-            decoded.append(d)
-        n = sum(d.num_records for d in decoded)
+            if incremental:
+                fold_scalars(d, n)
+                fold_features(d, n)
+                # Entity ids still need the string tables; keep only those
+                # and DROP the bag/scalar columns before the next decode
+                # (otherwise two partitions peak-coexist).
+                decoded.append(_MetaOnly(d))
+                n += d.num_records
+                del d
+            else:
+                decoded.append(d)
+                n += d.num_records
         if n == 0:
             raise ValueError(f"no records under {list(paths)}")
-        bag_pos = {b: k for k, b in enumerate(bag_names)}
 
         # Index maps: DefaultIndexMap.from_keys SORTS its keys, so the
         # union of each shard's bag key tables is all that matters (the
@@ -315,24 +293,16 @@ class AvroDataReader:
                         keys.update(d.bags[bag_pos[b]].key_strings)
                 index_maps[shard] = DefaultIndexMap.from_keys(
                     keys, add_intercept=cfg.has_intercept)
+            base = 0
+            for d in decoded:
+                fold_scalars(d, base)
+                fold_features(d, base)
+                base += d.num_records
 
-        # Scalars + uids.
-        response = np.concatenate(
-            [d.response for d in decoded]).astype(np.float32)
-        offsets = np.concatenate(
-            [d.offsets for d in decoded]).astype(np.float32)
-        weights = np.concatenate(
-            [d.weights for d in decoded]).astype(np.float32)
-        # uids: default to the GLOBAL record index; overwrite only where a
-        # record carried one (vectorized fancy-index assignment).
-        uids = np.arange(n).astype(object)
-        base = 0
-        for d in decoded:
-            present = d.uid_kind != 0
-            if present.any():
-                seg = uids[base: base + d.num_records]
-                seg[present] = d.uids[present]
-            base += d.num_records
+        response = np.concatenate([c[0] for c in scal_chunks])
+        offsets = np.concatenate([c[1] for c in scal_chunks])
+        weights = np.concatenate([c[2] for c in scal_chunks])
+        uids = np.concatenate([c[3] for c in scal_chunks])
 
         # Feature shards.
         feature_shards: dict = {}
@@ -340,26 +310,12 @@ class AvroDataReader:
             imap = index_maps[shard]
             dcols = len(imap)
             ji = imap.get_index(INTERCEPT_KEY) if cfg.has_intercept else -1
-            rows_l, cols_l, vals_l = [], [], []
-            base = 0
-            for d in decoded:
-                for b in cfg.feature_bags:
-                    bag = d.bags[bag_pos[b]]
-                    if not len(bag.rows):
-                        continue
-                    lut = np.asarray([imap.get_index(s)
-                                      for s in bag.key_strings], np.int64)
-                    cols = lut[bag.keys]
-                    keep = cols >= 0
-                    rows_l.append(bag.rows[keep] + base)
-                    cols_l.append(cols[keep])
-                    vals_l.append(bag.values[keep])
-                base += d.num_records
-            rows = (np.concatenate(rows_l) if rows_l
+            pieces = coo_chunks[shard]
+            rows = (np.concatenate([p[0] for p in pieces]) if pieces
                     else np.zeros(0, np.int64))
-            cols = (np.concatenate(cols_l) if cols_l
+            cols = (np.concatenate([p[1] for p in pieces]) if pieces
                     else np.zeros(0, np.int64))
-            vals = (np.concatenate(vals_l) if vals_l
+            vals = (np.concatenate([p[2] for p in pieces]) if pieces
                     else np.zeros(0, np.float64))
             if not cfg.sparse:
                 mat = np.zeros((n, dcols), np.float32)
@@ -460,6 +416,177 @@ class AvroDataReader:
         )
         return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
                             uids=uids)
+
+
+class _MetaOnly:
+    """Retains only a DecodedFile's metadataMap columns (what entity-id
+    assembly still needs) so the much larger bag/scalar columns can be
+    freed file-by-file in the incremental native path."""
+
+    __slots__ = ("num_records", "meta_key_strings", "meta_keys",
+                 "meta_rows", "meta_vals", "meta_val_strings")
+
+    def __init__(self, d):
+        self.num_records = d.num_records
+        self.meta_key_strings = d.meta_key_strings
+        self.meta_keys = d.meta_keys
+        self.meta_rows = d.meta_rows
+        self.meta_vals = d.meta_vals
+        self.meta_val_strings = d.meta_val_strings
+
+
+class _ChunkAccumulator:
+    """Bounded-memory columnar assembly for the Python decode path.
+
+    Per chunk it runs exactly the historical per-record loop (missing-
+    response errors with GLOBAL record indices, accumulate-then-set-
+    intercept feature assembly, encounter-order entity vocabularies) but
+    emits compact columnar pieces and lets the record dicts go; peak
+    transient memory is one chunk of dicts, independent of input size.
+    """
+
+    def __init__(self, fields, feature_shard_configs, index_maps,
+                 random_effect_types, vocabs, frozen_vocab,
+                 allow_unseen_entities):
+        self.fields = fields
+        self.cfgs = feature_shard_configs
+        self.index_maps = index_maps
+        self.re_types = list(random_effect_types)
+        self.vocabs = vocabs
+        self.frozen_vocab = frozen_vocab
+        self.allow_unseen = allow_unseen_entities
+        self.num_rows = 0
+        self._response: list[np.ndarray] = []
+        self._offsets: list[np.ndarray] = []
+        self._weights: list[np.ndarray] = []
+        self._uids: list[np.ndarray] = []
+        self._dense: dict[str, list[np.ndarray]] = {
+            s: [] for s, c in feature_shard_configs.items() if not c.sparse}
+        # Sparse shards accumulate CSR pieces: (row_nnz, cols, vals).
+        self._sparse: dict[str, list[tuple]] = {
+            s: [] for s, c in feature_shard_configs.items() if c.sparse}
+        self._ids: dict[str, list[np.ndarray]] = {
+            t: [] for t in random_effect_types}
+
+    def add_chunk(self, records: list[dict]) -> None:
+        fields = self.fields
+        base = self.num_rows
+        n = len(records)
+        response = np.zeros(n, np.float32)
+        offsets = np.zeros(n, np.float32)
+        weights = np.ones(n, np.float32)
+        uids = np.empty(n, object)
+        mats = {s: np.zeros((n, len(self.index_maps[s])), np.float32)
+                for s in self._dense}
+        sp_rows = {s: [dict() for _ in range(n)] for s in self._sparse}
+        ids = {t: np.zeros(n, np.int32) for t in self.re_types}
+
+        for i, rec in enumerate(records):
+            # Reference AvroDataReader fails fast on a missing response
+            # column; defaulting would silently train on all-zero labels.
+            if rec.get(fields.response) is None:
+                raise ValueError(
+                    f"record {base + i} is missing required response field "
+                    f"{fields.response!r}")
+            response[i] = rec[fields.response]
+            off = rec.get(fields.offset)
+            offsets[i] = 0.0 if off is None else off
+            w = rec.get(fields.weight)
+            weights[i] = 1.0 if w is None else w
+            uid = rec.get(fields.uid)
+            uids[i] = base + i if uid is None else uid
+            for shard, cfg in self.cfgs.items():
+                imap = self.index_maps[shard]
+                if cfg.sparse:
+                    row = sp_rows[shard][i]
+                    for bag in cfg.feature_bags:
+                        for f in rec.get(bag) or ():
+                            j = imap.get_index(feature_key(
+                                f["name"], f.get("term", "")))
+                            if j >= 0:
+                                row[j] = row.get(j, 0.0) + f["value"]
+                    if cfg.has_intercept:
+                        j = imap.get_index(INTERCEPT_KEY)
+                        if j >= 0:
+                            row[j] = 1.0
+                    continue
+                mat = mats[shard]
+                for bag in cfg.feature_bags:
+                    for f in rec.get(bag) or ():
+                        j = imap.get_index(feature_key(
+                            f["name"], f.get("term", "")))
+                        if j >= 0:
+                            mat[i, j] += f["value"]
+                if cfg.has_intercept:
+                    j = imap.get_index(INTERCEPT_KEY)
+                    if j >= 0:
+                        mat[i, j] = 1.0
+            for t in self.re_types:
+                raw = _entity_value(rec, t, fields.metadata)
+                if raw is None:
+                    raise ValueError(
+                        f"record {base + i} missing random-effect id {t!r}")
+                vocab = self.vocabs[t]
+                if raw not in vocab:
+                    if self.frozen_vocab and not self.allow_unseen:
+                        raise KeyError(
+                            f"unseen entity {raw!r} for {t!r} under a "
+                            f"frozen vocabulary (scoring with unseen "
+                            f"entities must map them explicitly, or pass "
+                            f"allow_unseen_entities=True)")
+                    vocab[raw] = len(vocab)
+                ids[t][i] = vocab[raw]
+
+        self._response.append(response)
+        self._offsets.append(offsets)
+        self._weights.append(weights)
+        self._uids.append(uids)
+        for s, m in mats.items():
+            self._dense[s].append(m)
+        for s, rows in sp_rows.items():
+            row_nnz = np.asarray([len(r) for r in rows], np.int64)
+            cols = np.asarray([j for r in rows
+                               for j in sorted(r)], np.int32)
+            vals = np.asarray([r[j] for r in rows
+                               for j in sorted(r)], np.float32)
+            self._sparse[s].append((row_nnz, cols, vals))
+        for t, col in ids.items():
+            self._ids[t].append(col)
+        self.num_rows += n
+
+    def finalize(self):
+        from photon_ml_tpu.data.sparse import from_csr
+
+        n = self.num_rows
+        response = np.concatenate(self._response)
+        feature_shards: dict = {
+            s: np.concatenate(chunks) for s, chunks in self._dense.items()}
+        for s, pieces in self._sparse.items():
+            d = len(self.index_maps[s])
+            indptr = np.zeros(n + 1, np.int64)
+            np.cumsum(np.concatenate([p[0] for p in pieces]),
+                      out=indptr[1:])
+            ell = from_csr(indptr,
+                           np.concatenate([p[1] for p in pieces]),
+                           np.concatenate([p[2] for p in pieces]),
+                           labels=response, num_features=d)
+            feature_shards[s] = SparseShard(
+                indices=ell.indices, values=ell.values, num_features=d)
+        ds = GameDataset(
+            response=response,
+            offsets=np.concatenate(self._offsets),
+            weights=np.concatenate(self._weights),
+            feature_shards=feature_shards,
+            entity_ids={t: np.concatenate(chunks)
+                        for t, chunks in self._ids.items()},
+            num_entities={t: len(v) for t, v in self.vocabs.items()},
+            intercept_index={
+                s: (self.index_maps[s].get_index(INTERCEPT_KEY)
+                    if c.has_intercept else None)
+                for s, c in self.cfgs.items()
+            },
+        )
+        return ds, np.concatenate(self._uids)
 
 
 @dataclasses.dataclass

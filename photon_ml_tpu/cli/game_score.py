@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-dir", required=True)
     p.add_argument("--evaluators", default="",
                    help="optional comma-separated evaluators")
+    p.add_argument("--batch-rows", type=int, default=None,
+                   help="score in bounded device batches of this many rows "
+                        "through the host->device prefetch pipeline "
+                        "(inputs larger than device memory; identical "
+                        "scores)")
     p.add_argument("--as-mean", action="store_true",
                    help="apply the inverse link (probabilities/rates)")
     p.add_argument("--output-format", default="NPZ",
@@ -124,10 +129,13 @@ def run(args) -> dict:
     summary = {"num_rows": data.num_rows}
     if evaluators:
         result, evaluation = transformer.transform_and_evaluate(
-            data, as_mean=args.as_mean)
+            data, as_mean=args.as_mean, batch_rows=args.batch_rows)
         summary["metrics"] = evaluation.metrics
     else:
-        result = transformer.transform(data, as_mean=args.as_mean)
+        result = (transformer.transform_batched(
+                      data, args.batch_rows, as_mean=args.as_mean)
+                  if args.batch_rows
+                  else transformer.transform(data, as_mean=args.as_mean))
     if args.avro_feature_shard:
         # Preserve the input records' real uids (ReadMeta) so downstream
         # joins of the scoring output back to the source data hold — the

@@ -1,0 +1,143 @@
+"""Host→device prefetch pipeline and batched scoring tests (SURVEY §0:
+"host-side readers feeding a device-prefetch pipeline")."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                       FixedEffectDataConfiguration,
+                                       RandomEffectDataConfiguration)
+from photon_ml_tpu.api.estimator import GameEstimator
+from photon_ml_tpu.api.transformer import GameTransformer
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.data.prefetch import (device_prefetch, iter_row_chunks,
+                                         stage_dataset)
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+
+def test_device_prefetch_order_and_placement():
+    batches = [np.full((4,), i, np.float32) for i in range(7)]
+    out = list(device_prefetch(batches, depth=3))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert isinstance(b, jax.Array)
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+    # Depth larger than the stream and depth=1 both behave.
+    assert len(list(device_prefetch(batches[:2], depth=5))) == 2
+    assert len(list(device_prefetch(batches, depth=1))) == 7
+    assert list(device_prefetch([], depth=2)) == []
+    with pytest.raises(ValueError, match="depth"):
+        next(device_prefetch(batches, depth=0))
+
+
+def test_device_prefetch_keeps_bounded_chunks_in_flight():
+    placed = []
+
+    def source():
+        for i in range(6):
+            # At most `depth` chunks may have been placed beyond those the
+            # consumer has already received.
+            yield np.full((2,), i, np.float32)
+
+    consumed = 0
+    gen = device_prefetch(
+        (placed.append(i) or b for i, b in enumerate(source())), depth=2)
+    for _ in gen:
+        consumed += 1
+        assert len(placed) <= consumed + 2
+    assert consumed == 6
+
+
+def test_iter_row_chunks_partition():
+    rng = np.random.default_rng(0)
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=103, d_global=4, re_specs={"userId": (7, 3)}))
+    chunks = list(iter_row_chunks(ds, 25))
+    assert [c.num_rows for c in chunks] == [25, 25, 25, 25, 3]
+    np.testing.assert_array_equal(
+        np.concatenate([c.response for c in chunks]), ds.response)
+    with pytest.raises(ValueError, match="batch_rows"):
+        next(iter_row_chunks(ds, 0))
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(3)
+    ds = from_synthetic(synthetic.game_data(
+        rng, n=1500, d_global=6, re_specs={"userId": (12, 3)}))
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    cc = {"fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"), optimization=opt),
+          "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration("userId", "re_userId"),
+            optimization=opt)}
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc,
+                        ["fixed", "per-user"], make_mesh())
+    return est.fit(ds)[0].model, ds
+
+
+def test_transform_batched_matches_transform(trained):
+    model, ds = trained
+    t = GameTransformer(model, ["AUC"])
+    full = t.transform(ds)
+    for rows in (64, 1024, 10_000):
+        batched = t.transform_batched(ds, rows)
+        np.testing.assert_allclose(batched.scores, full.scores,
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(batched.uids, full.uids)
+    # Through the evaluating entry point too.
+    r1, e1 = t.transform_and_evaluate(ds)
+    r2, e2 = t.transform_and_evaluate(ds, batch_rows=97)
+    np.testing.assert_allclose(r2.scores, r1.scores, rtol=1e-6, atol=1e-6)
+    assert abs(e1.metrics["AUC"] - e2.metrics["AUC"]) < 1e-9
+
+
+def test_stage_dataset_device_resident(trained):
+    model, ds = trained
+    staged = stage_dataset(ds)
+    assert isinstance(staged.response, jax.Array)
+    assert isinstance(staged.feature_shards["global"], jax.Array)
+    np.testing.assert_allclose(np.asarray(model.score(staged)),
+                               np.asarray(model.score(ds)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_game_score_cli_batch_rows(trained, tmp_path):
+    """--batch-rows scores identically through the prefetch pipeline."""
+    import json
+    import os
+
+    from photon_ml_tpu.cli import game_score
+    from photon_ml_tpu.data.io import save_game_dataset
+    from photon_ml_tpu.models import io as model_io
+
+    model, ds = trained
+    data_dir = str(tmp_path / "data")
+    save_game_dataset(ds, data_dir)
+    model_dir = str(tmp_path / "model")
+    model_io.save_game_model(model, model_dir)
+
+    outs = {}
+    for tag, extra in (("full", []), ("batched", ["--batch-rows", "111"])):
+        out = str(tmp_path / tag)
+        game_score.run(game_score.build_parser().parse_args([
+            "--data", data_dir, "--model-dir", model_dir,
+            "--output-dir", out, "--evaluators", "AUC"] + extra))
+        z = np.load(os.path.join(out, "scores.npz"))
+        outs[tag] = (z["score"],
+                     json.load(open(os.path.join(out, "summary.json"))))
+    np.testing.assert_allclose(outs["batched"][0], outs["full"][0],
+                               rtol=1e-6, atol=1e-6)
+    assert abs(outs["batched"][1]["metrics"]["AUC"]
+               - outs["full"][1]["metrics"]["AUC"]) < 1e-9
