@@ -216,17 +216,41 @@ class GameEstimator:
             # guarantee this; here it must be asserted).
             for t, n_train in data.num_entities.items():
                 n_val = validation_data.num_entities.get(t)
+                if n_val is None:
+                    continue
+                # Provenance tokens (AvroDataReader attaches them) settle
+                # alignment exactly: validation's BASE vocabulary must be
+                # training's FINAL one — a true extension passes whatever
+                # the sizes, an independently-built vocabulary fails even
+                # at identical size (counts cannot tell those apart).
+                tr_tok = data.vocab_tokens.get(t)
+                va_tok = validation_data.vocab_tokens.get(t)
+                if tr_tok is not None and va_tok is not None:
+                    # Aligned iff validation's vocabulary IS training's
+                    # final one (content-identical — e.g. a subset() split)
+                    # or extends it (base == training's final).
+                    if tr_tok[1] not in va_tok:
+                        raise ValueError(
+                            f"validation entity vocabulary for {t!r} was "
+                            f"not derived from the training vocabulary "
+                            f"(provenance mismatch): entity ids would "
+                            f"silently misalign. Read validation with the "
+                            f"training vocabularies (AvroDataReader "
+                            f"entity_vocabs=meta.entity_vocabs, "
+                            f"allow_unseen_entities=True)")
+                    continue
+                # No tokens (hand-built datasets): fall back to counts.
                 # An EXTENSION of the training vocabulary is legal
                 # (allow_unseen_entities: unseen ids get rows past the
                 # frozen range and score with zero RE contribution); a
                 # smaller/reshuffled vocabulary is silent id misalignment.
-                if n_val is not None and n_val < n_train:
+                if n_val < n_train:
                     raise ValueError(
                         f"validation entity vocabulary for {t!r} has size "
                         f"{n_val} < training {n_train}; read validation "
                         f"with the training vocabularies "
                         f"(AvroDataReader entity_vocabs=...)")
-                if n_val is not None and n_val > n_train:
+                if n_val > n_train:
                     # Counts cannot distinguish a true extension from an
                     # unrelated larger vocabulary — make the assumption
                     # loud so an independently-built validation set is

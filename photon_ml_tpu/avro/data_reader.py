@@ -17,7 +17,8 @@ from typing import Optional, Sequence, Union
 import numpy as np
 
 from photon_ml_tpu.avro.container import read_records
-from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+from photon_ml_tpu.data.game_data import (GameDataset, SparseShard,
+                                          vocab_token)
 from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
                                           IndexMap, feature_key)
 
@@ -168,6 +169,7 @@ class AvroDataReader:
         if acc.num_rows == 0:
             raise ValueError(f"no records under {paths}")
         ds, uids = acc.finalize()
+        ds.vocab_tokens = _make_vocab_tokens(entity_vocabs, vocabs)
         return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
                             uids=uids)
 
@@ -263,9 +265,7 @@ class AvroDataReader:
                                forbidden_fields=frozenset(
                                    random_effect_types))
             if d is None:
-                if incremental and n:
-                    return None  # fall back cleanly before any output
-                return None
+                return None  # exotic schema: Python codec takes over
             if incremental:
                 fold_scalars(d, n)
                 fold_features(d, n)
@@ -413,6 +413,7 @@ class AvroDataReader:
                         if cfg.has_intercept else None)
                 for shard, cfg in feature_shard_configs.items()
             },
+            vocab_tokens=_make_vocab_tokens(entity_vocabs, vocabs),
         )
         return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
                             uids=uids)
@@ -545,10 +546,10 @@ class _ChunkAccumulator:
             self._dense[s].append(m)
         for s, rows in sp_rows.items():
             row_nnz = np.asarray([len(r) for r in rows], np.int64)
-            cols = np.asarray([j for r in rows
-                               for j in sorted(r)], np.int32)
-            vals = np.asarray([r[j] for r in rows
-                               for j in sorted(r)], np.float32)
+            by_row = [sorted(r.items()) for r in rows]
+            cols = np.asarray([j for r in by_row for j, _ in r], np.int32)
+            vals = np.asarray([v for r in by_row for _, v in r],
+                              np.float32)
             self._sparse[s].append((row_nnz, cols, vals))
         for t, col in ids.items():
             self._ids[t].append(col)
@@ -587,6 +588,25 @@ class _ChunkAccumulator:
             },
         )
         return ds, np.concatenate(self._uids)
+
+
+def _make_vocab_tokens(frozen_vocabs, final_vocabs):
+    """(base, final) provenance digests per RE type: ``base`` identifies
+    the frozen vocabulary the ids extend (the final vocabulary itself when
+    built fresh), ``final`` the resulting one. Lets a consumer distinguish
+    a true vocabulary extension from an unrelated same-size vocabulary —
+    counts cannot (GameEstimator.fit checks validation.base ==
+    training.final)."""
+    tokens = {}
+    for t, v in final_vocabs.items():
+        final = vocab_token(v)
+        if frozen_vocabs is not None and t in frozen_vocabs:
+            base = (final if len(frozen_vocabs[t]) == len(v)
+                    else vocab_token(frozen_vocabs[t]))
+        else:
+            base = final
+        tokens[t] = (base, final)
+    return tokens
 
 
 @dataclasses.dataclass

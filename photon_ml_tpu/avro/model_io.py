@@ -101,10 +101,15 @@ def save_game_model_avro(
                 vocab = {str(i): i for i in range(m.num_entities)}
             Z = np.asarray(m.factors)
             A = np.asarray(m.projection)
+            # A vocabulary extended via allow_unseen_entities maps entities
+            # to rows past the trained table; those have no coefficients
+            # (they score zero) and the load path already tolerates
+            # oversized vocabularies — skip them instead of IndexError.
             recs = [{"effectId": ent,
                      "factors": [float(v) for v in Z[row]]}
                     for ent, row in sorted(vocab.items(),
-                                           key=lambda kv: kv[1])]
+                                           key=lambda kv: kv[1])
+                    if row < Z.shape[0]]
             write_records(os.path.join(sub, "latent-factors.avro"),
                           schemas.LATENT_FACTOR_AVRO, recs, codec=codec)
             proj_recs = []
@@ -134,6 +139,10 @@ def save_game_model_avro(
                          else np.asarray(m.variances))
             recs = []
             for ent, row in sorted(vocab.items(), key=lambda kv: kv[1]):
+                if row >= means.shape[0]:
+                    # Extended vocabulary (allow_unseen_entities): no
+                    # trained row — scores zero; load tolerates the gap.
+                    continue
                 rec = {
                     "modelId": ent,
                     "modelClass": "RandomEffectModel",

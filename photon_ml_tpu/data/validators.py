@@ -2,7 +2,9 @@
 
 Reference parity: photon-client ``DataValidators.scala`` — before training,
 check that the data is sane for the task: features/offsets/weights finite,
-weights positive, and labels valid for the objective (binary for logistic /
+weights non-negative (zero weights are legal per-row masks, but an
+all-zero weight column is a degenerate model and draws a warning), and
+labels valid for the objective (binary for logistic /
 smoothed-hinge, finite for linear regression, non-negative for Poisson).
 The reference exposes validation levels (VALIDATE_FULL / VALIDATE_SAMPLE /
 DISABLED) on the drivers; the same knob here is ``level``.
@@ -15,10 +17,13 @@ actionable errors rather than NaN losses thousands of steps later.
 from __future__ import annotations
 
 import enum
+import logging
 
 import numpy as np
 
 from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
 
 
 class DataValidationLevel(enum.Enum):
@@ -117,6 +122,11 @@ def validate_arrays(
             raise ValueError(
                 f"weights must be >= 0; first negative at row "
                 f"{_orig_row(idx, i)} ({w[i]})")
+        if w.size and not (w > 0.0).any():
+            logger.warning(
+                "every checked weight is zero — the objective is "
+                "identically 0 and training will produce a degenerate "
+                "model (did the weight column default wrong?)")
     if offsets is not None:
         _check_finite("offsets", np.asarray(offsets), idx)
 

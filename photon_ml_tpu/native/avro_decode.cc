@@ -32,6 +32,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <new>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -576,6 +577,18 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
         if (h->error.empty()) h->error = "corrupt block header";
         return -1;
       }
+      // A decoded record occupies at least one byte, and deflate expands
+      // at most ~1032x, so a block declaring more records than its payload
+      // could possibly hold is corrupt (or hostile). Reject it here rather
+      // than letting the declared total drive a std::bad_alloc through the
+      // extern "C" boundary below (every other corruption path surfaces as
+      // a ValueError, not an abort).
+      const int64_t ratio = (h->codec == "deflate") ? 1032 : 1;
+      if (byte_size > (int64_t{1} << 40) / ratio ||
+          count > byte_size * ratio) {
+        h->error = "block declares more records than its payload can hold";
+        return -1;
+      }
       c.p += byte_size;
       if (std::memcmp(c.p, h->sync, 16) != 0) {
         h->error = "sync marker mismatch (corrupt block)";
@@ -586,12 +599,17 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
     }
   }
 
-  h->response.assign(static_cast<size_t>(h->n_records), 0.0);
-  h->offset.assign(static_cast<size_t>(h->n_records), 0.0);
-  h->weight.assign(static_cast<size_t>(h->n_records), 1.0);
-  h->uid_kind.assign(static_cast<size_t>(h->n_records), 0);
-  h->uid_long.assign(static_cast<size_t>(h->n_records), 0);
-  h->uid_str.assign(static_cast<size_t>(h->n_records), std::string());
+  try {
+    h->response.assign(static_cast<size_t>(h->n_records), 0.0);
+    h->offset.assign(static_cast<size_t>(h->n_records), 0.0);
+    h->weight.assign(static_cast<size_t>(h->n_records), 1.0);
+    h->uid_kind.assign(static_cast<size_t>(h->n_records), 0);
+    h->uid_long.assign(static_cast<size_t>(h->n_records), 0);
+    h->uid_str.assign(static_cast<size_t>(h->n_records), std::string());
+  } catch (const std::bad_alloc&) {
+    h->error = "cannot allocate columns for declared record count";
+    return -1;
+  }
 
   int64_t row = 0;
   std::vector<uint8_t> scratch;

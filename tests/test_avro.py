@@ -251,6 +251,41 @@ class TestDataReader:
         assert meta2.index_maps is meta.index_maps
         assert ds2.num_entities["userId"] == len(meta.entity_vocabs["userId"])
 
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_vocab_provenance_tokens(self, tmp_path, use_native):
+        """Datasets carry (base, final) vocabulary digests: a fresh build
+        has base == final; a frozen read that EXTENDS the vocabulary keeps
+        base == the frozen vocabulary's digest (== the fresh read's final),
+        so GameEstimator can verify validation derives from training."""
+        path, _ = _write_game_avro(tmp_path, n_users=4)
+        cfgs = {"global": FeatureShardConfig(("features",), True)}
+        reader = AvroDataReader()
+        ds, meta = reader.read(path, cfgs, random_effect_types=["userId"],
+                               use_native=use_native)
+        base, final = ds.vocab_tokens["userId"]
+        assert base == final
+        # Second file introduces a user outside the frozen vocabulary.
+        recs = [{"name": "ex", "uid": 99, "label": 1.0,
+                 "weight": 1.0, "offset": 0.0,
+                 "features": [{"name": "x0", "term": "", "value": 1.0}],
+                 "metadataMap": {"userId": "uNEW"}}]
+        path2 = str(tmp_path / "val.avro")
+        write_records(path2, schemas.TRAINING_EXAMPLE_AVRO, recs)
+        ds2, _ = reader.read(path2, cfgs, random_effect_types=["userId"],
+                             index_maps=meta.index_maps,
+                             entity_vocabs=meta.entity_vocabs,
+                             allow_unseen_entities=True,
+                             use_native=use_native)
+        base2, final2 = ds2.vocab_tokens["userId"]
+        assert base2 == final  # derives from the training vocabulary
+        assert final2 != base2  # and extends it
+        # Re-reading under the frozen vocab with no unseen ids: unchanged.
+        ds3, _ = reader.read(path, cfgs, random_effect_types=["userId"],
+                             index_maps=meta.index_maps,
+                             entity_vocabs=meta.entity_vocabs,
+                             use_native=use_native)
+        assert ds3.vocab_tokens["userId"] == (final, final)
+
     def test_unseen_entity_under_frozen_vocab_raises(self, tmp_path):
         path, _ = _write_game_avro(tmp_path)
         reader = AvroDataReader()
@@ -485,6 +520,47 @@ def test_writer_honors_field_names_preset(tmp_path):
     np.testing.assert_allclose(ds2.response, ds.response)
     np.testing.assert_allclose(ds2.offsets, ds.offsets, atol=1e-6)
     np.testing.assert_allclose(ds2.feature_shards["global"], X, atol=1e-6)
+
+
+def test_model_save_with_extended_vocab(tmp_path):
+    """Saving under a vocabulary EXTENDED via allow_unseen_entities (rows
+    past the trained table) must skip the untrained entities — they have no
+    coefficients and score zero — instead of IndexError (advisor r2)."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+    from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+    from photon_ml_tpu.types import TaskType
+
+    imap = DefaultIndexMap.from_keys(["f0", "f1"], add_intercept=False)
+    rng = np.random.default_rng(7)
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "re": RandomEffectModel(
+            re_type="userId", shard_id="s",
+            means=jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))),
+        "mf": FactoredRandomEffectModel(
+            re_type="userId", shard_id="s",
+            projection=jnp.asarray(
+                rng.normal(size=(2, 2)).astype(np.float32)),
+            factors=jnp.asarray(
+                rng.normal(size=(2, 2)).astype(np.float32))),
+    })
+    extended = {"uA": 0, "uB": 1, "uUnseen": 2, "uUnseen2": 3}
+    path = str(tmp_path / "m")
+    save_game_model_avro(gm, path, {"s": imap},
+                         entity_vocabs={"userId": extended})
+    # Loading with the same extended vocab zero-fills the unseen rows.
+    loaded = load_game_model_avro(path, {"s": imap},
+                                  entity_vocabs={"userId": extended})
+    re, mf = loaded.models["re"], loaded.models["mf"]
+    assert re.means.shape[0] == 4 and mf.factors.shape[0] == 4
+    np.testing.assert_allclose(np.asarray(re.means)[:2],
+                               np.asarray(gm.models["re"].means),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mf.factors)[:2],
+                               np.asarray(gm.models["mf"].factors),
+                               atol=1e-6)
+    assert np.all(np.asarray(re.means)[2:] == 0.0)
+    assert np.all(np.asarray(mf.factors)[2:] == 0.0)
 
 
 def test_model_load_with_larger_scoring_vocab(tmp_path):

@@ -221,3 +221,39 @@ def test_mismatched_validation_vocab_rejected(rng, mesh):
         val, num_entities={"userId": val.num_entities["userId"] + 5})
     result = est.fit(train, validation_data=extended)[0]
     assert np.isfinite(result.evaluation.primary_value)
+
+
+def test_validation_vocab_provenance_tokens(rng, mesh):
+    """With provenance tokens attached (AvroDataReader does), a validation
+    vocabulary NOT derived from the training one is rejected even at
+    identical size — the case counts cannot catch (advisor r2) — while a
+    true extension passes whatever the sizes."""
+    train, val = _datasets(rng, n=400)
+    train = dataclasses.replace(
+        train, vocab_tokens={"userId": ("tok-train", "tok-train")})
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates=_coordinates(),
+        update_sequence=["fixed", "per-user"],
+        mesh=mesh, validation_evaluators=["AUC"])
+    # Same entity count, unrelated vocabulary: provenance mismatch.
+    unrelated = dataclasses.replace(
+        val, vocab_tokens={"userId": ("tok-other", "tok-other")})
+    with pytest.raises(ValueError, match="provenance"):
+        est.fit(train, validation_data=unrelated)
+    # True extension: validation's BASE is training's FINAL token.
+    extension = dataclasses.replace(
+        val,
+        num_entities={"userId": val.num_entities["userId"] + 3},
+        vocab_tokens={"userId": ("tok-train", "tok-extended")})
+    result = est.fit(train, validation_data=extension)[0]
+    assert np.isfinite(result.evaluation.primary_value)
+    # Content-identical vocabularies are aligned even when training itself
+    # extended a frozen vocabulary (both datasets carry (B, F), B != F —
+    # e.g. one read split via subset()).
+    train_ext = dataclasses.replace(
+        train, vocab_tokens={"userId": ("tok-base", "tok-train")})
+    val_same = dataclasses.replace(
+        val, vocab_tokens={"userId": ("tok-base", "tok-train")})
+    result = est.fit(train_ext, validation_data=val_same)[0]
+    assert np.isfinite(result.evaluation.primary_value)

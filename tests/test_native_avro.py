@@ -358,6 +358,20 @@ def test_trailing_block_padding_accepted(tmp_path):
         assert ds.num_rows == 1 and ds.response[0] == 1.0
 
 
+def test_hostile_block_count_rejected(tmp_path):
+    """A block declaring vastly more records than its payload could hold
+    must surface as ValueError like every other corruption path — not
+    drive a std::bad_alloc through the extern "C" boundary (advisor r2)."""
+    path = _handrolled_file(tmp_path, "huge.avro", [_minimal_record()],
+                            count=10**15)
+    cfgs = {"global": FeatureShardConfig(("features",), True)}
+    with pytest.raises(ValueError, match="records"):
+        AvroDataReader().read(path, cfgs, use_native=True)
+    # Python codec also fails loudly (truncation mid-decode).
+    with pytest.raises((ValueError, IndexError, EOFError)):
+        AvroDataReader().read(path, cfgs, use_native=False)
+
+
 def test_overlong_varint_rejected(tmp_path):
     """A >64-bit varint is corrupt: Python raises, native must too (not
     silently wrap into plausible data)."""

@@ -47,6 +47,24 @@ class TestArrayValidation:
                             np.array([1.0, 2.0]),
                             weights=np.array([1.0, -0.5]))
 
+    def test_all_zero_weights_warn(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="photon_ml_tpu.data.validators"):
+            validate_arrays(TaskType.LINEAR_REGRESSION,
+                            np.array([1.0, 2.0]),
+                            weights=np.array([0.0, 0.0]))
+        assert any("zero" in r.message for r in caplog.records)
+        # A single positive weight is a legal per-row mask: no warning.
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="photon_ml_tpu.data.validators"):
+            validate_arrays(TaskType.LINEAR_REGRESSION,
+                            np.array([1.0, 2.0]),
+                            weights=np.array([0.0, 1.0]))
+        assert not caplog.records
+
     def test_nonfinite_offset_rejected(self):
         with pytest.raises(ValueError, match="offsets"):
             validate_arrays(TaskType.LINEAR_REGRESSION, np.array([1.0]),
