@@ -300,6 +300,41 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
     }
 
 
+def bench_host_staging(n=10_000_000, num_entities=1_000_000, d=1_000_000,
+                       nnz=8):
+    """Host-side staging at the design-target scale (round-2 verdict:
+    unmeasured): build_bucketing + per-entity subspace projection for a
+    random effect over 10M rows, 1M entities, d=1M sparse features —
+    all-numpy work that happens once per fit, before any device step."""
+    from photon_ml_tpu.data.game_data import SparseShard
+    from photon_ml_tpu.game.buckets import build_bucketing
+    from photon_ml_tpu.game.projector import (build_bucket_projection,
+                                              shard_coo)
+
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, num_entities, n).astype(np.int32)
+    idx = np.sort(rng.integers(0, d, (n, nnz)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    idx[dup] = d
+    vals[dup] = 0.0
+    shard = SparseShard(idx, vals, d)
+
+    t0 = time.perf_counter()
+    bucketing = build_bucketing(ids, num_entities)
+    t1 = time.perf_counter()
+    coo = shard_coo(shard)
+    for bk in bucketing.buckets:
+        build_bucket_projection(bk, shard, None, coo=coo)
+    t2 = time.perf_counter()
+    return {
+        "staging_bucketing_seconds": round(t1 - t0, 2),
+        "staging_projection_seconds": round(t2 - t1, 2),
+        "staging_seconds_10m_rows_1m_entities": round(t2 - t0, 2),
+    }
+
+
 def bench_pallas_scatter(n=1 << 17, k=32, d=512):
     """Pallas compare+accumulate scatter vs XLA sort/segment scatter at the
     moderate-d regime the kernel targets. Skipped off-TPU (the Mosaic
@@ -429,6 +464,8 @@ def main():
     sparse = bench_sparse()
     _progress("sparse random effect")
     sparse_re = bench_sparse_random_effect()
+    _progress("host staging at 10M rows / 1M entities")
+    staging = bench_host_staging()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
     _progress("avro ingestion")
@@ -460,6 +497,7 @@ def main():
             "sparse_hybrid_staging_seconds":
                 sparse["sparse_hybrid_staging_seconds"],
             **sparse_re,
+            **staging,
             **{key: round(v, 1) for key, v in scatter.items()},
             **ingest,
             "game_cd_iteration_seconds": round(game_iter_s, 3),

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Render the performance sections of README.md and docs/PARITY.md from the
+canonical bench JSON.
+
+Round-2 verdict: performance numbers were being hand-copied into the docs
+and drifted from the driver-captured bench (63 Gnnz/s vs the real 0.07;
+833 M vs 727 M; 0.04 s vs 0.064 s; 14x vs 12.9x). This script makes the
+bench JSON the single source of truth: ``python dev-scripts/
+render_perf_docs.py`` rewrites everything between the
+``<!-- bench:autogen ... -->`` markers from ``docs/BENCH_CURRENT.json``
+(refresh it with ``python bench.py > docs/BENCH_CURRENT.json`` on the
+device), and ``--check`` exits 1 if the docs are stale
+(tests/test_utils.py pins this in CI).
+
+Lines are emitted only for keys present in the JSON, so older bench
+captures render without error.
+"""
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_JSON = os.path.join(ROOT, "docs", "BENCH_CURRENT.json")
+BEGIN = "<!-- bench:autogen:begin (dev-scripts/render_perf_docs.py) -->"
+END = "<!-- bench:autogen:end -->"
+
+# v5e single-chip roofs the achieved numbers are audited against.
+HBM_PEAK_GBS = 800.0
+
+
+def load_bench(path=BENCH_JSON):
+    with open(path) as fh:
+        doc = json.load(fh)
+    if "parsed" in doc:  # driver capture (BENCH_rNN.json) wrapper
+        doc = doc["parsed"]
+    flat = dict(doc.get("secondary", {}))
+    flat["primary_samples_per_sec"] = doc.get("value")
+    flat["vs_baseline"] = doc.get("vs_baseline")
+    return flat
+
+
+def _human_rate(x):
+    """365_445_753 -> '365 M'; 94_000 -> '94 k'."""
+    if x >= 995e6:
+        return f"{x / 1e9:.2f}".rstrip("0").rstrip(".") + " B"
+    if x >= 1e6:
+        v = x / 1e6
+        return f"{v:.0f} M" if v >= 10 else f"{v:.1f} M"
+    if x >= 1e3:
+        return f"{x / 1e3:.0f} k"
+    return f"{x:.0f}"
+
+
+def _lines(b):
+    """(readme_row, parity_bullet) pairs, None entries skipped."""
+    out = []
+
+    def row(label, value, bullet=None):
+        out.append((f"| {label} | {value} |", bullet or f"{label}: {value}"))
+
+    v = b.get("primary_samples_per_sec")
+    if v:
+        gbs = b.get("achieved_gbytes_per_sec")
+        extra = (f" ({gbs:.0f} GB/s ≈ {100 * gbs / HBM_PEAK_GBS:.0f}% of "
+                 f"HBM peak)" if gbs else "")
+        row("Dense f32 gradient step (n=2¹⁹, d=256)",
+            f"**{_human_rate(v)} samples/s**{extra}",
+            f"dense f32 gradient step **{_human_rate(v)} samples/s** at "
+            f"n=2¹⁹, d=256{extra.replace('(', '— ').rstrip(')')} "
+            f"(bandwidth-bound, as expected)")
+        bf = b.get("bf16_samples_per_sec")
+        if bf:
+            row("…with bf16 feature storage",
+                f"**{_human_rate(bf)} samples/s** ({bf / v:.1f}× f32)",
+                f"bf16 feature storage **{_human_rate(bf)} samples/s** "
+                f"({bf / v:.1f}× f32: halves the streamed bytes, f32 MXU "
+                f"accumulation)")
+    if b.get("lbfgs_full_iteration_ms"):
+        row("Full compiled L-BFGS iteration (n=131k, d=256)",
+            f"{b['lbfgs_full_iteration_ms']:.2f} ms",
+            f"full compiled L-BFGS iteration (value+grad + two-loop + "
+            f"strong-Wolfe) {b['lbfgs_full_iteration_ms']:.2f} ms at "
+            f"n=131k, d=256")
+    if b.get("tron_full_iteration_ms"):
+        row("TRON iteration (10 CG steps)",
+            f"{b['tron_full_iteration_ms']:.1f} ms")
+    sp = b.get("sparse_1m_feature_samples_per_sec")
+    if sp:
+        gnnz = b.get("sparse_gnnz_per_sec")
+        ell = b.get("sparse_ell_samples_per_sec")
+        # Only label the number as the hybrid layout when this capture
+        # actually measured it (pre-hybrid captures report the ELL path).
+        hybrid = b.get("sparse_hybrid_hot_cols") is not None
+        vs_ell = f", {sp / ell:.1f}× the exact-ELL scatter" if ell else ""
+        label = ("Sparse 1M-feature gradient step (hybrid hot-dense/cold)"
+                 if hybrid else "Sparse 1M-feature gradient step (ELL)")
+        tail = (" — hybrid hot-dense/cold-class layout riding the Zipf "
+                "head (exact objective; ELL shard_map kept for "
+                "multi-device/feature-sharded runs)" if hybrid else "")
+        row(label,
+            f"**{_human_rate(sp)} samples/s**"
+            + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "") + vs_ell,
+            f"sparse 1M-feature gradient step **{_human_rate(sp)} "
+            f"samples/s**" + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "")
+            + vs_ell + tail)
+        spb = b.get("sparse_bf16_samples_per_sec")
+        if spb:
+            row("…with bf16 feature storage",
+                f"**{_human_rate(spb)} samples/s**")
+    if b.get("sparse_re_fit_seconds") is not None:
+        cfgs = b.get("sparse_re_config", "")
+        row(f"Sparse random-effect fit ({cfgs})",
+            f"{b['sparse_re_fit_seconds']:.2f} s/fit + "
+            f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
+            f"staging",
+            f"sparse random effects ({cfgs}): "
+            f"{b['sparse_re_fit_seconds']:.2f} s per train_model after "
+            f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
+            f"staging — the (n, d) dense matrix never exists")
+    if b.get("staging_seconds_10m_rows_1m_entities") is not None:
+        row("Host staging, 10M rows / 1M entities / d=1M sparse",
+            f"**{b['staging_seconds_10m_rows_1m_entities']:.0f} s** "
+            f"(bucketing + per-entity subspace projection)",
+            f"host-side staging at 10M rows / 1M entities / d=1M sparse: "
+            f"**{b['staging_seconds_10m_rows_1m_entities']:.0f} s** total "
+            f"(build_bucketing "
+            f"{b.get('staging_bucketing_seconds', 0):.1f} s + projection "
+            f"{b.get('staging_projection_seconds', 0):.1f} s) — one "
+            f"vectorized sort + segment-reduce pass, no per-entity loops")
+    pal = b.get("scatter_pallas_d512_us")
+    xla = b.get("scatter_xla_d512_us")
+    if pal and xla:
+        row("Pallas scatter vs XLA (d=512)", f"**{xla / pal:.1f}×**",
+            f"Pallas compare+accumulate scatter kernel **{xla / pal:.1f}× "
+            f"XLA's** sort/segment lowering at d=512")
+    if b.get("game_cd_iteration_seconds") is not None:
+        row("GAME CD sweep, 100k rows / 2.5k entities",
+            f"**{b['game_cd_iteration_seconds']:.3f} s** steady-state "
+            f"(20.9 s in round 1)",
+            f"GAME CD sweep (fixed + 2 RE coordinates): "
+            f"**{b['game_cd_iteration_seconds']:.3f} s** steady-state on "
+            f"the 100k-example config (20.9 s in round 1; device-resident "
+            f"descent)")
+    av = b.get("avro_native_records_per_sec")
+    avp = b.get("avro_python_records_per_sec")
+    if av and avp:
+        row("Avro ingestion, native C++ vs Python codec",
+            f"**{av / avp:.1f}×** ({_human_rate(av)} vs {_human_rate(avp)} "
+            f"records/s)")
+    return out
+
+
+def render_block(b, style):
+    lines = _lines(b)
+    if style == "readme":
+        body = ["| Workload | Number |", "|---|---|"]
+        body += [r for r, _ in lines]
+    else:
+        body = [f"- {p};" for _, p in lines]
+        if body:
+            body[-1] = body[-1][:-1] + "."
+    return "\n".join([BEGIN] + body + [END])
+
+
+def splice(text, block):
+    i = text.index(BEGIN)
+    j = text.index(END) + len(END)
+    return text[:i] + block + text[j:]
+
+
+def main(argv):
+    check = "--check" in argv
+    b = load_bench()
+    stale = []
+    for path, style in [(os.path.join(ROOT, "README.md"), "readme"),
+                        (os.path.join(ROOT, "docs", "PARITY.md"), "parity")]:
+        with open(path) as fh:
+            text = fh.read()
+        new = splice(text, render_block(b, style))
+        if new != text:
+            if check:
+                stale.append(path)
+            else:
+                with open(path, "w") as fh:
+                    fh.write(new)
+                print(f"rendered {path}")
+    if stale:
+        print("STALE perf docs (run dev-scripts/render_perf_docs.py):")
+        for p in stale:
+            print(f"  {p}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -83,10 +83,15 @@ def build_bucketing(
     """
     entity_ids = np.asarray(entity_ids)
     n = entity_ids.shape[0]
-    order = np.argsort(entity_ids, kind="stable")
-    sorted_ids = entity_ids[order]
-    uniq, starts, counts = np.unique(sorted_ids, return_index=True,
-                                     return_counts=True)
+    # Entity ids are rows into the entity table (non-negative, bounded), so
+    # segments come from one bincount pass instead of np.unique's second
+    # sort; int32 keys sort measurably faster than int64 at 10⁷ rows.
+    order = np.argsort(entity_ids.astype(np.int32, copy=False),
+                       kind="stable")
+    counts_all = np.bincount(entity_ids)
+    uniq = np.flatnonzero(counts_all)
+    counts = counts_all[uniq]
+    starts = (np.cumsum(counts) - counts).astype(np.int64)
 
     trained = np.zeros(num_entities, bool)
     capped = counts if upper_bound is None else np.minimum(counts, upper_bound)
@@ -96,8 +101,11 @@ def build_bucketing(
     if upper_bound is not None:
         passive_examples += int((counts - capped)[keep].sum())
 
-    # Bucket key: power-of-two capacity of the capped count.
-    caps = np.maximum(min_capacity, np.array([_next_pow2(c) for c in capped]))
+    # Bucket key: power-of-two capacity of the capped count. log2 of an
+    # exact power of two is exact in float64, so ceil never overshoots.
+    caps = np.maximum(
+        min_capacity,
+        1 << np.ceil(np.log2(np.maximum(capped, 1))).astype(np.int64))
     buckets: list[EntityBucket] = []
     for cap in np.unique(caps[keep]):
         sel = np.where(keep & (caps == cap))[0]
@@ -107,18 +115,26 @@ def build_bucketing(
         ex = np.full((pad_e, int(cap)), -1, np.int64)
         rows = np.full((pad_e,), -1, np.int32)
         cnts = np.zeros((pad_e,), np.int32)
-        for i, u in enumerate(sel):
-            c = int(capped[u])
-            idx = order[starts[u]: starts[u] + counts[u]]
-            if c < counts[u]:
-                # Cap: random subset (reference uses reservoir-style sampling).
-                pick = (rng.choice(counts[u], size=c, replace=False)
-                        if rng is not None else np.arange(c))
-                idx = idx[pick]
-            ex[i, :c] = idx
-            rows[i] = uniq[u]
-            cnts[i] = c
-            trained[uniq[u]] = True
+        # One padded gather for the whole class (no per-entity loop; at
+        # 10⁶ entities the loop dominated staging): lane j of entity i
+        # reads order[starts[i] + j] when j < its capped count.
+        c_sel = capped[sel].astype(np.int64)
+        lane = np.arange(int(cap), dtype=np.int64)[None, :]
+        valid = lane < c_sel[:, None]
+        src = np.minimum(starts[sel][:, None] + lane, n - 1)
+        ex[:e_b] = np.where(valid, order[src], -1)
+        if rng is not None:
+            # Random capping draws per-entity subsets; only entities whose
+            # count exceeds the cap need it (same rng call sequence as the
+            # historical per-entity loop: ascending entity order).
+            for i in np.flatnonzero(c_sel < counts[sel]):
+                u = sel[i]
+                pick = rng.choice(counts[u], size=int(c_sel[i]),
+                                  replace=False)
+                ex[i, :c_sel[i]] = order[starts[u] + pick]
+        rows[:e_b] = uniq[sel]
+        cnts[:e_b] = c_sel
+        trained[uniq[sel]] = True
         buckets.append(EntityBucket(entity_rows=rows, example_idx=ex,
                                     counts=cnts))
 

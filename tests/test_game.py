@@ -80,6 +80,48 @@ def test_bucketing_upper_bound_caps_samples(rng):
     assert b.num_passive_examples == 84
 
 
+def test_bucketing_matches_per_entity_reference(rng):
+    """The vectorized builder (one padded gather per capacity class; no
+    per-entity Python loops — round-2 verdict: staging at 10⁶ entities)
+    must reproduce the straightforward per-entity construction exactly,
+    including deterministic capping and padding."""
+    def reference(ids, num_entities, lower_bound, upper_bound):
+        order = np.argsort(ids, kind="stable")
+        uniq, starts, counts = np.unique(ids[order], return_index=True,
+                                         return_counts=True)
+        capped = (counts if upper_bound is None
+                  else np.minimum(counts, upper_bound))
+        keep = counts >= max(1, lower_bound)
+        caps = np.maximum(8, np.array([bkt._next_pow2(int(c))
+                                       for c in capped]))
+        out = {}
+        for cap in np.unique(caps[keep]):
+            sel = np.where(keep & (caps == cap))[0]
+            pad_e = ((len(sel) + 7) // 8) * 8
+            ex = np.full((pad_e, int(cap)), -1, np.int64)
+            rows = np.full((pad_e,), -1, np.int32)
+            for i, u in enumerate(sel):
+                c = int(capped[u])
+                ex[i, :c] = order[starts[u]: starts[u] + c]
+                rows[i] = uniq[u]
+            out[int(cap)] = (rows, ex)
+        return out
+
+    for trial in range(5):
+        n = int(rng.integers(50, 2000))
+        E = int(rng.integers(3, 60))
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        lb = int(rng.integers(1, 4))
+        ub = None if trial % 2 else int(rng.integers(4, 40))
+        got = bkt.build_bucketing(ids, E, lower_bound=lb, upper_bound=ub)
+        want = reference(ids, E, lb, ub)
+        assert {b.capacity for b in got.buckets} == set(want)
+        for b in got.buckets:
+            rows, ex = want[b.capacity]
+            np.testing.assert_array_equal(b.entity_rows, rows)
+            np.testing.assert_array_equal(b.example_idx, ex)
+
+
 def test_bucket_weights_zero_padding(rng):
     ids = rng.integers(0, 7, size=60).astype(np.int32)
     b = bkt.build_bucketing(ids, 7)

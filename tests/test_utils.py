@@ -246,3 +246,24 @@ class TestNativeLibsvm:
             assert d.dense[1, 0] == 0.0
 
         both("1 1:9e999\n0 1:1e-999\n", expect_inf_and_zero)
+
+
+class TestPerfDocsRendered:
+    """README/PARITY perf numbers must be rendered from the committed
+    bench capture, never hand-edited (round-2 verdict: doc drift)."""
+
+    def test_docs_in_sync_with_bench_json(self):
+        import importlib.util
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(root, "dev-scripts", "render_perf_docs.py")
+        spec = importlib.util.spec_from_file_location("render_perf", script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert os.path.exists(mod.BENCH_JSON), (
+            "docs/BENCH_CURRENT.json missing — capture one with "
+            "`python bench.py > docs/BENCH_CURRENT.json`")
+        assert mod.main(["--check"]) == 0, (
+            "perf docs drifted from docs/BENCH_CURRENT.json — run "
+            "dev-scripts/render_perf_docs.py")
