@@ -69,6 +69,11 @@ class RandomEffectDataConfiguration:
     # LocalDataset.filterFeaturesByPearsonCorrelationScore). Implies
     # projection.
     features_to_samples_ratio: Optional[float] = None
+    # Keep the trained model in each entity's active-column subspace
+    # (reference: RandomEffectModelInProjectedSpace) instead of the dense
+    # (num_entities, d) table. None = automatic: on when the dense table
+    # would exceed ~1 GiB. Requires a projected coordinate.
+    subspace_model: Optional[bool] = None
 
     def __post_init__(self):
         if self.projector.upper() not in ("NONE", "INDEX_MAP", "RANDOM"):

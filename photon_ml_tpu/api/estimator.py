@@ -137,7 +137,8 @@ class GameEstimator:
                                                 NormalizationContext()),
                     projection=cc.data.projector.upper() == "INDEX_MAP",
                     features_to_samples_ratio=(
-                        cc.data.features_to_samples_ratio))
+                        cc.data.features_to_samples_ratio),
+                    subspace_model=cc.data.subspace_model)
             elif isinstance(cc.data, FactoredRandomEffectDataConfiguration):
                 if cc.data.feature_shard_id in self.normalization:
                     raise ValueError(

@@ -25,7 +25,8 @@ import numpy as np
 from photon_ml_tpu.avro import schemas
 from photon_ml_tpu.avro.container import read_records, write_records
 from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
-                                       RandomEffectModel)
+                                       RandomEffectModel,
+                                       SubspaceRandomEffectModel)
 from photon_ml_tpu.index.indexmap import (DefaultIndexMap, IndexMap,
                                           split_key)
 from photon_ml_tpu.models.coefficients import Coefficients
@@ -54,6 +55,21 @@ def _ntv_to_vector(ntv: list[dict], imap: IndexMap, dim: int) -> np.ndarray:
         if j >= 0:
             vec[j] = rec["value"]
     return vec
+
+
+def _active_to_ntv(cols_row: np.ndarray, vals_row: np.ndarray,
+                   imap: IndexMap) -> list[dict]:
+    """Entries for ALL active columns (zero coefficients included): the
+    active set IS the entity's subspace and must survive a round trip."""
+    out = []
+    for a in np.flatnonzero(cols_row >= 0):
+        j = int(cols_row[a])
+        key = imap.get_feature_name(j)
+        if key is None:
+            raise KeyError(f"index map has no feature for column {j}")
+        name, term = split_key(key)
+        out.append({"name": name, "term": term, "value": float(vals_row[a])})
+    return out
 
 
 def _is_factored(m) -> bool:
@@ -128,6 +144,41 @@ def save_game_model_avro(
                 "type": "factored", "shard": m.shard_id,
                 "re_type": m.re_type, "num_entities": m.num_entities,
                 "rank": int(m.rank),
+            }
+        elif isinstance(m, SubspaceRandomEffectModel):
+            # Reference: RandomEffectModelInProjectedSpace — per-entity
+            # records carry exactly the active-column coefficients (the
+            # BayesianLinearModelAvro name/term/value layout is naturally
+            # sparse), so the (E, d) dense table never exists on disk
+            # either.
+            sub = os.path.join(path, _RANDOM, cid)
+            vocab = entity_vocabs.get(m.re_type)
+            if vocab is None:
+                vocab = {str(i): i for i in range(m.num_entities)}
+            cols = np.asarray(m.cols)
+            means = np.asarray(m.means)
+            variances = (None if m.variances is None
+                         else np.asarray(m.variances))
+            recs = []
+            for ent, row in sorted(vocab.items(), key=lambda kv: kv[1]):
+                if row >= cols.shape[0]:
+                    continue  # extended vocab: untrained, scores zero
+                rec = {
+                    "modelId": ent,
+                    "modelClass": "RandomEffectModel",
+                    "means": _active_to_ntv(cols[row], means[row], imap),
+                }
+                if variances is not None:
+                    rec["variances"] = _active_to_ntv(
+                        cols[row], variances[row], imap)
+                recs.append(rec)
+            write_records(os.path.join(sub, "part-00000.avro"),
+                          schemas.BAYESIAN_LINEAR_MODEL_AVRO, recs,
+                          codec=codec)
+            meta["coordinates"][cid] = {
+                "type": "random-subspace", "shard": m.shard_id,
+                "re_type": m.re_type, "num_entities": m.num_entities,
+                "subspace_dim": int(m.subspace_dim),
             }
         else:
             sub = os.path.join(path, _RANDOM, cid)
@@ -216,6 +267,50 @@ def load_game_model_avro(
             models[cid] = FactoredRandomEffectModel(
                 re_type=info["re_type"], shard_id=info["shard"],
                 projection=jnp.asarray(A), factors=jnp.asarray(Z))
+        elif info["type"] == "random-subspace":
+            from photon_ml_tpu.index.indexmap import feature_key
+
+            recs = read_records(os.path.join(path, _RANDOM, cid))
+            vocab = entity_vocabs.get(info["re_type"]) or {
+                r["modelId"]: i for i, r in enumerate(recs)}
+            n_ent = max(info.get("num_entities", 0), len(vocab),
+                        max(vocab.values(), default=-1) + 1)
+            A = max(int(info.get("subspace_dim", 1)), 1)
+            cols = np.full((n_ent, A), -1, np.int32)
+            means = np.zeros((n_ent, A), np.float32)
+            variances = None
+            for rec in recs:
+                row = vocab.get(rec["modelId"])
+                if row is None:
+                    continue
+                for a, e in enumerate(rec["means"][:A]):
+                    j = imap.get_index(feature_key(e["name"],
+                                                   e.get("term", "")))
+                    if j >= 0:
+                        cols[row, a] = j
+                        means[row, a] = e["value"]
+                if rec.get("variances") is not None:
+                    if variances is None:
+                        variances = np.zeros((n_ent, A), np.float32)
+                    for a, e in enumerate(rec["variances"][:A]):
+                        if cols[row, a] >= 0:
+                            variances[row, a] = e["value"]
+            # Re-sort each row by column id (padding last): the caller's
+            # index map may reorder columns (or drop some, leaving -1
+            # holes mid-row), and score() requires sorted cols rows.
+            order = np.argsort(
+                np.where(cols < 0, np.iinfo(np.int32).max, cols),
+                axis=1, kind="stable")
+            cols = np.take_along_axis(cols, order, axis=1)
+            means = np.take_along_axis(means, order, axis=1)
+            if variances is not None:
+                variances = np.take_along_axis(variances, order, axis=1)
+            models[cid] = SubspaceRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard"],
+                num_features=dim, cols=jnp.asarray(cols),
+                means=jnp.asarray(means),
+                variances=(None if variances is None
+                           else jnp.asarray(variances)))
         else:
             recs = read_records(os.path.join(path, _RANDOM, cid))
             vocab = entity_vocabs.get(info["re_type"]) or {

@@ -285,6 +285,12 @@ def run(args) -> dict:
                 hybrid=(None if hybrid_kv == "auto"
                         else hybrid_kv == "true"))
         elif kv["type"] == "random":
+            sub_kv = kv.get("subspace", "auto").lower()
+            if sub_kv not in ("auto", "true", "false"):
+                raise ValueError(
+                    f"subspace= must be auto, true, or false "
+                    f"(got {sub_kv!r})")
+            kv["subspace"] = sub_kv
             data = RandomEffectDataConfiguration(
                 random_effect_type=kv["re"],
                 feature_shard_id=kv["shard"],
@@ -296,7 +302,10 @@ def run(args) -> dict:
                                      if "projected_dim" in kv else None),
                 features_to_samples_ratio=(
                     float(kv["features_to_samples_ratio"])
-                    if "features_to_samples_ratio" in kv else None))
+                    if "features_to_samples_ratio" in kv else None),
+                subspace_model=(
+                    None if kv.get("subspace", "auto") == "auto"
+                    else kv["subspace"] == "true"))
         elif kv["type"] == "factored":
             data = FactoredRandomEffectDataConfiguration(
                 random_effect_type=kv["re"],

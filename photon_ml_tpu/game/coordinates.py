@@ -68,6 +68,12 @@ Array = jax.Array
 # None (projected buckets with no intercept column).
 _UNSET = object()
 
+# Max entity lanes per vmapped random-effect solve dispatch: the solver's
+# carry/line-search temps scale with lanes, and one dispatch over ~600k
+# lanes OOMs a 16 GB chip. 64k lanes keeps temps ~100 MB at typical widths
+# while staying large enough to saturate the chip.
+_LANE_CHUNK = 65536
+
 
 class FixedEffectCoordinate:
     """One shared GLM trained data-parallel over the mesh.
@@ -622,6 +628,7 @@ class RandomEffectCoordinate:
         seed: int = 0,
         projection: bool = False,
         features_to_samples_ratio: Optional[float] = None,
+        subspace_model: Optional[bool] = None,
     ):
         from photon_ml_tpu.data.game_data import SparseShard
         self.is_sparse = isinstance(dataset.feature_shards[shard_id],
@@ -669,6 +676,19 @@ class RandomEffectCoordinate:
         self.features_to_samples_ratio = features_to_samples_ratio
         self.projection = bool(projection) or (
             features_to_samples_ratio is not None)
+        # Subspace model representation (reference:
+        # RandomEffectModelInProjectedSpace): the trained table stays
+        # (E, A) in each entity's active-column space instead of the dense
+        # (E, d) — mandatory at the scale where E·d is unmaterializable.
+        # Auto-on when the dense table would exceed ~1 GiB.
+        if subspace_model is None:
+            subspace_model = (self.projection and
+                              self.num_entities * self.dim > (1 << 28))
+        if subspace_model and not self.projection:
+            raise ValueError(
+                "subspace_model=True requires projection=True (the "
+                "subspace IS the per-entity projection)")
+        self.subspace = bool(subspace_model)
         # Stage static per-bucket device arrays ONCE: features/labels/weights
         # in (E_b, cap, …) layout plus the gather/scatter index maps. The
         # entity axis is sharded over the mesh's data axis (P2) when the
@@ -693,6 +713,7 @@ class RandomEffectCoordinate:
             f_full = np.ones_like(s_full)
 
         coo = prj.shard_coo(X) if self.projection else None
+        bucket_cols: list[np.ndarray] = []  # per-bucket (E_b, d_active)
         for b in self.bucketing.buckets:
             wb = bkt.bucket_weights(b, ds.weights)
             ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
@@ -708,6 +729,7 @@ class RandomEffectCoordinate:
                                                    triplets=trip)
                 (yb,) = bkt.gather_bucket_arrays(b, ds.response)
                 f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
+                bucket_cols.append(proj.cols)
                 extra = [proj.cols]
                 if f_full is not None:
                     extra.append(f_p)
@@ -717,8 +739,54 @@ class RandomEffectCoordinate:
             else:
                 Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
                 arrays = (Xb, yb, wb, ex, rows)
-            self._bucket_data.append(
-                tuple(put(np.asarray(a)) for a in arrays))
+            # Bound the vmapped-solve footprint: a single dispatch over
+            # hundreds of thousands of entity lanes exhausts HBM on solver
+            # temps (the L-BFGS carry and line-search buffers scale with
+            # lanes), so buckets split into ≤ _LANE_CHUNK-entity pieces.
+            # Chunks of equal shape share one compiled program; chunk
+            # boundaries stay multiples of the entity pad (sharding-safe).
+            E_b = rows.shape[0]
+            for lo in range(0, E_b, _LANE_CHUNK):
+                hi = min(lo + _LANE_CHUNK, E_b)
+                self._bucket_data.append(tuple(
+                    put(np.asarray(a)[lo:hi]) for a in arrays))
+        if self.subspace:
+            # (E, A) active-column table: each entity lives in exactly one
+            # bucket, so its model row is its bucket row padded to the
+            # widest bucket's d_active. The PUBLIC model layout sorts each
+            # row by column id (padding last) so SubspaceRandomEffectModel
+            # .score can join new datasets with a device-side searchsorted;
+            # the bucket-internal layout (intercept slot 0) is reached
+            # through the stored permutation at the train/warm-start
+            # boundary.
+            A = max((c.shape[1] for c in bucket_cols), default=1)
+            cols_tab = np.full((self.num_entities, A), -1, np.int32)
+            for b, c in zip(self.bucketing.buckets, bucket_cols):
+                live = b.entity_rows >= 0
+                cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
+            perm = np.argsort(
+                np.where(cols_tab < 0, np.iinfo(np.int32).max, cols_tab),
+                axis=1, kind="stable").astype(np.int32)  # sorted ← bucket
+            cols_sorted = np.take_along_axis(cols_tab, perm, axis=1)
+            self.subspace_cols = cols_sorted
+            self._cols_dev = put(cols_sorted)
+            self._perm_dev = put(perm)
+            self._inv_perm_dev = put(
+                np.argsort(perm, axis=1, kind="stable").astype(np.int32))
+            if self.is_sparse:
+                # Stage the score-side join ONCE: data nonzeros → flat
+                # slots of the (E, A) table (E*A = miss/passive → zero).
+                from photon_ml_tpu.game.models import _subspace_positions
+                flat = _subspace_positions(
+                    cols_sorted, self.dim,
+                    np.asarray(ds.entity_ids[re_type]),
+                    np.asarray(dataset.feature_shards[shard_id].indices))
+                fp_dtype = (np.int32 if cols_sorted.size < 2**31 - 1
+                            else np.int64)
+                self._sp_flatpos = put(flat.astype(fp_dtype))
+                # The raw column ids are only needed by the dense-table
+                # score path — free the device copy at scale.
+                self._sp_indices = None
         self._build_fits()
 
     def _build_fits(self):
@@ -813,8 +881,26 @@ class RandomEffectCoordinate:
             safe_cols = jnp.where(cols >= 0, cols, dim)
             return ob, w0, safe_rows, safe_cols
 
+        subspace = self.subspace
+
+        def sub_gathers(W, offsets, ex, rows, da):
+            """Subspace-table layout: the entity's model row IS its bucket
+            row (same active-column order), so warm starts are a plain row
+            gather + static slice to this bucket's width."""
+            ob = offsets[jnp.maximum(ex, 0)]
+            w0 = W[jnp.maximum(rows, 0)][:, :da]
+            safe_rows = jnp.where(rows >= 0, rows, num_entities)
+            return ob, w0, safe_rows
+
         def fit_bucket(W, offsets, Xb, yb, wb, ex, rows, *extra):
             cols, f, s = unpack(extra)
+            if subspace:
+                da = cols.shape[1]
+                ob, w0, safe_rows = sub_gathers(W, offsets, ex, rows, da)
+                w_fit = vsolve(Xb, yb, wb, ob, w0, f, s)
+                # Whole-row set: the padding tail past d_active stays zero.
+                w_pad = jnp.pad(w_fit, ((0, 0), (0, W.shape[1] - da)))
+                return W.at[safe_rows].set(w_pad, mode="drop")
             ob, w0, safe_rows, safe_cols = gathers(W, offsets, ex, rows, cols)
             w_fit = vsolve(Xb, yb, wb, ob, w0, f, s)
             # projectBackward semantics: a trained entity's FULL row is
@@ -825,6 +911,12 @@ class RandomEffectCoordinate:
 
         def var_bucket(W, V, offsets, Xb, yb, wb, ex, rows, *extra):
             cols, f, s = unpack(extra)
+            if subspace:
+                da = cols.shape[1]
+                ob, w_opt, safe_rows = sub_gathers(W, offsets, ex, rows, da)
+                var = vvar(Xb, yb, wb, ob, w_opt, f, s)
+                v_pad = jnp.pad(var, ((0, 0), (0, V.shape[1] - da)))
+                return V.at[safe_rows].set(v_pad, mode="drop")
             ob, w_opt, safe_rows, safe_cols = gathers(W, offsets, ex, rows,
                                                       cols)
             var = vvar(Xb, yb, wb, ob, w_opt, f, s)
@@ -885,26 +977,77 @@ class RandomEffectCoordinate:
     def adapt_initial(self, initial):
         """Accept a factored warm start by materializing its implied
         full-rank (E, d) table (reference: the factored coordinate hands
-        RandomEffectModels to neighboring coordinate updates)."""
+        RandomEffectModels to neighboring coordinate updates). In subspace
+        mode, dense warm starts are additionally gathered into this
+        coordinate's (E, A) active-column layout — inactive-column mass
+        cannot survive a projected retrain anyway (projectBackward)."""
         from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
 
         if isinstance(initial, FactoredRandomEffectModel):
-            return initial.to_random_effect_model()
-        return initial
+            initial = initial.to_random_effect_model()
+        if not self.subspace:
+            if isinstance(initial, SubspaceRandomEffectModel):
+                return initial.to_random_effect_model()
+            return initial
+        if isinstance(initial, SubspaceRandomEffectModel):
+            if initial.cols.shape[0] != self.subspace_cols.shape[0]:
+                raise ValueError(
+                    f"subspace warm start has {initial.cols.shape[0]} "
+                    f"entities, coordinate expects "
+                    f"{self.subspace_cols.shape[0]}")
+            if np.array_equal(np.asarray(initial.cols),
+                              self.subspace_cols):
+                return initial
+            # Active sets differ (e.g. bucket bounds changed between
+            # runs): re-map per entity via sorted-row searchsorted —
+            # coefficients for columns no longer active are dropped
+            # (projectBackward semantics), never misattributed.
+            src_c = jnp.asarray(initial.cols)
+            src_s = jnp.where(src_c < 0, self.dim + 1, src_c)
+            tgt = jnp.asarray(self.subspace_cols)
+            tgt_q = jnp.where(tgt < 0, self.dim + 2, tgt)  # never matches
+            pos = jax.vmap(jnp.searchsorted)(src_s, tgt_q)
+            posc = jnp.minimum(pos, src_c.shape[1] - 1)
+            hit = jnp.take_along_axis(src_s, posc, axis=1) == tgt_q
+            means = jnp.take_along_axis(
+                jnp.asarray(initial.means), posc, axis=1) * hit
+            return SubspaceRandomEffectModel(
+                re_type=self.re_type, shard_id=self.shard_id,
+                num_features=self.dim, cols=tgt, means=means)
+        # Dense (E, d) → gather the active columns per entity.
+        cols = jnp.asarray(self.subspace_cols)
+        means = jnp.asarray(initial.means)
+        ga = means[jnp.arange(cols.shape[0])[:, None],
+                   jnp.maximum(cols, 0)] * (cols >= 0)
+        return SubspaceRandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            num_features=self.dim, cols=cols, means=ga)
 
     def train_model(
         self,
         offsets: Array,
         initial: Optional[RandomEffectModel] = None,
     ) -> RandomEffectModel:
+        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
+
         if initial is not None:
             initial = self.adapt_initial(initial)
         # Warm starts arrive in original space. Unprojected path: the W table
         # is transformed once at entry and mapped back once at exit.
         # Projected path: transforms are per-entity inside the bucket fit, so
-        # W stays in original space throughout.
+        # W stays in original space throughout. Subspace path: same, with
+        # the table in (E, A) active-column layout — (E, d) never exists.
         if initial is None:
-            W = jnp.zeros((self.num_entities, self.dim), jnp.float32)
+            shape = (self.subspace_cols.shape if self.subspace
+                     else (self.num_entities, self.dim))
+            W = jnp.zeros(shape, jnp.float32)
+        elif self.subspace:
+            # Model layout is column-sorted; the bucket programs run in
+            # bucket layout (intercept slot 0). take_along_axis yields a
+            # fresh array, safe under fit_bucket's donation.
+            W = jnp.take_along_axis(jnp.asarray(initial.means),
+                                    self._inv_perm_dev, axis=1)
         elif self.projection:
             # Explicit copies: fit_bucket donates W.
             W = jnp.array(initial.means, copy=True)
@@ -914,6 +1057,11 @@ class RandomEffectCoordinate:
         offsets = jnp.asarray(offsets)
         for arrays in self._bucket_data:
             W = self._fit_bucket(W, offsets, *arrays)
+        if self.subspace:
+            return SubspaceRandomEffectModel(
+                re_type=self.re_type, shard_id=self.shard_id,
+                num_features=self.dim, cols=self._cols_dev,
+                means=jnp.take_along_axis(W, self._perm_dev, axis=1))
         W_raw = W if self.projection else self.norm.model_to_original_space(W)
         return RandomEffectModel(
             re_type=self.re_type, shard_id=self.shard_id, means=W_raw)
@@ -925,13 +1073,17 @@ class RandomEffectCoordinate:
         if VarianceComputationType(self.config.variance_computation) == \
                 VarianceComputationType.NONE:
             return model
-        if self.projection:
+        if self.subspace:
+            # Sorted model layout → bucket layout for the programs.
+            W = jnp.take_along_axis(jnp.asarray(model.means),
+                                    self._inv_perm_dev, axis=1)
+        elif self.projection:
             # Per-entity transforms (and the original-space mapping) happen
             # inside var_bucket; W stays original space.
             W = jnp.asarray(model.means)
         else:
             W = jnp.asarray(self.norm.model_to_transformed_space(model.means))
-        V = jnp.zeros((self.num_entities, self.dim), jnp.float32)
+        V = jnp.zeros(model.means.shape, jnp.float32)
         offsets = jnp.asarray(offsets)
         for arrays in self._bucket_data:
             V = self._var_bucket(W, V, offsets, *arrays)
@@ -941,9 +1093,25 @@ class RandomEffectCoordinate:
             # FixedEffectCoordinate use (factor² scaling + intercept
             # shift-mass term).
             V = self.norm.variances_to_original_space(V)
+        if self.subspace:
+            V = jnp.take_along_axis(V, self._perm_dev, axis=1)
         return dataclasses.replace(model, variances=V)
 
-    def score(self, model: RandomEffectModel) -> Array:
+    def score(self, model) -> Array:
+        if self.subspace:
+            W_flat = jnp.asarray(model.means).reshape(-1)
+            if self.is_sparse:
+                # Staged join: each data nonzero's flat slot in the (E, A)
+                # table was computed once at __init__ (misses → one past
+                # the end → zero contribution).
+                safe = jnp.minimum(self._sp_flatpos, W_flat.shape[0] - 1)
+                g = W_flat[safe] * (self._sp_flatpos < W_flat.shape[0])
+                return jnp.sum(self._sp_values * g, axis=-1)
+            cols = jnp.asarray(self._cols_dev)[self._ids]  # (n, A)
+            xa = jnp.take_along_axis(
+                self._X, jnp.maximum(cols, 0), axis=1) * (cols >= 0)
+            return jnp.einsum("na,na->n", xa,
+                              jnp.asarray(model.means)[self._ids])
         if self.is_sparse:
             # Σ_k v_ik · W[e_i, idx_ik]. ELL padding slots carry value 0
             # by contract, so clamping their sentinel index (== d) into
@@ -954,7 +1122,14 @@ class RandomEffectCoordinate:
                 self._sp_values * W[self._ids[:, None], idx], axis=-1)
         return jnp.einsum("nd,nd->n", self._X, model.means[self._ids])
 
-    def initial_model(self) -> RandomEffectModel:
+    def initial_model(self):
+        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
+
+        if self.subspace:
+            return SubspaceRandomEffectModel(
+                re_type=self.re_type, shard_id=self.shard_id,
+                num_features=self.dim, cols=self._cols_dev,
+                means=jnp.zeros(self.subspace_cols.shape, jnp.float32))
         return RandomEffectModel(
             re_type=self.re_type, shard_id=self.shard_id,
             means=jnp.zeros((self.num_entities, self.dim), jnp.float32))

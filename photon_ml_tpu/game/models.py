@@ -96,10 +96,136 @@ class RandomEffectModel:
         return jnp.where(ids < self.means.shape[0], contrib, 0.0)
 
 
+def _subspace_positions(cols: np.ndarray, num_features: int,
+                        entity_ids: np.ndarray,
+                        indices: np.ndarray) -> np.ndarray:
+    """Map data nonzeros into per-entity subspace slots.
+
+    ``cols`` is the model's (E, A) active-column table (-1 padding);
+    ``indices`` the dataset's (n, k) ELL column ids (sentinel
+    ``num_features`` for padding). Returns (n, k) FLAT indices into
+    ``cols``-shaped tables, with misses (column inactive for that entity,
+    entity beyond the table, ELL padding) mapped to E*A (one past the end).
+
+    One sorted join over (entity, column) keys — vectorized host numpy, no
+    per-entity work; the (E, d) dense table this replaces never exists.
+    """
+    E, A = cols.shape
+    d1 = np.int64(num_features + 1)
+    valid_m = cols >= 0
+    mkeys = (np.repeat(np.arange(E, dtype=np.int64), A) * d1
+             + np.where(valid_m, cols, -1).astype(np.int64).reshape(-1))
+    flat_slots = np.arange(E * A, dtype=np.int64)
+    keep = valid_m.reshape(-1)
+    mkeys, flat_slots = mkeys[keep], flat_slots[keep]
+    order = np.argsort(mkeys, kind="stable")
+    mkeys, flat_slots = mkeys[order], flat_slots[order]
+
+    ids = np.asarray(entity_ids, np.int64)
+    dkeys = (np.minimum(ids, E - 1)[:, None] * d1
+             + np.minimum(np.asarray(indices, np.int64), num_features))
+    if not len(mkeys):  # no active columns anywhere: every lookup misses
+        return np.full(dkeys.shape, E * A, np.int64)
+    pos = np.searchsorted(mkeys, dkeys)
+    pos_c = np.minimum(pos, len(mkeys) - 1)
+    hit = (mkeys[pos_c] == dkeys) & (ids[:, None] < E)
+    return np.where(hit, flat_slots[pos_c], E * A).astype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class SubspaceRandomEffectModel:
+    """Per-entity models kept in their active-column subspaces.
+
+    Reference parity: photon-api ``model/RandomEffectModelInProjectedSpace
+    .scala`` — models live in each entity's projected space and only
+    project back for output. Here that is the PRIMARY representation for
+    the large-scale sparse regime: ``cols`` (num_entities, A) holds each
+    entity's active global columns (-1 padding, A = max subspace width)
+    and ``means`` the coefficients for exactly those columns, in original
+    space — so a 10⁶-entity × 10⁶-feature random effect stores E·A
+    coefficients, not the impossible dense (E, d) table.
+    """
+
+    re_type: str
+    shard_id: str
+    num_features: int  # full feature-space dimension d
+    cols: Array  # (num_entities, A) int32 active columns; -1 padding
+    means: Array  # (num_entities, A) coefficients for those columns
+    variances: Optional[Array] = None  # (num_entities, A)
+
+    @property
+    def num_entities(self) -> int:
+        return self.cols.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return int(self.num_features)
+
+    @property
+    def subspace_dim(self) -> int:
+        return self.cols.shape[1]
+
+    def score(self, dataset: GameDataset) -> Array:
+        """Score without ever materializing (E, d).
+
+        ``cols`` rows are SORTED by column id (padding -1 at the end, by
+        construction in RandomEffectCoordinate), so mapping a dataset's
+        columns into each entity's subspace is a per-row device
+        ``searchsorted`` — no host-side join, staged datasets stay
+        device-resident across repeated validation scoring.
+        """
+        from photon_ml_tpu.data.game_data import SparseShard
+
+        shard = dataset.feature_shards[self.shard_id]
+        ids = jnp.asarray(dataset.entity_ids[self.re_type])
+        E, A = self.cols.shape
+        safe_e = jnp.minimum(ids, E - 1)
+        if isinstance(shard, SparseShard):
+            C = jnp.asarray(self.cols)[safe_e]  # (n, A)
+            Cs = jnp.where(C < 0, self.num_features + 1, C)
+            idx = jnp.asarray(shard.indices)  # (n, k); sentinel d padding
+            pos = jax.vmap(jnp.searchsorted)(Cs, idx)
+            posc = jnp.minimum(pos, A - 1)
+            hit = ((jnp.take_along_axis(Cs, posc, axis=1) == idx)
+                   & (ids[:, None] < E))
+            Wn = jnp.asarray(self.means)[safe_e]
+            return jnp.sum(jnp.asarray(shard.values)
+                           * jnp.take_along_axis(Wn, posc, axis=1) * hit,
+                           axis=-1)
+        # Dense shard: gather each row's entity-active columns of X.
+        cols = jnp.asarray(self.cols)[safe_e]  # (n, A)
+        X = jnp.asarray(shard)
+        xa = jnp.take_along_axis(
+            X, jnp.maximum(cols, 0), axis=1) * (cols >= 0)
+        contrib = jnp.einsum("na,na->n", xa,
+                             jnp.asarray(self.means)[safe_e])
+        return jnp.where(ids < E, contrib, 0.0)
+
+    def to_random_effect_model(self) -> "RandomEffectModel":
+        """Materialize the dense (E, d) table (small-d interop only)."""
+        E, A = self.cols.shape
+        cols = jnp.asarray(self.cols)
+        safe_c = jnp.where(cols >= 0, cols, self.num_features)
+        rows = jnp.repeat(jnp.arange(E), A)
+
+        def scatter(tab):
+            if tab is None:
+                return None
+            W = jnp.zeros((E, self.num_features + 1), jnp.float32)
+            W = W.at[rows, safe_c.reshape(-1)].set(
+                jnp.asarray(tab).reshape(-1))
+            return W[:, : self.num_features]
+
+        return RandomEffectModel(
+            re_type=self.re_type, shard_id=self.shard_id,
+            means=scatter(self.means), variances=scatter(self.variances))
+
+
 # FactoredRandomEffectModel (game/factored.py) also satisfies this contract
 # (score(dataset) + re_type/shard_id); kept out of the Union to avoid an
 # import cycle — use duck typing where models are dispatched.
-CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
+CoordinateModel = Union[FixedEffectModel, RandomEffectModel,
+                        SubspaceRandomEffectModel]
 
 
 @dataclasses.dataclass

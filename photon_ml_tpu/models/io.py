@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
-                                       RandomEffectModel)
+                                       RandomEffectModel,
+                                       SubspaceRandomEffectModel)
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
@@ -39,6 +40,10 @@ def coordinate_meta(m) -> dict:
         return {"type": "random", "shard_id": m.shard_id,
                 "re_type": m.re_type, "num_entities": int(m.num_entities),
                 "dim": int(m.dim)}
+    if isinstance(m, SubspaceRandomEffectModel):
+        return {"type": "random-subspace", "shard_id": m.shard_id,
+                "re_type": m.re_type, "num_entities": int(m.num_entities),
+                "dim": int(m.dim), "subspace_dim": int(m.subspace_dim)}
     if isinstance(m, FactoredRandomEffectModel):
         return {"type": "factored", "shard_id": m.shard_id,
                 "re_type": m.re_type, "num_entities": int(m.num_entities),
@@ -64,6 +69,13 @@ def save_coordinate(path: str, cid: str, m) -> dict:
         # LatentFactorAvro pair) rather than materialized coefficients.
         payload = {"projection": np.asarray(m.projection),
                    "factors": np.asarray(m.factors)}
+    elif meta["type"] == "random-subspace":
+        # Reference: RandomEffectModelInProjectedSpace — coefficients stay
+        # in each entity's active-column subspace on disk too.
+        payload = {"cols": np.asarray(m.cols),
+                   "means": np.asarray(m.means)}
+        if m.variances is not None:
+            payload["variances"] = np.asarray(m.variances)
     else:
         payload = {"means": np.asarray(m.means)}
         if m.variances is not None:
@@ -116,6 +128,16 @@ def load_game_model(path: str) -> GameModel:
                 re_type=info["re_type"], shard_id=info["shard_id"],
                 projection=jnp.asarray(z["projection"]),
                 factors=jnp.asarray(z["factors"]))
+        elif info["type"] == "random-subspace":
+            z = np.load(os.path.join(path, "random-effect", cid,
+                                     "coefficients.npz"))
+            models[cid] = SubspaceRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                num_features=int(info["dim"]),
+                cols=jnp.asarray(z["cols"]),
+                means=jnp.asarray(z["means"]),
+                variances=(jnp.asarray(z["variances"])
+                           if "variances" in z else None))
         else:
             z = np.load(os.path.join(path, "random-effect", cid,
                                      "coefficients.npz"))

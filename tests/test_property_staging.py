@@ -1,0 +1,151 @@
+"""Property-based tests for the host staging pipeline.
+
+The reference relies on Spark's shuffle semantics for the per-entity
+grouping invariants (RandomEffectDataset partitioning, LocalDataset active
+sets — SURVEY.md §2.2); here the same invariants are enforced by vectorized
+numpy staging (`game/buckets.py`, `game/projector.py`), so they get
+adversarial coverage: Hypothesis draws adversarial entity distributions
+(empty entities, singletons, one giant entity, duplicate columns) and the
+properties must hold for every draw.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from photon_ml_tpu.data.game_data import SparseShard
+from photon_ml_tpu.game import buckets as bkt
+from photon_ml_tpu.game import projector as prj
+
+
+@st.composite
+def _entity_ids(draw):
+    """Adversarial id columns: skewed multiplicities over a small table."""
+    num_entities = draw(st.integers(2, 24))
+    # Per-entity multiplicities, many zero (entities with no data).
+    mult = draw(st.lists(st.integers(0, 40), min_size=num_entities,
+                         max_size=num_entities))
+    ids = np.repeat(np.arange(num_entities), mult)
+    if ids.size == 0:
+        ids = np.array([0])
+    perm = np.random.default_rng(draw(st.integers(0, 999))).permutation(
+        ids.size)
+    return ids[perm].astype(np.int32), num_entities
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=_entity_ids(), lower=st.integers(1, 5),
+       upper=st.one_of(st.none(), st.integers(1, 12)))
+def test_bucketing_partition_invariants(data, lower, upper):
+    ids, num_entities = data
+    b = bkt.build_bucketing(ids, num_entities, lower_bound=lower,
+                            upper_bound=upper)
+    counts = np.bincount(ids, minlength=num_entities)
+    seen_entities = set()
+    claimed_examples = []
+    for bucket in b.buckets:
+        live = bucket.entity_rows >= 0
+        # Padding lanes are fully inert.
+        assert np.all(bucket.example_idx[~live] == -1)
+        assert np.all(bucket.counts[~live] == 0)
+        for row, cnt, ex in zip(bucket.entity_rows[live],
+                                bucket.counts[live],
+                                bucket.example_idx[live]):
+            assert row not in seen_entities  # each entity in ONE bucket
+            seen_entities.add(int(row))
+            kept = ex[ex >= 0]
+            assert len(kept) == cnt
+            # Capacity class: pow-2 >= count, count within bounds.
+            assert cnt <= bucket.capacity
+            assert counts[row] >= lower
+            if upper is not None:
+                assert cnt == min(counts[row], upper)
+            else:
+                assert cnt == counts[row]
+            # Every kept example really belongs to this entity, once.
+            assert np.all(ids[kept] == row)
+            assert len(np.unique(kept)) == len(kept)
+            claimed_examples.extend(kept.tolist())
+    # Trained set == entities meeting the lower bound.
+    expect_trained = {int(e) for e in np.flatnonzero(counts >= lower)}
+    assert seen_entities == expect_trained
+    assert set(np.flatnonzero(b.trained_entities)) == expect_trained
+    # No example claimed twice across all buckets.
+    assert len(claimed_examples) == len(set(claimed_examples))
+    # Passive accounting: dropped entities' examples + capped overflow.
+    dropped = int(counts[counts < lower].sum())
+    overflow = 0
+    if upper is not None:
+        kept_counts = counts[counts >= lower]
+        overflow = int(np.maximum(kept_counts - upper, 0).sum())
+    assert b.num_passive_examples == dropped + overflow
+
+
+@st.composite
+def _ell_shard(draw):
+    """Small ELL shard with duplicate-column padding slots and explicit
+    zeros — the wire-level corner cases of the sparse staging path."""
+    n = draw(st.integers(1, 40))
+    d = draw(st.integers(2, 20))
+    nnz = draw(st.integers(1, min(4, d)))
+    rng = np.random.default_rng(draw(st.integers(0, 999)))
+    idx = np.sort(rng.integers(0, d, size=(n, nnz)), axis=1).astype(
+        np.int32)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    # Some explicit zeros (must NOT count as active columns).
+    vals[rng.random(vals.shape) < 0.2] = 0.0
+    idx[dup] = d
+    vals[dup] = 0.0
+    ids = rng.integers(0, draw(st.integers(1, 8)), size=n).astype(np.int32)
+    return SparseShard(idx, vals, d), ids
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=_ell_shard())
+def test_projection_active_sets_match_brute_force(data):
+    shard, ids = data
+    num_entities = int(ids.max()) + 1
+    b = bkt.build_bucketing(ids, num_entities)
+    dense = np.zeros(shard.shape, np.float32)
+    valid = shard.indices < shard.num_features
+    np.add.at(dense,
+              (np.broadcast_to(np.arange(shard.shape[0])[:, None],
+                               shard.indices.shape)[valid],
+               shard.indices[valid]), shard.values[valid])
+    for bucket in b.buckets:
+        p_sp = prj.build_bucket_projection(bucket, shard, None)
+        p_dn = prj.build_bucket_projection(bucket, dense, None)
+        # Sparse and dense staging agree exactly.
+        np.testing.assert_array_equal(p_sp.cols, p_dn.cols)
+        live = bucket.entity_rows >= 0
+        for lane in np.flatnonzero(live):
+            ex = bucket.example_idx[lane]
+            rows = ex[ex >= 0]
+            want = np.flatnonzero(np.any(dense[rows] != 0.0, axis=0))
+            got = p_sp.cols[lane]
+            got = np.sort(got[got >= 0])
+            np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=30, deadline=None)
+@given(data=_ell_shard(), ratio=st.floats(0.05, 2.0))
+def test_pearson_cap_respected_for_every_entity(data, ratio):
+    shard, ids = data
+    num_entities = int(ids.max()) + 1
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, size=shard.shape[0]).astype(np.float32)
+    b = bkt.build_bucketing(ids, num_entities)
+    for bucket in b.buckets:
+        p = prj.build_bucket_projection(
+            bucket, shard, None, labels=labels,
+            features_to_samples_ratio=ratio)
+        live = bucket.entity_rows >= 0
+        for lane in np.flatnonzero(live):
+            n_e = int(bucket.counts[lane])
+            cap = max(1, int(np.ceil(ratio * n_e)))
+            got = p.cols[lane]
+            assert int((got >= 0).sum()) <= cap

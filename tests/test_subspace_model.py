@@ -1,0 +1,365 @@
+"""Subspace (projected-space) random-effect models.
+
+Reference parity: photon-api ``model/RandomEffectModelInProjectedSpace
+.scala`` — per-entity models live in each entity's projected space. Here
+that representation is exact: a SubspaceRandomEffectModel must reproduce
+the dense-table path bit-for-bit (same solves, different storage), score
+identically on staged AND fresh datasets (incl. unseen entities), survive
+npz + Avro round trips, and interoperate with dense warm starts.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.game.models import (GameModel, RandomEffectModel,
+                                       SubspaceRandomEffectModel)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+from tests.test_sparse_game import _sparse_re_data
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _opt(variance=VarianceComputationType.NONE):
+    return GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0),
+        variance_computation=variance)
+
+
+def test_subspace_fit_matches_dense_table(mesh):
+    """Same solves, different storage: the (E, A) subspace table must
+    reproduce the dense-table projected fit exactly (means, scores,
+    variances), and model-level scoring must agree on the training data."""
+    sparse_ds, _ = _sparse_re_data(n=2048, d=64, num_entities=24, seed=3)
+    cfg = _opt(variance=VarianceComputationType.SIMPLE)
+    c_dense = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, cfg, mesh,
+        subspace_model=False)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, cfg, mesh,
+        subspace_model=True)
+    assert c_sub.subspace and not c_dense.subspace
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    m_dense = c_dense.train_model(off)
+    m_sub = c_sub.train_model(off)
+    assert isinstance(m_sub, SubspaceRandomEffectModel)
+    # Materialized table identical.
+    np.testing.assert_allclose(
+        np.asarray(m_sub.to_random_effect_model().means),
+        np.asarray(m_dense.means), rtol=1e-4, atol=1e-5)
+    # Coordinate (staged) scoring identical.
+    np.testing.assert_allclose(np.asarray(c_sub.score(m_sub)),
+                               np.asarray(c_dense.score(m_dense)),
+                               rtol=1e-4, atol=1e-5)
+    # Model-level scoring identical (validation/transformer path).
+    np.testing.assert_allclose(np.asarray(m_sub.score(sparse_ds)),
+                               np.asarray(m_dense.score(sparse_ds)),
+                               rtol=1e-4, atol=1e-5)
+    # Variances identical after materialization.
+    v_dense = c_dense.compute_model_variances(m_dense, off)
+    v_sub = c_sub.compute_model_variances(m_sub, off)
+    np.testing.assert_allclose(
+        np.asarray(v_sub.to_random_effect_model().variances),
+        np.asarray(v_dense.variances), rtol=1e-4, atol=1e-6)
+
+
+def test_subspace_scores_fresh_dataset_with_unseen_entities(mesh):
+    """model.score on a dataset the coordinate never staged: columns
+    outside an entity's subspace and entity ids beyond the table must
+    contribute exactly zero (the passive/unseen contract)."""
+    sparse_ds, _ = _sparse_re_data(n=1024, d=48, num_entities=12, seed=5)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    m_sub = c_sub.train_model(off)
+    m_dense = m_sub.to_random_effect_model()
+
+    rng = np.random.default_rng(9)
+    n2, k = 256, 5
+    idx = np.sort(rng.integers(0, 48, (n2, k)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n2, k)).astype(np.float32)
+    idx[dup] = 48
+    vals[dup] = 0.0
+    ids2 = rng.integers(0, 16, n2).astype(np.int32)  # ids 12..15 unseen
+    fresh = GameDataset(
+        response=np.zeros(n2, np.float32),
+        offsets=np.zeros(n2, np.float32),
+        weights=np.ones(n2, np.float32),
+        feature_shards={"re": SparseShard(idx, vals, 48)},
+        entity_ids={"userId": ids2},
+        num_entities={"userId": 16},
+        intercept_index={})
+    got = np.asarray(m_sub.score(fresh))
+    want = np.asarray(m_dense.score(fresh))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    assert np.all(got[ids2 >= 12] == 0.0)
+
+
+def test_subspace_warm_start_interop(mesh):
+    """Dense warm starts enter the subspace coordinate (active columns
+    gathered); a continued fit from the previous subspace model is
+    accepted unchanged and converges to the same optimum."""
+    sparse_ds, _ = _sparse_re_data(n=1024, d=48, num_entities=12, seed=6)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    m1 = c_sub.train_model(off)
+    # Subspace warm start: fixed point of the solve.
+    m2 = c_sub.train_model(off, initial=m1)
+    # Warm-started L-BFGS re-enters at the optimum but may take one small
+    # step before the loss-delta criterion fires — tolerance, not layout.
+    np.testing.assert_allclose(np.asarray(m2.means), np.asarray(m1.means),
+                               rtol=2e-2, atol=1e-3)
+    # Dense warm start with inactive-column mass: gathered through the
+    # active sets, same optimum.
+    dense_ws = RandomEffectModel(
+        re_type="userId", shard_id="re",
+        means=jnp.asarray(np.random.default_rng(0).normal(
+            size=(12, 48)).astype(np.float32)))
+    m3 = c_sub.train_model(off, initial=dense_ws)
+    np.testing.assert_allclose(np.asarray(m3.means), np.asarray(m1.means),
+                               rtol=2e-2, atol=1e-3)
+    # And a subspace model warm-starts a dense-table coordinate.
+    c_dense = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=False)
+    m4 = c_dense.train_model(off, initial=m1)
+    np.testing.assert_allclose(
+        np.asarray(m4.means),
+        np.asarray(m1.to_random_effect_model().means),
+        rtol=2e-2, atol=1e-3)
+
+
+def test_subspace_npz_and_avro_roundtrip(mesh, tmp_path):
+    from photon_ml_tpu.avro.model_io import (load_game_model_avro,
+                                             save_game_model_avro)
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+    from photon_ml_tpu.models.io import load_game_model, save_game_model
+
+    sparse_ds, _ = _sparse_re_data(n=1024, d=32, num_entities=10, seed=8)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC,
+        _opt(variance=VarianceComputationType.SIMPLE), mesh,
+        subspace_model=True)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    m = c_sub.compute_model_variances(c_sub.train_model(off), off)
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={"re": m})
+
+    # npz (checkpoint/warm-start) layout.
+    save_game_model(gm, str(tmp_path / "npz"))
+    loaded = load_game_model(str(tmp_path / "npz")).models["re"]
+    assert isinstance(loaded, SubspaceRandomEffectModel)
+    np.testing.assert_array_equal(np.asarray(loaded.cols),
+                                  np.asarray(m.cols))
+    np.testing.assert_allclose(np.asarray(loaded.means),
+                               np.asarray(m.means), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(loaded.variances),
+                               np.asarray(m.variances), atol=1e-7)
+
+    # Avro (interoperable) layout: active sets survive, scores agree.
+    imap = DefaultIndexMap({f"f{j}": j for j in range(32)})
+    vocab = {f"u{i}": i for i in range(10)}
+    save_game_model_avro(gm, str(tmp_path / "avro"), {"re": imap},
+                         entity_vocabs={"userId": vocab})
+    loaded_a = load_game_model_avro(
+        str(tmp_path / "avro"), {"re": imap},
+        entity_vocabs={"userId": vocab}).models["re"]
+    assert isinstance(loaded_a, SubspaceRandomEffectModel)
+    np.testing.assert_allclose(np.asarray(loaded_a.score(sparse_ds)),
+                               np.asarray(m.score(sparse_ds)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_subspace_requires_projection(mesh):
+    syn_n = 256
+    rng = np.random.default_rng(0)
+    ds = GameDataset(
+        response=rng.integers(0, 2, syn_n).astype(np.float32),
+        offsets=np.zeros(syn_n, np.float32),
+        weights=np.ones(syn_n, np.float32),
+        feature_shards={"re": rng.normal(size=(syn_n, 6)).astype(
+            np.float32)},
+        entity_ids={"userId": rng.integers(0, 6, syn_n).astype(np.int32)},
+        num_entities={"userId": 6},
+        intercept_index={})
+    with pytest.raises(ValueError, match="projection"):
+        RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
+                               _opt(), mesh, subspace_model=True)
+    # Auto stays off at small scale, dense model comes back.
+    c = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
+                               _opt(), mesh, projection=True)
+    assert not c.subspace
+
+
+def test_subspace_descent_and_estimator(mesh):
+    """End to end through GameEstimator with subspace_model=True: descent
+    converges, validation evaluates, and the result scores new data."""
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           RandomEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+
+    sparse_ds, _ = _sparse_re_data(n=3072, d=64, num_entities=16, seed=12)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={
+            "per-user": CoordinateConfiguration(
+                data=RandomEffectDataConfiguration(
+                    "userId", "re", projector="INDEX_MAP",
+                    subspace_model=True),
+                optimization=_opt()),
+        },
+        update_sequence=["per-user"], mesh=mesh,
+        validation_evaluators=["AUC"])
+    result = est.fit(sparse_ds, validation_data=sparse_ds)[0]
+    m = result.model.models["per-user"]
+    assert isinstance(m, SubspaceRandomEffectModel)
+    assert result.evaluation.primary_value > 0.8  # planted effects learned
+
+
+def test_lane_chunking_matches_unchunked(mesh, monkeypatch):
+    """Bucket lane chunks (bounded vmapped-solve dispatches) are a pure
+    memory-shape choice: an 8-lane chunk size must reproduce the
+    single-dispatch fit, dense-table and subspace alike. Identical only in
+    exact arithmetic — XLA tiles reductions differently per batch shape,
+    and f32 reassociation noise amplifies through ~60 solver iterations —
+    so the check is at convergence scale, not ULP scale."""
+    from photon_ml_tpu.game import coordinates as coord_mod
+
+    sparse_ds, _ = _sparse_re_data(n=2048, d=64, num_entities=30, seed=4)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    base = {}
+    for sub in (False, True):
+        c = RandomEffectCoordinate(
+            sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+            subspace_model=sub)
+        assert len(c._bucket_data) == len(c.bucketing.buckets)
+        base[sub] = c.train_model(off)
+    monkeypatch.setattr(coord_mod, "_LANE_CHUNK", 8)
+    for sub in (False, True):
+        c = RandomEffectCoordinate(
+            sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+            subspace_model=sub)
+        assert len(c._bucket_data) > len(c.bucketing.buckets)
+        m = c.train_model(off)
+        np.testing.assert_allclose(np.asarray(m.means),
+                                   np.asarray(base[sub].means),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_subspace_empty_active_sets(mesh):
+    """Every entity below lower_bound: the subspace table is all padding
+    and construction + scoring must survive (all-miss join), not
+    IndexError (review r3)."""
+    rng = np.random.default_rng(2)
+    n, d, E, k = 64, 32, 64, 3
+    idx = np.sort(rng.integers(0, d, (n, k)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    idx[dup] = d
+    vals[dup] = 0.0
+    ds = GameDataset(
+        response=rng.integers(0, 2, n).astype(np.float32),
+        offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"re": SparseShard(idx, vals, d)},
+        entity_ids={"userId": np.arange(n).astype(np.int32) % E},
+        num_entities={"userId": E},
+        intercept_index={})
+    c = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
+                               _opt(), mesh, lower_bound=50,
+                               subspace_model=True)
+    m = c.train_model(np.zeros(n, np.float32))
+    assert np.all(np.asarray(c.score(m)) == 0.0)
+    assert np.all(np.asarray(m.score(ds)) == 0.0)
+
+
+def test_subspace_warm_start_remap_across_active_sets(mesh):
+    """A subspace warm start whose active sets differ from the
+    coordinate's (e.g. feature filtering changed between runs) re-maps by
+    column id — matching columns carry over, dropped ones vanish, nothing
+    is misattributed (review r3)."""
+    sparse_ds, _ = _sparse_re_data(n=1024, d=48, num_entities=12, seed=6)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    c_full = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    m_full = c_full.train_model(off)
+    # A coordinate with Pearson-filtered (smaller) active sets.
+    c_filt = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True, features_to_samples_ratio=0.05)
+    remapped = c_filt.adapt_initial(m_full)
+    # Equivalent to gathering the dense table through the target sets.
+    dense = np.asarray(m_full.to_random_effect_model().means)
+    tgt = np.asarray(c_filt.subspace_cols)
+    want = dense[np.arange(tgt.shape[0])[:, None],
+                 np.maximum(tgt, 0)] * (tgt >= 0)
+    np.testing.assert_allclose(np.asarray(remapped.means), want,
+                               rtol=1e-6, atol=1e-7)
+    # And the fit accepts it.
+    m2 = c_filt.train_model(off, initial=m_full)
+    assert np.all(np.isfinite(np.asarray(m2.means)))
+
+
+def test_subspace_avro_roundtrip_reordered_index_map(mesh, tmp_path):
+    """Loading under a REORDERED index map must keep cols rows sorted
+    (score()'s searchsorted invariant) and score identically (review
+    r3)."""
+    from photon_ml_tpu.avro.model_io import (load_game_model_avro,
+                                             save_game_model_avro)
+    from photon_ml_tpu.index.indexmap import DefaultIndexMap
+
+    sparse_ds, _ = _sparse_re_data(n=512, d=16, num_entities=6, seed=13)
+    c = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    m = c.train_model(np.zeros(sparse_ds.num_rows, np.float32))
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={"re": m})
+    imap = DefaultIndexMap({f"f{j}": j for j in range(16)})
+    vocab = {f"u{i}": i for i in range(6)}
+    save_game_model_avro(gm, str(tmp_path / "m"), {"re": imap},
+                         entity_vocabs={"userId": vocab})
+    # Reversed column order in the loading map.
+    imap_rev = DefaultIndexMap({f"f{j}": 15 - j for j in range(16)})
+    loaded = load_game_model_avro(
+        str(tmp_path / "m"), {"re": imap_rev},
+        entity_vocabs={"userId": vocab}).models["re"]
+    cols = np.asarray(loaded.cols)
+    active = np.where(cols < 0, np.iinfo(np.int32).max, cols)
+    assert np.all(np.diff(active, axis=1) >= 0)  # sorted, padding last
+    # Scores agree once the DATASET is expressed in the new column order.
+    shard = sparse_ds.feature_shards["re"]
+    idx = np.asarray(shard.indices)
+    remapped_idx = np.where(idx < 16, 15 - idx, 16).astype(np.int32)
+    order = np.argsort(np.where(remapped_idx >= 16, 99, remapped_idx),
+                       axis=1, kind="stable")
+    ds_rev = dataclasses.replace(
+        sparse_ds,
+        feature_shards={"re": SparseShard(
+            np.take_along_axis(remapped_idx, order, axis=1),
+            np.take_along_axis(np.asarray(shard.values), order, axis=1),
+            16)})
+    np.testing.assert_allclose(np.asarray(loaded.score(ds_rev)),
+                               np.asarray(m.score(sparse_ds)),
+                               rtol=1e-5, atol=1e-6)
