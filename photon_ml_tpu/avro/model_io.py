@@ -159,22 +159,30 @@ def save_game_model_avro(
             means = np.asarray(m.means)
             variances = (None if m.variances is None
                          else np.asarray(m.variances))
-            recs = []
-            for ent, row in sorted(vocab.items(), key=lambda kv: kv[1]):
-                if row >= cols.shape[0]:
-                    continue  # extended vocab: untrained, scores zero
-                rec = {
-                    "modelId": ent,
-                    "modelClass": "RandomEffectModel",
-                    "means": _active_to_ntv(cols[row], means[row], imap),
-                }
-                if variances is not None:
-                    rec["variances"] = _active_to_ntv(
-                        cols[row], variances[row], imap)
-                recs.append(rec)
+
+            def sub_records(vocab=vocab, cols=cols, means=means,
+                            variances=variances, imap=imap):
+                # Generator: at the 10⁶-entity scale this branch exists
+                # for, materializing every record dict first would cost
+                # gigabytes of host RAM — stream one entity at a time.
+                for ent, row in sorted(vocab.items(),
+                                       key=lambda kv: kv[1]):
+                    if row >= cols.shape[0]:
+                        continue  # extended vocab: untrained, scores zero
+                    rec = {
+                        "modelId": ent,
+                        "modelClass": "RandomEffectModel",
+                        "means": _active_to_ntv(cols[row], means[row],
+                                                imap),
+                    }
+                    if variances is not None:
+                        rec["variances"] = _active_to_ntv(
+                            cols[row], variances[row], imap)
+                    yield rec
+
             write_records(os.path.join(sub, "part-00000.avro"),
-                          schemas.BAYESIAN_LINEAR_MODEL_AVRO, recs,
-                          codec=codec)
+                          schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                          sub_records(), codec=codec)
             meta["coordinates"][cid] = {
                 "type": "random-subspace", "shard": m.shard_id,
                 "re_type": m.re_type, "num_entities": m.num_entities,
@@ -298,13 +306,9 @@ def load_game_model_avro(
             # Re-sort each row by column id (padding last): the caller's
             # index map may reorder columns (or drop some, leaving -1
             # holes mid-row), and score() requires sorted cols rows.
-            order = np.argsort(
-                np.where(cols < 0, np.iinfo(np.int32).max, cols),
-                axis=1, kind="stable")
-            cols = np.take_along_axis(cols, order, axis=1)
-            means = np.take_along_axis(means, order, axis=1)
-            if variances is not None:
-                variances = np.take_along_axis(variances, order, axis=1)
+            from photon_ml_tpu.game.models import sort_subspace_rows
+            cols, _, means, variances = sort_subspace_rows(
+                cols, means, variances)
             models[cid] = SubspaceRandomEffectModel(
                 re_type=info["re_type"], shard_id=info["shard"],
                 num_features=dim, cols=jnp.asarray(cols),

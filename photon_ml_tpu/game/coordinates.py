@@ -764,10 +764,8 @@ class RandomEffectCoordinate:
             for b, c in zip(self.bucketing.buckets, bucket_cols):
                 live = b.entity_rows >= 0
                 cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
-            perm = np.argsort(
-                np.where(cols_tab < 0, np.iinfo(np.int32).max, cols_tab),
-                axis=1, kind="stable").astype(np.int32)  # sorted ← bucket
-            cols_sorted = np.take_along_axis(cols_tab, perm, axis=1)
+            from photon_ml_tpu.game.models import sort_subspace_rows
+            cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
             self.subspace_cols = cols_sorted
             self._cols_dev = put(cols_sorted)
             self._perm_dev = put(perm)
@@ -1016,6 +1014,11 @@ class RandomEffectCoordinate:
                 re_type=self.re_type, shard_id=self.shard_id,
                 num_features=self.dim, cols=tgt, means=means)
         # Dense (E, d) → gather the active columns per entity.
+        if initial.means.shape[0] != self.subspace_cols.shape[0]:
+            raise ValueError(
+                f"warm start has {initial.means.shape[0]} entities, "
+                f"coordinate expects {self.subspace_cols.shape[0]} "
+                f"(a clamped gather would misattribute rows)")
         cols = jnp.asarray(self.subspace_cols)
         means = jnp.asarray(initial.means)
         ga = means[jnp.arange(cols.shape[0])[:, None],

@@ -353,8 +353,13 @@ class FactoredRandomEffectCoordinate:
         least-squares-projected INTO that fixed subspace
         (``z_e = A⁺ w_e``).
         """
-        from photon_ml_tpu.game.models import RandomEffectModel
+        from photon_ml_tpu.game.models import (RandomEffectModel,
+                                               SubspaceRandomEffectModel)
 
+        if isinstance(initial, SubspaceRandomEffectModel):
+            # Factored coordinates are inherently small-d (they hold a
+            # dense (d, r) projection), so materializing is affordable.
+            initial = initial.to_random_effect_model()
         if not isinstance(initial, RandomEffectModel):
             return initial
         if self.learn_projection:

@@ -96,6 +96,26 @@ class RandomEffectModel:
         return jnp.where(ids < self.means.shape[0], contrib, 0.0)
 
 
+def sort_subspace_rows(cols: np.ndarray, *tables: Optional[np.ndarray]):
+    """Canonicalize subspace rows: sort each row by column id with padding
+    (-1) last, permuting the parallel coefficient tables identically.
+
+    This IS the SubspaceRandomEffectModel layout invariant — ``score()``'s
+    per-row searchsorted requires it — shared by the coordinate's staging
+    and the Avro loader. Returns (cols_sorted, order, *tables_sorted);
+    ``order`` is the sorted←unsorted permutation; None tables pass
+    through.
+    """
+    order = np.argsort(
+        np.where(cols < 0, np.iinfo(np.int32).max, cols),
+        axis=1, kind="stable").astype(np.int32)
+    out = [np.take_along_axis(cols, order, axis=1), order]
+    for t in tables:
+        out.append(None if t is None
+                   else np.take_along_axis(np.asarray(t), order, axis=1))
+    return tuple(out)
+
+
 def _subspace_positions(cols: np.ndarray, num_features: int,
                         entity_ids: np.ndarray,
                         indices: np.ndarray) -> np.ndarray:

@@ -254,3 +254,65 @@ def test_kill_and_resume_with_factored_coordinate(rng, mesh, tmp_path):
     for cid in ref:
         np.testing.assert_allclose(got[cid], ref[cid], rtol=1e-3,
                                    atol=1e-4)
+
+
+def test_kill_and_resume_with_subspace_coordinate(rng, mesh, tmp_path):
+    """A SubspaceRandomEffectModel's (cols, means) state survives
+    kill-and-resume and reproduces the uninterrupted model."""
+    from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+    from photon_ml_tpu.game.models import SubspaceRandomEffectModel
+
+    n, d, E, nnz = 900, 64, 12, 4
+    ids = rng.integers(0, E, n).astype(np.int32)
+    idx = np.sort(rng.integers(0, d, (n, nnz)).astype(np.int32), axis=1)
+    dup = np.zeros_like(idx, bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    idx[dup] = d
+    vals[dup] = 0.0
+    y = rng.integers(0, 2, n).astype(np.float32)
+    ds = GameDataset(
+        response=y, offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"global": rng.normal(size=(n, 5)).astype(
+            np.float32), "re": SparseShard(idx, vals, d)},
+        entity_ids={"userId": ids}, num_entities={"userId": E},
+        intercept_index={})
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-7))
+    cc = {
+        "fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"), optimization=opt),
+        "per-user": CoordinateConfiguration(
+            data=RandomEffectDataConfiguration(
+                "userId", "re", projector="INDEX_MAP",
+                subspace_model=True),
+            optimization=opt),
+    }
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cc,
+                        ["fixed", "per-user"], mesh, descent_iterations=2)
+    coords = est._build_coordinates(
+        ds, {cid: c.optimization for cid, c in cc.items()})
+    cfg = descent.CoordinateDescentConfig(["fixed", "per-user"],
+                                          iterations=2)
+
+    ref_model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, dict(coords),
+                               cfg)
+    ref = _model_arrays(ref_model)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    killed = dict(coords)
+    killed["per-user"] = _KillSwitch(coords["per-user"], allow=1)
+    with pytest.raises(KeyboardInterrupt):
+        descent.run(TaskType.LOGISTIC_REGRESSION, killed, cfg,
+                    checkpoint_manager=CheckpointManager(ckpt_dir))
+    model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, dict(coords), cfg,
+                           checkpoint_manager=CheckpointManager(ckpt_dir))
+    m = model.models["per-user"]
+    assert isinstance(m, SubspaceRandomEffectModel)
+    np.testing.assert_array_equal(
+        np.asarray(m.cols), np.asarray(ref_model.models["per-user"].cols))
+    got = _model_arrays(model)
+    for cid in ref:
+        np.testing.assert_allclose(got[cid], ref[cid], rtol=1e-3,
+                                   atol=1e-4)

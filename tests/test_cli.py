@@ -247,6 +247,30 @@ def test_game_train_sparse_random_effect(rng, tmp_path):
         open(os.path.join(score_out, "summary.json")).read())
     assert score_summary["metrics"]["AUC"] > 0.8
 
+    # subspace=true: same fit through the subspace model representation
+    # (RandomEffectModelInProjectedSpace parity); save/score round trip.
+    out2 = str(tmp_path / "out-sub")
+    summary2 = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", train_dir,
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId,subspace=true",
+        "--update-sequence", "per-user",
+        "--evaluators", "AUC",
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out2,
+    ]))
+    assert summary2["best_metrics"]["AUC"] == pytest.approx(
+        summary["best_metrics"]["AUC"], abs=5e-3)
+    score_out2 = str(tmp_path / "scores-sub")
+    game_score.run(game_score.build_parser().parse_args([
+        "--data", train_dir, "--model-dir", os.path.join(out2, "best"),
+        "--output-dir", score_out2, "--evaluators", "AUC",
+    ]))
+    score_summary2 = json.loads(
+        open(os.path.join(score_out2, "summary.json")).read())
+    assert score_summary2["metrics"]["AUC"] == pytest.approx(
+        summary2["best_metrics"]["AUC"], abs=1e-6)
+
 
 # -- tuning mode (VERDICT round-1 item 9) ----------------------------------
 

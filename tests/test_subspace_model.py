@@ -363,3 +363,40 @@ def test_subspace_avro_roundtrip_reordered_index_map(mesh, tmp_path):
     np.testing.assert_allclose(np.asarray(loaded.score(ds_rev)),
                                np.asarray(m.score(sparse_ds)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_subspace_warm_start_into_factored(mesh):
+    """A subspace model warm-starts a factored coordinate (materialized to
+    full rank first — factored coordinates are inherently small-d), the
+    cross-type hand-off descent relies on (review r3)."""
+    from photon_ml_tpu.game.factored import (FactoredRandomEffectCoordinate,
+                                             FactoredRandomEffectModel)
+
+    sparse_ds, dense_ds = _sparse_re_data(n=1024, d=48, num_entities=12,
+                                          seed=6)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    m1 = c_sub.train_model(off)
+    c_mf = FactoredRandomEffectCoordinate(
+        dense_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        rank=2, alternations=1)
+    m2 = c_mf.train_model(off, initial=m1)
+    assert isinstance(m2, FactoredRandomEffectModel)
+    assert np.all(np.isfinite(np.asarray(m2.factors)))
+
+
+def test_subspace_dense_warm_start_entity_mismatch_rejected(mesh):
+    """A dense warm start with a different entity count must fail loudly —
+    a clamped gather would hand every new entity the last old entity's
+    coefficients (review r3)."""
+    sparse_ds, _ = _sparse_re_data(n=1024, d=48, num_entities=12, seed=6)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    short = RandomEffectModel(
+        re_type="userId", shard_id="re",
+        means=jnp.zeros((7, 48), jnp.float32))
+    with pytest.raises(ValueError, match="entities"):
+        c_sub.adapt_initial(short)
