@@ -458,10 +458,10 @@ def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
         np.asarray(model.models["per-user"].means[:1])
         return time.perf_counter() - t0
 
-    # Wide span: each sweep is ~40-150 ms steady-state, so a (1, 6)
+    # Wide span: each sweep is ~40-150 ms steady-state, so a (1, 11)
     # separation keeps tunnel RPC jitter (~10 ms/dispatch) out of the
     # reported per-iteration figure.
-    return _slope(run, 1, 6)
+    return _slope(run, 1, 11)
 
 
 def main():

@@ -1,0 +1,9 @@
+"""Shared example bootstrap: make the repo root importable so the examples
+run as plain scripts (``python examples/<name>.py``) without installing
+the package. A script's own directory is always on sys.path, so a bare
+``import _bootstrap`` works from any cwd."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

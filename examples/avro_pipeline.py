@@ -12,6 +12,7 @@ C++ Avro block decoder when a toolchain is available.
 Run: python examples/avro_pipeline.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import os
 import tempfile
 

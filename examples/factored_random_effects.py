@@ -14,6 +14,7 @@ planted rank-2 structure:
 Run: python examples/factored_random_effects.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import numpy as np
 
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,

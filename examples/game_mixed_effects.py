@@ -6,6 +6,7 @@ checkpointed, and scored.
 Run: python examples/game_mixed_effects.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import tempfile
 
 import numpy as np

@@ -4,6 +4,7 @@ regression with L-BFGS + L2 over the device mesh → evaluate → save/load.
 Run: python examples/glm_quickstart.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import tempfile
 
 import jax.numpy as jnp

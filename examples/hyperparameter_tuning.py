@@ -5,6 +5,7 @@ GameTrainingDriver's hyperParameterTuning mode).
 Run: python examples/hyperparameter_tuning.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import numpy as np
 
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,

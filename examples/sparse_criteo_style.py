@@ -5,6 +5,7 @@ coordinate, optionally sharding the coefficient dimension over the mesh's
 Run: python examples/sparse_criteo_style.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 import numpy as np
 
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,

@@ -26,7 +26,8 @@ from photon_ml_tpu.avro import schemas
 from photon_ml_tpu.avro.container import read_records, write_records
 from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
                                        RandomEffectModel,
-                                       SubspaceRandomEffectModel)
+                                       SubspaceRandomEffectModel,
+                                       sort_subspace_rows)
 from photon_ml_tpu.index.indexmap import (DefaultIndexMap, IndexMap,
                                           split_key)
 from photon_ml_tpu.models.coefficients import Coefficients
@@ -306,7 +307,6 @@ def load_game_model_avro(
             # Re-sort each row by column id (padding last): the caller's
             # index map may reorder columns (or drop some, leaving -1
             # holes mid-row), and score() requires sorted cols rows.
-            from photon_ml_tpu.game.models import sort_subspace_rows
             cols, _, means, variances = sort_subspace_rows(
                 cols, means, variances)
             models[cid] = SubspaceRandomEffectModel(

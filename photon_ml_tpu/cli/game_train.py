@@ -61,7 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--task", default="LOGISTIC_REGRESSION",
                    choices=[t.value for t in TaskType])
     p.add_argument("--coordinate", action="append", required=True,
-                   help="coordinate spec (repeatable)")
+                   help="coordinate spec (repeatable): name=,type=fixed|"
+                        "random|factored,shard=[,re=,min_samples=,"
+                        "max_samples=,projector=NONE|INDEX_MAP|RANDOM,"
+                        "projected_dim=,features_to_samples_ratio=,"
+                        "subspace=auto|true|false (keep the trained "
+                        "random-effect model in per-entity subspace form),"
+                        "rank=,alternations=,hybrid=,dtype=]")
     p.add_argument("--opt-config", action="append", default=[],
                    help="'<coordinate>:<optimizer mini-DSL>' (repeatable)")
     p.add_argument("--update-sequence", required=True,

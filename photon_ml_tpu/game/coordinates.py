@@ -43,7 +43,10 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game import buckets as bkt
 from photon_ml_tpu.game import projector as prj
-from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.game.models import (FixedEffectModel, RandomEffectModel,
+                                       SubspaceRandomEffectModel,
+                                       _subspace_positions,
+                                       sort_subspace_rows)
 from photon_ml_tpu.game.sampling import (binary_classification_down_sample,
                                          default_down_sample)
 from photon_ml_tpu.models.coefficients import Coefficients
@@ -764,7 +767,6 @@ class RandomEffectCoordinate:
             for b, c in zip(self.bucketing.buckets, bucket_cols):
                 live = b.entity_rows >= 0
                 cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
-            from photon_ml_tpu.game.models import sort_subspace_rows
             cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
             self.subspace_cols = cols_sorted
             self._cols_dev = put(cols_sorted)
@@ -774,7 +776,6 @@ class RandomEffectCoordinate:
             if self.is_sparse:
                 # Stage the score-side join ONCE: data nonzeros → flat
                 # slots of the (E, A) table (E*A = miss/passive → zero).
-                from photon_ml_tpu.game.models import _subspace_positions
                 flat = _subspace_positions(
                     cols_sorted, self.dim,
                     np.asarray(ds.entity_ids[re_type]),
@@ -980,7 +981,6 @@ class RandomEffectCoordinate:
         coordinate's (E, A) active-column layout — inactive-column mass
         cannot survive a projected retrain anyway (projectBackward)."""
         from photon_ml_tpu.game.factored import FactoredRandomEffectModel
-        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
 
         if isinstance(initial, FactoredRandomEffectModel):
             initial = initial.to_random_effect_model()
@@ -1032,8 +1032,6 @@ class RandomEffectCoordinate:
         offsets: Array,
         initial: Optional[RandomEffectModel] = None,
     ) -> RandomEffectModel:
-        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
-
         if initial is not None:
             initial = self.adapt_initial(initial)
         # Warm starts arrive in original space. Unprojected path: the W table
@@ -1126,8 +1124,6 @@ class RandomEffectCoordinate:
         return jnp.einsum("nd,nd->n", self._X, model.means[self._ids])
 
     def initial_model(self):
-        from photon_ml_tpu.game.models import SubspaceRandomEffectModel
-
         if self.subspace:
             return SubspaceRandomEffectModel(
                 re_type=self.re_type, shard_id=self.shard_id,
