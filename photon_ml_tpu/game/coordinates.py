@@ -769,9 +769,14 @@ class RandomEffectCoordinate:
                 cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
             cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
             self.subspace_cols = cols_sorted
-            self._cols_dev = put(cols_sorted)
-            self._perm_dev = put(perm)
-            self._inv_perm_dev = put(
+            # Model-adjacent arrays stay process-local (NOT mesh-sharded),
+            # mirroring the dense path's W table: the trained model must be
+            # host-fetchable on rank 0 for checkpoints/saves, and a
+            # mesh-sharded cols table would span non-addressable devices
+            # in multi-host runs. Bucket DATA arrays remain sharded.
+            self._cols_dev = jnp.asarray(cols_sorted)
+            self._perm_dev = jnp.asarray(perm)
+            self._inv_perm_dev = jnp.asarray(
                 np.argsort(perm, axis=1, kind="stable").astype(np.int32))
             if self.is_sparse:
                 # Stage the score-side join ONCE: data nonzeros → flat
@@ -782,7 +787,8 @@ class RandomEffectCoordinate:
                     np.asarray(dataset.feature_shards[shard_id].indices))
                 fp_dtype = (np.int32 if cols_sorted.size < 2**31 - 1
                             else np.int64)
-                self._sp_flatpos = put(flat.astype(fp_dtype))
+                # Like _sp_values: score-side arrays stay process-local.
+                self._sp_flatpos = jnp.asarray(flat.astype(fp_dtype))
                 # The raw column ids are only needed by the dense-table
                 # score path — free the device copy at scale.
                 self._sp_indices = None

@@ -109,13 +109,20 @@ def _cli_args(train_dir: str, out: str, iterations: int = 1) -> list[str]:
     return [
         "--train", train_dir, "--validation", train_dir,
         "--coordinate", "name=fixed,type=fixed,shard=global",
+        # BOTH random-effect representations cross the jax.distributed
+        # seam: the dense W-table path and the subspace (projected-space)
+        # path over the same shard.
         "--coordinate", "name=per-user,type=random,shard=re_userId,"
                         "re=userId",
-        "--update-sequence", "fixed,per-user",
+        "--coordinate", "name=per-user-sub,type=random,shard=re_userId,"
+                        "re=userId,projector=INDEX_MAP,subspace=true",
+        "--update-sequence", "fixed,per-user,per-user-sub",
         "--iterations", str(iterations),
         "--evaluators", "AUC",
         "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
         "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--opt-config",
+        "per-user-sub:optimizer=LBFGS,reg=L2,reg_weight=1.0",
         "--output-dir", out,
         "--distributed",
     ]
@@ -213,14 +220,14 @@ def test_two_process_kill_then_resume(tmp_path):
     assert info["metrics"]["AUC"] > 0.6
     assert os.path.isdir(os.path.join(out, "best"))
     # The relaunch actually CONSUMED the checkpoint: it finished all
-    # 3 iterations x 2 coordinates, and trained exactly the steps the
+    # 3 iterations x 3 coordinates, and trained exactly the steps the
     # pre-kill run had not yet committed (each training step logs one
     # "CD iter" line; resumed steps are skipped before training).
     assert state_before.get("done_steps", 0) >= 1, state_before
     with open(ckpt_state) as f:
         state_after = json.load(f)
-    assert state_after["complete"] and state_after["done_steps"] == 6, \
+    assert state_after["complete"] and state_after["done_steps"] == 9, \
         state_after
     trained_after_resume = outs[0].count("CD iter")
-    assert trained_after_resume == 6 - state_before["done_steps"], (
+    assert trained_after_resume == 9 - state_before["done_steps"], (
         trained_after_resume, state_before["done_steps"])

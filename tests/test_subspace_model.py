@@ -400,3 +400,22 @@ def test_subspace_dense_warm_start_entity_mismatch_rejected(mesh):
         means=jnp.zeros((7, 48), jnp.float32))
     with pytest.raises(ValueError, match="entities"):
         c_sub.adapt_initial(short)
+
+
+def test_subspace_transform_batched_matches_transform(mesh):
+    """GameTransformer.transform_batched over a subspace model: chunked
+    device scoring (searchsorted join per chunk) must equal the one-shot
+    path exactly."""
+    from photon_ml_tpu.api.transformer import GameTransformer
+
+    sparse_ds, _ = _sparse_re_data(n=2048, d=64, num_entities=24, seed=3)
+    c_sub = RandomEffectCoordinate(
+        sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
+        subspace_model=True)
+    m = c_sub.train_model(np.zeros(sparse_ds.num_rows, np.float32))
+    gm = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={"re": m})
+    tr = GameTransformer(gm)
+    one = np.asarray(tr.transform(sparse_ds).scores)
+    chunked = np.asarray(
+        tr.transform_batched(sparse_ds, batch_rows=300).scores)
+    np.testing.assert_allclose(chunked, one, rtol=1e-6, atol=1e-7)
