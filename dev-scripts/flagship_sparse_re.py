@@ -1,9 +1,9 @@
 """Flagship-scale sparse random effect on one chip: 10M rows, 1M entities,
 d=1M sparse features.
 
-Reproduces the numbers quoted in docs/PARITY.md (host staging ~2.5 min,
-steady-state fit+score ~2 min for all 10^6 per-entity L-BFGS solves, AUC
-~0.995 against planted effects). Needs ~12 GB host RAM for data
+Reproduces the numbers quoted in docs/PARITY.md (host staging ~60 s
+uncontended, steady-state fit+score 2-4 min across runs for all 10^6
+per-entity L-BFGS solves, AUC ~0.995 against planted effects). Needs ~12 GB host RAM for data
 generation and one TPU chip (first run adds remote-compile time; the
 persistent cache makes reruns fast). Neither the 40 TB dense (n, d)
 matrix nor the 4 TB (E, d) model table ever exists: buckets stage at

@@ -7,7 +7,7 @@ buckets at each entity's active dimension (LinearSubspaceProjector
 parity), and the trained model keeps (E, A) active-column coefficients
 (`SubspaceRandomEffectModel`, the reference's
 RandomEffectModelInProjectedSpace). Measured at full scale on one TPU
-chip: 10M rows / 1M entities / d=1M trains in ~112 s steady-state
+chip: 10M rows / 1M entities / d=1M trains in ~2-4 min steady-state
 (docs/PARITY.md).
 
 Run on CPU (virtual mesh) or a TPU:
