@@ -149,3 +149,56 @@ def test_pearson_cap_respected_for_every_entity(data, ratio):
             cap = max(1, int(np.ceil(ratio * n_e)))
             got = p.cols[lane]
             assert int((got >= 0).sum()) <= cap
+
+
+@settings(max_examples=25, deadline=None)
+@given(data=_ell_shard())
+def test_subspace_score_joins_agree(data):
+    """The subspace model's two join implementations — the coordinate's
+    staged host-side sorted join (_subspace_positions) and the model's
+    device-side per-row searchsorted — must produce identical scores on
+    the same dataset, for adversarial ELL shards (duplicate-column
+    padding, explicit zeros, skewed entities)."""
+    from photon_ml_tpu.data.game_data import GameDataset
+    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    shard, ids = data
+    n = shard.shape[0]
+    rng = np.random.default_rng(0)
+    ds = GameDataset(
+        response=rng.integers(0, 2, n).astype(np.float32),
+        offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={"re": shard},
+        entity_ids={"userId": ids},
+        num_entities={"userId": int(ids.max()) + 1},
+        intercept_index={})
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-6),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    c = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC, cfg,
+                               make_mesh(), subspace_model=True)
+    m = c.train_model(np.zeros(n, np.float32))
+    np.testing.assert_allclose(np.asarray(c.score(m)),
+                               np.asarray(m.score(ds)),
+                               rtol=1e-5, atol=1e-6)
+    # Out-of-range entity ids (a fresh dataset read with an extended
+    # vocabulary) must score exactly zero through the device join —
+    # checked against the materialized dense table's own guard.
+    import dataclasses as _dc
+    E = int(ids.max()) + 1
+    wide = _dc.replace(
+        ds,
+        entity_ids={"userId": (ids.astype(np.int64) + (np.arange(n) % 2)
+                               * E).astype(np.int32)},
+        num_entities={"userId": 2 * E})
+    got = np.asarray(m.score(wide))
+    want = np.asarray(m.to_random_effect_model().score(wide))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert np.all(got[np.asarray(wide.entity_ids["userId"]) >= E] == 0.0)
