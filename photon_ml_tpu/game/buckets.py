@@ -60,6 +60,10 @@ class EntityBucketing:
     # Entities dropped by the lower bound (passive-only).
     num_passive_only_entities: int
     num_passive_examples: int
+    # Every bucket's entity count is a multiple of this (consumers chunking
+    # the entity axis must keep slice lengths multiples of it to preserve
+    # mesh-divisibility of sharded staging).
+    entity_pad_multiple: int = 8
 
 
 def _next_pow2(x: int) -> int:
@@ -83,11 +87,21 @@ def build_bucketing(
     """
     entity_ids = np.asarray(entity_ids)
     n = entity_ids.shape[0]
-    # Entity ids are rows into the entity table (non-negative, bounded), so
-    # segments come from one bincount pass instead of np.unique's second
-    # sort; int32 keys sort measurably faster than int64 at 10⁷ rows.
-    order = np.argsort(entity_ids.astype(np.int32, copy=False),
-                       kind="stable")
+    # Entity ids are rows into the entity table (non-negative, bounded) —
+    # the int32 sort key below would silently mis-sort ids >= 2**31 and
+    # bincount would raise on negatives, so turn violations into a loud
+    # error here.
+    if n and (int(entity_ids.min()) < 0
+              or int(entity_ids.max()) >= num_entities):
+        raise ValueError(
+            f"entity ids must lie in [0, {num_entities}); got range "
+            f"[{int(entity_ids.min())}, {int(entity_ids.max())}]")
+    # Segments come from one bincount pass instead of np.unique's second
+    # sort; int32 keys sort measurably faster than int64 at 10⁷ rows (the
+    # narrowing is guarded: past int32 range keep the original dtype).
+    sort_keys = (entity_ids.astype(np.int32, copy=False)
+                 if num_entities <= 2**31 else entity_ids)
+    order = np.argsort(sort_keys, kind="stable")
     counts_all = np.bincount(entity_ids)
     uniq = np.flatnonzero(counts_all)
     counts = counts_all[uniq]
@@ -144,6 +158,7 @@ def build_bucketing(
         trained_entities=trained,
         num_passive_only_entities=num_passive_only,
         num_passive_examples=passive_examples,
+        entity_pad_multiple=entity_pad_multiple,
     )
 
 

@@ -745,12 +745,18 @@ class RandomEffectCoordinate:
             # Bound the vmapped-solve footprint: a single dispatch over
             # hundreds of thousands of entity lanes exhausts HBM on solver
             # temps (the L-BFGS carry and line-search buffers scale with
-            # lanes), so buckets split into ≤ _LANE_CHUNK-entity pieces.
-            # Chunks of equal shape share one compiled program; chunk
-            # boundaries stay multiples of the entity pad (sharding-safe).
+            # lanes), so buckets split into ~_LANE_CHUNK-entity pieces.
+            # The chunk size is rounded UP to a multiple of this
+            # coordinate's entity pad so every slice (bucket sizes are pad
+            # multiples, so the tail slice included) keeps the divisibility
+            # put() needs to shard — a fixed 65536 would silently
+            # replicate previously-sharded buckets on non-power-of-two
+            # data axes.
+            pad = self.bucketing.entity_pad_multiple
+            chunk = ((_LANE_CHUNK + pad - 1) // pad) * pad
             E_b = rows.shape[0]
-            for lo in range(0, E_b, _LANE_CHUNK):
-                hi = min(lo + _LANE_CHUNK, E_b)
+            for lo in range(0, E_b, chunk):
+                hi = min(lo + chunk, E_b)
                 self._bucket_data.append(tuple(
                     put(np.asarray(a)[lo:hi]) for a in arrays))
         if self.subspace:
@@ -1000,6 +1006,12 @@ class RandomEffectCoordinate:
                     f"subspace warm start has {initial.cols.shape[0]} "
                     f"entities, coordinate expects "
                     f"{self.subspace_cols.shape[0]}")
+            if initial.num_features != self.dim:
+                raise ValueError(
+                    f"subspace warm start has {initial.num_features} "
+                    f"features, coordinate expects {self.dim} (the "
+                    f"searchsorted sentinels would collide with real "
+                    f"column ids)")
             if np.array_equal(np.asarray(initial.cols),
                               self.subspace_cols):
                 return initial
@@ -1025,6 +1037,11 @@ class RandomEffectCoordinate:
                 f"warm start has {initial.means.shape[0]} entities, "
                 f"coordinate expects {self.subspace_cols.shape[0]} "
                 f"(a clamped gather would misattribute rows)")
+        if initial.means.shape[1] != self.dim:
+            raise ValueError(
+                f"warm start has {initial.means.shape[1]} features, "
+                f"coordinate expects {self.dim} "
+                f"(a clamped gather would misattribute columns)")
         cols = jnp.asarray(self.subspace_cols)
         means = jnp.asarray(initial.means)
         ga = means[jnp.arange(cols.shape[0])[:, None],

@@ -29,6 +29,7 @@
 
 #include <zlib.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -430,12 +431,21 @@ bool inflate_raw(Handle* h, const uint8_t* src, size_t n,
     h->error = "zlib init failed";
     return false;
   }
+  // avail_in is 32-bit; feed the source in <4 GiB slices so spec-legal
+  // multi-GiB blocks decode instead of zlib seeing a truncated prefix.
+  size_t fed = 0;
   zs.next_in = const_cast<uint8_t*>(src);
-  zs.avail_in = static_cast<uInt>(n);
+  zs.avail_in = 0;
   out->clear();
   uint8_t buf[1 << 16];
   int rc = Z_OK;
   while (rc != Z_STREAM_END) {
+    if (zs.avail_in == 0 && fed < n) {
+      const size_t take = std::min(n - fed, size_t{1} << 30);
+      zs.next_in = const_cast<uint8_t*>(src + fed);
+      zs.avail_in = static_cast<uInt>(take);
+      fed += take;
+    }
     zs.next_out = buf;
     zs.avail_out = sizeof(buf);
     rc = inflate(&zs, Z_NO_FLUSH);
@@ -445,7 +455,7 @@ bool inflate_raw(Handle* h, const uint8_t* src, size_t n,
       return false;
     }
     out->insert(out->end(), buf, buf + (sizeof(buf) - zs.avail_out));
-    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) {
+    if (rc == Z_OK && zs.avail_in == 0 && fed >= n && zs.avail_out != 0) {
       inflateEnd(&zs);
       h->error = "deflate block is truncated";
       return false;
@@ -582,10 +592,15 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
       // could possibly hold is corrupt (or hostile). Reject it here rather
       // than letting the declared total drive a std::bad_alloc through the
       // extern "C" boundary below (every other corruption path surfaces as
-      // a ValueError, not an abort).
+      // a ValueError, not an abort). Overflow-safe form: ceil(count/ratio)
+      // bytes are the minimum payload — no byte_size*ratio product, so
+      // spec-legal multi-GiB blocks (byte_size already bounded by the real
+      // file size via need() above) pass through; a hostile count that
+      // still slips past merely lands in the allocation catch below.
+      // (count - 1) / ratio cannot overflow for any int64 count, unlike
+      // count + ratio - 1.
       const int64_t ratio = (h->codec == "deflate") ? 1032 : 1;
-      if (byte_size > (int64_t{1} << 40) / ratio ||
-          count > byte_size * ratio) {
+      if (count > 0 && (count - 1) / ratio >= byte_size) {
         h->error = "block declares more records than its payload can hold";
         return -1;
       }
@@ -606,7 +621,7 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
     h->uid_kind.assign(static_cast<size_t>(h->n_records), 0);
     h->uid_long.assign(static_cast<size_t>(h->n_records), 0);
     h->uid_str.assign(static_cast<size_t>(h->n_records), std::string());
-  } catch (const std::bad_alloc&) {
+  } catch (const std::exception&) {  // bad_alloc or length_error
     h->error = "cannot allocate columns for declared record count";
     return -1;
   }
@@ -614,8 +629,13 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
   int64_t row = 0;
   std::vector<uint8_t> scratch;
 
-  // Decode pass (single traversal, mirrors pass 1).
-  {
+  // Decode pass (single traversal, mirrors pass 1). The whole pass sits
+  // under the same allocation catch as the column assigns: a hostile
+  // deflate block can expand up to ~1032x its (file-size-bounded) payload,
+  // and the scratch/string growth it drives must surface as a ValueError
+  // through pavro_error, never as an exception escaping the extern "C"
+  // frame.
+  try {
     Cursor c{h->file.data() + h->blocks_start,
              h->file.data() + h->file.size()};
     while (c.p < c.end) {
@@ -636,6 +656,9 @@ long pavro_decode(void* hv, const int32_t* plan, long plan_len,
       // Trailing payload bytes after the declared records are ignored —
       // the Python DataFileReader accepts such files too (parity).
     }
+  } catch (const std::exception&) {
+    h->error = "cannot allocate memory while decoding blocks";
+    return -1;
   }
   return static_cast<long>(h->n_records);
 }
