@@ -97,7 +97,7 @@ def _lines(b):
                  if hybrid else "Sparse 1M-feature gradient step (ELL)")
         tail = (" — hybrid hot-dense/cold-class layout riding the Zipf "
                 "head (exact objective; ELL shard_map kept for "
-                "multi-device/feature-sharded runs)" if hybrid else "")
+                "feature-sharded runs)" if hybrid else "")
         row(label,
             f"**{_human_rate(sp)} samples/s**"
             + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "") + vs_ell,
@@ -108,6 +108,14 @@ def _lines(b):
         if spb:
             row("…with bf16 feature storage",
                 f"**{_human_rate(spb)} samples/s**")
+        spsh = b.get("sparse_hybrid_sharded_samples_per_sec")
+        if spsh:
+            row("…data-parallel composition (HybridShards, S=1)",
+                f"**{_human_rate(spsh)} samples/s**",
+                f"…through the data-parallel HybridShards composition "
+                f"(shard_map + psum, 1-device mesh): "
+                f"**{_human_rate(spsh)} samples/s** — the multi-device "
+                f"hybrid path runs at the single-layout rate")
     if b.get("sparse_re_fit_seconds") is not None:
         cfgs = b.get("sparse_re_config", "")
         row(f"Sparse random-effect fit ({cfgs})",

@@ -62,6 +62,7 @@ class GameEstimator:
         validation_evaluators: Optional[list[str]] = None,
         normalization: Optional[dict[str, NormalizationContext]] = None,
         compute_variances_at_end: bool = True,
+        staging_cache_dir: Optional[str] = None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -71,6 +72,11 @@ class GameEstimator:
         self.validation_evaluators = validation_evaluators or []
         self.normalization = normalization or {}
         self.compute_variances_at_end = compute_variances_at_end
+        # Disk cache for projected random-effect staging artifacts
+        # (game/staging_cache.py): a warm re-fit of the same dataset in a
+        # fresh process memory-maps the staged blocks instead of re-paying
+        # the projection pass.
+        self.staging_cache_dir = staging_cache_dir
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
@@ -138,7 +144,8 @@ class GameEstimator:
                     projection=cc.data.projector.upper() == "INDEX_MAP",
                     features_to_samples_ratio=(
                         cc.data.features_to_samples_ratio),
-                    subspace_model=cc.data.subspace_model)
+                    subspace_model=cc.data.subspace_model,
+                    staging_cache_dir=self.staging_cache_dir)
             elif isinstance(cc.data, FactoredRandomEffectDataConfiguration):
                 if cc.data.feature_shard_id in self.normalization:
                     raise ValueError(

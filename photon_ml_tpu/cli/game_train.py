@@ -139,6 +139,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
                         "/ JAX_PROCESS_ID; automatic on Cloud TPU). "
                         "Recovery from a lost host is restart + --resume.")
+    p.add_argument("--staging-cache-dir",
+                   help="persist projected random-effect staging artifacts "
+                        "here, keyed by dataset content digest — a re-run "
+                        "on the same data memory-maps the staged blocks "
+                        "instead of re-paying the projection pass")
     return p
 
 
@@ -348,7 +353,8 @@ def run(args) -> dict:
         update_sequence=[c for c in args.update_sequence.split(",") if c],
         mesh=make_mesh(distributed=getattr(args, "distributed", False)),
         descent_iterations=args.iterations,
-        validation_evaluators=evaluators)
+        validation_evaluators=evaluators,
+        staging_cache_dir=args.staging_cache_dir)
 
     initial_models = None
     if args.model_input_dir:

@@ -297,15 +297,20 @@ class SparseFixedEffectCoordinate:
     start move.
 
     Two execution layouts:
-    - ``hybrid`` (default on a single-data-shard mesh): the hot-dense /
+    - ``hybrid`` (default whenever coefficients replicate): the hot-dense /
       cold-class layout of ops/hybrid_sparse.py — the Zipf head of the
       feature space rides the MXU as a dense block and the cold tail's
       random crossings shrink to ~15% of the volume (measured ~4-10× the
       ELL step at d=1M on one v5e chip). Exact, not approximate: the
       solve happens in a statically permuted feature space and maps back.
-    - ELL shard_map pipeline (parallel/sparse_objective.py): the
-      multi-device path, required for ``feature_sharded=True`` (P3) and
-      any mesh whose data axis is sharded.
+      On a multi-data-shard mesh the rows split contiguously into
+      per-shard hybrid layouts under one GLOBAL permutation
+      (HybridShards): hot/cold aggregates run shard-local and psum over
+      ``data``, so the fast path composes with data parallelism.
+    - ELL shard_map pipeline (parallel/sparse_objective.py): required for
+      ``feature_sharded=True`` (P3), where the coefficient dimension
+      itself shards over ``model`` and the hybrid layout's replicated
+      permuted space does not exist.
 
     Normalization is not supported here (the reference normalizes dense
     shards only; scaling sparse values would densify shift terms).
@@ -348,20 +353,15 @@ class SparseFixedEffectCoordinate:
 
         single_shard = mesh.shape[DATA_AXIS] == 1
         if hybrid is None:
-            self.hybrid = single_shard and not self.feature_sharded
+            self.hybrid = not self.feature_sharded
         else:
             self.hybrid = bool(hybrid)
             if self.hybrid and self.feature_sharded:
                 raise ValueError(
                     "hybrid=True is incompatible with feature_sharded "
-                    "(the hybrid layout owns the whole permuted feature "
-                    "space on each data shard)")
-            if self.hybrid and not single_shard:
-                raise ValueError(
-                    f"hybrid=True needs a single-data-shard mesh (got "
-                    f"data={mesh.shape[DATA_AXIS]}); use hybrid=None for "
-                    f"automatic selection or hybrid=False for the ELL "
-                    f"shard_map pipeline")
+                    "(the hybrid layout needs the permuted coefficient "
+                    "space replicated on every shard)")
+        self._hybrid_sharded = self.hybrid and not single_shard
 
         batch = SparseBatch(
             indices=np.asarray(shard.indices),
@@ -377,7 +377,13 @@ class SparseFixedEffectCoordinate:
 
             dt = (_jnp.bfloat16 if feature_dtype == "bfloat16"
                   else _jnp.float32)
-            self._staged = hybrid_mod.build_hybrid(batch, feature_dtype=dt)
+            if self._hybrid_sharded:
+                shb = hybrid_mod.build_hybrid_shards(
+                    batch, mesh.shape[DATA_AXIS], feature_dtype=dt)
+                self._staged = sp.shard_hybrid(shb, mesh)
+            else:
+                self._staged = hybrid_mod.build_hybrid(
+                    batch, feature_dtype=dt)
             self._ii_perm = (
                 None if self.intercept_index is None else int(
                     np.asarray(self._staged.inv_perm)[self.intercept_index]))
@@ -452,11 +458,12 @@ class SparseFixedEffectCoordinate:
 
     def _build_hybrid_fits(self):
         """Jitted hybrid-layout programs. Per CD step only (n,) offsets and
-        the warm start move; the staged HybridSparseBatch is a jit argument
-        (never a baked constant) so the big hot block stays device-resident
-        across compilations. Down-sampling masks weights in place of the
-        ELL path's row gather — the objective is identical (dropped rows
-        get weight 0, kept rows scale by the rate multiplier)."""
+        the warm start move; the staged HybridSparseBatch / HybridShards is
+        a jit argument (never a baked constant) so the big hot block stays
+        device-resident across compilations. Down-sampling masks weights in
+        place of the ELL path's row gather — the objective is identical
+        (dropped rows get weight 0, kept rows scale by the rate
+        multiplier)."""
         from photon_ml_tpu.ops import hybrid_sparse as hybrid_mod
         from photon_ml_tpu.parallel import sparse_problem as sp
 
@@ -464,6 +471,10 @@ class SparseFixedEffectCoordinate:
             self.config, variance_computation=VarianceComputationType.NONE)
         loss = self.loss
         ii_perm = self._ii_perm
+
+        if self._hybrid_sharded:
+            self._build_hybrid_sharded_fits(cfg, ii_perm)
+            return
 
         def fit(hb, offsets, w0):
             hbo = dataclasses.replace(hb, offsets=jnp.asarray(offsets))
@@ -492,6 +503,61 @@ class SparseFixedEffectCoordinate:
             return hybrid_mod.to_original_space(
                 hbo, hybrid_mod.hessian_diagonal(
                     loss, hybrid_mod.to_permuted_space(hbo, means), hbo))
+
+        self._fit = jax.jit(fit)
+        self._fit_sampled = jax.jit(fit_sampled)
+        self._score = jax.jit(score_fn)
+        self._hess_diag = jax.jit(hess_diag)
+
+    def _build_hybrid_sharded_fits(self, cfg, ii_perm):
+        """Jitted programs over the data-sharded hybrid layout.
+
+        Offsets/weights keep the contract of the rest of the class — flat
+        padded global row order — and reshape to the (S, n_l) grid at the
+        jit boundary (padding sits at the global tail, so flat index ==
+        original row id)."""
+        from photon_ml_tpu.parallel import sparse_objective as sobj
+        from photon_ml_tpu.parallel import sparse_problem as sp
+
+        loss = self.loss
+        mesh = self.mesh
+        S = self._staged.num_shards
+        n_l = self._staged.rows_per_shard
+        n = self.dataset.num_rows
+
+        def grid(offsets):
+            flat = jnp.zeros((S * n_l,), jnp.asarray(offsets).dtype
+                             ).at[:offsets.shape[0]].set(offsets)
+            return flat.reshape(S, n_l)
+
+        def fit(shb, offsets, w0):
+            shbo = dataclasses.replace(shb, offsets=grid(offsets))
+            coef, _ = sp.run_hybrid_sharded(
+                loss, shbo, mesh, cfg, initial=Coefficients(w0),
+                intercept_index_permuted=ii_perm)
+            return coef.means
+
+        def fit_sampled(shb, idx, mult, offsets, w0):
+            wf = shb.weights.reshape(-1)
+            w_masked = jnp.zeros_like(wf).at[idx].set(
+                wf[idx] * mult).reshape(shb.weights.shape)
+            shbo = dataclasses.replace(shb, weights=w_masked,
+                                       offsets=grid(offsets))
+            coef, _ = sp.run_hybrid_sharded(
+                loss, shbo, mesh, cfg, initial=Coefficients(w0),
+                intercept_index_permuted=ii_perm)
+            return coef.means
+
+        def score_fn(shb, means):
+            # Staged offsets are zeros, so margins == X @ w exactly; rows
+            # come back in flat padded global order.
+            return sobj.make_hybrid_margins(mesh, shb)(means[shb.perm])
+
+        def hess_diag(shb, offsets, means):
+            shbo = dataclasses.replace(shb, offsets=grid(offsets))
+            diag = sobj.make_hybrid_hessian_diagonal(
+                loss, mesh, shbo)(means[shbo.perm])
+            return diag[shbo.inv_perm]
 
         self._fit = jax.jit(fit)
         self._fit_sampled = jax.jit(fit_sampled)
@@ -632,6 +698,7 @@ class RandomEffectCoordinate:
         projection: bool = False,
         features_to_samples_ratio: Optional[float] = None,
         subspace_model: Optional[bool] = None,
+        staging_cache_dir: Optional[str] = None,
     ):
         from photon_ml_tpu.data.game_data import SparseShard
         self.is_sparse = isinstance(dataset.feature_shards[shard_id],
@@ -715,33 +782,91 @@ class RandomEffectCoordinate:
         if s_full is not None and f_full is None:
             f_full = np.ones_like(s_full)
 
-        coo = prj.shard_coo(X) if self.projection else None
-        bucket_cols: list[np.ndarray] = []  # per-bucket (E_b, d_active)
-        for b in self.bucketing.buckets:
-            wb = bkt.bucket_weights(b, ds.weights)
-            ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 padding
-            rows = b.entity_rows  # (E_b,) int32; -1 padding
-            if self.projection:
-                trip = prj.bucket_triplets(b, X, coo)
-                proj = prj.build_bucket_projection(
-                    b, X, self.intercept_index,
-                    labels=np.asarray(ds.response),
-                    features_to_samples_ratio=self.features_to_samples_ratio,
-                    triplets=trip)
-                Xb = prj.gather_projected_features(b, proj, X,
-                                                   triplets=trip)
-                (yb,) = bkt.gather_bucket_arrays(b, ds.response)
-                f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
-                bucket_cols.append(proj.cols)
-                extra = [proj.cols]
-                if f_full is not None:
-                    extra.append(f_p)
-                if s_full is not None:
-                    extra.append(s_p)
-                arrays = (Xb, yb, wb, ex, rows, *extra)
-            else:
-                Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
-                arrays = (Xb, yb, wb, ex, rows)
+        # Projected staging products persist on disk keyed by dataset
+        # content + staging params (photon_ml_tpu/game/staging_cache.py):
+        # a warm re-fit of the same data memory-maps the staged blocks
+        # instead of re-paying the projection sort/segment pass.
+        from photon_ml_tpu.game import staging_cache
+
+        cached = None
+        self._staging_cache_key = None
+        if staging_cache_dir and self.projection:
+            self._staging_cache_key = staging_cache.staging_key(
+                dataset, norm, re_type=re_type, shard_id=shard_id,
+                lower_bound=lower_bound, upper_bound=upper_bound,
+                seed=seed, pad=self.bucketing.entity_pad_multiple,
+                ratio=self.features_to_samples_ratio,
+                intercept=self.intercept_index, subspace=self.subspace)
+            cached = staging_cache.load(staging_cache_dir,
+                                        self._staging_cache_key)
+
+        if cached is not None:
+            host_buckets, sub = cached
+        else:
+            coo = prj.shard_coo(X) if self.projection else None
+            trips = (prj.all_bucket_triplets(self.bucketing.buckets, X, coo)
+                     if self.projection else None)
+            bucket_cols: list[np.ndarray] = []  # per-bucket (E_b, d_active)
+            host_buckets: list[tuple] = []
+            for bi, b in enumerate(self.bucketing.buckets):
+                wb = bkt.bucket_weights(b, ds.weights)
+                ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 pad
+                rows = b.entity_rows  # (E_b,) int32; -1 padding
+                if self.projection:
+                    trip = trips[bi]
+                    proj = prj.build_bucket_projection(
+                        b, X, self.intercept_index,
+                        labels=np.asarray(ds.response),
+                        features_to_samples_ratio=(
+                            self.features_to_samples_ratio),
+                        triplets=trip)
+                    Xb = prj.gather_projected_features(b, proj, X,
+                                                       triplets=trip)
+                    (yb,) = bkt.gather_bucket_arrays(b, ds.response)
+                    f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
+                    bucket_cols.append(proj.cols)
+                    extra = [proj.cols]
+                    if f_full is not None:
+                        extra.append(f_p)
+                    if s_full is not None:
+                        extra.append(s_p)
+                    host_buckets.append((Xb, yb, wb, ex, rows, *extra))
+                else:
+                    Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
+                    host_buckets.append((Xb, yb, wb, ex, rows))
+            sub = {}
+            if self.subspace:
+                # (E, A) active-column table: each entity lives in exactly
+                # one bucket, so its model row is its bucket row padded to
+                # the widest bucket's d_active. The PUBLIC model layout
+                # sorts each row by column id (padding last) so
+                # SubspaceRandomEffectModel.score can join new datasets
+                # with a device-side searchsorted; the bucket-internal
+                # layout (intercept slot 0) is reached through the stored
+                # permutation at the train/warm-start boundary.
+                A = max((c.shape[1] for c in bucket_cols), default=1)
+                cols_tab = np.full((self.num_entities, A), -1, np.int32)
+                for b, c in zip(self.bucketing.buckets, bucket_cols):
+                    live = b.entity_rows >= 0
+                    cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
+                cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
+                sub = {"cols": cols_sorted, "perm": perm}
+                if self.is_sparse:
+                    # Stage the score-side join ONCE: data nonzeros → flat
+                    # slots of the (E, A) table (E*A = miss/passive → 0).
+                    flat = _subspace_positions(
+                        cols_sorted, self.dim,
+                        np.asarray(ds.entity_ids[re_type]),
+                        np.asarray(dataset.feature_shards[shard_id].indices))
+                    fp_dtype = (np.int32 if cols_sorted.size < 2**31 - 1
+                                else np.int64)
+                    sub["flat"] = flat.astype(fp_dtype)
+            if self._staging_cache_key is not None:
+                staging_cache.save(staging_cache_dir,
+                                   self._staging_cache_key,
+                                   host_buckets, sub)
+
+        for arrays in host_buckets:
             # Bound the vmapped-solve footprint: a single dispatch over
             # hundreds of thousands of entity lanes exhausts HBM on solver
             # temps (the L-BFGS carry and line-search buffers scale with
@@ -754,26 +879,14 @@ class RandomEffectCoordinate:
             # data axes.
             pad = self.bucketing.entity_pad_multiple
             chunk = ((_LANE_CHUNK + pad - 1) // pad) * pad
-            E_b = rows.shape[0]
+            E_b = arrays[4].shape[0]
             for lo in range(0, E_b, chunk):
                 hi = min(lo + chunk, E_b)
                 self._bucket_data.append(tuple(
                     put(np.asarray(a)[lo:hi]) for a in arrays))
         if self.subspace:
-            # (E, A) active-column table: each entity lives in exactly one
-            # bucket, so its model row is its bucket row padded to the
-            # widest bucket's d_active. The PUBLIC model layout sorts each
-            # row by column id (padding last) so SubspaceRandomEffectModel
-            # .score can join new datasets with a device-side searchsorted;
-            # the bucket-internal layout (intercept slot 0) is reached
-            # through the stored permutation at the train/warm-start
-            # boundary.
-            A = max((c.shape[1] for c in bucket_cols), default=1)
-            cols_tab = np.full((self.num_entities, A), -1, np.int32)
-            for b, c in zip(self.bucketing.buckets, bucket_cols):
-                live = b.entity_rows >= 0
-                cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
-            cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
+            cols_sorted = np.asarray(sub["cols"])
+            perm = np.asarray(sub["perm"])
             self.subspace_cols = cols_sorted
             # Model-adjacent arrays stay process-local (NOT mesh-sharded),
             # mirroring the dense path's W table: the trained model must be
@@ -785,16 +898,8 @@ class RandomEffectCoordinate:
             self._inv_perm_dev = jnp.asarray(
                 np.argsort(perm, axis=1, kind="stable").astype(np.int32))
             if self.is_sparse:
-                # Stage the score-side join ONCE: data nonzeros → flat
-                # slots of the (E, A) table (E*A = miss/passive → zero).
-                flat = _subspace_positions(
-                    cols_sorted, self.dim,
-                    np.asarray(ds.entity_ids[re_type]),
-                    np.asarray(dataset.feature_shards[shard_id].indices))
-                fp_dtype = (np.int32 if cols_sorted.size < 2**31 - 1
-                            else np.int64)
                 # Like _sp_values: score-side arrays stay process-local.
-                self._sp_flatpos = jnp.asarray(flat.astype(fp_dtype))
+                self._sp_flatpos = jnp.asarray(np.asarray(sub["flat"]))
                 # The raw column ids are only needed by the dense-table
                 # score path — free the device copy at scale.
                 self._sp_indices = None

@@ -1,0 +1,116 @@
+"""Persistent staging cache for projected random-effect coordinates.
+
+Reference parity note: the reference pays its RandomEffectDataset build
+(partition + projector construction) inside every Spark job and relies on
+RDD caching within the job; re-running the driver re-pays it. Here the
+host-side staging products (per-bucket projected feature blocks + column
+maps + subspace join tables) persist on disk keyed by the DATASET CONTENT
+DIGEST (game/descent._dataset_digest) plus every staging parameter, so a
+re-fit of the same data in a fresh process skips the projection pass
+entirely — at the 10M-row / 1M-entity flagship config that pass is tens of
+seconds of sort/segment work per coordinate.
+
+Layout: ``<cache_dir>/<key>/`` holding ``meta.json`` (bucket tuple arity)
+and one ``.npy`` per staged array. Writers stage into a temp directory and
+``os.rename`` it into place (atomic on one filesystem), so readers never
+observe a half-written entry. Loads memory-map the arrays: the host copy
+is never materialized — bytes stream straight from the page cache into the
+device transfer the coordinate performs anyway.
+
+Anything unreadable (version skew, partial copy, foreign files) is treated
+as a miss — the caller restages and overwrites.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+# Bump when the staged representation changes shape/meaning.
+STAGING_VERSION = 1
+
+
+def staging_key(dataset, norm, **params) -> str:
+    """Cache key: dataset content digest + normalization digest + every
+    staging parameter (bounds, seed, projection flags, …)."""
+    from photon_ml_tpu.game.descent import (_dataset_digest,
+                                            normalization_digest)
+
+    h = hashlib.sha1()
+    h.update(f"v{STAGING_VERSION}".encode())
+    h.update(_dataset_digest(dataset).encode())
+    h.update(normalization_digest(norm).encode())
+    for k in sorted(params):
+        h.update(f"{k}={params[k]!r};".encode())
+    return h.hexdigest()
+
+
+def save(cache_dir: str, key: str,
+         bucket_arrays: list[tuple[np.ndarray, ...]],
+         subspace: Optional[dict[str, np.ndarray]] = None) -> None:
+    """Persist one coordinate's staged host arrays (atomic rename)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=cache_dir, prefix=f".{key}.tmp")
+    try:
+        meta = {"version": STAGING_VERSION,
+                "arity": [len(t) for t in bucket_arrays],
+                "subspace": sorted(subspace) if subspace else []}
+        for i, t in enumerate(bucket_arrays):
+            for j, a in enumerate(t):
+                np.save(os.path.join(tmp, f"b{i}_{j}.npy"),
+                        np.asarray(a), allow_pickle=False)
+        for name, a in (subspace or {}).items():
+            np.save(os.path.join(tmp, f"sub_{name}.npy"),
+                    np.asarray(a), allow_pickle=False)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        final = os.path.join(cache_dir, key)
+        if os.path.isdir(final):
+            # Replace, never keep: the caller just restaged because load()
+            # missed, so whatever sits here is stale or corrupt (a
+            # concurrent GOOD writer produced identical content — swapping
+            # it is harmless). Move aside first so readers only ever see a
+            # complete entry at ``final``.
+            old = tempfile.mkdtemp(dir=cache_dir, prefix=f".{key}.old")
+            os.rename(final, os.path.join(old, "entry"))
+            shutil.rmtree(old, ignore_errors=True)
+        try:
+            os.rename(tmp, final)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load(cache_dir: str, key: str
+         ) -> Optional[tuple[list[tuple[np.ndarray, ...]],
+                             dict[str, np.ndarray]]]:
+    """(bucket_arrays, subspace) for a cached key, or None on any miss.
+
+    Arrays come back memory-mapped (read-only)."""
+    path = os.path.join(cache_dir, key)
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("version") != STAGING_VERSION:
+            return None
+        bucket_arrays = [
+            tuple(np.load(os.path.join(path, f"b{i}_{j}.npy"),
+                          mmap_mode="r", allow_pickle=False)
+                  for j in range(arity))
+            for i, arity in enumerate(meta["arity"])]
+        subspace = {
+            name: np.load(os.path.join(path, f"sub_{name}.npy"),
+                          mmap_mode="r", allow_pickle=False)
+            for name in meta["subspace"]}
+        return bucket_arrays, subspace
+    except Exception:
+        return None

@@ -205,13 +205,206 @@ def build_hybrid(
     )
 
 
-def to_permuted_space(hb: HybridSparseBatch, w: Array) -> Array:
-    """Original-space (d,) vector → permuted space (once per fit)."""
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HybridShards:
+    """Data-parallel stack of per-shard hybrid layouts (P3 composition).
+
+    The single-shard hybrid layout above owns the whole batch; this is its
+    multi-device composition: rows are padded to ``S * rows_per_shard``
+    (padding rows carry weight 0) and split CONTIGUOUSLY into S shards,
+    and every data array carries a leading shard axis that shards over the
+    mesh's ``data`` axis. The feature-space permutation and the cold count
+    classes are GLOBAL — computed from global column counts — so the
+    permuted coefficient space (what the optimizer sees, replicated) is
+    identical across shards, hot gradients psum like the dense
+    data-parallel path, and each shard's cold entries reference LOCAL row
+    ids (pad == rows_per_shard, the zero sentinel lane).
+
+    A column that happens to have no nonzeros in some shard still owns its
+    class row there (all pad lanes) — inert by the pad contract, so the
+    data-axis psum over per-shard gradients is exact.
+    """
+
+    X_hot: Array  # (S, n_l, k) dense hot blocks
+    cold_rowids: tuple[Array, ...]  # per class: (S, C, L) int32, pad == n_l
+    cold_vals: tuple[Array, ...]  # per class: (S, C, L) f32, pad == 0
+    labels: Array  # (S, n_l)
+    weights: Array  # (S, n_l); padding rows weight 0
+    offsets: Array  # (S, n_l)
+    perm: Array  # (d,) int32: new col -> original col
+    inv_perm: Array  # (d,) int32: original col -> new col
+    num_features: int = dataclasses.field(metadata=dict(static=True))
+    num_hot: int = dataclasses.field(metadata=dict(static=True))
+    class_starts: tuple[int, ...] = dataclasses.field(
+        metadata=dict(static=True))
+    num_rows_global: int = dataclasses.field(
+        metadata=dict(static=True))  # true rows before padding
+
+    @property
+    def num_shards(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.labels.shape[1]
+
+    @property
+    def num_rows(self) -> int:
+        """Padded global row count (S * n_l) — flat score/offset length."""
+        return self.labels.shape[0] * self.labels.shape[1]
+
+    @property
+    def dim(self) -> int:
+        return self.num_features
+
+
+def local_shard(shb: HybridShards, X_hot: Array,
+                cold_rowids: tuple[Array, ...],
+                cold_vals: tuple[Array, ...], labels: Array,
+                weights: Array, offsets: Array) -> HybridSparseBatch:
+    """One shard's block (leading axis 1, as shard_map yields it) as a
+    HybridSparseBatch, so every aggregate above runs unchanged per shard.
+
+    The perm fields are deliberately empty: the per-shard aggregates never
+    touch them (permutation handling happens once, outside the shard_map).
+    """
+    empty = jnp.zeros((0,), jnp.int32)
+    return HybridSparseBatch(
+        X_hot=X_hot[0], cold_rowids=tuple(r[0] for r in cold_rowids),
+        cold_vals=tuple(v[0] for v in cold_vals), labels=labels[0],
+        weights=weights[0], offsets=offsets[0], perm=empty, inv_perm=empty,
+        num_features=shb.num_features, num_hot=shb.num_hot,
+        class_starts=shb.class_starts)
+
+
+def build_hybrid_shards(
+    batch: SparseBatch,
+    n_shards: int,
+    hot_threshold: Optional[int] = None,
+    max_hot: int = 4096,
+    feature_dtype=jnp.float32,
+) -> HybridShards:
+    """Stage an ELL SparseBatch into S per-shard hybrid layouts (host-side,
+    once). Same hot/cold policy as ``build_hybrid`` — global counts decide
+    the hot set and the cold classes; only the ROWS split across shards.
+    """
+    indices = np.asarray(batch.indices)
+    values = np.asarray(batch.values)
+    n = indices.shape[0]
+    d = int(batch.num_features)
+    S = int(n_shards)
+    n_l = -(-n // S)  # ceil: rows per shard
+    n_pad = n_l * S
+    if hot_threshold is None:
+        hot_threshold = max(8, n // 4096)
+
+    flat_col = indices.reshape(-1)
+    flat_row = np.repeat(np.arange(n, dtype=np.int64), indices.shape[1])
+    flat_val = values.reshape(-1)
+    live = (flat_col < d) & (flat_val != 0.0)
+    counts = np.bincount(flat_col[live], minlength=d)
+
+    order_desc = np.argsort(-counts, kind="stable").astype(np.int32)
+    k = int(min(max_hot, int((counts >= hot_threshold).sum())))
+    inv_perm = np.empty(d, np.int32)
+    inv_perm[order_desc] = np.arange(d, dtype=np.int32)
+
+    # Hot blocks: one global dense scatter, then the contiguous row split.
+    X_hot = np.zeros((n_pad, max(k, 1)), np.float32)
+    new_col = inv_perm[np.minimum(flat_col, d - 1)]
+    hot_sel = live & (new_col < k)
+    if k:
+        X_hot[flat_row[hot_sel], new_col[hot_sel]] = flat_val[hot_sel]
+    X_hot = X_hot[:, :k].reshape(S, n_l, k)
+
+    # Cold entries keyed by (shard, permuted column).
+    cold_sel = live & (new_col >= k)
+    c_new = (new_col[cold_sel] - k).astype(np.int64)
+    c_row = flat_row[cold_sel]
+    c_val = flat_val[cold_sel]
+    c_shard = c_row // n_l
+    c_local = (c_row - c_shard * n_l).astype(np.int32)
+
+    cold_counts = counts[order_desc][k:]  # global, descending
+    present = int((cold_counts > 0).sum())
+
+    rowids_cls: list[np.ndarray] = []
+    vals_cls: list[np.ndarray] = []
+    class_starts: list[int] = []
+    if present:
+        key = c_shard * present + c_new
+        order = np.argsort(key, kind="stable")
+        key_s = key[order]
+        c_new_s = c_new[order]
+        loc_s = c_local[order]
+        val_s = c_val[order]
+        grp_counts = np.bincount(key_s, minlength=S * present)
+        grp_starts = (np.cumsum(grp_counts) - grp_counts).astype(np.int64)
+        pos = np.arange(key_s.size, dtype=np.int64) - grp_starts[key_s]
+        M = grp_counts.reshape(S, present)  # per-shard per-column counts
+
+        # Classes by GLOBAL count (same as build_hybrid), so each class is
+        # one contiguous run of the permuted space; the per-shard lane
+        # width L fits the largest per-shard column count in the class.
+        cls = np.ceil(np.log2(np.maximum(
+            cold_counts[:present], 1))).astype(np.int64)
+        cls_of_entry = cls[c_new_s]
+        for kk in np.unique(cls)[::-1]:
+            selc = np.flatnonzero(cls == kk)
+            c0 = int(selc[0])
+            C = selc.size
+            Lmax = int(M[:, selc].max())
+            L = 1 << max(0, int(np.ceil(np.log2(max(Lmax, 1)))))
+            rp = np.full((S, C, L), n_l, np.int32)
+            vp = np.zeros((S, C, L), np.float32)
+            e = np.flatnonzero(cls_of_entry == kk)
+            sh = key_s[e] // present
+            co = c_new_s[e] - c0
+            rp[sh, co, pos[e]] = loc_s[e]
+            vp[sh, co, pos[e]] = val_s[e]
+            rowids_cls.append(rp)
+            vals_cls.append(vp)
+            class_starts.append(c0)
+
+    def pad1(a):
+        return np.concatenate(
+            [np.asarray(a, np.float32), np.zeros(n_pad - n, np.float32)])
+
+    if feature_dtype == jnp.bfloat16:
+        import ml_dtypes
+
+        X_hot = X_hot.astype(ml_dtypes.bfloat16)
+    # Leaves stay HOST numpy: materializing the global hot block on the
+    # default device first would allocate the UNSHARDED array there (the
+    # exact OOM this composition avoids) and transfer everything twice.
+    # shard_hybrid (parallel/sparse_problem.py) device_puts each leaf
+    # straight to its mesh sharding.
+    return HybridShards(
+        X_hot=X_hot,
+        cold_rowids=tuple(rowids_cls),
+        cold_vals=tuple(vals_cls),
+        labels=pad1(batch.labels).reshape(S, n_l),
+        weights=pad1(batch.weights).reshape(S, n_l),
+        offsets=pad1(batch.offsets).reshape(S, n_l),
+        perm=order_desc,
+        inv_perm=inv_perm,
+        num_features=d,
+        num_hot=k,
+        class_starts=tuple(class_starts),
+        num_rows_global=n,
+    )
+
+
+def to_permuted_space(hb, w: Array) -> Array:
+    """Original-space (d,) vector → permuted space (once per fit).
+    Accepts either layout (HybridSparseBatch or HybridShards)."""
     return w[hb.perm]
 
 
-def to_original_space(hb: HybridSparseBatch, w_perm: Array) -> Array:
-    """Permuted-space (d,) vector → original space (once per fit)."""
+def to_original_space(hb, w_perm: Array) -> Array:
+    """Permuted-space (d,) vector → original space (once per fit).
+    Accepts either layout (HybridSparseBatch or HybridShards)."""
     return w_perm[hb.inv_perm]
 
 

@@ -141,6 +141,93 @@ def make_hvp(
     return lambda w, v: _hvp_sharded(w, v, batch)
 
 
+def _hybrid_leaves(shb):
+    """The data-sharded array leaves of a HybridShards (leading axis S)."""
+    return (shb.X_hot, shb.cold_rowids, shb.cold_vals, shb.labels,
+            shb.weights, shb.offsets)
+
+
+def _hybrid_specs(leaves):
+    return jax.tree.map(
+        lambda leaf: P(DATA_AXIS, *(None,) * (jnp.ndim(leaf) - 1)), leaves)
+
+
+def make_hybrid_value_and_gradient(loss: PointwiseLoss, mesh: Mesh, shb):
+    """(w_perm) → (Σ value, Σ grad) over the sharded hybrid layout.
+
+    w is replicated in the GLOBAL permuted space; each shard runs the
+    single-device hot/cold aggregate on its local rows and the data-axis
+    psum assembles the exact global value/gradient — the same collective
+    placement as the dense data-parallel path (hot block) with the cold
+    classes' random crossings kept entirely shard-local.
+    """
+    from photon_ml_tpu.ops import hybrid_sparse as hybrid
+
+    leaves = _hybrid_leaves(shb)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), _hybrid_specs(leaves)),
+                       out_specs=(P(), P()))
+    def _vg(w, lv):
+        hb = hybrid.local_shard(shb, *lv)
+        v, g = hybrid.value_and_gradient(loss, w, hb)
+        return lax.psum(v, DATA_AXIS), lax.psum(g, DATA_AXIS)
+
+    return lambda w: _vg(w, leaves)
+
+
+def make_hybrid_hvp(loss: PointwiseLoss, mesh: Mesh, shb):
+    """(w_perm, v_perm) → Σ H·v over the sharded hybrid layout."""
+    from photon_ml_tpu.ops import hybrid_sparse as hybrid
+
+    leaves = _hybrid_leaves(shb)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P(), _hybrid_specs(leaves)),
+                       out_specs=P())
+    def _hvp(w, v, lv):
+        hb = hybrid.local_shard(shb, *lv)
+        return lax.psum(hybrid.hessian_vector(loss, w, v, hb), DATA_AXIS)
+
+    return lambda w, v: _hvp(w, v, leaves)
+
+
+def make_hybrid_hessian_diagonal(loss: PointwiseLoss, mesh: Mesh, shb):
+    """(w_perm) → Σ diag(H) in permuted space (SIMPLE variances)."""
+    from photon_ml_tpu.ops import hybrid_sparse as hybrid
+
+    leaves = _hybrid_leaves(shb)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), _hybrid_specs(leaves)),
+                       out_specs=P())
+    def _hd(w, lv):
+        hb = hybrid.local_shard(shb, *lv)
+        return lax.psum(hybrid.hessian_diagonal(loss, w, hb), DATA_AXIS)
+
+    return lambda w: _hd(w, leaves)
+
+
+def make_hybrid_margins(mesh: Mesh, shb):
+    """(w_perm) → (S·n_l,) flat margins (row order = padded global order).
+
+    Scores stay data-sharded on exit (out spec P(data)): no collective at
+    all — each shard's rows are scored where they live.
+    """
+    from photon_ml_tpu.ops import hybrid_sparse as hybrid
+
+    leaves = _hybrid_leaves(shb)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), _hybrid_specs(leaves)),
+                       out_specs=P(DATA_AXIS))
+    def _margins(w, lv):
+        hb = hybrid.local_shard(shb, *lv)
+        return hybrid.margins(hb, w)
+
+    return lambda w: _margins(w, leaves)
+
+
 def make_hessian_diagonal(
     loss: PointwiseLoss,
     mesh: Mesh,

@@ -123,6 +123,86 @@ def run_hybrid(
     return Coefficients(means=means, variances=variances), result
 
 
+def shard_hybrid(shb, mesh: Mesh):
+    """Place a HybridShards on the mesh: data arrays' leading shard axis
+    over ``data``, the permutation tables replicated."""
+    import dataclasses as dc
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put_data(leaf):
+        return jax.device_put(leaf, NamedSharding(
+            mesh, P(DATA_AXIS, *(None,) * (np.ndim(leaf) - 1))))
+
+    rep = NamedSharding(mesh, P())
+    return dc.replace(
+        shb,
+        X_hot=put_data(shb.X_hot),
+        cold_rowids=tuple(put_data(a) for a in shb.cold_rowids),
+        cold_vals=tuple(put_data(a) for a in shb.cold_vals),
+        labels=put_data(shb.labels),
+        weights=put_data(shb.weights),
+        offsets=put_data(shb.offsets),
+        perm=jax.device_put(shb.perm, rep),
+        inv_perm=jax.device_put(shb.inv_perm, rep),
+    )
+
+
+def run_hybrid_sharded(
+    loss: PointwiseLoss,
+    shb,
+    mesh: Mesh,
+    config: GLMOptimizationConfiguration,
+    initial: Optional[Coefficients] = None,
+    intercept_index_permuted: Optional[int] = None,
+) -> tuple[Coefficients, OptResult]:
+    """Fit one GLM over a HybridShards — the multi-device Criteo fast path.
+
+    Identical contract to ``run_hybrid``: the whole solve lives in the
+    GLOBAL permuted feature space (replicated w; the shard_map objectives
+    psum per-shard hot/cold aggregates over ``data``), and only the
+    returned Coefficients map back to original column order.
+    """
+    from photon_ml_tpu.parallel import sparse_objective as sobj_mod
+
+    dim = shb.num_features
+    mask = jnp.asarray(intercept_mask(dim, intercept_index_permuted))
+    reg = config.regularization
+    l2 = reg.l2_weight()
+
+    vg = with_l2(
+        sobj_mod.make_hybrid_value_and_gradient(loss, mesh, shb), l2, mask)
+    hvp = with_l2_hvp(
+        sobj_mod.make_hybrid_hvp(loss, mesh, shb), l2, mask)
+
+    l1 = reg.l1_weight()
+    l1w = (jnp.asarray(
+        l1 * intercept_mask(dim, intercept_index_permuted))
+        if l1 > 0.0 else None)
+    opt_cfg = resolve_optimizer_config(config.optimizer, l1w is not None)
+
+    if initial is not None:
+        w0 = jnp.asarray(initial.means)[shb.perm]
+    else:
+        w0 = jnp.zeros((dim,), jnp.float32)
+
+    result = optimize(vg, w0, opt_cfg, hvp=hvp, l1_weights=l1w)
+
+    variances = None
+    kind = VarianceComputationType(config.variance_computation)
+    if kind == VarianceComputationType.SIMPLE:
+        diag = sobj_mod.make_hybrid_hessian_diagonal(
+            loss, mesh, shb)(result.w)
+        variances = variances_from_diagonal(diag, l2, mask)[shb.inv_perm]
+    elif kind == VarianceComputationType.FULL:
+        raise NotImplementedError(
+            "FULL variance needs the dense d×d Hessian — not available at "
+            "sparse/Criteo scale (use SIMPLE, as the reference does)")
+
+    means = result.w[shb.inv_perm]
+    return Coefficients(means=means, variances=variances), result
+
+
 def run(
     loss: PointwiseLoss,
     batch: SparseBatch,

@@ -87,8 +87,11 @@ def test_sparse_coordinate_feature_sharded_matches(mesh):
     batch, _ = _sparse_data(d=67)  # not a multiple of the model axis
     ds = from_sparse_batch(batch)
     cfg = _opt()
+    # hybrid=False pins the replicated ELL formulation so this compares
+    # the SAME objective evaluation with and without the model-axis
+    # sharding (the hybrid layout sums in a different order).
     plain = SparseFixedEffectCoordinate(
-        ds, "global", losses.LOGISTIC, cfg, mesh)
+        ds, "global", losses.LOGISTIC, cfg, mesh, hybrid=False)
     sharded = SparseFixedEffectCoordinate(
         ds, "global", losses.LOGISTIC, cfg, mesh, feature_sharded=True)
     off = np.zeros(batch.num_rows, np.float32)
@@ -424,21 +427,125 @@ def test_hybrid_down_sampling_matches_ell(mesh1):
 
 
 def test_hybrid_auto_selection(mesh, mesh1):
-    """auto: on for single-data-shard meshes, off (ELL shard_map) when the
-    data axis is sharded; explicit True on a sharded mesh is rejected."""
+    """auto: hybrid whenever coefficients replicate — single-device uses
+    the single layout, a sharded data axis the HybridShards composition;
+    only feature_sharded (no replicated permuted space) keeps ELL."""
     batch, _ = _sparse_data(n=256, d=32)
     ds = from_sparse_batch(batch)
-    assert SparseFixedEffectCoordinate(
-        ds, "global", losses.LOGISTIC, _opt(), mesh1).hybrid
+    c1 = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, _opt(), mesh1)
+    assert c1.hybrid and not c1._hybrid_sharded
+    c8 = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, _opt(), mesh)
+    assert c8.hybrid and c8._hybrid_sharded
     assert not SparseFixedEffectCoordinate(
-        ds, "global", losses.LOGISTIC, _opt(), mesh).hybrid
-    with pytest.raises(ValueError, match="single-data-shard"):
-        SparseFixedEffectCoordinate(
-            ds, "global", losses.LOGISTIC, _opt(), mesh, hybrid=True)
+        ds, "global", losses.LOGISTIC, _opt(), mesh,
+        feature_sharded=True).hybrid
     with pytest.raises(ValueError, match="feature_sharded"):
         SparseFixedEffectCoordinate(
             ds, "global", losses.LOGISTIC, _opt(), mesh1,
             feature_sharded=True, hybrid=True)
+
+
+def test_hybrid_sharded_matches_ell(mesh, mesh1):
+    """The data-sharded hybrid composition (HybridShards) minimizes the
+    SAME objective as the ELL pipeline and the single-device hybrid
+    layout, with exact scoring/variance path equivalence — the P3
+    composition the single-shard layout could not cover."""
+    batch, _ = sp.synthetic_sparse(2049, 256, 8, seed=4)  # odd: pad rows
+    batch = _intercepted(batch)
+    ds = from_sparse_batch(batch)
+    ds = dataclasses.replace(ds, intercept_index={"global": 256})
+    cfg = dataclasses.replace(
+        _opt(), variance_computation=VarianceComputationType.SIMPLE)
+    ell = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh, hybrid=False)
+    hyb = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh)
+    one = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg, mesh1)
+    assert hyb.hybrid and hyb._hybrid_sharded
+    off = np.zeros(batch.num_rows, np.float32)
+    m_ell = ell.train_model(off)
+    m_hyb = hyb.train_model(off)
+    w_e = np.asarray(m_ell.coefficients.means)
+    w_h = np.asarray(m_hyb.coefficients.means)
+    f_e = _ell_objective(batch, w_e, l2=1.0, intercept=256)
+    f_h = _ell_objective(batch, w_h, l2=1.0, intercept=256)
+    assert abs(f_e - f_h) < 1e-5 * abs(f_e), (f_e, f_h)
+    np.testing.assert_allclose(w_h, w_e, rtol=0.1, atol=1e-3)
+    # Scores at the SAME model: all three layouts agree exactly.
+    np.testing.assert_allclose(np.asarray(hyb.score(m_ell)),
+                               np.asarray(ell.score(m_ell)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hyb.score(m_ell)),
+                               np.asarray(one.score(m_ell)),
+                               rtol=1e-4, atol=1e-4)
+    # Variances at the SAME model: exact path equivalence.
+    v_ell = ell.compute_model_variances(m_ell, off)
+    v_hyb = hyb.compute_model_variances(m_ell, off)
+    np.testing.assert_allclose(
+        np.asarray(v_hyb.coefficients.variances),
+        np.asarray(v_ell.coefficients.variances), rtol=1e-4, atol=1e-7)
+
+
+def test_hybrid_sharded_objective_is_exact(mesh):
+    """Raw value/gradient/margins of the sharded hybrid objective equal
+    the single-device hybrid layout's and the ELL shard_map pipeline's at
+    an arbitrary w — the composition is exact, not approximate."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import hybrid_sparse as hs
+    from photon_ml_tpu.parallel import sparse_objective as sobj
+    from photon_ml_tpu.parallel import sparse_problem as spp
+
+    batch, _ = sp.synthetic_sparse(1023, 300, 8, seed=0, zipf=True)
+    d = batch.num_features
+    w = np.random.default_rng(1).normal(size=d).astype(np.float32)
+
+    hb = hs.build_hybrid(batch)
+    shb = spp.shard_hybrid(hs.build_hybrid_shards(batch, 8), mesh)
+    v1, g1 = hs.value_and_gradient(
+        losses.LOGISTIC, hs.to_permuted_space(hb, jnp.asarray(w)), hb)
+    g1 = np.asarray(hs.to_original_space(hb, g1))
+    w8p = jnp.asarray(w)[shb.perm]
+    v8, g8 = sobj.make_hybrid_value_and_gradient(
+        losses.LOGISTIC, mesh, shb)(w8p)
+    g8 = np.asarray(g8)[np.asarray(shb.inv_perm)]
+    vE, gE = sobj.make_value_and_gradient(
+        losses.LOGISTIC, mesh, spp.shard_sparse_batch(batch, mesh))(
+        jnp.asarray(w))
+    assert abs(float(v1) - float(v8)) < 1e-5 * abs(float(v1))
+    assert abs(float(vE) - float(v8)) < 1e-5 * abs(float(vE))
+    np.testing.assert_allclose(g8, g1, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(g8, np.asarray(gE), rtol=1e-3, atol=1e-4)
+    m1 = np.asarray(hs.margins(hb, hs.to_permuted_space(hb, jnp.asarray(w))))
+    m8 = np.asarray(sobj.make_hybrid_margins(mesh, shb)(w8p))[:batch.num_rows]
+    np.testing.assert_allclose(m8, m1, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_sharded_down_sampling_matches(mesh, mesh1):
+    """Same seed ⇒ same subsampled objective across the sharded and
+    single-device hybrid layouts (flat padded row order == original row
+    order, so the weight mask lands on the same rows)."""
+    batch, _ = sp.synthetic_sparse(2048, 64, 6, seed=7)
+    ds = from_sparse_batch(batch)
+    cfg = dataclasses.replace(_opt(), down_sampling_rate=0.5)
+    off = np.zeros(batch.num_rows, np.float32)
+    w = {}
+    for name, m in (("one", mesh1), ("sharded", mesh)):
+        w[name] = np.asarray(SparseFixedEffectCoordinate(
+            ds, "global", losses.LOGISTIC, cfg, m,
+            down_sampling_seed=9).train_model(off).coefficients.means)
+    from photon_ml_tpu.game.sampling import binary_classification_down_sample
+    idx, mult = binary_classification_down_sample(
+        np.random.default_rng(9), ds.response, 0.5)
+    w_mask = np.zeros(ds.num_rows, np.float32)
+    w_mask[idx] = np.asarray(ds.weights)[idx] * np.asarray(mult)
+    f_1 = _ell_objective(batch, w["one"], l2=1.0, weights=jnp.asarray(w_mask))
+    f_8 = _ell_objective(batch, w["sharded"], l2=1.0,
+                         weights=jnp.asarray(w_mask))
+    assert abs(f_1 - f_8) < 1e-5 * abs(f_1), (f_1, f_8)
 
 
 def test_hybrid_layout_roundtrip():
@@ -570,3 +677,79 @@ def test_game_train_accepts_libsvm_file(rng, tmp_path):
         "--output-dir", out,
     ]))
     assert summary["best_metrics"]["AUC"] > 0.7
+
+
+def test_staging_cache_roundtrip(mesh, tmp_path, monkeypatch):
+    """Warm staging (digest-keyed disk cache) skips the projection pass
+    and reproduces the cold coordinate exactly — staged arrays, trained
+    model, scores, and the subspace join tables."""
+    from photon_ml_tpu.game import projector as prj
+
+    sparse_ds, _ = _sparse_re_data()
+    cfg = _opt()
+    cache = str(tmp_path / "stage")
+    calls = {"n": 0}
+    real = prj.build_bucket_projection
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(prj, "build_bucket_projection", counting)
+    kw = dict(staging_cache_dir=cache, subspace_model=True)
+    cold = RandomEffectCoordinate(sparse_ds, "userId", "re",
+                                  losses.LOGISTIC, cfg, mesh, **kw)
+    n_cold = calls["n"]
+    assert n_cold > 0
+    warm = RandomEffectCoordinate(sparse_ds, "userId", "re",
+                                  losses.LOGISTIC, cfg, mesh, **kw)
+    assert calls["n"] == n_cold  # no projection work on the warm path
+    assert len(warm._bucket_data) == len(cold._bucket_data)
+    for tc, tw in zip(cold._bucket_data, warm._bucket_data):
+        assert len(tc) == len(tw)
+        for ac, aw in zip(tc, tw):
+            np.testing.assert_array_equal(np.asarray(ac), np.asarray(aw))
+    np.testing.assert_array_equal(cold.subspace_cols, warm.subspace_cols)
+    np.testing.assert_array_equal(np.asarray(cold._sp_flatpos),
+                                  np.asarray(warm._sp_flatpos))
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    m_cold = cold.train_model(off)
+    m_warm = warm.train_model(off)
+    np.testing.assert_array_equal(np.asarray(m_cold.means),
+                                  np.asarray(m_warm.means))
+    np.testing.assert_array_equal(np.asarray(cold.score(m_cold)),
+                                  np.asarray(warm.score(m_warm)))
+
+
+def test_staging_cache_keys_on_content(mesh, tmp_path):
+    """Different data or staging params never hit the same cache entry."""
+    from photon_ml_tpu.game import staging_cache
+
+    sparse_ds, _ = _sparse_re_data()
+    other_ds, _ = _sparse_re_data(seed=5)
+    cache = str(tmp_path / "stage")
+    cfg = _opt()
+    c1 = RandomEffectCoordinate(sparse_ds, "userId", "re", losses.LOGISTIC,
+                                cfg, mesh, staging_cache_dir=cache)
+    c2 = RandomEffectCoordinate(other_ds, "userId", "re", losses.LOGISTIC,
+                                cfg, mesh, staging_cache_dir=cache)
+    c3 = RandomEffectCoordinate(sparse_ds, "userId", "re", losses.LOGISTIC,
+                                cfg, mesh, staging_cache_dir=cache,
+                                upper_bound=2)
+    keys = {c._staging_cache_key for c in (c1, c2, c3)}
+    assert len(keys) == 3
+    # A corrupt entry is a miss, not an error: truncate every array file.
+    import os
+    entry = os.path.join(cache, c1._staging_cache_key)
+    for f in os.listdir(entry):
+        if f.endswith(".npy"):
+            open(os.path.join(entry, f), "wb").close()
+    assert staging_cache.load(cache, c1._staging_cache_key) is None
+    c1b = RandomEffectCoordinate(sparse_ds, "userId", "re", losses.LOGISTIC,
+                                 cfg, mesh, staging_cache_dir=cache)
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(c1b.train_model(off).means),
+        np.asarray(c1.train_model(off).means), rtol=1e-5, atol=1e-6)
+    # ...and the restage REPLACED the poisoned entry (no permanent miss).
+    assert staging_cache.load(cache, c1._staging_cache_key) is not None
