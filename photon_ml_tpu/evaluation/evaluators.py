@@ -136,24 +136,58 @@ def grouped_auc(
     labels: Array,
     group_ids: Array,
     num_groups: int,
+    weights: Optional[Array] = None,
 ) -> tuple[Array, Array]:
     """Per-group tie-averaged AUC for ALL groups at once.
 
     Returns ``(per_group_auc, valid)`` where ``valid`` marks groups having at
     least one positive and one negative (the reference's MultiAUCEvaluator
     skips one-class groups). One sort + segment reductions; no group loop.
+
+    With ``weights``, each group's statistic is the weighted
+    P(score+ > score-) with half credit on ties — the same definition the
+    global weighted ``auc`` uses (the reference's per-entity evaluators
+    run over weighted score RDDs); ``valid`` then requires positive weight
+    on both classes.
     """
     order = _group_sort(scores, group_ids)
     g = group_ids[order]
     s = scores[order]
     y = labels[order].astype(jnp.float32)
     n = scores.shape[0]
-    pos_idx = jnp.arange(n, dtype=jnp.float32)
 
-    # Tie runs within (group, score): average global positions over each run.
+    # Tie runs within (group, score).
     prev_same = (g == jnp.roll(g, 1)) & (s == jnp.roll(s, 1))
     prev_same = prev_same.at[0].set(False)
     run_id = jnp.cumsum(~prev_same) - 1
+
+    if weights is not None:
+        w = weights[order].astype(jnp.float32)
+        wpos = w * y
+        wneg = w * (1.0 - y)
+        # Within-group exclusive cumulative negative weight: the global
+        # cumsum already contains every earlier group's total (the layout
+        # is group-major), so subtracting each group's exclusive prefix
+        # leaves the within-group value.
+        cn = jnp.cumsum(wneg)
+        grp_tot_neg = jax.ops.segment_sum(wneg, g, num_segments=num_groups)
+        grp_prefix = jnp.cumsum(grp_tot_neg) - grp_tot_neg
+        within_excl = cn - wneg - grp_prefix[g]
+        # Strictly-below credit stops at the tie run's first element; the
+        # run's own negatives contribute half credit. within_excl is
+        # non-decreasing, so the run minimum IS its first element's value.
+        below_run = jax.ops.segment_min(within_excl, run_id,
+                                        num_segments=n)[run_id]
+        run_neg = jax.ops.segment_sum(wneg, run_id, num_segments=n)[run_id]
+        credit = wpos * (below_run + 0.5 * (run_neg - wneg))
+        wp = jax.ops.segment_sum(wpos, g, num_segments=num_groups)
+        wn = grp_tot_neg
+        auc_g = jax.ops.segment_sum(credit, g, num_segments=num_groups) \
+            / jnp.maximum(wp * wn, 1e-12)
+        valid = (wp > 0) & (wn > 0)
+        return auc_g, valid
+
+    pos_idx = jnp.arange(n, dtype=jnp.float32)
     run_pos_sum = jax.ops.segment_sum(pos_idx, run_id, num_segments=n)
     run_count = jax.ops.segment_sum(jnp.ones_like(pos_idx), run_id,
                                     num_segments=n)
@@ -174,9 +208,11 @@ def grouped_auc(
     return auc_g, valid
 
 
-def mean_grouped_auc(scores, labels, group_ids, num_groups) -> Array:
+def mean_grouped_auc(scores, labels, group_ids, num_groups,
+                     weights=None) -> Array:
     """Average per-group AUC over valid groups (MultiAUCEvaluator result)."""
-    auc_g, valid = grouped_auc(scores, labels, group_ids, num_groups)
+    auc_g, valid = grouped_auc(scores, labels, group_ids, num_groups,
+                               weights)
     v = valid.astype(jnp.float32)
     return jnp.sum(auc_g * v) / jnp.maximum(jnp.sum(v), 1.0)
 
@@ -187,11 +223,18 @@ def grouped_precision_at_k(
     group_ids: Array,
     num_groups: int,
     k: int,
+    weights: Optional[Array] = None,
 ) -> tuple[Array, Array]:
     """Per-group precision@k for all groups at once.
 
     ``valid`` marks groups with at least k examples (reference:
     MultiPrecisionAtKEvaluator filters groups with < k samples).
+
+    With ``weights``, the k highest-scored examples are still chosen by
+    score alone (k is a result-set size, not a weight budget); the
+    precision over them is the WEIGHTED positive fraction
+    Σ w·y / Σ w, consistent with the weighted score-set semantics of the
+    other evaluators.
     """
     order = _group_sort(-scores, group_ids)  # score descending within group
     g = group_ids[order]
@@ -202,16 +245,29 @@ def grouped_precision_at_k(
     starts = jnp.cumsum(counts) - counts
     pos_in_group = jnp.arange(n, dtype=jnp.float32) - starts[g]
     in_top_k = pos_in_group < k
-    hits = jax.ops.segment_sum(y * in_top_k, g, num_segments=num_groups)
-    denom = jnp.minimum(counts, float(k))
-    prec = hits / jnp.maximum(denom, 1.0)
-    valid = counts >= k
+    if weights is not None:
+        w = weights[order].astype(jnp.float32)
+        hits = jax.ops.segment_sum(w * y * in_top_k, g,
+                                   num_segments=num_groups)
+        denom = jax.ops.segment_sum(w * in_top_k, g,
+                                    num_segments=num_groups)
+        prec = hits / jnp.maximum(denom, 1e-12)
+        # An all-zero-weight top-k has no defined precision — exclude the
+        # group (the same rule the weighted grouped AUC applies to
+        # zero-weight classes) instead of averaging in a spurious 0.
+        valid = (counts >= k) & (denom > 0)
+    else:
+        hits = jax.ops.segment_sum(y * in_top_k, g, num_segments=num_groups)
+        denom = jnp.minimum(counts, float(k))
+        prec = hits / jnp.maximum(denom, 1.0)
+        valid = counts >= k
     return prec, valid
 
 
-def mean_grouped_precision_at_k(scores, labels, group_ids, num_groups, k):
+def mean_grouped_precision_at_k(scores, labels, group_ids, num_groups, k,
+                                weights=None):
     prec, valid = grouped_precision_at_k(scores, labels, group_ids,
-                                         num_groups, k)
+                                         num_groups, k, weights)
     v = valid.astype(jnp.float32)
     return jnp.sum(prec * v) / jnp.maximum(jnp.sum(v), 1.0)
 
@@ -287,10 +343,11 @@ def evaluate(
         if group_ids is None or num_groups is None:
             raise ValueError(f"{etype} needs group_ids/num_groups")
         if etype.name == "AUC":
-            return mean_grouped_auc(scores, labels, group_ids, num_groups)
+            return mean_grouped_auc(scores, labels, group_ids, num_groups,
+                                    weights)
         if etype.name == "PRECISION":
             return mean_grouped_precision_at_k(scores, labels, group_ids,
-                                               num_groups, etype.k)
+                                               num_groups, etype.k, weights)
         raise ValueError(etype)  # pragma: no cover
     if etype.name == "AUC":
         return auc(scores, labels, weights)

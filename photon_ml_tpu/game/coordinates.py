@@ -526,8 +526,11 @@ class SparseFixedEffectCoordinate:
         n = self.dataset.num_rows
 
         def grid(offsets):
-            flat = jnp.zeros((S * n_l,), jnp.asarray(offsets).dtype
-                             ).at[:offsets.shape[0]].set(offsets)
+            # fit() passes raw (n,) offsets; fit_sampled already padded
+            # them to the staged length via _padded_offsets.
+            offsets = jnp.asarray(offsets)
+            flat = (offsets if offsets.shape[0] == S * n_l
+                    else self._padded_offsets(offsets))
             return flat.reshape(S, n_l)
 
         def fit(shb, offsets, w0):
@@ -796,7 +799,11 @@ class RandomEffectCoordinate:
                 lower_bound=lower_bound, upper_bound=upper_bound,
                 seed=seed, pad=self.bucketing.entity_pad_multiple,
                 ratio=self.features_to_samples_ratio,
-                intercept=self.intercept_index, subspace=self.subspace)
+                intercept=self.intercept_index, subspace=self.subspace,
+                # Declared dimensions the array digest cannot see: the
+                # staged entity tables and the subspace join sentinels
+                # depend on both.
+                num_entities=self.num_entities, dim=self.dim)
             cached = staging_cache.load(staging_cache_dir,
                                         self._staging_cache_key)
 

@@ -238,8 +238,6 @@ class HybridShards:
     num_hot: int = dataclasses.field(metadata=dict(static=True))
     class_starts: tuple[int, ...] = dataclasses.field(
         metadata=dict(static=True))
-    num_rows_global: int = dataclasses.field(
-        metadata=dict(static=True))  # true rows before padding
 
     @property
     def num_shards(self) -> int:
@@ -392,7 +390,6 @@ def build_hybrid_shards(
         num_features=d,
         num_hot=k,
         class_starts=tuple(class_starts),
-        num_rows_global=n,
     )
 
 

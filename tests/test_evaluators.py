@@ -168,3 +168,96 @@ def test_weighted_auc_property_brute_force(rng):
         got = float(auc(jnp.asarray(scores, jnp.float32),
                         jnp.asarray(labels), jnp.asarray(weights)))
         assert abs(got - expected) < 1e-5, (trial, got, expected)
+
+
+def test_weighted_grouped_auc_property_brute_force(rng):
+    """Weighted grouped AUC == the per-group brute-force weighted pairwise
+    statistic, on random instances with heavy ties and one-class /
+    zero-weight groups (which must be invalid, not NaN)."""
+    for trial in range(20):
+        n = int(rng.integers(6, 60))
+        ngroups = int(rng.integers(1, 6))
+        g = rng.integers(0, ngroups, size=n).astype(np.int32)
+        scores = np.round(rng.normal(size=n), 1).astype(np.float32)
+        labels = rng.integers(0, 2, size=n).astype(np.float32)
+        weights = rng.uniform(0.0, 3.0, size=n).astype(np.float32)
+        weights[rng.random(n) < 0.2] = 0.0  # exercise zero weights
+
+        auc_g, valid = ev.grouped_auc(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(g),
+            ngroups, jnp.asarray(weights))
+        auc_g, valid = np.asarray(auc_g), np.asarray(valid)
+        for gi in range(ngroups):
+            sel = g == gi
+            s, y, w = scores[sel], labels[sel], weights[sel]
+            wp = w[y == 1].sum()
+            wn = w[y == 0].sum()
+            assert bool(valid[gi]) == bool(wp > 0 and wn > 0)
+            if not valid[gi]:
+                continue
+            num = 0.0
+            for i in np.where(y == 1)[0]:
+                for j in np.where(y == 0)[0]:
+                    if s[i] > s[j]:
+                        num += w[i] * w[j]
+                    elif s[i] == s[j]:
+                        num += 0.5 * w[i] * w[j]
+            assert abs(auc_g[gi] - num / (wp * wn)) < 1e-5, (trial, gi)
+
+
+def test_weighted_grouped_auc_unit_weights_match_unweighted(rng):
+    scores = np.round(rng.normal(size=300), 1).astype(np.float32)
+    labels = rng.integers(0, 2, size=300).astype(np.float32)
+    g = rng.integers(0, 7, size=300).astype(np.int32)
+    a1, v1 = ev.grouped_auc(jnp.asarray(scores), jnp.asarray(labels),
+                            jnp.asarray(g), 7)
+    a2, v2 = ev.grouped_auc(jnp.asarray(scores), jnp.asarray(labels),
+                            jnp.asarray(g), 7,
+                            jnp.ones(300, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_allclose(np.asarray(a1)[np.asarray(v1)],
+                               np.asarray(a2)[np.asarray(v2)],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weighted_grouped_precision_at_k(rng):
+    """Weighted grouped precision@k == per-group loop: top-k by score, then
+    the weighted positive fraction over those k."""
+    n, ngroups, k = 200, 5, 3
+    scores = rng.normal(size=n).astype(np.float32)  # distinct w.h.p.
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    g = rng.integers(0, ngroups, size=n).astype(np.int32)
+    weights = rng.uniform(0.1, 3.0, size=n).astype(np.float32)
+    prec, valid = ev.grouped_precision_at_k(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(g),
+        ngroups, k, jnp.asarray(weights))
+    prec, valid = np.asarray(prec), np.asarray(valid)
+    for gi in range(ngroups):
+        sel = g == gi
+        s, y, w = scores[sel], labels[sel], weights[sel]
+        assert bool(valid[gi]) == (sel.sum() >= k)
+        if not valid[gi]:
+            continue
+        top = np.argsort(-s)[:k]
+        expected = (w[top] * y[top]).sum() / w[top].sum()
+        assert abs(prec[gi] - expected) < 1e-5, gi
+
+
+def test_evaluate_passes_weights_to_grouped(rng):
+    """evaluate() routes example weights through the grouped forms."""
+    n = 120
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    g = rng.integers(0, 4, size=n).astype(np.int32)
+    weights = rng.uniform(0.1, 3.0, size=n).astype(np.float32)
+    et = ev.EvaluatorType.parse("AUC@userId")
+    unw = float(ev.evaluate(et, jnp.asarray(scores), jnp.asarray(labels),
+                            group_ids=jnp.asarray(g), num_groups=4))
+    wtd = float(ev.evaluate(et, jnp.asarray(scores), jnp.asarray(labels),
+                            weights=jnp.asarray(weights),
+                            group_ids=jnp.asarray(g), num_groups=4))
+    ref = float(ev.mean_grouped_auc(jnp.asarray(scores), jnp.asarray(labels),
+                                    jnp.asarray(g), 4,
+                                    jnp.asarray(weights)))
+    assert abs(wtd - ref) < 1e-6
+    assert wtd != unw  # the weights actually changed the statistic
