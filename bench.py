@@ -341,19 +341,29 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
         shutil.rmtree(cache_dir, ignore_errors=True)
     off = np.zeros(n, np.float32)
 
-    def run(iters):
-        t0 = time.perf_counter()
-        model = None
-        for _ in range(iters):
-            model = coord.train_model(off, initial=model)
-        np.asarray(model.means[:1])
-        return time.perf_counter() - t0
+    def make_run(c):
+        def run(iters):
+            t0 = time.perf_counter()
+            model = None
+            for _ in range(iters):
+                model = c.train_model(off, initial=model)
+            np.asarray(model.means[:1])
+            return time.perf_counter() - t0
+        return run
 
-    dt = _slope(run, 1, 4)
+    dt = _slope(make_run(coord), 1, 4)
+
+    # bf16 bucket-block storage: halves the staged blocks' HBM, f32 MXU
+    # accumulation (same contract as the dense fixed path).
+    coord16 = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
+                                     cfg, make_mesh(),
+                                     feature_dtype="bfloat16")
+    dt16 = _slope(make_run(coord16), 1, 4)
     return {
         "sparse_re_staging_seconds": round(staging, 2),
         "sparse_re_staging_warm_seconds": round(staging_warm, 2),
         "sparse_re_fit_seconds": round(dt, 3),
+        "sparse_re_bf16_fit_seconds": round(dt16, 3),
         "sparse_re_config": f"n={n} d={d} entities={num_entities}",
     }
 

@@ -74,12 +74,21 @@ class RandomEffectDataConfiguration:
     # (num_entities, d) table. None = automatic: on when the dense table
     # would exceed ~1 GiB. Requires a projected coordinate.
     subspace_model: Optional[bool] = None
+    # On-device storage dtype for the staged (E_b, cap, d_active) bucket
+    # blocks — same contract as the fixed-effect knob: "bfloat16" halves
+    # the blocks' HBM and the per-entity solves accumulate in f32 on the
+    # MXU; coefficients/optimizer state stay f32.
+    feature_dtype: str = "float32"
 
     def __post_init__(self):
         if self.projector.upper() not in ("NONE", "INDEX_MAP", "RANDOM"):
             raise ValueError(
                 f"unknown projector {self.projector!r}; "
                 "expected NONE, INDEX_MAP, or RANDOM")
+        if self.feature_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unsupported feature_dtype {self.feature_dtype!r}; "
+                "expected float32 or bfloat16")
         if self.projector.upper() == "RANDOM":
             if self.projected_dimension is None \
                     or self.projected_dimension < 1:

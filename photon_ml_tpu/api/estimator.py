@@ -145,7 +145,8 @@ class GameEstimator:
                     features_to_samples_ratio=(
                         cc.data.features_to_samples_ratio),
                     subspace_model=cc.data.subspace_model,
-                    staging_cache_dir=self.staging_cache_dir)
+                    staging_cache_dir=self.staging_cache_dir,
+                    feature_dtype=cc.data.feature_dtype)
             elif isinstance(cc.data, FactoredRandomEffectDataConfiguration):
                 if cc.data.feature_shard_id in self.normalization:
                     raise ValueError(

@@ -316,7 +316,8 @@ def run(args) -> dict:
                     if "features_to_samples_ratio" in kv else None),
                 subspace_model=(
                     None if kv.get("subspace", "auto") == "auto"
-                    else kv["subspace"] == "true"))
+                    else kv["subspace"] == "true"),
+                feature_dtype=kv.get("dtype", "float32"))
         elif kv["type"] == "factored":
             data = FactoredRandomEffectDataConfiguration(
                 random_effect_type=kv["re"],

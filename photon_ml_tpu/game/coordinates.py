@@ -702,8 +702,13 @@ class RandomEffectCoordinate:
         features_to_samples_ratio: Optional[float] = None,
         subspace_model: Optional[bool] = None,
         staging_cache_dir: Optional[str] = None,
+        feature_dtype: str = "float32",
     ):
         from photon_ml_tpu.data.game_data import SparseShard
+        if feature_dtype not in ("float32", "bfloat16"):
+            # Before staging: at flagship scale the projection pass below
+            # costs minutes, and a typo'd dtype must not pay it first.
+            raise ValueError(f"unsupported feature_dtype {feature_dtype!r}")
         self.is_sparse = isinstance(dataset.feature_shards[shard_id],
                                     SparseShard)
         if self.is_sparse:
@@ -873,6 +878,14 @@ class RandomEffectCoordinate:
                                    self._staging_cache_key,
                                    host_buckets, sub)
 
+        # bf16 feature STORAGE (same contract as the dense fixed path:
+        # aggregators accumulate in f32 via preferred_element_type). The
+        # cast happens here — after the staging cache, which stays f32 and
+        # dtype-independent — so only the staged bucket blocks shrink; the
+        # scoring-side (n, d) shard keeps full precision.
+        self.feature_dtype = feature_dtype
+        feat_cast = jnp.bfloat16 if feature_dtype == "bfloat16" else None
+
         for arrays in host_buckets:
             # Bound the vmapped-solve footprint: a single dispatch over
             # hundreds of thousands of entity lanes exhausts HBM on solver
@@ -889,8 +902,13 @@ class RandomEffectCoordinate:
             E_b = arrays[4].shape[0]
             for lo in range(0, E_b, chunk):
                 hi = min(lo + chunk, E_b)
-                self._bucket_data.append(tuple(
-                    put(np.asarray(a)[lo:hi]) for a in arrays))
+                tup = []
+                for ai, a in enumerate(arrays):
+                    a = np.asarray(a)[lo:hi]
+                    if ai == 0 and feat_cast is not None:  # Xb block
+                        a = a.astype(feat_cast)
+                    tup.append(put(a))
+                self._bucket_data.append(tuple(tup))
         if self.subspace:
             cols_sorted = np.asarray(sub["cols"])
             perm = np.asarray(sub["perm"])

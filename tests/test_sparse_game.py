@@ -753,3 +753,37 @@ def test_staging_cache_keys_on_content(mesh, tmp_path):
         np.asarray(c1.train_model(off).means), rtol=1e-5, atol=1e-6)
     # ...and the restage REPLACED the poisoned entry (no permanent miss).
     assert staging_cache.load(cache, c1._staging_cache_key) is not None
+
+
+def test_random_effect_bf16_feature_storage(mesh):
+    """bf16 bucket-block storage reproduces the f32 per-entity solves to
+    bf16 tolerance, on both the projected (sparse) and dense RE paths,
+    with equal AUC on planted effects (the dense fixed path's contract:
+    storage shrinks, accumulation stays f32)."""
+    sparse_ds, dense_ds = _sparse_re_data()
+    cfg = _opt()
+    off = np.zeros(sparse_ds.num_rows, np.float32)
+    y = np.asarray(sparse_ds.response)
+    from photon_ml_tpu.evaluation import evaluators as ev
+
+    for ds_, proj in ((sparse_ds, True), (dense_ds, False)):
+        c32 = RandomEffectCoordinate(ds_, "userId", "re", losses.LOGISTIC,
+                                     cfg, mesh, projection=proj)
+        c16 = RandomEffectCoordinate(ds_, "userId", "re", losses.LOGISTIC,
+                                     cfg, mesh, projection=proj,
+                                     feature_dtype="bfloat16")
+        assert c16._bucket_data[0][0].dtype == jnp.bfloat16
+        m32 = c32.train_model(off)
+        m16 = c16.train_model(off)
+        w32, w16 = np.asarray(m32.means), np.asarray(m16.means)
+        # bf16 storage: ~1e-2 relative coefficient deltas are expected.
+        np.testing.assert_allclose(w16, w32, rtol=0.3, atol=0.05)
+        a32 = float(ev.auc(jnp.asarray(np.asarray(c32.score(m32))),
+                           jnp.asarray(y)))
+        a16 = float(ev.auc(jnp.asarray(np.asarray(c16.score(m16))),
+                           jnp.asarray(y)))
+        assert a16 > a32 - 0.01, (proj, a16, a32)
+
+    with pytest.raises(ValueError, match="feature_dtype"):
+        RandomEffectCoordinate(sparse_ds, "userId", "re", losses.LOGISTIC,
+                               cfg, mesh, feature_dtype="int8")
