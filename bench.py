@@ -337,6 +337,14 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
                                cfg, make_mesh(),
                                staging_cache_dir=cache_dir)
         staging_warm = time.perf_counter() - t0
+        # bf16 bucket-block storage: halves the staged blocks' HBM, f32 MXU
+        # accumulation (same contract as the dense fixed path). The f32
+        # staging cache is dtype-independent (cast happens after load), so
+        # reuse it rather than re-paying the projection pass.
+        coord16 = RandomEffectCoordinate(ds, "userId", "re",
+                                         losses.LOGISTIC, cfg, make_mesh(),
+                                         staging_cache_dir=cache_dir,
+                                         feature_dtype="bfloat16")
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)
     off = np.zeros(n, np.float32)
@@ -352,12 +360,6 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
         return run
 
     dt = _slope(make_run(coord), 1, 4)
-
-    # bf16 bucket-block storage: halves the staged blocks' HBM, f32 MXU
-    # accumulation (same contract as the dense fixed path).
-    coord16 = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
-                                     cfg, make_mesh(),
-                                     feature_dtype="bfloat16")
     dt16 = _slope(make_run(coord16), 1, 4)
     return {
         "sparse_re_staging_seconds": round(staging, 2),
@@ -525,6 +527,29 @@ def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
     return _slope(run, 1, 11)
 
 
+def bench_game_20m():
+    """North-star MovieLens-20M-shaped CD sweep (BASELINE config 4) —
+    gated behind PML_BENCH_20M=1: generation + staging + the timed descents
+    add ~10+ minutes, too slow for every capture. The measurement itself
+    lives in dev-scripts/flagship_movielens.py (shared, min-of-3 slope)."""
+    import importlib.util
+    import os
+
+    if os.environ.get("PML_BENCH_20M") != "1":
+        return {}
+    spec = importlib.util.spec_from_file_location(
+        "flagship_movielens",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "dev-scripts", "flagship_movielens.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_flagship(log=_progress)
+    return {k: v for k, v in out.items()
+            if k in ("game_cd_iteration_seconds_20m",
+                     "flagship_validation_auc",
+                     "flagship_first_descent_seconds")}
+
+
 def main():
     _progress("gradient step")
     grad = bench_gradient_step()
@@ -542,6 +567,7 @@ def main():
     ingest = bench_avro_ingest()  # {} without a native toolchain
     _progress("GAME coordinate-descent sweep")
     game_iter_s = bench_game_iteration()
+    game_20m = bench_game_20m()  # {} unless PML_BENCH_20M=1
     _progress("done")
     print(json.dumps({
         "metric": "glm_gradient_step_samples_per_sec_per_chip",
@@ -571,6 +597,7 @@ def main():
             **{key: round(v, 1) for key, v in scatter.items()},
             **ingest,
             "game_cd_iteration_seconds": round(game_iter_s, 3),
+            **game_20m,
             "cpu_numpy_baseline_samples_per_sec": round(
                 grad["cpu_numpy_samples_per_sec"]),
             "timing_method": "dependency-chain slope (async-tunnel safe)",

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Tripwire: fail loudly if the reference mount populates unverified.
+
+SURVEY.md's provenance header records that /root/reference was EMPTY when
+the survey was written (2026-07-29), so every parity claim in this repo is
+measured against SURVEY.md's reconstruction of upstream photon-ml, not the
+actual fork.  SURVEY.md's first-action instruction is: the moment the mount
+populates, spot-check survey sections 1-3 against the real tree before
+trusting any parity row.
+
+This script encodes that instruction so it cannot be forgotten:
+
+  * mount absent or empty          -> OK (status quo, documented)
+  * mount non-empty AND docs/REFERENCE_VERIFIED.md exists -> OK (the
+    spot-check happened and was written down)
+  * mount non-empty, no verification doc -> FAIL with instructions
+
+Wired into dev-scripts/run_tests.sh so CI trips the moment the condition
+changes.  See VERDICT.md (round 3) item 8.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REFERENCE = "/root/reference"
+VERIFIED_DOC = os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "REFERENCE_VERIFIED.md")
+
+
+def reference_file_count() -> int:
+    if not os.path.isdir(REFERENCE):
+        return 0
+    count = 0
+    for _root, _dirs, files in os.walk(REFERENCE):
+        count += len(files)
+    return count
+
+
+def main() -> int:
+    n = reference_file_count()
+    if n == 0:
+        print("reference-mount tripwire: /root/reference is empty "
+              "(status quo — parity remains vs SURVEY.md reconstruction).")
+        return 0
+    if os.path.exists(VERIFIED_DOC):
+        print(f"reference-mount tripwire: mount has {n} files and "
+              "docs/REFERENCE_VERIFIED.md exists — verified, OK.")
+        return 0
+    print(
+        f"reference-mount tripwire: /root/reference now contains {n} files\n"
+        "but docs/REFERENCE_VERIFIED.md does not exist.\n"
+        "\n"
+        "ACTION REQUIRED (SURVEY.md first-action instruction):\n"
+        "  1. Spot-check SURVEY.md sections 1-3 (layer map, component\n"
+        "     inventory, call stacks) against the real reference tree.\n"
+        "  2. Record findings — confirmed rows, corrected rows, fork\n"
+        "     deltas — in docs/REFERENCE_VERIFIED.md.\n"
+        "  3. Re-run this script; it passes once the doc exists.\n",
+        file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""North-star GAME config at full scale: MovieLens-20M-shaped coordinate
+descent on one chip (BASELINE.md config 4; round-3 verdict item 2).
+
+20M rows with Zipf-skewed per-user (138k entities) and per-item (27k
+entities) random effects plus a dense global fixed effect — the exact
+shape of MovieLens-20M (138,493 users / 27,278 movies / 20,000,263
+ratings), with planted effects so AUC is checkable without the (blocked)
+real download. The run reports:
+
+  * host staging seconds per coordinate (bucketing + block packing),
+  * steady-state seconds per CD sweep — min-of-3 slope between 1- and
+    3-iteration descents (the same dependency-chain discipline bench.py
+    uses; min-of-N because tunnel delay is additive and heavy-tailed),
+  * validation AUC vs the planted effects.
+
+    python dev-scripts/flagship_movielens.py [--rows 20000000] [--json]
+
+Needs ~6 GB host RAM for generation; device arrays fit comfortably in one
+v5e chip's HBM (global block 2.6 GB f32; use --bf16 to halve it). The same
+config is available in bench.py behind PML_BENCH_20M=1 as
+``game_cd_iteration_seconds_20m``.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
+                 d_global=32, feature_dtype="float32", cd_spans=(1, 3),
+                 min_of=3, log=lambda msg: None):
+    """Build the MovieLens-shaped dataset and measure staged CD. Returns a
+    dict of measurements (shared by this script and bench.py's gated line)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.evaluation.evaluators import auc
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.coordinates import (FixedEffectCoordinate,
+                                                RandomEffectCoordinate)
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(2026)
+    log(f"generating {n_rows:,} rows ({n_users:,} users x {n_items:,} items)")
+    t0 = time.perf_counter()
+    syn = synthetic.game_data(
+        rng, n=n_rows, d_global=d_global,
+        re_specs={"userId": (n_users, 8), "itemId": (n_items, 8)},
+        task="logistic")
+    n_val = max(n_rows // 20, 1)
+    ds_all = from_synthetic(syn)
+    ds, val = ds_all.subset(np.arange(n_rows - n_val)), \
+        ds_all.subset(np.arange(n_rows - n_val, n_rows))
+    gen_s = time.perf_counter() - t0
+    log(f"generated in {gen_s:.1f}s; staging coordinates")
+
+    mesh = make_mesh()
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    staging = {}
+    coords = {}
+    for name, builder in (
+        ("fixed", lambda: FixedEffectCoordinate(
+            ds, "global", losses.LOGISTIC, cfg, mesh,
+            feature_dtype=feature_dtype)),
+        ("per-user", lambda: RandomEffectCoordinate(
+            ds, "userId", "re_userId", losses.LOGISTIC, cfg, mesh,
+            feature_dtype=feature_dtype)),
+        ("per-item", lambda: RandomEffectCoordinate(
+            ds, "itemId", "re_itemId", losses.LOGISTIC, cfg, mesh,
+            feature_dtype=feature_dtype)),
+    ):
+        t0 = time.perf_counter()
+        coords[name] = builder()
+        staging[name] = time.perf_counter() - t0
+        log(f"  {name} staged in {staging[name]:.1f}s")
+    seq = ["fixed", "per-user", "per-item"]
+
+    def run_cd(iters):
+        cd = descent.CoordinateDescentConfig(seq, iterations=iters)
+        t0 = time.perf_counter()
+        model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd)
+        np.asarray(model.models["fixed"].coefficients.means)
+        np.asarray(model.models["per-user"].means[:1])
+        return time.perf_counter() - t0, model
+
+    log("warm-up sweep (includes compile)")
+    t_first, _ = run_cd(cd_spans[0])
+    log(f"first {cd_spans[0]}-iteration descent (incl. compile): "
+        f"{t_first:.1f}s; timing steady state (min of {min_of})")
+    t_small = min(run_cd(cd_spans[0])[0] for _ in range(min_of))
+    t_large = None
+    model = None
+    for _ in range(min_of):
+        t, model = run_cd(cd_spans[1])
+        t_large = t if t_large is None else min(t_large, t)
+    per_sweep = max(t_large - t_small, 0.0) / (cd_spans[1] - cd_spans[0])
+    log(f"steady-state sweep: {per_sweep:.2f}s "
+        f"(slope between {cd_spans[0]} and {cd_spans[1]} iterations)")
+
+    log("scoring validation split")
+    scores = model.score(val)
+    val_auc = float(auc(scores, jnp.asarray(val.response)))
+    log(f"validation AUC vs planted effects: {val_auc:.4f}")
+    return {
+        "game_cd_iteration_seconds_20m": round(per_sweep, 3),
+        "flagship_rows": n_rows,
+        "flagship_staging_seconds": {k: round(v, 1)
+                                     for k, v in staging.items()},
+        "flagship_first_descent_seconds": round(t_first, 1),
+        "flagship_validation_auc": round(val_auc, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000_000)
+    ap.add_argument("--users", type=int, default=138_000)
+    ap.add_argument("--items", type=int, default=27_000)
+    ap.add_argument("--bf16", action="store_true",
+                    help="bf16 feature storage (f32 accumulation)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON line instead of prose")
+    args = ap.parse_args()
+    log = (lambda m: print(f"[flagship {time.strftime('%H:%M:%S')}] {m}",
+                           file=sys.stderr, flush=True))
+    out = run_flagship(
+        n_rows=args.rows, n_users=args.users, n_items=args.items,
+        feature_dtype="bfloat16" if args.bf16 else "float32", log=log)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()

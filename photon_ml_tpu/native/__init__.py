@@ -26,10 +26,17 @@ def build_library(name: str, link: tuple[str, ...] = ()) -> str:
         if (not os.path.exists(out)
                 or os.path.getmtime(out) < os.path.getmtime(src)):
             os.makedirs(_BUILD_DIR, exist_ok=True)
-            tmp = out + ".tmp"
-            subprocess.run(
-                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
-                 "-o", tmp, src, *link],
-                check=True, capture_output=True)
-            os.replace(tmp, out)
+            # pid-suffixed temp + atomic rename: concurrent builders (e.g.
+            # pytest-xdist workers — the threading lock is per-process) each
+            # write their own object and the last rename wins intact.
+            tmp = f"{out}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+                     "-o", tmp, src, *link],
+                    check=True, capture_output=True)
+                os.replace(tmp, out)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
     return out

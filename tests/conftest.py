@@ -24,7 +24,12 @@ def _env_ok() -> bool:
     )
 
 
-if not _env_ok() and os.environ.get("_PHOTON_TEST_REEXEC") != "1":
+# Under pytest-xdist, only the controller may re-exec: workers are spawned
+# with execnet-internal argv that `python -m pytest` cannot reproduce. The
+# controller loads conftest before spawning workers, so workers inherit the
+# fixed environment and _env_ok() is already true for them.
+if (not _env_ok() and os.environ.get("_PHOTON_TEST_REEXEC") != "1"
+        and "PYTEST_XDIST_WORKER" not in os.environ):
     os.environ["_PHOTON_TEST_REEXEC"] = "1"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -28,6 +28,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from photon_ml_tpu.data import synthetic
 from photon_ml_tpu.data.game_data import from_synthetic
 from photon_ml_tpu.data.io import save_game_dataset
