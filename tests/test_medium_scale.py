@@ -1,0 +1,101 @@
+"""Medium-scale (1M-row) GAME integration — slow tier.
+
+The reference exercises its drivers on real bundled Avro fixtures
+(``GameIntegTest`` resources, SURVEY.md §4); with the network blocked, the
+scale dimension of that discipline is reproduced here with a 1M-row
+synthetic MovieLens-shaped dataset (Zipf-skewed per-user + per-item random
+effects) driven through the REAL CLI entry points: train → save → score →
+warm-start. Round-3 verdict item 6: nothing above 100k rows previously ran
+outside one-off bench sessions.
+
+Marked ``slow``: a few minutes on the virtual CPU mesh. Run with
+``pytest -m slow`` (dev-scripts/run_tests.sh includes it).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import game_score, game_train
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import from_synthetic
+from photon_ml_tpu.data.io import save_game_dataset
+from photon_ml_tpu.models import io as model_io
+
+pytestmark = pytest.mark.slow
+
+N_ROWS = 1_000_000
+
+
+@pytest.fixture(scope="module")
+def medium_dirs(tmp_path_factory):
+    rng = np.random.default_rng(20260731)
+    syn = synthetic.game_data(
+        rng, n=N_ROWS, d_global=16,
+        re_specs={"userId": (50_000, 8), "itemId": (20_000, 6)},
+        task="logistic")
+    ds = from_synthetic(syn)
+    idx = rng.permutation(N_ROWS)
+    split = int(0.9 * N_ROWS)
+    base = tmp_path_factory.mktemp("medium")
+    train_dir = str(base / "train")
+    val_dir = str(base / "val")
+    save_game_dataset(ds.subset(idx[:split]), train_dir)
+    save_game_dataset(ds.subset(idx[split:]), val_dir)
+    return train_dir, val_dir, str(base)
+
+
+_COORD_ARGS = [
+    "--coordinate", "name=fixed,type=fixed,shard=global",
+    "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                    "re=userId,min_samples=2",
+    "--coordinate", "name=per-item,type=random,shard=re_itemId,"
+                    "re=itemId,min_samples=2",
+    "--update-sequence", "fixed,per-user,per-item",
+    "--evaluators", "AUC",
+    "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+    "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+    "--opt-config", "per-item:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+]
+
+
+def test_million_row_train_score_warmstart(medium_dirs):
+    train_dir, val_dir, base = medium_dirs
+    out_cold = os.path.join(base, "out_cold")
+    summary = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", val_dir,
+        *_COORD_ARGS,
+        "--iterations", "2",
+        "--no-checkpoint",
+        "--output-dir", out_cold,
+    ]))
+    cold_auc = summary["best_metrics"]["AUC"]
+    # Planted Zipf-skewed effects at 1M rows: mixed-effects logistic should
+    # separate well above chance even on CPU-mesh budgets.
+    assert cold_auc > 0.75
+
+    # Scoring driver round trip on the saved model at full validation scale.
+    model = model_io.load_game_model(os.path.join(out_cold, "best"))
+    assert set(model.models) == {"fixed", "per-user", "per-item"}
+    score_out = os.path.join(base, "scores")
+    score_summary = game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", os.path.join(out_cold, "best"),
+        "--output-dir", score_out, "--evaluators", "AUC",
+    ]))
+    assert score_summary["num_rows"] == N_ROWS - int(0.9 * N_ROWS)
+    assert abs(score_summary["metrics"]["AUC"] - cold_auc) < 0.02
+
+    # Warm start from the saved model: one more sweep must not degrade the
+    # starting model's validation AUC (the reference's incremental-training
+    # contract, here asserted at 1M rows).
+    out_warm = os.path.join(base, "out_warm")
+    warm = game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir, "--validation", val_dir,
+        *_COORD_ARGS,
+        "--iterations", "1",
+        "--no-checkpoint",
+        "--model-input-dir", os.path.join(out_cold, "best"),
+        "--output-dir", out_warm,
+    ]))
+    assert warm["best_metrics"]["AUC"] >= cold_auc - 1e-3
