@@ -1,0 +1,43 @@
+"""GAME coordinates: fixed-effect and random-effect training units.
+
+Reference parity: photon-api ``algorithm/Coordinate.scala``,
+``algorithm/FixedEffectCoordinate.scala`` (one distributed GLM fit over the
+whole dataset), ``algorithm/RandomEffectCoordinate.scala`` (per-entity local
+GLM fits inside ``mapValues`` over ``RDD[(REId, LocalDataset)]``).
+
+TPU-first design:
+- FixedEffectCoordinate = the data-parallel psum objective + compiled
+  optimizer (photon_ml_tpu/parallel/problem.py) over the mesh (P1).
+- RandomEffectCoordinate = per-bucket ``vmap``-ped compiled optimizer over
+  padded entity blocks (photon_ml_tpu/game/buckets.py), entity axis sharded
+  over the mesh, per-lane convergence masks freezing finished entities (P2).
+
+Residency discipline (the point of the rebuild — replaces the reference's
+per-L-BFGS-iteration driver⇄executor broadcast/treeAggregate): every array
+that survives a coordinate-descent step lives on device for the whole run.
+Each coordinate builds its jitted fit program ONCE at construction:
+
+- fixed effect: ``fit(staged_batch, offsets, w0) → w`` — the entire L-BFGS/
+  TRON/OWL-QN while_loop plus psum objective is one cached XLA executable;
+  per CD step the only new inputs are the (n,) offsets and the warm start.
+- random effect: ``fit_bucket(W, offsets, Xb, yb, wb, ex, rows) → W`` —
+  offsets gather, warm-start gather, vmapped solve, and trained-row scatter
+  all happen on device; the (E, d) coefficient table never visits the host.
+
+Both expose ``train_model(offsets, initial)`` and ``score(model)`` plus
+variance computation, mirroring the reference Coordinate contract
+(trainModel / score / updateOffset — offsets here are passed explicitly
+rather than mutating a dataset).
+"""
+
+from photon_ml_tpu.game.coordinates.fixed import FixedEffectCoordinate
+from photon_ml_tpu.game.coordinates.sparse_fixed import \
+    SparseFixedEffectCoordinate
+from photon_ml_tpu.game.coordinates.random_effect import \
+    RandomEffectCoordinate
+
+__all__ = [
+    "FixedEffectCoordinate",
+    "SparseFixedEffectCoordinate",
+    "RandomEffectCoordinate",
+]

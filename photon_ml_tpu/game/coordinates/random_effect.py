@@ -1,33 +1,8 @@
-"""GAME coordinates: fixed-effect and random-effect training units.
+"""Random-effect coordinate: per-entity vmapped solves over padded entity
+buckets (P2), dense or subspace-projected, with optional sparse-shard input.
 
-Reference parity: photon-api ``algorithm/Coordinate.scala``,
-``algorithm/FixedEffectCoordinate.scala`` (one distributed GLM fit over the
-whole dataset), ``algorithm/RandomEffectCoordinate.scala`` (per-entity local
-GLM fits inside ``mapValues`` over ``RDD[(REId, LocalDataset)]``).
-
-TPU-first design:
-- FixedEffectCoordinate = the data-parallel psum objective + compiled
-  optimizer (photon_ml_tpu/parallel/problem.py) over the mesh (P1).
-- RandomEffectCoordinate = per-bucket ``vmap``-ped compiled optimizer over
-  padded entity blocks (photon_ml_tpu/game/buckets.py), entity axis sharded
-  over the mesh, per-lane convergence masks freezing finished entities (P2).
-
-Residency discipline (the point of the rebuild — replaces the reference's
-per-L-BFGS-iteration driver⇄executor broadcast/treeAggregate): every array
-that survives a coordinate-descent step lives on device for the whole run.
-Each coordinate builds its jitted fit program ONCE at construction:
-
-- fixed effect: ``fit(staged_batch, offsets, w0) → w`` — the entire L-BFGS/
-  TRON/OWL-QN while_loop plus psum objective is one cached XLA executable;
-  per CD step the only new inputs are the (n,) offsets and the warm start.
-- random effect: ``fit_bucket(W, offsets, Xb, yb, wb, ex, rows) → W`` —
-  offsets gather, warm-start gather, vmapped solve, and trained-row scatter
-  all happen on device; the (E, d) coefficient table never visits the host.
-
-Both expose ``train_model(offsets, initial)`` and ``score(model)`` plus
-variance computation, mirroring the reference Coordinate contract
-(trainModel / score / updateOffset — offsets here are passed explicitly
-rather than mutating a dataset).
+See the package docstring (photon_ml_tpu/game/coordinates/__init__.py) for
+the residency discipline shared by all coordinate types.
 """
 
 from __future__ import annotations
@@ -43,27 +18,18 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game import buckets as bkt
 from photon_ml_tpu.game import projector as prj
-from photon_ml_tpu.game.models import (FixedEffectModel, RandomEffectModel,
+from photon_ml_tpu.game.models import (RandomEffectModel,
                                        SubspaceRandomEffectModel,
                                        _subspace_positions,
                                        sort_subspace_rows)
-from photon_ml_tpu.game.sampling import (binary_classification_down_sample,
-                                         default_down_sample)
-from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim import optimize
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType,
                                          compute_variances, make_objective,
-                                         resolve_optimizer_config,
-                                         variances_from_diagonal,
-                                         variances_from_matrix)
-from photon_ml_tpu.optim.regularization import intercept_mask
-from photon_ml_tpu.parallel import objective as dobj
-from photon_ml_tpu.parallel import problem as dist_problem
-from photon_ml_tpu.parallel.mesh import (DATA_AXIS, data_sharded,
-                                         pad_to_multiple, shard_batch)
+                                         resolve_optimizer_config)
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, data_sharded
 
 Array = jax.Array
 
@@ -76,595 +42,6 @@ _UNSET = object()
 # lanes OOMs a 16 GB chip. 64k lanes keeps temps ~100 MB at typical widths
 # while staying large enough to saturate the chip.
 _LANE_CHUNK = 65536
-
-
-class FixedEffectCoordinate:
-    """One shared GLM trained data-parallel over the mesh.
-
-    Reference parity: FixedEffectCoordinate + DistributedOptimizationProblem.
-
-    Model-space contract: the optimizer runs in the normalization-transformed
-    space, but the FixedEffectModel handed out ALWAYS holds ORIGINAL-space
-    coefficients (converted at the train boundary, reconverted for warm
-    starts) so every scorer — GameModel.score, the transformer, the CLIs,
-    save/load — is a plain X @ w. The two are algebraically identical:
-    X @ (w∘f) − (w∘f)·s == X @ model_to_original_space(w).
-    """
-
-    def __init__(
-        self,
-        dataset: GameDataset,
-        shard_id: str,
-        loss: PointwiseLoss,
-        config: GLMOptimizationConfiguration,
-        mesh,
-        norm: NormalizationContext = NormalizationContext(),
-        down_sampling_seed: int = 0,
-        feature_dtype: str = "float32",
-    ):
-        self.dataset = dataset
-        self.shard_id = shard_id
-        self.loss = loss
-        self.config = config
-        self.mesh = mesh
-        self.norm = norm
-        self.intercept_index = dataset.intercept_index.get(shard_id)
-        self._down_sampling_seed = down_sampling_seed
-        self._rng = np.random.default_rng(down_sampling_seed)
-        self.feature_dtype = feature_dtype
-        # Stage the full training batch on device ONCE (offsets are a
-        # placeholder — they are the per-CD-step input). shard_batch pads to
-        # a multiple of the data-axis size with zero-weight rows. Scoring
-        # reuses the staged features — no second device copy of X.
-        # feature_dtype="bfloat16" stores X at half width (see
-        # ops/aggregators._matvec for the f32-accumulation contract).
-        self._staged = shard_batch(
-            LabeledBatch.build(dataset.feature_shards[shard_id],
-                               dataset.response, dataset.weights,
-                               feature_dtype=feature_dtype),
-            mesh)
-        self._build_fits()
-
-    def _padded_offsets(self, offsets: Array) -> Array:
-        """Extend (n,) offsets with zeros to the staged padded length
-        (padding rows have weight 0, so their offsets are inert)."""
-        offsets = jnp.asarray(offsets)
-        n = self.dataset.num_rows
-        return jnp.zeros((self._staged.num_rows,), offsets.dtype
-                         ).at[:n].set(offsets)
-
-    def _build_fits(self):
-        """(Re)build the cached jitted fit programs for the current config."""
-        cfg = dataclasses.replace(
-            self.config, variance_computation=VarianceComputationType.NONE)
-        loss, mesh, norm = self.loss, self.mesh, self.norm
-        ii = self.intercept_index
-
-        def fit(staged: LabeledBatch, offsets: Array, w0: Array) -> Array:
-            batch = dataclasses.replace(staged,
-                                        offsets=self._padded_offsets(offsets))
-            coef, _ = dist_problem.run(
-                loss, batch, mesh, cfg, initial=Coefficients(w0), norm=norm,
-                intercept_index=ii, already_sharded=True)
-            return coef.means
-
-        def fit_sampled(staged: LabeledBatch, idx: Array, mult: Array,
-                        offsets: Array, w0: Array) -> Array:
-            # Down-sampled pass: gather the subsample on device, rescale
-            # weights, pad back to a data-axis multiple (static shapes: the
-            # samplers return deterministic sizes).
-            sub = LabeledBatch(
-                features=staged.features[idx],
-                labels=staged.labels[idx],
-                weights=staged.weights[idx] * mult,
-                offsets=offsets[idx],
-            ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
-            coef, _ = dist_problem.run(
-                loss, sub, mesh, cfg, initial=Coefficients(w0), norm=norm,
-                intercept_index=ii, already_sharded=True)
-            return coef.means
-
-        self._fit = jax.jit(fit)
-        self._fit_sampled = jax.jit(fit_sampled)
-
-    @property
-    def dim(self) -> int:
-        return self.dataset.shard_dim(self.shard_id)
-
-    def with_optimization_config(
-        self, config: GLMOptimizationConfiguration
-    ) -> "FixedEffectCoordinate":
-        """Cheap copy with a new optimization config (same data/device
-        arrays) — the estimator's reg-weight grid loop swaps configs without
-        re-staging data (reference: datasets built once per coordinate,
-        reused across the GameOptimizationConfiguration grid)."""
-        import copy
-
-        c = copy.copy(self)
-        c.config = config
-        # Fresh, identically-seeded RNG so every grid point trains on the
-        # SAME down-sampled subsets (grid comparison must not depend on how
-        # far a shared RNG advanced in earlier grid points).
-        c._rng = np.random.default_rng(self._down_sampling_seed)
-        c._build_fits()
-        return c
-
-    def train_model(
-        self,
-        offsets: Array,
-        initial: Optional[FixedEffectModel] = None,
-    ) -> FixedEffectModel:
-        if initial is not None:
-            w0 = self.norm.model_to_transformed_space(
-                initial.coefficients.means)
-        else:
-            w0 = jnp.zeros((self.dim,), jnp.float32)
-        offsets = jnp.asarray(offsets)
-        rate = self.config.down_sampling_rate
-        if rate < 1.0:
-            # Reference: DownSampler subsamples the fixed-effect coordinate's
-            # data each training pass, rescaling weights by 1/rate. The
-            # sampler is picked by TASK (reference behavior), not by
-            # inspecting label values. Index draw is host-side (cheap, label
-            # metadata only); the data gather happens on device.
-            if self.loss.name in ("logistic", "smoothed_hinge"):
-                idx, mult = binary_classification_down_sample(
-                    self._rng, self.dataset.response, rate)
-            else:
-                idx, mult = default_down_sample(
-                    self._rng, self.dataset.num_rows, rate)
-            w_t = self._fit_sampled(self._staged, jnp.asarray(idx),
-                                    jnp.asarray(mult), offsets, w0)
-        else:
-            w_t = self._fit(self._staged, offsets, w0)
-        raw = Coefficients(self.norm.model_to_original_space(w_t))
-        return FixedEffectModel(shard_id=self.shard_id, coefficients=raw)
-
-    def compute_model_variances(
-        self, model: FixedEffectModel, offsets: Array
-    ) -> FixedEffectModel:
-        """Coefficient variances at the optimum (post-descent pass).
-
-        Variances are computed in the transformed space and mapped back by
-        the factor² scaling implied by w_orig = w∘f (the intercept's extra
-        shift term is a location change and does not rescale its variance).
-        """
-        kind = VarianceComputationType(self.config.variance_computation)
-        if kind == VarianceComputationType.NONE:
-            return model
-        batch = dataclasses.replace(self._staged,
-                                    offsets=self._padded_offsets(offsets))
-        w_t = self.norm.model_to_transformed_space(model.coefficients.means)
-        mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
-        l2 = self.config.regularization.l2_weight()
-        if kind == VarianceComputationType.SIMPLE:
-            diag = dobj.make_hessian_diagonal(
-                self.loss, self.mesh, batch, self.norm)(w_t)
-            var_t = variances_from_diagonal(diag, l2, mask)
-        else:
-            H = dobj.make_hessian_matrix(
-                self.loss, self.mesh, batch, self.norm)(w_t)
-            var_t = variances_from_matrix(H, l2, mask)
-        var_t = self.norm.variances_to_original_space(var_t)
-        return dataclasses.replace(
-            model, coefficients=Coefficients(model.coefficients.means, var_t))
-
-    def score(self, model: FixedEffectModel) -> Array:
-        """Raw-space score (identical to the training margins by algebra)."""
-        from photon_ml_tpu.ops.aggregators import scores as agg_scores
-
-        n = self.dataset.num_rows
-        return agg_scores(self._staged.features,
-                          model.coefficients.means)[:n]
-
-    def initial_model(self) -> FixedEffectModel:
-        return FixedEffectModel(
-            shard_id=self.shard_id,
-            coefficients=Coefficients.zeros(self.dim))
-
-    def advance_down_sampling(self, steps: int) -> None:
-        """Fast-forward the down-sampling RNG past ``steps`` completed
-        train_model calls (checkpoint resume must subsample the remaining
-        steps exactly as the uninterrupted run would have)."""
-        _advance_down_sampling(self, steps)
-
-
-def _advance_down_sampling(coord, steps: int) -> None:
-    rate = coord.config.down_sampling_rate
-    if rate >= 1.0:
-        return
-    for _ in range(steps):
-        if coord.loss.name in ("logistic", "smoothed_hinge"):
-            binary_classification_down_sample(
-                coord._rng, coord.dataset.response, rate)
-        else:
-            default_down_sample(coord._rng, coord.dataset.num_rows, rate)
-
-
-class SparseFixedEffectCoordinate:
-    """Fixed-effect GLM over an ELL sparse shard (the Criteo path).
-
-    Reference parity: same FixedEffectCoordinate contract, but the
-    objective is the sparse gather/scatter pipeline
-    (parallel/sparse_objective.py) instead of dense matmuls — the analogue
-    of the reference training on sparse Breeze vectors + PalDB index maps.
-    With ``feature_sharded=True`` the coefficient dimension additionally
-    shards over the mesh's ``model`` axis (P3) for feature spaces too large
-    to replicate.
-
-    Residency discipline matches the dense coordinate: the staged batch
-    lives on device once; per CD step only (n,) offsets and the warm
-    start move.
-
-    Two execution layouts:
-    - ``hybrid`` (default whenever coefficients replicate): the hot-dense /
-      cold-class layout of ops/hybrid_sparse.py — the Zipf head of the
-      feature space rides the MXU as a dense block and the cold tail's
-      random crossings shrink to ~15% of the volume (measured ~4-10× the
-      ELL step at d=1M on one v5e chip). Exact, not approximate: the
-      solve happens in a statically permuted feature space and maps back.
-      On a multi-data-shard mesh the rows split contiguously into
-      per-shard hybrid layouts under one GLOBAL permutation
-      (HybridShards): hot/cold aggregates run shard-local and psum over
-      ``data``, so the fast path composes with data parallelism.
-    - ELL shard_map pipeline (parallel/sparse_objective.py): required for
-      ``feature_sharded=True`` (P3), where the coefficient dimension
-      itself shards over ``model`` and the hybrid layout's replicated
-      permuted space does not exist.
-
-    Normalization is not supported here (the reference normalizes dense
-    shards only; scaling sparse values would densify shift terms).
-    Sparse RANDOM effects are deliberately not a separate class: large-d
-    sparse per-entity features are exactly the regime the per-entity
-    subspace projection handles (RandomEffectCoordinate stages dense
-    d_active buckets straight from the ELL triplets).
-    """
-
-    def __init__(
-        self,
-        dataset: GameDataset,
-        shard_id: str,
-        loss: PointwiseLoss,
-        config: GLMOptimizationConfiguration,
-        mesh,
-        feature_sharded: bool = False,
-        down_sampling_seed: int = 0,
-        hybrid: Optional[bool] = None,
-        feature_dtype: str = "float32",
-    ):
-        from photon_ml_tpu.data.game_data import SparseShard
-        from photon_ml_tpu.data.sparse import SparseBatch
-        from photon_ml_tpu.parallel import sparse_problem as sp
-
-        shard = dataset.feature_shards[shard_id]
-        if not isinstance(shard, SparseShard):
-            raise TypeError(f"shard {shard_id!r} is not sparse")
-        self.dataset = dataset
-        self.shard_id = shard_id
-        self.loss = loss
-        self.config = config
-        self.mesh = mesh
-        self.feature_sharded = bool(feature_sharded)
-        self.intercept_index = dataset.intercept_index.get(shard_id)
-        self._down_sampling_seed = down_sampling_seed
-        self._rng = np.random.default_rng(down_sampling_seed)
-        self._dim = int(shard.num_features)
-        self.feature_dtype = feature_dtype
-
-        single_shard = mesh.shape[DATA_AXIS] == 1
-        if hybrid is None:
-            self.hybrid = not self.feature_sharded
-        else:
-            self.hybrid = bool(hybrid)
-            if self.hybrid and self.feature_sharded:
-                raise ValueError(
-                    "hybrid=True is incompatible with feature_sharded "
-                    "(the hybrid layout needs the permuted coefficient "
-                    "space replicated on every shard)")
-        self._hybrid_sharded = self.hybrid and not single_shard
-
-        batch = SparseBatch(
-            indices=np.asarray(shard.indices),
-            values=np.asarray(shard.values),
-            labels=np.asarray(dataset.response),
-            weights=np.asarray(dataset.weights),
-            offsets=np.zeros(dataset.num_rows, np.float32),
-            num_features=self._dim)
-        if self.hybrid:
-            import jax.numpy as _jnp
-
-            from photon_ml_tpu.ops import hybrid_sparse as hybrid_mod
-
-            dt = (_jnp.bfloat16 if feature_dtype == "bfloat16"
-                  else _jnp.float32)
-            if self._hybrid_sharded:
-                shb = hybrid_mod.build_hybrid_shards(
-                    batch, mesh.shape[DATA_AXIS], feature_dtype=dt)
-                self._staged = sp.shard_hybrid(shb, mesh)
-            else:
-                self._staged = hybrid_mod.build_hybrid(
-                    batch, feature_dtype=dt)
-            self._ii_perm = (
-                None if self.intercept_index is None else int(
-                    np.asarray(self._staged.inv_perm)[self.intercept_index]))
-        else:
-            if self.feature_sharded:
-                from photon_ml_tpu.parallel.mesh import MODEL_AXIS
-                batch = sp._pad_features(
-                    batch,
-                    pad_to_multiple(self._dim, mesh.shape[MODEL_AXIS]))
-            self._staged = sp.shard_sparse_batch(batch, mesh)
-        self._build_fits()
-
-    # -- jitted programs ---------------------------------------------------
-
-    def _padded_offsets(self, offsets: jax.Array) -> jax.Array:
-        offsets = jnp.asarray(offsets)
-        n = self.dataset.num_rows
-        return jnp.zeros((self._staged.num_rows,), offsets.dtype
-                         ).at[:n].set(offsets)
-
-    def _build_fits(self):
-        if self.hybrid:
-            self._build_hybrid_fits()
-            return
-        from photon_ml_tpu.ops import sparse_aggregators as sagg
-        from photon_ml_tpu.parallel import sparse_problem as sp
-
-        cfg = dataclasses.replace(
-            self.config, variance_computation=VarianceComputationType.NONE)
-        loss, mesh, fs = self.loss, self.mesh, self.feature_sharded
-        ii = self.intercept_index
-        d_true = self._dim
-        d_staged = self._staged.num_features
-
-        def lift(w0):
-            """True-dim warm start → staged (possibly feature-padded) dim."""
-            if d_staged == d_true:
-                return w0
-            return jnp.zeros((d_staged,), w0.dtype).at[:d_true].set(w0)
-
-        def fit(staged, offsets, w0):
-            batch = dataclasses.replace(
-                staged, offsets=self._padded_offsets(offsets))
-            coef, _ = sp.run(loss, batch, mesh, cfg,
-                             initial=Coefficients(lift(w0)),
-                             intercept_index=ii,
-                             feature_sharded=fs, already_sharded=True)
-            return coef.means[:d_true]
-
-        def fit_sampled(staged, idx, mult, offsets, w0):
-            sub = dataclasses.replace(
-                staged,
-                indices=staged.indices[idx],
-                values=staged.values[idx],
-                labels=staged.labels[idx],
-                weights=staged.weights[idx] * mult,
-                offsets=offsets[idx],
-            ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
-            coef, _ = sp.run(loss, sub, mesh, cfg,
-                             initial=Coefficients(lift(w0)),
-                             intercept_index=ii,
-                             feature_sharded=fs, already_sharded=True)
-            return coef.means[:d_true]
-
-        def score_fn(staged, means):
-            # Staged offsets are zeros, so margins == X @ w exactly.
-            return sagg.margins(staged, means)
-
-        self._fit = jax.jit(fit)
-        self._fit_sampled = jax.jit(fit_sampled)
-        self._score = jax.jit(score_fn)
-
-    def _build_hybrid_fits(self):
-        """Jitted hybrid-layout programs. Per CD step only (n,) offsets and
-        the warm start move; the staged HybridSparseBatch / HybridShards is
-        a jit argument (never a baked constant) so the big hot block stays
-        device-resident across compilations. Down-sampling masks weights in
-        place of the ELL path's row gather — the objective is identical
-        (dropped rows get weight 0, kept rows scale by the rate
-        multiplier)."""
-        from photon_ml_tpu.ops import hybrid_sparse as hybrid_mod
-        from photon_ml_tpu.parallel import sparse_problem as sp
-
-        cfg = dataclasses.replace(
-            self.config, variance_computation=VarianceComputationType.NONE)
-        loss = self.loss
-        ii_perm = self._ii_perm
-
-        if self._hybrid_sharded:
-            self._build_hybrid_sharded_fits(cfg, ii_perm)
-            return
-
-        def fit(hb, offsets, w0):
-            hbo = dataclasses.replace(hb, offsets=jnp.asarray(offsets))
-            coef, _ = sp.run_hybrid(loss, hbo, cfg,
-                                    initial=Coefficients(w0),
-                                    intercept_index_permuted=ii_perm)
-            return coef.means
-
-        def fit_sampled(hb, idx, mult, offsets, w0):
-            w_masked = jnp.zeros_like(hb.weights).at[idx].set(
-                hb.weights[idx] * mult)
-            hbo = dataclasses.replace(hb, weights=w_masked,
-                                      offsets=jnp.asarray(offsets))
-            coef, _ = sp.run_hybrid(loss, hbo, cfg,
-                                    initial=Coefficients(w0),
-                                    intercept_index_permuted=ii_perm)
-            return coef.means
-
-        def score_fn(hb, means):
-            # Staged offsets are zeros, so margins == X @ w exactly.
-            return hybrid_mod.margins(
-                hb, hybrid_mod.to_permuted_space(hb, means))
-
-        def hess_diag(hb, offsets, means):
-            hbo = dataclasses.replace(hb, offsets=jnp.asarray(offsets))
-            return hybrid_mod.to_original_space(
-                hbo, hybrid_mod.hessian_diagonal(
-                    loss, hybrid_mod.to_permuted_space(hbo, means), hbo))
-
-        self._fit = jax.jit(fit)
-        self._fit_sampled = jax.jit(fit_sampled)
-        self._score = jax.jit(score_fn)
-        self._hess_diag = jax.jit(hess_diag)
-
-    def _build_hybrid_sharded_fits(self, cfg, ii_perm):
-        """Jitted programs over the data-sharded hybrid layout.
-
-        Offsets/weights keep the contract of the rest of the class — flat
-        padded global row order — and reshape to the (S, n_l) grid at the
-        jit boundary (padding sits at the global tail, so flat index ==
-        original row id)."""
-        from photon_ml_tpu.parallel import sparse_objective as sobj
-        from photon_ml_tpu.parallel import sparse_problem as sp
-
-        loss = self.loss
-        mesh = self.mesh
-        S = self._staged.num_shards
-        n_l = self._staged.rows_per_shard
-        n = self.dataset.num_rows
-
-        def grid(offsets):
-            # fit() passes raw (n,) offsets; fit_sampled already padded
-            # them to the staged length via _padded_offsets.
-            offsets = jnp.asarray(offsets)
-            flat = (offsets if offsets.shape[0] == S * n_l
-                    else self._padded_offsets(offsets))
-            return flat.reshape(S, n_l)
-
-        def fit(shb, offsets, w0):
-            shbo = dataclasses.replace(shb, offsets=grid(offsets))
-            coef, _ = sp.run_hybrid_sharded(
-                loss, shbo, mesh, cfg, initial=Coefficients(w0),
-                intercept_index_permuted=ii_perm)
-            return coef.means
-
-        def fit_sampled(shb, idx, mult, offsets, w0):
-            wf = shb.weights.reshape(-1)
-            w_masked = jnp.zeros_like(wf).at[idx].set(
-                wf[idx] * mult).reshape(shb.weights.shape)
-            shbo = dataclasses.replace(shb, weights=w_masked,
-                                       offsets=grid(offsets))
-            coef, _ = sp.run_hybrid_sharded(
-                loss, shbo, mesh, cfg, initial=Coefficients(w0),
-                intercept_index_permuted=ii_perm)
-            return coef.means
-
-        def score_fn(shb, means):
-            # Staged offsets are zeros, so margins == X @ w exactly; rows
-            # come back in flat padded global order.
-            return sobj.make_hybrid_margins(mesh, shb)(means[shb.perm])
-
-        def hess_diag(shb, offsets, means):
-            shbo = dataclasses.replace(shb, offsets=grid(offsets))
-            diag = sobj.make_hybrid_hessian_diagonal(
-                loss, mesh, shbo)(means[shbo.perm])
-            return diag[shbo.inv_perm]
-
-        self._fit = jax.jit(fit)
-        self._fit_sampled = jax.jit(fit_sampled)
-        self._score = jax.jit(score_fn)
-        self._hess_diag = jax.jit(hess_diag)
-
-    # -- coordinate contract ----------------------------------------------
-
-    @property
-    def dim(self) -> int:
-        return self._dim
-
-    def with_optimization_config(
-        self, config: GLMOptimizationConfiguration
-    ) -> "SparseFixedEffectCoordinate":
-        import copy
-
-        c = copy.copy(self)
-        c.config = config
-        c._rng = np.random.default_rng(self._down_sampling_seed)
-        c._build_fits()
-        return c
-
-    def train_model(
-        self,
-        offsets: jax.Array,
-        initial: Optional[FixedEffectModel] = None,
-    ) -> FixedEffectModel:
-        if initial is not None:
-            w0 = jnp.asarray(initial.coefficients.means)
-        else:
-            w0 = jnp.zeros((self.dim,), jnp.float32)
-        offsets = jnp.asarray(offsets)
-        rate = self.config.down_sampling_rate
-        if rate < 1.0:
-            if self.loss.name in ("logistic", "smoothed_hinge"):
-                idx, mult = binary_classification_down_sample(
-                    self._rng, self.dataset.response, rate)
-            else:
-                idx, mult = default_down_sample(
-                    self._rng, self.dataset.num_rows, rate)
-            w = self._fit_sampled(self._staged, jnp.asarray(idx),
-                                  jnp.asarray(mult),
-                                  self._padded_offsets(offsets), w0)
-        else:
-            w = self._fit(self._staged, offsets, w0)
-        return FixedEffectModel(shard_id=self.shard_id,
-                                coefficients=Coefficients(w))
-
-    def compute_model_variances(
-        self, model: FixedEffectModel, offsets: jax.Array
-    ) -> FixedEffectModel:
-        from photon_ml_tpu.parallel import sparse_objective as sobj
-
-        kind = VarianceComputationType(self.config.variance_computation)
-        if kind == VarianceComputationType.NONE:
-            return model
-        if kind == VarianceComputationType.FULL:
-            raise NotImplementedError(
-                "FULL variance needs the dense d×d Hessian — use SIMPLE at "
-                "sparse scale (as the reference does)")
-        if self.hybrid:
-            diag = self._hess_diag(self._staged,
-                                   self._padded_offsets(offsets),
-                                   jnp.asarray(model.coefficients.means))
-            var = variances_from_diagonal(
-                diag, self.config.regularization.l2_weight(),
-                jnp.asarray(intercept_mask(self.dim, self.intercept_index)))
-            return dataclasses.replace(
-                model,
-                coefficients=Coefficients(model.coefficients.means, var))
-        batch = dataclasses.replace(
-            self._staged, offsets=self._padded_offsets(offsets))
-        d_staged = batch.num_features
-        w = jnp.zeros((d_staged,), jnp.float32
-                      ).at[:self.dim].set(model.coefficients.means)
-        diag = sobj.make_hessian_diagonal(
-            self.loss, self.mesh, batch, self.feature_sharded)(w)
-        mask = np.zeros(d_staged, np.float32)
-        mask[:self.dim] = intercept_mask(self.dim, self.intercept_index)
-        var = variances_from_diagonal(
-            diag, self.config.regularization.l2_weight(),
-            jnp.asarray(mask))[:self.dim]
-        return dataclasses.replace(
-            model,
-            coefficients=Coefficients(model.coefficients.means, var))
-
-    def score(self, model: FixedEffectModel) -> jax.Array:
-        n = self.dataset.num_rows
-        means = jnp.asarray(model.coefficients.means)
-        d_staged = self._staged.num_features
-        if d_staged != self.dim:
-            means = jnp.zeros((d_staged,), means.dtype
-                              ).at[:self.dim].set(means)
-        return self._score(self._staged, means)[:n]
-
-    def initial_model(self) -> FixedEffectModel:
-        return FixedEffectModel(
-            shard_id=self.shard_id,
-            coefficients=Coefficients.zeros(self.dim))
-
-    def advance_down_sampling(self, steps: int) -> None:
-        """See FixedEffectCoordinate.advance_down_sampling."""
-        _advance_down_sampling(self, steps)
 
 
 class RandomEffectCoordinate:

@@ -243,7 +243,7 @@ def test_lane_chunking_matches_unchunked(mesh, monkeypatch):
     exact arithmetic — XLA tiles reductions differently per batch shape,
     and f32 reassociation noise amplifies through ~60 solver iterations —
     so the check is at convergence scale, not ULP scale."""
-    from photon_ml_tpu.game import coordinates as coord_mod
+    from photon_ml_tpu.game.coordinates import random_effect as coord_mod
 
     sparse_ds, _ = _sparse_re_data(n=2048, d=64, num_entities=30, seed=4)
     off = np.zeros(sparse_ds.num_rows, np.float32)
