@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
@@ -394,7 +395,23 @@ def evaluation_suite(
     group_ids_by_column: Optional[dict[str, Array]] = None,
     num_groups_by_column: Optional[dict[str, int]] = None,
 ) -> EvaluationResults:
-    """Run several evaluators over one score set (EvaluationSuite.scala)."""
+    """Run several evaluators over one score set (EvaluationSuite.scala).
+
+    Inputs are gathered to HOST first: callers hand in mesh-sharded device
+    arrays (device-resident validation scoring), and the metric math below
+    is eager sort/gather/cumsum — on a sharded array every such op is its
+    own little collective program, and XLA:CPU's 8-participant rendezvous
+    aborts the whole process if any participant thread is starved for 40 s
+    (observed under CPU oversubscription on the virtual mesh). The (n,)
+    pulls are a few hundred KB per CD step; the design win being protected
+    — features never re-staged host→device — is untouched.
+    """
+    scores = np.asarray(scores)
+    labels = np.asarray(labels)
+    weights = None if weights is None else np.asarray(weights)
+    if group_ids_by_column:
+        group_ids_by_column = {k: np.asarray(v)
+                               for k, v in group_ids_by_column.items()}
     metrics: dict[str, float] = {}
     for spec in specs:
         et = EvaluatorType.parse(spec)
