@@ -37,7 +37,7 @@ enable_compilation_cache()
 
 def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
                  d_global=32, feature_dtype="float32", cd_spans=(1, 3),
-                 min_of=3, log=lambda msg: None):
+                 min_of=3, max_samples=65536, log=lambda msg: None):
     """Build the MovieLens-shaped dataset and measure staged CD. Returns a
     dict of measurements (shared by this script and bench.py's gated line)."""
     import jax.numpy as jnp
@@ -80,12 +80,19 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
         ("fixed", lambda: FixedEffectCoordinate(
             ds, "global", losses.LOGISTIC, cfg, mesh,
             feature_dtype=feature_dtype)),
+        # max_samples caps ACTIVE rows per entity (reference
+        # numActiveDataPointsUpperBound — production GLMix practice):
+        # without it, Zipf-head entities land in power-of-two capacity
+        # classes up to 2^22 rows, and the padded bucket blocks inflate
+        # 19M real rows to ~78M padded (measured) — enough to exhaust one
+        # chip's HBM. Capped at 64k, a d=8 per-entity model loses nothing
+        # statistically and every row is still scored (passive semantics).
         ("per-user", lambda: RandomEffectCoordinate(
             ds, "userId", "re_userId", losses.LOGISTIC, cfg, mesh,
-            feature_dtype=feature_dtype)),
+            feature_dtype=feature_dtype, upper_bound=max_samples)),
         ("per-item", lambda: RandomEffectCoordinate(
             ds, "itemId", "re_itemId", losses.LOGISTIC, cfg, mesh,
-            feature_dtype=feature_dtype)),
+            feature_dtype=feature_dtype, upper_bound=max_samples)),
     ):
         t0 = time.perf_counter()
         coords[name] = builder()
@@ -136,6 +143,9 @@ def main():
     ap.add_argument("--items", type=int, default=27_000)
     ap.add_argument("--bf16", action="store_true",
                     help="bf16 feature storage (f32 accumulation)")
+    ap.add_argument("--max-samples", type=int, default=65536,
+                    help="active rows per entity "
+                         "(numActiveDataPointsUpperBound parity)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line instead of prose")
     args = ap.parse_args()
@@ -143,7 +153,8 @@ def main():
                            file=sys.stderr, flush=True))
     out = run_flagship(
         n_rows=args.rows, n_users=args.users, n_items=args.items,
-        feature_dtype="bfloat16" if args.bf16 else "float32", log=log)
+        feature_dtype="bfloat16" if args.bf16 else "float32",
+        max_samples=args.max_samples, log=log)
     if args.json:
         print(json.dumps(out))
     else:

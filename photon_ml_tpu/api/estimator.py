@@ -186,13 +186,11 @@ class GameEstimator:
         if not self.validation_evaluators:
             return None
         scores = model.score(dataset)
-        gids = {name: jnp.asarray(ids)
-                for name, ids in dataset.entity_ids.items()}
-        ngroups = dict(dataset.num_entities)
         return ev.evaluation_suite(
             self.validation_evaluators, scores,
-            jnp.asarray(dataset.response), jnp.asarray(dataset.weights),
-            group_ids_by_column=gids, num_groups_by_column=ngroups)
+            dataset.response, dataset.weights,
+            group_ids_by_column=dict(dataset.entity_ids),
+            num_groups_by_column=dict(dataset.num_entities))
 
     # -- fit ---------------------------------------------------------------
 

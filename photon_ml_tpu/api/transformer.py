@@ -103,12 +103,11 @@ class GameTransformer:
             raise ValueError("no evaluators configured")
         result = (self.transform_batched(data, batch_rows)
                   if batch_rows else self.transform(data))
-        gids = {name: jnp.asarray(ids)
-                for name, ids in data.entity_ids.items()}
+        # Host arrays pass through as-is — evaluation_suite does its own
+        # single-device placement (one transfer per array, no collectives).
         evaluation = ev.evaluation_suite(
-            self.evaluators, jnp.asarray(result.scores),
-            jnp.asarray(data.response), jnp.asarray(data.weights),
-            group_ids_by_column=gids,
+            self.evaluators, result.scores, data.response, data.weights,
+            group_ids_by_column=dict(data.entity_ids),
             num_groups_by_column=dict(data.num_entities))
         if as_mean:
             loss = losses_mod.loss_for_task(self.model.task)

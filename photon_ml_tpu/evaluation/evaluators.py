@@ -397,20 +397,26 @@ def evaluation_suite(
 ) -> EvaluationResults:
     """Run several evaluators over one score set (EvaluationSuite.scala).
 
-    Inputs are gathered to HOST first: callers hand in mesh-sharded device
-    arrays (device-resident validation scoring), and the metric math below
-    is eager sort/gather/cumsum — on a sharded array every such op is its
-    own little collective program, and XLA:CPU's 8-participant rendezvous
-    aborts the whole process if any participant thread is starved for 40 s
-    (observed under CPU oversubscription on the virtual mesh). The (n,)
-    pulls are a few hundred KB per CD step; the design win being protected
-    — features never re-staged host→device — is untouched.
+    Inputs are re-placed on ONE device first: callers hand in mesh-sharded
+    device arrays (device-resident validation scoring), and the metric math
+    below is eager sort/gather/cumsum — on a sharded array every such op is
+    its own little collective program, and XLA:CPU's 8-participant
+    rendezvous aborts the whole process if any participant thread is
+    starved for 40 s (observed under CPU oversubscription on the virtual
+    mesh). Gather to host, then device_put unsharded: each array crosses
+    the link exactly twice per evaluation (down + up) instead of once per
+    eager op, and every subsequent metric op is single-device — no
+    collectives, no rendezvous. The design win being protected — features
+    never re-staged host→device — is untouched.
     """
-    scores = np.asarray(scores)
-    labels = np.asarray(labels)
-    weights = None if weights is None else np.asarray(weights)
+    def _single_device(x):
+        return jax.device_put(np.asarray(x))
+
+    scores = _single_device(scores)
+    labels = _single_device(labels)
+    weights = None if weights is None else _single_device(weights)
     if group_ids_by_column:
-        group_ids_by_column = {k: np.asarray(v)
+        group_ids_by_column = {k: _single_device(v)
                                for k, v in group_ids_by_column.items()}
     metrics: dict[str, float] = {}
     for spec in specs:

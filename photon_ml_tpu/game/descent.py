@@ -23,6 +23,7 @@ import logging
 import time
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -122,6 +123,21 @@ def run(
     base = jnp.asarray(some.dataset.offsets)
     total = jnp.zeros((n,), jnp.float32)
 
+    # At large n, synchronize the dispatch stream once per coordinate
+    # update. JAX enqueues every fit/score program ahead of execution, and
+    # the runtime holds each queued program's output and scratch buffers
+    # from ENQUEUE time — a full un-synced descent sweep at 19M rows
+    # reproducibly exhausts HBM even though the same programs run fine
+    # back-to-back with a barrier between them (and the resident arrays
+    # total only a few GB). The barrier costs one tunnel round trip per
+    # coordinate update, so it is gated to sizes where scratch stacking
+    # can plausibly matter; small configs keep full dispatch pipelining.
+    sync_updates = n >= (1 << 22)
+
+    def _sync(x):
+        if sync_updates:
+            jax.block_until_ready(x)
+
     # Initialize models (warm starts / checkpoint state) and their scores.
     for cid in seq:
         coord = coordinates[cid]
@@ -137,6 +153,7 @@ def run(
         s = coord.score(models[cid])
         scores[cid] = s
         total = total + s
+        _sync(total)
 
     emitter = ev_mod.default_emitter
     emitter.emit(ev_mod.TrainingStart(
@@ -160,6 +177,7 @@ def run(
             total = total + new_scores - scores[cid]
             scores[cid] = new_scores
             models[cid] = model
+            _sync(total)
             elapsed = time.monotonic() - t0
             rec = {"iteration": it, "coordinate": cid,
                    "train_seconds": elapsed}
