@@ -15,10 +15,12 @@ real download. The run reports:
 
     python dev-scripts/flagship_movielens.py [--rows 20000000] [--json]
 
-Needs ~6 GB host RAM for generation; device arrays fit comfortably in one
-v5e chip's HBM (global block 2.6 GB f32; use --bf16 to halve it). The same
-config is available in bench.py behind PML_BENCH_20M=1 as
-``game_cd_iteration_seconds_20m``.
+Needs ~6 GB host RAM for generation. At the full 20M rows, --bf16 is
+REQUIRED on one 16 GB chip: the f32 run exhausts HBM during the first
+descent even with the active-row cap (measured 2026-07-31; the resident
+set roughly doubles and the solver's per-class scratch follows), while
+bf16 completes with headroom. The same config is available in bench.py
+behind PML_BENCH_20M=1 as ``game_cd_iteration_seconds_20m`` (bf16).
 """
 import argparse
 import json
