@@ -561,15 +561,19 @@ def _staging_in_subprocess():
     interferes with the host-side sorts. A subprocess gives the host
     benchmark the clean environment its number is supposed to describe."""
     import subprocess
+    import tempfile
 
     # stderr passes through: the child runs ~15 s with no other progress
-    # marker, and on failure its traceback must reach the bench log.
-    out = subprocess.run(
-        [sys.executable, "-c",
-         "import json, bench; print(json.dumps(bench.bench_host_staging()))"],
-        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
-            os.path.abspath(__file__)), check=True)
-    return json.loads(out.stdout.strip().splitlines()[-1])
+    # marker, and on failure its traceback must reach the bench log. The
+    # result comes back via a temp file, not stdout — stray prints from
+    # the child's import chain must not corrupt the JSON.
+    with tempfile.NamedTemporaryFile(mode="r", suffix=".json") as f:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import json, sys, bench; json.dump(bench.bench_host_staging(),"
+             " open(sys.argv[1], 'w'))", f.name],
+            cwd=os.path.dirname(os.path.abspath(__file__)), check=True)
+        return json.load(f)
 
 
 def main():

@@ -23,7 +23,9 @@ from __future__ import annotations
 import os
 import sys
 
-REFERENCE = "/root/reference"
+# Overridable so CI runners with the mount elsewhere still check the
+# right path; the resolved path is printed so a wrong one is visible.
+REFERENCE = os.environ.get("PML_REFERENCE_DIR", "/root/reference")
 VERIFIED_DOC = os.path.join(os.path.dirname(__file__), "..", "docs",
                             "REFERENCE_VERIFIED.md")
 
@@ -40,15 +42,15 @@ def reference_file_count() -> int:
 def main() -> int:
     n = reference_file_count()
     if n == 0:
-        print("reference-mount tripwire: /root/reference is empty "
+        print(f"reference-mount tripwire: {REFERENCE} is empty "
               "(status quo — parity remains vs SURVEY.md reconstruction).")
         return 0
     if os.path.exists(VERIFIED_DOC):
-        print(f"reference-mount tripwire: mount has {n} files and "
+        print(f"reference-mount tripwire: {REFERENCE} has {n} files and "
               "docs/REFERENCE_VERIFIED.md exists — verified, OK.")
         return 0
     print(
-        f"reference-mount tripwire: /root/reference now contains {n} files\n"
+        f"reference-mount tripwire: {REFERENCE} now contains {n} files\n"
         "but docs/REFERENCE_VERIFIED.md does not exist.\n"
         "\n"
         "ACTION REQUIRED (SURVEY.md first-action instruction):\n"

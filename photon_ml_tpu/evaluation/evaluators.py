@@ -397,20 +397,44 @@ def evaluation_suite(
 ) -> EvaluationResults:
     """Run several evaluators over one score set (EvaluationSuite.scala).
 
-    Inputs are re-placed on ONE device first: callers hand in mesh-sharded
-    device arrays (device-resident validation scoring), and the metric math
-    below is eager sort/gather/cumsum — on a sharded array every such op is
-    its own little collective program, and XLA:CPU's 8-participant
-    rendezvous aborts the whole process if any participant thread is
-    starved for 40 s (observed under CPU oversubscription on the virtual
-    mesh). Gather to host, then device_put unsharded: each array crosses
-    the link exactly twice per evaluation (down + up) instead of once per
-    eager op, and every subsequent metric op is single-device — no
-    collectives, no rendezvous. The design win being protected — features
-    never re-staged host→device — is untouched.
+    Multi-device inputs are re-placed on ONE device first: callers hand in
+    mesh-sharded device arrays (device-resident validation scoring), and
+    the metric math below is eager sort/gather/cumsum — on a sharded array
+    every such op is its own little collective program, and XLA:CPU's
+    8-participant rendezvous aborts the whole process if any participant
+    thread is starved for 40 s (observed under CPU oversubscription on the
+    virtual mesh). Gather to host, then device_put unsharded: each array
+    crosses the link exactly twice per evaluation (down + up) instead of
+    once per eager op, and every subsequent metric op is single-device —
+    no collectives, no rendezvous. The design win being protected —
+    features never re-staged host→device — is untouched.
+
+    Inputs that are already host NumPy or single-device jax.Arrays skip
+    the round trip entirely. Multi-host (DCN) callers must hand in
+    addressable or fully-replicated arrays: a sharded global array whose
+    shards live on other processes cannot be gathered here (np.asarray on
+    it raises), and the error below says so instead of crashing opaquely.
     """
+    target = jax.devices()[0]
+
     def _single_device(x):
-        return jax.device_put(np.asarray(x))
+        if isinstance(x, np.ndarray):
+            return jax.device_put(x, target)
+        if isinstance(x, jax.Array):
+            dset = x.sharding.device_set
+            if len(dset) == 1:
+                # Already single-device: skip the host round trip. Re-place
+                # only if committed elsewhere (device-to-device, no host) —
+                # mixed-device inputs would crash the eager metric math.
+                return (x if next(iter(dset)) == target
+                        else jax.device_put(x, target))
+            if not (x.is_fully_addressable or x.is_fully_replicated):
+                raise ValueError(
+                    "evaluation_suite needs addressable or fully-replicated "
+                    "arrays; got a multi-process sharded array. Multi-host "
+                    "callers must all-gather (or replicate) scores/labels "
+                    "before evaluating.")
+        return jax.device_put(np.asarray(x), target)
 
     scores = _single_device(scores)
     labels = _single_device(labels)

@@ -41,6 +41,10 @@ class CoordinateDescentConfig:
 
     update_sequence: list[str]
     iterations: int = 1
+    # Per-update dispatch-stream barrier: None = auto (estimate the
+    # enqueue-held scratch in bytes and sync when it could plausibly
+    # exhaust HBM), True/False = force. See the gate in run().
+    sync_updates: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -123,16 +127,32 @@ def run(
     base = jnp.asarray(some.dataset.offsets)
     total = jnp.zeros((n,), jnp.float32)
 
-    # At large n, synchronize the dispatch stream once per coordinate
+    # At scale, synchronize the dispatch stream once per coordinate
     # update. JAX enqueues every fit/score program ahead of execution, and
     # the runtime holds each queued program's output and scratch buffers
     # from ENQUEUE time — a full un-synced descent sweep at 19M rows
     # reproducibly exhausts HBM even though the same programs run fine
     # back-to-back with a barrier between them (and the resident arrays
     # total only a few GB). The barrier costs one tunnel round trip per
-    # coordinate update, so it is gated to sizes where scratch stacking
-    # can plausibly matter; small configs keep full dispatch pipelining.
-    sync_updates = n >= (1 << 22)
+    # coordinate update, so it is gated on an ESTIMATE of the scratch a
+    # fully un-synced descent would hold: per queued update, O(n) score
+    # outputs plus working buffers scaling with the coordinate's feature
+    # dim (capped — sparse/tiled formulations never materialize n×d), for
+    # every update the whole descent enqueues. Small configs keep full
+    # dispatch pipelining; config.sync_updates forces either way.
+    if config.sync_updates is not None:
+        sync_updates = bool(config.sync_updates)
+    else:
+        # The byte estimate only ever ADDS protection beyond the empirical
+        # n >= 4.2M row floor (where the 19M OOM was reproduced): the
+        # estimate undercounts RE training scratch, so it must not be able
+        # to turn the barrier OFF in the regime the floor covers.
+        est_bytes = 0
+        for cid in seq:
+            dim = int(getattr(coordinates[cid], "dim", 8) or 8)
+            est_bytes += n * 4 * (2 + min(dim, 4096))
+        est_bytes *= max(1, config.iterations)
+        sync_updates = n >= (1 << 22) or est_bytes >= (1 << 30)
 
     def _sync(x):
         if sync_updates:

@@ -261,3 +261,30 @@ def test_evaluate_passes_weights_to_grouped(rng):
                                     jnp.asarray(weights)))
     assert abs(wtd - ref) < 1e-6
     assert wtd != unw  # the weights actually changed the statistic
+
+
+def test_evaluation_suite_input_placements_agree(rng):
+    """evaluation_suite gives identical metrics for host NumPy,
+    single-device, other-device-committed, and mesh-sharded inputs (the
+    single-device fast path must not skip colocation)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    n = 1024
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.float32)
+    base = ev.evaluation_suite(["AUC", "RMSE"], scores, labels)
+
+    variants = {
+        "single_device": (jnp.asarray(scores), jnp.asarray(labels)),
+        "other_device": (jax.device_put(scores, jax.devices()[-1]), labels),
+    }
+    mesh = make_mesh()
+    sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    variants["mesh_sharded"] = (jax.device_put(scores, sh),
+                                jax.device_put(labels, sh))
+    for name, (s, y) in variants.items():
+        out = ev.evaluation_suite(["AUC", "RMSE"], s, y)
+        for k, v in base.metrics.items():
+            assert abs(out.metrics[k] - v) < 1e-5, (name, k)

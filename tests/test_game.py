@@ -299,3 +299,19 @@ def test_fixed_effect_with_normalization_scores_raw_space(rng, mesh):
     # And training with normalization on ill-scaled features actually works:
     a = float(ev.auc(jnp.asarray(s_model), jnp.asarray(ds.response)))
     assert a > 0.6
+
+
+def test_descent_sync_updates_knob_is_behavior_neutral(rng, mesh):
+    """config.sync_updates (auto/forced-on/forced-off) changes only the
+    dispatch-stream barrier, never the trained model."""
+    ds = _tiny_game(rng, n=600)
+    coords = _build_coordinates(ds, mesh)
+    outs = []
+    for sync in (None, True, False):
+        cfg = descent.CoordinateDescentConfig(["fixed", "per-user"],
+                                              iterations=2,
+                                              sync_updates=sync)
+        model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, coords, cfg)
+        outs.append(np.asarray(model.models["fixed"].coefficients.means))
+    assert np.allclose(outs[0], outs[1])
+    assert np.allclose(outs[0], outs[2])
