@@ -1,0 +1,226 @@
+"""Criteo's row axis on one chip: a streamed n=100M GAME fit.
+
+Round-4 verdict item 2 (SURVEY §6 config 5, §7 step 9): d=1M and E=1M
+were demonstrated, but the largest committed row axis was 10–20M.
+"1TB-scale" means n in the hundreds of millions, STREAMED — no formulation
+that materializes an O(n × anything) device block can hold it. This run:
+
+  * generates a Criteo-shaped synthetic in fixed-size chunks (planted
+    fixed-effect weights over d=1M Zipf-popular columns + planted
+    per-entity effects over E=1M entity feature pools);
+  * stages each chunk once into the host-resident hybrid hot/cold layout
+    (ops/streaming_sparse.build_chunked — peak host beyond the staged
+    output is ONE chunk);
+  * trains block coordinate descent with the row-STREAMED fixed effect
+    (every L-BFGS value/gradient double-buffers chunks through the chip —
+    the TPU-native DistributedGLMLossFunction treeAggregate pass) plus the
+    device-resident sparse random effect (per-entity subspace buckets);
+  * reports staging seconds, per-sweep seconds, train AUC vs the planted
+    truth, and the host's peak RSS (the flat-memory claim, measured).
+
+    python dev-scripts/flagship_criteo_stream.py \
+        [--rows 100000000] [--chunk-rows 10000000] [--json]
+
+Defaults need ~35 GB host RAM (staged chunks + RE arrays) and one 16 GB
+chip (bf16 feature storage on both coordinates). Smaller sanity run:
+``--rows 2000000 --chunk-rows 500000 --entities 20000``.
+"""
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def _rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
+                      nnz_fe=8, nnz_re=4, chunk_rows=10_000_000,
+                      hot_block_gb=1.25, pin_gb=4.0, iterations=2,
+                      seed=11, log=lambda m: None):
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+    from photon_ml_tpu.data.sparse import SparseBatch
+    from photon_ml_tpu.evaluation.evaluators import auc
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.coordinates import (
+        RandomEffectCoordinate, StreamingSparseFixedEffectCoordinate)
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    n_chunks = (n_rows + chunk_rows - 1) // chunk_rows
+    rng0 = np.random.default_rng(seed)
+
+    # Planted truths (small: O(d) + O(E)): fixed-effect weights over the
+    # full column space; per-entity coefficients over 16-column pools.
+    w_true = (rng0.normal(size=d) * 0.7).astype(np.float32)
+    pools = rng0.integers(0, d, size=(n_entities, 16)).astype(np.int32)
+    beta = (rng0.normal(size=(n_entities, 16)) * 0.8).astype(np.float32)
+
+    # Zipf-ish fixed-effect column popularity via inverse-CDF sampling
+    # (u^a maps uniforms onto a power-law rank distribution).
+    zipf_a = 6.0
+
+    # RE arrays accumulate across chunks (O(n) host, written once).
+    re_idx = np.empty((n_rows, nnz_re), np.int32)
+    re_val = np.empty((n_rows, nnz_re), np.float32)
+    ids_all = np.empty((n_rows,), np.int32)
+    y_all = np.empty((n_rows,), np.float32)
+
+    def gen_chunks():
+        for c in range(n_chunks):
+            rng = np.random.default_rng(seed + 1000 + c)
+            lo = c * chunk_rows
+            hi = min(lo + chunk_rows, n_rows)
+            m = hi - lo
+            # Fixed-effect features: Zipf-popular columns, dedup via the
+            # pad slot (index d, value 0) like every sparse source here.
+            u = rng.random((m, nnz_fe))
+            fe_idx = np.minimum((d * u ** zipf_a).astype(np.int64),
+                                d - 1).astype(np.int32)
+            fe_idx.sort(axis=1)
+            dup = np.zeros_like(fe_idx, bool)
+            dup[:, 1:] = fe_idx[:, 1:] == fe_idx[:, :-1]
+            fe_val = rng.normal(size=(m, nnz_fe)).astype(np.float32)
+            margin = np.einsum("ij,ij->i", np.where(dup, 0.0, fe_val),
+                               w_true[fe_idx]).astype(np.float32)
+            fe_idx[dup] = d
+            fe_val[dup] = 0.0
+            # Random-effect features from each row's entity pool.
+            ids = rng.integers(0, n_entities, size=m).astype(np.int32)
+            slot = rng.integers(0, 16, size=(m, nnz_re))
+            ridx = np.sort(pools[ids[:, None], slot], axis=1)
+            rdup = np.zeros_like(ridx, bool)
+            rdup[:, 1:] = ridx[:, 1:] == ridx[:, :-1]
+            rval = rng.normal(size=(m, nnz_re)).astype(np.float32)
+            margin += np.einsum(
+                "ij,ij->i", np.where(rdup, 0.0, rval),
+                beta[ids[:, None], slot]).astype(np.float32)
+            ridx[rdup] = d
+            rval[rdup] = 0.0
+            y = (rng.random(m) < 1.0 / (1.0 + np.exp(-margin))).astype(
+                np.float32)
+            re_idx[lo:hi], re_val[lo:hi] = ridx, rval
+            ids_all[lo:hi], y_all[lo:hi] = ids, y
+            yield SparseBatch(
+                indices=fe_idx, values=fe_val, labels=y,
+                weights=np.ones(m, np.float32),
+                offsets=np.zeros(m, np.float32),  # streaming contract
+                num_features=d)
+
+    num_hot = ss.plan_num_hot(chunk_rows, int(hot_block_gb * 2 ** 30),
+                              jnp.bfloat16)
+    log(f"{n_rows:,} rows in {n_chunks} chunks; num_hot={num_hot}")
+    t0 = time.perf_counter()
+    chunked = ss.build_chunked(gen_chunks(), d, chunk_rows,
+                               num_hot=num_hot,
+                               feature_dtype=jnp.bfloat16, log=log)
+    fe_staging = time.perf_counter() - t0
+    log(f"FE chunk staging {fe_staging:.1f}s; host peak {_rss_gb():.1f} GB")
+
+    ds = GameDataset(
+        response=y_all, offsets=np.zeros(n_rows, np.float32),
+        weights=np.ones(n_rows, np.float32),
+        feature_shards={"re": SparseShard(re_idx, re_val, d)},
+        entity_ids={"userId": ids_all},
+        num_entities={"userId": n_entities},
+        intercept_index={})
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=12, tolerance=1e-6),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+
+    # Pin as many leading chunks as the HBM budget allows: each pinned
+    # chunk is stream traffic saved on EVERY objective evaluation.
+    chunk_bytes = sum(
+        a.nbytes for a in jax.tree.leaves(chunked.chunks[0]))
+    pin = min(chunked.num_chunks,
+              int(pin_gb * 2 ** 30 / max(chunk_bytes, 1)))
+    log(f"chunk ≈ {chunk_bytes / 2**30:.2f} GiB on device; pinning {pin} "
+        f"of {chunked.num_chunks} chunks (budget {pin_gb} GiB)")
+    fe_coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, cfg,
+        pin_device_chunks=pin,
+        log=lambda m: log(f"  [fe-lbfgs] {m}"))
+    t0 = time.perf_counter()
+    re_coord = RandomEffectCoordinate(
+        ds, "userId", "re", losses.LOGISTIC, cfg, make_mesh(),
+        lower_bound=2, upper_bound=65536, feature_dtype="bfloat16")
+    re_staging = time.perf_counter() - t0
+    log(f"RE staging {re_staging:.1f}s; host peak {_rss_gb():.1f} GB")
+
+    coords = {"fixed": fe_coord, "per-user": re_coord}
+    t0 = time.perf_counter()
+    model, hist = descent.run(
+        TaskType.LOGISTIC_REGRESSION, coords,
+        descent.CoordinateDescentConfig(["fixed", "per-user"],
+                                        iterations=iterations))
+    descent_s = time.perf_counter() - t0
+    per_update = {r["coordinate"]: r["train_seconds"]
+                  for r in hist.records[-2:]}  # last sweep's updates
+    log(f"{iterations}-sweep descent {descent_s:.1f}s "
+        f"(last sweep per-coordinate {per_update})")
+
+    log("scoring (streamed FE + RE)")
+    scores = fe_coord.score(model.models["fixed"]) + \
+        re_coord.score(model.models["per-user"])
+    train_auc = float(auc(scores, jnp.asarray(y_all)))
+    log(f"train AUC vs planted effects: {train_auc:.4f}; "
+        f"host peak {_rss_gb():.1f} GB")
+    return {
+        "criteo_stream_rows": n_rows,
+        "criteo_stream_chunks": n_chunks,
+        "criteo_stream_fe_staging_seconds": round(fe_staging, 1),
+        "criteo_stream_re_staging_seconds": round(re_staging, 1),
+        "criteo_stream_descent_seconds": round(descent_s, 1),
+        "criteo_stream_last_sweep_seconds": {
+            k: round(v, 1) for k, v in per_update.items()},
+        "criteo_stream_train_auc": round(train_auc, 4),
+        "criteo_stream_host_peak_gb": round(_rss_gb(), 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000_000)
+    ap.add_argument("--features", type=int, default=1_000_000)
+    ap.add_argument("--entities", type=int, default=1_000_000)
+    ap.add_argument("--chunk-rows", type=int, default=10_000_000)
+    ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    def log(m):
+        print(f"[criteo-stream {time.strftime('%H:%M:%S')}] {m}",
+              file=sys.stderr, flush=True)
+
+    out = run_criteo_stream(
+        n_rows=args.rows, d=args.features, n_entities=args.entities,
+        chunk_rows=args.chunk_rows, iterations=args.iterations, log=log)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,9 +35,12 @@ from photon_ml_tpu.game.coordinates.sparse_fixed import \
     SparseFixedEffectCoordinate
 from photon_ml_tpu.game.coordinates.random_effect import \
     RandomEffectCoordinate
+from photon_ml_tpu.game.coordinates.streaming_fixed import \
+    StreamingSparseFixedEffectCoordinate
 
 __all__ = [
     "FixedEffectCoordinate",
     "SparseFixedEffectCoordinate",
     "RandomEffectCoordinate",
+    "StreamingSparseFixedEffectCoordinate",
 ]

@@ -1,0 +1,131 @@
+"""Row-streamed sparse fixed-effect coordinate: the Criteo row axis.
+
+Reference parity: photon-api ``FixedEffectCoordinate`` +
+``DistributedGLMLossFunction`` — the fixed-effect fit is a driver-loop
+optimization whose every value/gradient is one pass over RDD partitions,
+so n never has to fit on one executor. Here the partitions are host-
+resident hybrid chunks (``ops/streaming_sparse.ChunkedHybrid``) streamed
+through the chip per evaluation with double-buffered prefetch, and the
+driver loop is the host-driven L-BFGS (``optim/streaming.py``). Use this
+coordinate when the staged layout exceeds HBM (n in the hundreds of
+millions on one 16 GB chip); the device-resident
+``SparseFixedEffectCoordinate`` is strictly faster whenever it fits.
+
+Streaming contract: the chunks must be staged with ZERO offsets — in
+coordinate descent the full residual (base offsets + other coordinates'
+scores) arrives as the ``offsets`` argument of ``train_model``, and
+``score`` must return pure wᵀx margins.
+
+Not supported at streaming scale (all raise with the reason): L1/OWL-QN
+(the orthant bookkeeping needs the compiled optimizer), normalization
+(Criteo-style sparse binary features train unnormalized; in-kernel factor
+application to the chunk stream is a straightforward extension),
+down-sampling, and SIMPLE/FULL variances.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.models import FixedEffectModel
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops import streaming_sparse as ss
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
+                                         VarianceComputationType)
+from photon_ml_tpu.optim.regularization import intercept_mask, with_l2
+from photon_ml_tpu.optim.streaming import minimize_streaming
+
+Array = jax.Array
+
+
+class StreamingSparseFixedEffectCoordinate:
+    """Drop-in coordinate for ``game/descent.run`` over a chunk stream."""
+
+    def __init__(
+        self,
+        dataset,
+        chunked: ss.ChunkedHybrid,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        intercept_index: Optional[int] = None,
+        prefetch_depth: int = 2,
+        pin_device_chunks: int = 0,
+        log=lambda m: None,
+    ):
+        if chunked.num_rows != dataset.num_rows:
+            raise ValueError(
+                f"chunk stream has {chunked.num_rows} rows, dataset "
+                f"{dataset.num_rows}")
+        if config.regularization.l1_weight() != 0.0:
+            raise ValueError(
+                "L1/OWL-QN is not supported on the streaming path (the "
+                "orthant bookkeeping lives in the compiled optimizer); "
+                "use L2, or the device-resident SparseFixedEffectCoordinate")
+        if config.down_sampling_rate < 1.0:
+            raise ValueError("down-sampling is not supported on the "
+                             "streaming path")
+        if VarianceComputationType(config.variance_computation) != \
+                VarianceComputationType.NONE:
+            raise ValueError(
+                "variance computation is not supported on the streaming "
+                "path (a diagonal-Hessian stream pass is a straightforward "
+                "extension if needed)")
+        self.dataset = dataset
+        self.chunked = chunked
+        self.shard_id = shard_id
+        self.loss = loss
+        self.config = config
+        self.intercept_index = intercept_index
+        self._log = log
+        # Spare-HBM chunk pinning: the caller sizes this against whatever
+        # else the fit keeps resident (e.g. RE bucket blocks).
+        self._pinned = ss.pin_chunks(chunked, pin_device_chunks)
+        self._vg = ss.make_value_and_gradient(
+            loss, chunked, prefetch_depth=prefetch_depth,
+            pinned=self._pinned)
+        self._prefetch_depth = prefetch_depth
+        self._padded_n = chunked.num_chunks * chunked.chunk_rows
+
+    @property
+    def dim(self) -> int:
+        return self.chunked.dim
+
+    def _pad_offsets(self, offsets: Array) -> Array:
+        offsets = jnp.asarray(offsets, jnp.float32)
+        pad = self._padded_n - offsets.shape[0]
+        if pad:
+            offsets = jnp.concatenate(
+                [offsets, jnp.zeros((pad,), jnp.float32)])
+        return offsets
+
+    def train_model(
+        self,
+        offsets: Array,
+        initial: Optional[FixedEffectModel] = None,
+    ) -> FixedEffectModel:
+        w0 = (initial.coefficients.means if initial is not None
+              else jnp.zeros((self.dim,), jnp.float32))
+        off = self._pad_offsets(offsets)
+        mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
+        vg = with_l2(lambda w: self._vg(w, off),
+                     self.config.regularization.l2_weight(), mask)
+        result = minimize_streaming(vg, w0, self.config.optimizer,
+                                    log=self._log)
+        return FixedEffectModel(shard_id=self.shard_id,
+                                coefficients=Coefficients(result.w))
+
+    def score(self, model: FixedEffectModel) -> Array:
+        """(n,) wᵀx margins, streamed (chunks staged with zero offsets)."""
+        return ss.margins_chunked(self.chunked, model.coefficients.means,
+                                  prefetch_depth=self._prefetch_depth,
+                                  pinned=self._pinned)
+
+    def initial_model(self) -> FixedEffectModel:
+        return FixedEffectModel(shard_id=self.shard_id,
+                                coefficients=Coefficients.zeros(self.dim))

@@ -106,6 +106,7 @@ def build_hybrid(
     hot_threshold: Optional[int] = None,
     max_hot: int = 4096,
     feature_dtype=jnp.float32,
+    device: bool = True,
 ) -> HybridSparseBatch:
     """Stage an ELL SparseBatch into the hybrid layout (host-side, once).
 
@@ -198,15 +199,20 @@ def build_hybrid(
         import ml_dtypes
 
         X_hot = X_hot.astype(ml_dtypes.bfloat16)
+    # device=False keeps the leaves as host numpy (a valid pytree): the
+    # row-streaming path (ops/streaming_sparse.py) holds many chunks on
+    # host and device_puts them per objective pass instead of pinning
+    # them all in HBM.
+    put = jnp.asarray if device else (lambda a: a)
     return HybridSparseBatch(
-        X_hot=jnp.asarray(X_hot).astype(feature_dtype),
-        cold_rowids=tuple(jnp.asarray(a) for a in rowids_cls),
-        cold_vals=tuple(jnp.asarray(a) for a in vals_cls),
-        labels=jnp.asarray(np.asarray(batch.labels)),
-        weights=jnp.asarray(np.asarray(batch.weights)),
-        offsets=jnp.asarray(np.asarray(batch.offsets)),
-        perm=jnp.asarray(order_desc),
-        inv_perm=jnp.asarray(inv_perm),
+        X_hot=put(X_hot),
+        cold_rowids=tuple(put(a) for a in rowids_cls),
+        cold_vals=tuple(put(a) for a in vals_cls),
+        labels=put(np.asarray(batch.labels)),
+        weights=put(np.asarray(batch.weights)),
+        offsets=put(np.asarray(batch.offsets)),
+        perm=put(order_desc),
+        inv_perm=put(inv_perm),
         num_features=d,
         num_hot=k,
         class_starts=tuple(class_starts),

@@ -1,0 +1,523 @@
+"""Row-streamed sparse GLM aggregates: the Criteo row axis on one chip.
+
+Reference parity: photon-api ``DistributedGLMLossFunction`` computes each
+value/gradient as one Spark pass over RDD partitions (``treeAggregate``) —
+the n axis never has to fit on any single executor. This module is the
+TPU-native equivalent: the example rows live on HOST in fixed-size chunks
+staged into a hot-dense/cold-class layout (the ``ops/hybrid_sparse.py``
+design), and every objective evaluation streams them through the chip
+with double-buffered host→device prefetch, accumulating ``(value,
+gradient)`` in f32 on device. HBM holds at most ``prefetch_depth`` chunks
+plus the accumulators, so n is bounded by host RAM (or disk, via the
+chunk iterator), not by the 16 GB of one chip.
+
+**Canonical chunk structure — one compiled program for the whole stream.**
+Each jit specialization is a multi-minute remote compile in this
+environment, so chunks must share ONE program. Chunk layouts are
+therefore canonicalized:
+
+  * the hot block is EXACTLY ``num_hot`` columns (the chunk's top-k by
+    count — the hot/cold split is a free execution choice, any split is
+    the same objective);
+  * cold columns group into power-of-two count classes as in
+    hybrid_sparse, and each class's column count is padded UP to a power
+    of two with dummy columns (all-pad rowids — inert);
+  * dummy hot/cold slots map to an EXTENDED permuted space: ``perm`` is
+    (D',) with dummies pointing at the sentinel column ``d`` (so
+    ``w_pad[perm]`` reads 0 for them), and ``inv`` maps every original
+    column to its extended slot (absent columns → slot D', a reserved
+    zero) so gradients come back to original space by pure GATHER — no
+    d-sized scatter per chunk.
+
+Chunks are iid rows of one distribution, so the quantized shapes collide
+across chunks with overwhelming probability; a chunk that still differs
+merely triggers one extra compile (logged by ``build_chunked``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.ops.hybrid_sparse import _hot_matvec, _hot_rmatvec
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CanonicalChunk:
+    """One chunk in the canonical hot/cold layout (leaves may be host
+    numpy — device placement happens at stream time)."""
+
+    X_hot: Array  # (n, H)
+    cold_rowids: tuple[Array, ...]  # per class: (C_pad, L) int32, pad == n
+    cold_vals: tuple[Array, ...]  # per class: (C_pad, L) f32, pad == 0
+    labels: Array  # (n,)
+    weights: Array  # (n,); 0 marks pad rows of a short final chunk
+    offsets: Array  # (n,)
+    perm: Array  # (D',) int32: extended slot -> original col (dummy == d)
+    inv: Array  # (d,) int32: original col -> extended slot (absent == D')
+    num_features: int = dataclasses.field(metadata=dict(static=True))
+    num_hot: int = dataclasses.field(metadata=dict(static=True))
+    # Extended-space offset of each class (0 == first slot after hot).
+    class_starts: tuple[int, ...] = dataclasses.field(
+        metadata=dict(static=True))
+
+    @property
+    def num_rows(self) -> int:
+        return self.labels.shape[0]
+
+    def structure(self):
+        """Shape signature — equal signatures share one compiled program."""
+        return (self.X_hot.shape, self.num_hot,
+                tuple(r.shape for r in self.cold_rowids),
+                self.class_starts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedHybrid:
+    """Host-resident chunked layout of one logical (n, d) batch.
+
+    Equal row counts per chunk (short final chunk padded with weight-0
+    rows — inert in every aggregate; their margins are dropped by
+    ``margins_chunked``). ``num_rows`` is the REAL row count.
+    """
+
+    chunks: tuple[CanonicalChunk, ...]
+    num_rows: int
+    chunk_rows: int
+
+    @property
+    def dim(self) -> int:
+        return self.chunks[0].num_features
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks)
+
+
+def plan_num_hot(chunk_rows: int, hot_block_bytes: int,
+                 feature_dtype) -> int:
+    """Hot-block width that fits the byte budget: at streaming scale the
+    binding constraint is HBM (block bytes = chunk_rows × H × dtype),
+    not the throughput-optimal split of hybrid_sparse."""
+    bytes_per = 2 if feature_dtype == jnp.bfloat16 else 4
+    return max(8, int(hot_block_bytes) // (chunk_rows * bytes_per))
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def _build_canonical(raw, d: int, num_hot: int, feature_dtype,
+                     min_class_cols: int = 8) -> CanonicalChunk:
+    """Stage one ELL chunk into the canonical layout (host numpy)."""
+    indices = np.asarray(raw.indices)
+    values = np.asarray(raw.values)
+    n = indices.shape[0]
+
+    flat_col = indices.reshape(-1)
+    flat_row = np.repeat(np.arange(n, dtype=np.int32), indices.shape[1])
+    flat_val = values.reshape(-1)
+    live = (flat_col < d) & (flat_val != 0.0)
+    counts = np.bincount(flat_col[live], minlength=d)
+    order_desc = np.argsort(-counts, kind="stable").astype(np.int32)
+
+    H = num_hot
+    hot_cols = order_desc[:H]  # top-H by count (some may be count 0)
+    hot_live = counts[hot_cols] > 0
+
+    # inv_new: original col -> extended slot (filled as we lay out).
+    slot_of = np.full(d + 1, -1, np.int64)
+    slot_of[hot_cols] = np.arange(H)
+
+    new_col = slot_of[np.minimum(flat_col, d)]
+    X_hot = np.zeros((n, H), np.float32)
+    hot_sel = live & (new_col >= 0)
+    X_hot[flat_row[hot_sel], new_col[hot_sel]] = flat_val[hot_sel]
+
+    # Cold columns: count-desc after the hot set, pow-2 count classes.
+    cold_cols = order_desc[H:]
+    cold_counts = counts[cold_cols]
+    present = int((cold_counts > 0).sum())
+    cold_cols = cold_cols[:present]
+    cold_counts = cold_counts[:present]
+
+    cold_sel = live & (new_col < 0)
+    c_col = flat_col[cold_sel]
+    c_row = flat_row[cold_sel]
+    c_val = flat_val[cold_sel]
+    # Column-contiguous cold stream (count-desc order of cold columns).
+    rank_of = np.full(d, np.iinfo(np.int64).max, np.int64)
+    rank_of[cold_cols] = np.arange(present)
+    order = np.argsort(rank_of[c_col], kind="stable")
+    c_row, c_val = c_row[order], c_val[order]
+    col_start = np.concatenate(
+        [[0], np.cumsum(cold_counts)[:-1]]).astype(np.int64)
+
+    rowids_cls: list[np.ndarray] = []
+    vals_cls: list[np.ndarray] = []
+    class_starts: list[int] = []
+    perm_cold: list[np.ndarray] = []
+    ext_off = 0
+    if present:
+        cls = np.ceil(np.log2(np.maximum(cold_counts, 1))).astype(np.int64)
+        for kk in np.unique(cls)[::-1]:
+            sel = np.flatnonzero(cls == kk)
+            L = 1 << int(kk)
+            C = sel.size
+            C_pad = max(_next_pow2(C), min_class_cols)
+            rp = np.full((C_pad, L), n, np.int32)
+            vp = np.zeros((C_pad, L), np.float32)
+            starts = col_start[sel]
+            cnts = cold_counts[sel].astype(np.int64)
+            total = int(cnts.sum())
+            colpos = np.arange(total) - np.repeat(
+                np.concatenate([[0], np.cumsum(cnts)[:-1]]), cnts)
+            src = np.repeat(starts, cnts) + colpos
+            crow = np.repeat(np.arange(C, dtype=np.int64), cnts)
+            rp[crow, colpos] = c_row[src]
+            vp[crow, colpos] = c_val[src]
+            rowids_cls.append(rp)
+            vals_cls.append(vp)
+            class_starts.append(ext_off)
+            p = np.full(C_pad, d, np.int32)  # dummies -> sentinel col d
+            p[:C] = cold_cols[sel]
+            perm_cold.append(p)
+            slot_of[cold_cols[sel]] = H + ext_off + np.arange(C)
+            ext_off += C_pad
+
+    hot_perm = np.where(hot_live, hot_cols, d).astype(np.int32)
+    perm = np.concatenate([hot_perm] + perm_cold) if perm_cold \
+        else hot_perm
+    D = perm.shape[0]
+    inv = np.where(slot_of[:d] >= 0, slot_of[:d], D).astype(np.int32)
+
+    if feature_dtype == jnp.bfloat16:
+        # Host-side cast halves the host→device stream — which IS the
+        # steady-state cost of every streamed objective evaluation.
+        # Cold values are storage like the hot block (products upcast to
+        # f32 in-kernel), so they follow the same dtype contract.
+        import ml_dtypes
+
+        X_hot = X_hot.astype(ml_dtypes.bfloat16)
+        vals_cls = [v.astype(ml_dtypes.bfloat16) for v in vals_cls]
+    return CanonicalChunk(
+        X_hot=X_hot,
+        cold_rowids=tuple(rowids_cls),
+        cold_vals=tuple(vals_cls),
+        labels=np.asarray(raw.labels),
+        weights=np.asarray(raw.weights),
+        offsets=np.asarray(raw.offsets),
+        perm=perm,
+        inv=inv,
+        num_features=d,
+        num_hot=H,
+        class_starts=tuple(class_starts),
+    )
+
+
+def build_chunked(
+    chunk_iter: Iterable,
+    num_features: int,
+    chunk_rows: int,
+    num_hot: int = 512,
+    feature_dtype=jnp.float32,
+    log: Callable[[str], None] = lambda m: None,
+) -> ChunkedHybrid:
+    """Stage a stream of ELL chunks into host-resident canonical layouts.
+
+    ``chunk_iter`` yields objects with ``indices / values / labels /
+    weights / offsets`` host arrays (``data/sparse.SparseBatch`` or any
+    duck-typed source — the chunked Avro reader, a synthetic generator).
+    Peak host memory beyond the staged output is ONE chunk."""
+    num_hot = min(num_hot, num_features)
+    chunks = []
+    total = 0
+    for i, raw in enumerate(chunk_iter):
+        n_i = int(np.asarray(raw.labels).shape[0])
+        if n_i > chunk_rows:
+            raise ValueError(f"chunk {i} has {n_i} rows > chunk_rows="
+                             f"{chunk_rows}")
+        total += n_i
+        if n_i < chunk_rows:
+            raw = _pad_chunk(raw, chunk_rows, num_features)
+        ch = _build_canonical(raw, num_features, num_hot, feature_dtype)
+        chunks.append(ch)
+        log(f"staged chunk {i} ({n_i:,} rows, {ch.perm.shape[0]} extended "
+            f"cols, {len(ch.cold_rowids)} cold classes)")
+    if not chunks:
+        raise ValueError("empty chunk stream")
+    # Reconcile to the UNION structure: pow-2 quantization alone flaps at
+    # class boundaries between iid chunks, and every distinct structure
+    # would be its own multi-minute remote compile. Pad each chunk's
+    # classes up to the union (L → max C_pad over chunks; missing classes
+    # appear as all-dummy) so the whole stream shares ONE program.
+    union: dict[int, int] = {}
+    for ch in chunks:
+        for rows in ch.cold_rowids:
+            C, L = rows.shape
+            union[L] = max(union.get(L, 0), C)
+    sigs = {ch.structure() for ch in chunks}
+    if len(sigs) > 1 or any(
+            dict((r.shape[1], r.shape[0]) for r in ch.cold_rowids) != union
+            for ch in chunks):
+        log(f"reconciling {len(sigs)} chunk structures to the union "
+            f"({sorted(union.items(), reverse=True)})")
+        chunks = [_repad_to(ch, union) for ch in chunks]
+        assert len({ch.structure() for ch in chunks}) == 1
+    return ChunkedHybrid(chunks=tuple(chunks), num_rows=total,
+                         chunk_rows=chunk_rows)
+
+
+def _repad_to(ch: CanonicalChunk, union: dict[int, int]) -> CanonicalChunk:
+    """Pad a chunk's cold classes to the union structure (L desc order).
+    Dummy columns: rowids == n (inert scatter/gather), vals 0, perm slot
+    == d (reads the sentinel 0 coefficient); inv is rebuilt from perm."""
+    n = ch.labels.shape[0]
+    d = ch.num_features
+    by_L = {r.shape[1]: (r, v)
+            for r, v in zip(ch.cold_rowids, ch.cold_vals)}
+    # Per-class perm slices of the ORIGINAL layout.
+    perm = np.asarray(ch.perm)
+    perm_by_L = {}
+    off = ch.num_hot
+    for r in ch.cold_rowids:
+        C, L = r.shape
+        perm_by_L[L] = perm[off: off + C]
+        off += C
+    rows_out, vals_out, perm_out, starts = [], [], [perm[:ch.num_hot]], []
+    ext = 0
+    for L in sorted(union, reverse=True):
+        C_t = union[L]
+        vdt = ch.cold_vals[0].dtype if ch.cold_vals else np.float32
+        r, v = by_L.get(L, (np.full((0, L), n, np.int32),
+                            np.zeros((0, L), vdt)))
+        C = r.shape[0]
+        if C < C_t:
+            r = np.concatenate(
+                [np.asarray(r), np.full((C_t - C, L), n, np.int32)])
+            v = np.concatenate(
+                [np.asarray(v), np.zeros((C_t - C, L), vdt)])
+        p = np.full(C_t, d, np.int32)
+        p[:C] = perm_by_L.get(L, np.zeros((0,), np.int32))
+        rows_out.append(np.asarray(r))
+        vals_out.append(np.asarray(v))
+        perm_out.append(p)
+        starts.append(ext)
+        ext += C_t
+    new_perm = np.concatenate(perm_out)
+    D = new_perm.shape[0]
+    inv = np.full(d, D, np.int32)
+    real = new_perm < d
+    inv[new_perm[real]] = np.flatnonzero(real).astype(np.int32)
+    return dataclasses.replace(
+        ch, cold_rowids=tuple(rows_out), cold_vals=tuple(vals_out),
+        perm=new_perm, inv=inv, class_starts=tuple(starts))
+
+
+def _pad_chunk(raw, chunk_rows: int, d: int):
+    """Pad a short (final) chunk with weight-0 rows: every aggregate
+    multiplies by weight before reducing, so pad rows add exactly 0 to
+    value/gradient, and their margins are dropped by
+    ``margins_chunked``."""
+    from photon_ml_tpu.data.sparse import SparseBatch
+
+    idx = np.asarray(raw.indices)
+    n_i, nnz = idx.shape
+    pad = chunk_rows - n_i
+
+    def pad0(a):
+        a = np.asarray(a)
+        out = np.zeros((chunk_rows,) + a.shape[1:], a.dtype)
+        out[:n_i] = a
+        return out
+
+    idx_p = np.full((chunk_rows, nnz), d, np.int32)
+    idx_p[:n_i] = idx
+    return SparseBatch(
+        indices=idx_p, values=pad0(raw.values), labels=pad0(raw.labels),
+        weights=pad0(raw.weights), offsets=pad0(raw.offsets),
+        num_features=d)
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _masked(weights: Array, term: Array) -> Array:
+    return jnp.where(weights > 0.0, weights * term, 0.0)
+
+
+def _ext_coefficients(ch: CanonicalChunk, w: Array) -> Array:
+    """(D',) extended-space coefficients: dummies read the sentinel 0."""
+    w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+    return w_pad[ch.perm]
+
+
+def _chunk_margins_ext(ch: CanonicalChunk, w_ext: Array,
+                       offsets: Array) -> Array:
+    n = ch.labels.shape[0]
+    z = offsets + _hot_matvec(ch.X_hot, w_ext[:ch.num_hot])
+    if ch.cold_rowids:
+        parts = []
+        for start, rows, vals in zip(ch.class_starts, ch.cold_rowids,
+                                     ch.cold_vals):
+            C = rows.shape[0]
+            w_c = w_ext[ch.num_hot + start: ch.num_hot + start + C]
+            parts.append((w_c[:, None] * vals).reshape(-1))
+        flat_rows = jnp.concatenate(
+            [r.reshape(-1) for r in ch.cold_rowids])
+        acc = jnp.zeros((n + 1,), jnp.float32).at[flat_rows].add(
+            jnp.concatenate(parts))
+        z = z + acc[:n]
+    return z
+
+
+def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
+    """Σᵢ rᵢ·xᵢ in ORIGINAL space, via the extended layout + one gather."""
+    parts = [_hot_rmatvec(ch.X_hot, r).astype(jnp.float32)]
+    if ch.cold_rowids:
+        r_pad = jnp.concatenate([r, jnp.zeros((1,), r.dtype)])
+        flat_rows = jnp.concatenate(
+            [rr.reshape(-1) for rr in ch.cold_rowids])
+        gathered = r_pad[flat_rows]
+        off = 0
+        for rows, vals in zip(ch.cold_rowids, ch.cold_vals):
+            C, L = rows.shape
+            ru = gathered[off: off + C * L].reshape(C, L)
+            parts.append(jnp.sum(ru * vals, axis=1))
+            off += C * L
+    g_ext = jnp.concatenate(parts)
+    g_ext = jnp.concatenate([g_ext, jnp.zeros((1,), jnp.float32)])
+    return g_ext[ch.inv]  # absent cols hit the reserved zero slot
+
+
+# Kernels are cached per loss (and the margins kernel is a singleton):
+# a fresh @jax.jit wrapper per call would re-trace the chunk program on
+# every coordinate-descent update — exactly the repeated remote compile
+# the canonical structure exists to avoid.
+_VG_KERNELS: dict = {}
+
+
+def _chunk_value_grad(loss: PointwiseLoss):
+    """One jitted per-chunk pass: original-space w in, original-space
+    (value, grad) out — shared by every chunk with the same canonical
+    structure."""
+    f = _VG_KERNELS.get(loss.name)
+    if f is not None:
+        return f
+
+    @jax.jit
+    def f(w: Array, offsets: Array, ch: CanonicalChunk):
+        w_ext = _ext_coefficients(ch, w)
+        z = _chunk_margins_ext(ch, w_ext, offsets)
+        l, dl = loss.loss_and_dz(z, ch.labels)
+        value = jnp.sum(_masked(ch.weights, l))
+        r = _masked(ch.weights, dl)
+        return value, _chunk_rowterm_grad(ch, r)
+
+    _VG_KERNELS[loss.name] = f
+    return f
+
+
+@jax.jit
+def _margins_kernel(w: Array, offsets: Array, ch: CanonicalChunk):
+    return _chunk_margins_ext(ch, _ext_coefficients(ch, w), offsets)
+
+
+def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
+    """Yield device-resident chunks with ``depth`` transfers in flight
+    ahead of the consumer (same discipline as data/prefetch.py — the
+    host→device copy of chunk i+1 overlaps the compute on chunk i).
+    ``pinned`` are already-resident leading chunks (yielded as-is, no
+    transfer)."""
+    import collections
+
+    for ch in pinned:
+        yield ch
+    q = collections.deque()
+    it = iter(chunked.chunks[len(pinned):])
+    try:
+        for _ in range(depth):
+            q.append(jax.device_put(next(it)))
+    except StopIteration:
+        pass
+    while q:
+        ready = q.popleft()
+        try:
+            q.append(jax.device_put(next(it)))
+        except StopIteration:
+            pass
+        yield ready
+
+
+def _offsets_for(chunked: ChunkedHybrid, offsets: Optional[Array], i: int,
+                 ch: CanonicalChunk):
+    if offsets is None:
+        return ch.offsets if isinstance(ch.offsets, jax.Array) \
+            else jnp.asarray(ch.offsets)
+    lo = i * chunked.chunk_rows
+    return jax.lax.dynamic_slice_in_dim(
+        offsets, lo, chunked.chunk_rows, 0)
+
+
+def pin_chunks(chunked: ChunkedHybrid, count: int):
+    """Place the first ``count`` chunks on device permanently and return
+    them — spare HBM traded for stream traffic (the steady-state cost of
+    every objective evaluation drops by the pinned fraction). The caller
+    owns the sizing decision: pinned bytes compete with whatever else
+    the fit keeps resident (e.g. random-effect bucket blocks)."""
+    return tuple(jax.device_put(ch)
+                 for ch in chunked.chunks[:max(0, count)])
+
+
+def make_value_and_gradient(
+    loss: PointwiseLoss,
+    chunked: ChunkedHybrid,
+    prefetch_depth: int = 2,
+    pinned=(),
+) -> Callable[[Array, Optional[Array]], tuple[Array, Array]]:
+    """Streamed Σ-over-chunks (value, gradient) in original column space.
+
+    The returned callable is HOST-DRIVEN (a Python loop dispatching one
+    jitted pass per chunk) — it cannot be traced into an outer jit; pair
+    it with the host-driven optimizer in ``optim/streaming.py``.
+    ``offsets``, when given, is the full (padded_n,) device array of
+    per-row offsets (coordinate-descent residuals); None uses the offsets
+    staged in each chunk. ``pinned`` (from :func:`pin_chunks`) skips the
+    host→device transfer for the leading chunks.
+    """
+    kernel = _chunk_value_grad(loss)
+
+    def value_and_grad(w: Array, offsets: Optional[Array] = None):
+        value = jnp.zeros((), jnp.float32)
+        grad = jnp.zeros((chunked.dim,), jnp.float32)
+        for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
+            v, g = kernel(w, _offsets_for(chunked, offsets, i, ch), ch)
+            value = value + v
+            grad = grad + g
+        return value, grad
+
+    return value_and_grad
+
+
+def margins_chunked(
+    chunked: ChunkedHybrid,
+    w: Array,
+    offsets: Optional[Array] = None,
+    prefetch_depth: int = 2,
+    pinned=(),
+) -> Array:
+    """(num_rows,) margins (wᵀx + offset), streamed; pad rows dropped."""
+    parts = []
+    for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
+        parts.append(_margins_kernel(
+            w, _offsets_for(chunked, offsets, i, ch), ch))
+    z = jnp.concatenate(parts)
+    return z[:chunked.num_rows]

@@ -1,0 +1,224 @@
+"""Row-streamed sparse path: chunked hybrid aggregates, the host-driven
+L-BFGS, and the streaming fixed-effect coordinate.
+
+Mirrors the reference's DistributedGLMLossFunction tests (SURVEY.md §4):
+the streamed formulation must be numerically the SAME objective as the
+in-memory one — chunking is an execution detail, never a model change.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import sparse as sp
+from photon_ml_tpu.ops import hybrid_sparse as hs
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops import streaming_sparse as ss
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.lbfgs import minimize as minimize_compiled
+from photon_ml_tpu.optim.streaming import minimize_streaming
+
+
+def _chunks_of(batch, chunk_rows):
+    n = batch.num_rows
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        yield sp.SparseBatch(
+            indices=np.asarray(batch.indices)[lo:hi],
+            values=np.asarray(batch.values)[lo:hi],
+            labels=np.asarray(batch.labels)[lo:hi],
+            weights=np.asarray(batch.weights)[lo:hi],
+            offsets=np.asarray(batch.offsets)[lo:hi],
+            num_features=batch.num_features,
+        )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    b, _ = sp.synthetic_sparse(700, 96, 5, seed=3)
+    return b
+
+
+def _build(batch, chunk_rows=256):
+    # 700 rows / 256-row chunks: last chunk is SHORT (188 rows) — the
+    # weight-0 pad path is always exercised. num_hot=16 << d keeps real
+    # cold classes (and their dummy-column padding) in play.
+    return ss.build_chunked(_chunks_of(batch, chunk_rows),
+                            batch.num_features, chunk_rows, num_hot=16)
+
+
+def test_chunked_value_gradient_matches_monolithic(batch):
+    chunked = _build(batch)
+    assert chunked.num_rows == 700 and chunked.num_chunks == 3
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    vg = ss.make_value_and_gradient(losses.LOGISTIC, chunked)
+    off = jnp.asarray(np.asarray(batch.offsets))
+    pad = chunked.num_chunks * chunked.chunk_rows - chunked.num_rows
+    v_s, g_s = vg(w, jnp.concatenate([off, jnp.zeros(pad)]))
+
+    hb = hs.build_hybrid(batch)
+    v_m, g_m = hs.value_and_gradient(losses.LOGISTIC, w[hb.perm], hb)
+    g_m = g_m[hb.inv_perm]
+    assert abs(float(v_s) - float(v_m)) < 1e-3 * max(abs(float(v_m)), 1.0)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_m),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_margins_match_and_drop_pad(batch):
+    chunked = _build(batch)
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    z = ss.margins_chunked(chunked, w)
+    assert z.shape == (700,)
+    hb = hs.build_hybrid(batch)
+    z_m = hs.margins(hb, w[hb.perm])
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_m),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_streaming_lbfgs_matches_compiled(batch):
+    """The driver-loop L-BFGS and the compiled strong-Wolfe L-BFGS land
+    on the same optimum of the same smooth objective."""
+    chunked = _build(batch)
+    l2 = 1.0
+
+    vg_stream = ss.make_value_and_gradient(losses.LOGISTIC, chunked)
+
+    def vg_s(w):
+        f, g = vg_stream(w)
+        return f + 0.5 * l2 * jnp.sum(w * w), g + l2 * w
+
+    hb = hs.build_hybrid(batch)
+
+    def vg_c(w_perm):
+        f, g = hs.value_and_gradient(losses.LOGISTIC, w_perm, hb)
+        return f + 0.5 * l2 * jnp.sum(w_perm * w_perm), g + l2 * w_perm
+
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+    w0 = jnp.zeros((batch.num_features,), jnp.float32)
+    r_s = minimize_streaming(vg_s, w0, cfg)
+    r_c = minimize_compiled(vg_c, w0, cfg)
+    w_c = np.asarray(r_c.w)[np.asarray(hb.inv_perm)]
+    # Same strongly-convex optimum (the optimizers take different paths).
+    np.testing.assert_allclose(np.asarray(r_s.w), w_c, rtol=5e-3,
+                               atol=5e-3)
+    assert abs(float(r_s.value) - float(r_c.value)) < 1e-3 * max(
+        1.0, abs(float(r_c.value)))
+    assert bool(r_s.converged)
+
+
+def test_streaming_coordinate_in_descent_matches_resident(batch):
+    """A tiny GAME descent with the streaming FE coordinate reproduces
+    the device-resident SparseFixedEffectCoordinate's fit."""
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.coordinates import (
+        SparseFixedEffectCoordinate, StreamingSparseFixedEffectCoordinate)
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    ds = from_sparse_batch(batch)
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    # Streaming chunks are staged with ZERO offsets (the descent residual
+    # arrives via train_model's argument).
+    zero_off = dataclasses.replace(
+        batch, offsets=np.zeros(batch.num_rows, np.float32))
+    chunked = ss.build_chunked(_chunks_of(zero_off, 256),
+                               batch.num_features, 256, num_hot=16)
+    stream_coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, cfg)
+    resident_coord = SparseFixedEffectCoordinate(
+        ds, "global", losses.LOGISTIC, cfg,
+        make_mesh(num_data=1, devices=jax.devices()[:1]))
+
+    results = {}
+    for name, coord in (("stream", stream_coord),
+                        ("resident", resident_coord)):
+        model, _ = descent.run(
+            TaskType.LOGISTIC_REGRESSION, {"fixed": coord},
+            descent.CoordinateDescentConfig(["fixed"], iterations=1))
+        results[name] = (
+            np.asarray(model.models["fixed"].coefficients.means),
+            np.asarray(coord.score(model.models["fixed"])))
+    np.testing.assert_allclose(results["stream"][0],
+                               results["resident"][0],
+                               rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(results["stream"][1],
+                               results["resident"][1],
+                               rtol=5e-3, atol=5e-2)
+
+
+def test_streaming_coordinate_rejects_unsupported(batch):
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch)
+    for bad in (
+        GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L1,
+                                                 0.5)),
+        GLMOptimizationConfiguration(down_sampling_rate=0.5),
+    ):
+        with pytest.raises(ValueError):
+            StreamingSparseFixedEffectCoordinate(
+                ds, chunked, "global", losses.LOGISTIC, bad)
+
+
+def test_chunk_stream_shares_one_structure(batch):
+    """Every chunk must share ONE canonical structure (= one compiled
+    program for the whole stream — per-structure remote compiles are
+    multi-minute in the deployment environment)."""
+    chunked = _build(batch)
+    sigs = {c.structure() for c in chunked.chunks}
+    assert len(sigs) == 1, sigs
+
+
+def test_pinned_chunks_change_nothing(batch):
+    """Device-pinned leading chunks are an execution detail: same value,
+    gradient, and margins as the fully streamed pass."""
+    chunked = _build(batch)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    pinned = ss.pin_chunks(chunked, 2)
+    vg0 = ss.make_value_and_gradient(losses.LOGISTIC, chunked)
+    vg1 = ss.make_value_and_gradient(losses.LOGISTIC, chunked,
+                                     pinned=pinned)
+    v0, g0 = vg0(w)
+    v1, g1 = vg1(w)
+    assert abs(float(v0) - float(v1)) < 1e-4 * max(1.0, abs(float(v0)))
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ss.margins_chunked(chunked, w, pinned=pinned)),
+        np.asarray(ss.margins_chunked(chunked, w)), rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_chunk_storage_close_to_f32(batch):
+    """bf16 chunk storage (hot block + cold values) approximates the f32
+    objective within storage-quantization tolerance."""
+    chunked32 = _build(batch)
+    chunked16 = ss.build_chunked(_chunks_of(batch, 256),
+                                 batch.num_features, 256, num_hot=16,
+                                 feature_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    v32, g32 = ss.make_value_and_gradient(losses.LOGISTIC, chunked32)(w)
+    v16, g16 = ss.make_value_and_gradient(losses.LOGISTIC, chunked16)(w)
+    assert abs(float(v32) - float(v16)) < 0.02 * max(1.0, abs(float(v32)))
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                               rtol=0.05, atol=0.5)
