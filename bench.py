@@ -47,6 +47,68 @@ def _progress(msg: str) -> None:
           flush=True)
 
 
+def _host_timed(section, n=3, label=""):
+    """Min-of-N timing for a HOST-side section with a contention guard.
+
+    Tunnel jitter doesn't apply to host work, but this 1-core box does: a
+    background thread (device-runtime housekeeping, another process) can
+    inflate a single run 3-5× — the round-4 driver capture recorded the
+    10M-row projection pass at 52 s where its standalone time is ~11 s.
+    Min of N ≥ 3 runs is the contention-robust estimator; ALL samples and
+    the 1-min load average are returned so a committed JSON shows when a
+    capture was dirty instead of silently blessing one roll.
+
+    Returns (min_seconds, samples, contended) — ``contended`` is True when
+    the spread exceeds 1.5× the minimum, i.e. the min itself may still be
+    inflated and the line should not be quoted as a clean measurement.
+    """
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        section()
+        times.append(time.perf_counter() - t0)
+    lo, hi = min(times), max(times)
+    contended = hi > 1.5 * lo + 0.05
+    if contended:
+        _progress(f"WARNING {label or 'host section'}: timing spread "
+                  f"{lo:.2f}-{hi:.2f}s across {n} runs, load "
+                  f"{os.getloadavg()[0]:.2f} — host contended; even the "
+                  "min may be inflated")
+    return lo, [round(t, 3) for t in times], contended
+
+
+def _host_line(out, key, section, n=3):
+    """Record one host-side bench line: ``key`` = min of n runs,
+    ``key_samples`` = every run, ``key_contended`` only when dirty."""
+    lo, samples, contended = _host_timed(section, n=n, label=key)
+    out[key] = round(lo, 2)
+    out[f"{key}_samples"] = samples
+    if contended:
+        out[f"{key}_contended"] = True
+    return lo
+
+
+def _cold_line(out, key, section, warm_n=2):
+    """One-time staging cost: the FIRST run in this (fresh) process is
+    the number — min-of-N would report the warm re-run instead (observed
+    5–30× smaller: allocator/page-cache warm-up dominates these
+    allocation-heavy sections). Warm re-runs are recorded alongside for
+    contrast (``key_warm``); run this only from a fresh subprocess, where
+    'first' genuinely means cold."""
+    t0 = time.perf_counter()
+    section()
+    cold = time.perf_counter() - t0
+    warm = []
+    for _ in range(warm_n):
+        t0 = time.perf_counter()
+        section()
+        warm.append(time.perf_counter() - t0)
+    out[key] = round(cold, 2)
+    out[f"{key}_samples"] = [round(cold, 3)] + [round(w, 3) for w in warm]
+    out[f"{key}_warm"] = round(min(warm), 2)
+    return cold
+
+
 def _numpy_value_grad(X, y, w):
     z = X @ w
     p = 1.0 / (1.0 + np.exp(-z))
@@ -232,11 +294,11 @@ def bench_sparse(n=1 << 17, d=1_000_000, nnz=32):
     hyb_step = jax.jit(lambda ww, hb: hs.value_and_gradient(
         losses.LOGISTIC, ww, hb))
     for name, dtype in (("", jnp.float32), ("bf16_", jnp.bfloat16)):
-        t0 = time.perf_counter()
+        # Staging cost is measured COLD in bench_fresh_host_suite (a
+        # fresh subprocess) — timing it here, mid-device-phase in a warm
+        # process, produced the 11.65→37.04→20.09 swings of rounds 3–4.
         hb = hs.build_hybrid(batch, feature_dtype=dtype)
         if not name:
-            out["sparse_hybrid_staging_seconds"] = round(
-                time.perf_counter() - t0, 2)
             out["sparse_hybrid_hot_cols"] = hb.num_hot
 
         def run_hyb(iters, _hb=hb):
@@ -281,19 +343,14 @@ def bench_sparse(n=1 << 17, d=1_000_000, nnz=32):
     return out
 
 
-def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
-                               nnz=8):
-    """Sparse random-effect fit at large d (SURVEY §2.1 sparse RE): staging
-    time (COO → per-entity subspace buckets, never densifying (n, d)) and
-    the steady-state per-train_model time."""
+def _sparse_re_inputs(n=100_000, d=200_000, num_entities=1000, nnz=8):
+    """Shared dataset+config for the sparse-RE fit bench and the cold
+    staging line (same shapes so both describe the same workload)."""
     from photon_ml_tpu.data.game_data import GameDataset, SparseShard
-    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
-    from photon_ml_tpu.ops import losses
     from photon_ml_tpu.optim import OptimizerConfig
     from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
     from photon_ml_tpu.optim.regularization import (RegularizationContext,
                                                     RegularizationType)
-    from photon_ml_tpu.parallel.mesh import make_mesh
 
     rng = np.random.default_rng(3)
     ids = rng.integers(0, num_entities, n).astype(np.int32)
@@ -315,16 +372,27 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
     cfg = GLMOptimizationConfiguration(
         optimizer=OptimizerConfig(max_iterations=15, tolerance=1e-7),
         regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    return ds, cfg
+
+
+def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
+                               nnz=8):
+    """Sparse random-effect fit at large d (SURVEY §2.1 sparse RE):
+    steady-state per-train_model time (staging is measured cold in
+    bench_fresh_host_suite)."""
+    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    ds, cfg = _sparse_re_inputs(n, d, num_entities, nnz)
     import shutil
     import tempfile
 
-    # Cold staging is timed WITHOUT the cache so the metric keeps meaning
-    # "the projection pass" across captures; the cache's save cost stays
-    # out of it and the warm number is measured separately.
-    t0 = time.perf_counter()
+    # Staging cost is measured COLD in bench_fresh_host_suite (fresh
+    # subprocess); here the coordinate is just built for the fit timing.
+    res: dict = {}
     coord = RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
                                    cfg, make_mesh())
-    staging = time.perf_counter() - t0
     cache_dir = tempfile.mkdtemp(prefix="pml_staging_cache_")
     try:
         RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
@@ -333,11 +401,10 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
         # Warm path: a fresh coordinate on the same data memory-maps the
         # staged blocks from the digest-keyed cache instead of re-running
         # the projection pass.
-        t0 = time.perf_counter()
-        RandomEffectCoordinate(ds, "userId", "re", losses.LOGISTIC,
-                               cfg, make_mesh(),
-                               staging_cache_dir=cache_dir)
-        staging_warm = time.perf_counter() - t0
+        _host_line(res, "sparse_re_staging_warm_seconds",
+                   lambda: RandomEffectCoordinate(
+                       ds, "userId", "re", losses.LOGISTIC, cfg,
+                       make_mesh(), staging_cache_dir=cache_dir))
         # bf16 bucket-block storage: halves the staged blocks' HBM, f32 MXU
         # accumulation (same contract as the dense fixed path). The f32
         # staging cache is dtype-independent (cast happens after load), so
@@ -362,13 +429,12 @@ def bench_sparse_random_effect(n=100_000, d=200_000, num_entities=1000,
 
     dt = _slope(make_run(coord), 1, 4)
     dt16 = _slope(make_run(coord16), 1, 4)
-    return {
-        "sparse_re_staging_seconds": round(staging, 2),
-        "sparse_re_staging_warm_seconds": round(staging_warm, 2),
+    res.update({
         "sparse_re_fit_seconds": round(dt, 3),
         "sparse_re_bf16_fit_seconds": round(dt16, 3),
         "sparse_re_config": f"n={n} d={d} entities={num_entities}",
-    }
+    })
+    return res
 
 
 def bench_host_staging(n=10_000_000, num_entities=1_000_000, d=1_000_000,
@@ -393,19 +459,54 @@ def bench_host_staging(n=10_000_000, num_entities=1_000_000, d=1_000_000,
     vals[dup] = 0.0
     shard = SparseShard(idx, vals, d)
 
-    t0 = time.perf_counter()
-    bucketing = build_bucketing(ids, num_entities)
-    t1 = time.perf_counter()
-    coo = shard_coo(shard)
-    trips = all_bucket_triplets(bucketing.buckets, shard, coo)
-    for bk, trip in zip(bucketing.buckets, trips):
-        build_bucket_projection(bk, shard, None, triplets=trip)
-    t2 = time.perf_counter()
-    return {
-        "staging_bucketing_seconds": round(t1 - t0, 2),
-        "staging_projection_seconds": round(t2 - t1, 2),
-        "staging_seconds_10m_rows_1m_entities": round(t2 - t0, 2),
-    }
+    out: dict = {"staging_load_avg_1m": round(os.getloadavg()[0], 2)}
+    bucketing = build_bucketing(ids, num_entities)  # warm result for below
+
+    def _bucketing():
+        build_bucketing(ids, num_entities)
+
+    def _projection():
+        coo = shard_coo(shard)
+        trips = all_bucket_triplets(bucketing.buckets, shard, coo)
+        for bk, trip in zip(bucketing.buckets, trips):
+            build_bucket_projection(bk, shard, None, triplets=trip)
+
+    tb = _host_line(out, "staging_bucketing_seconds", _bucketing)
+    tp = _host_line(out, "staging_projection_seconds", _projection)
+    out["staging_seconds_10m_rows_1m_entities"] = round(tb + tp, 2)
+    return out
+
+
+def bench_fresh_host_suite():
+    """Everything that must be measured in a FRESH process, in one
+    subprocess pass: the 10M-row staging (min-of-3 — its host sorts
+    dominate, cold ≈ warm) and the COLD one-time staging lines (hybrid
+    build, sparse-RE coordinate construction — allocation-heavy sections
+    whose warm re-runs measure 5–30× faster, so min-of-N would misreport
+    them; see _cold_line)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.ops import hybrid_sparse as hs
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    out = bench_host_staging()
+
+    batch, _ = sp.synthetic_sparse(1 << 17, 1_000_000, 32, seed=2)
+    # block: device_put is async — staging "done" means the blocks are
+    # resident, not merely enqueued.
+    _cold_line(out, "sparse_hybrid_staging_seconds",
+               lambda: jax.block_until_ready(
+                   hs.build_hybrid(batch, feature_dtype=jnp.float32)))
+
+    ds, cfg = _sparse_re_inputs()
+    _cold_line(out, "sparse_re_staging_seconds",
+               lambda: RandomEffectCoordinate(
+                   ds, "userId", "re", losses.LOGISTIC, cfg, make_mesh()))
+    return out
 
 
 def bench_pallas_scatter(n=1 << 17, k=32, d=512):
@@ -472,11 +573,15 @@ def bench_avro_ingest(n=20_000, nnz=20):
         write_records(p, schemas.TRAINING_EXAMPLE_AVRO, recs,
                       codec="deflate")
         for name, use_native in (("native", True), ("python", False)):
-            t0 = time.perf_counter()
-            AvroDataReader().read(p, cfgs, random_effect_types=["userId"],
-                                  use_native=use_native)
-            out[f"avro_{name}_records_per_sec"] = round(
-                n / (time.perf_counter() - t0))
+            lo, samples, contended = _host_timed(
+                lambda _un=use_native: AvroDataReader().read(
+                    p, cfgs, random_effect_types=["userId"],
+                    use_native=_un),
+                label=f"avro_{name}")
+            out[f"avro_{name}_records_per_sec"] = round(n / lo)
+            out[f"avro_{name}_seconds_samples"] = samples
+            if contended:
+                out[f"avro_{name}_contended"] = True
     return out
 
 
@@ -553,6 +658,27 @@ def bench_game_20m():
                      "flagship_first_descent_seconds")}
 
 
+def bench_criteo_stream():
+    """Criteo row-axis streamed fit (n=100M, d=1M, E=1M) — gated behind
+    PML_BENCH_CRITEO=1: the run takes over an hour (generation + fresh
+    remote compiles + a streamed descent). The measurement lives in
+    dev-scripts/flagship_criteo_stream.py; committed numbers in
+    docs/PARITY.md "Criteo row axis"."""
+    import importlib.util
+    import os
+
+    if os.environ.get("PML_BENCH_CRITEO") != "1":
+        return {}
+    spec = importlib.util.spec_from_file_location(
+        "flagship_criteo_stream",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "dev-scripts", "flagship_criteo_stream.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_criteo_stream(log=_progress)
+    return {k: v for k, v in out.items() if k.startswith("criteo_stream")}
+
+
 def _staging_in_subprocess():
     """bench_host_staging in a FRESH python process. In-process, the pass
     measures 10-11 s standalone but 39-46 s after the full device-phase
@@ -570,7 +696,8 @@ def _staging_in_subprocess():
     with tempfile.NamedTemporaryFile(mode="r", suffix=".json") as f:
         subprocess.run(
             [sys.executable, "-c",
-             "import json, sys, bench; json.dump(bench.bench_host_staging(),"
+             "import json, sys, bench;"
+             " json.dump(bench.bench_fresh_host_suite(),"
              " open(sys.argv[1], 'w'))", f.name],
             cwd=os.path.dirname(os.path.abspath(__file__)), check=True)
         return json.load(f)
@@ -597,6 +724,7 @@ def main():
     _progress("GAME coordinate-descent sweep")
     game_iter_s = bench_game_iteration()
     game_20m = bench_game_20m()  # {} unless PML_BENCH_20M=1
+    criteo = bench_criteo_stream()  # {} unless PML_BENCH_CRITEO=1
     _progress("done")
     print(json.dumps({
         "metric": "glm_gradient_step_samples_per_sec_per_chip",
@@ -619,8 +747,8 @@ def main():
             "sparse_ell_samples_per_sec":
                 sparse["sparse_ell_samples_per_sec"],
             "sparse_hybrid_hot_cols": sparse["sparse_hybrid_hot_cols"],
-            "sparse_hybrid_staging_seconds":
-                sparse["sparse_hybrid_staging_seconds"],
+            **{k: v for k, v in sparse.items()
+               if k.startswith("sparse_hybrid_staging_seconds")},
             "sparse_hybrid_sharded_samples_per_sec":
                 sparse["sparse_hybrid_sharded_samples_per_sec"],
             **sparse_re,
@@ -629,6 +757,7 @@ def main():
             **ingest,
             "game_cd_iteration_seconds": round(game_iter_s, 3),
             **game_20m,
+            **criteo,
             "cpu_numpy_baseline_samples_per_sec": round(
                 grad["cpu_numpy_samples_per_sec"]),
             "timing_method": "dependency-chain slope (async-tunnel safe)",

@@ -1,0 +1,71 @@
+"""f32-vs-bf16 quality parity at the largest f32-feasible flagship scale.
+
+Round-4 verdict item 5: the 20M-row MovieLens north star REQUIRES bf16
+feature storage on one 16 GB chip (f32 OOMs), so its headline AUC rested
+on bf16 alone — parity was only tested small. This script anchors it: the
+same MovieLens-shaped config at 10M rows (the largest n where f32 fits)
+trained once with f32 and once with bf16 feature storage, identical data
+and seed, reporting both validation AUCs and the delta.
+
+Each dtype runs in a FRESH subprocess of flagship_movielens.py: clean HBM
+(no cross-run fragmentation) and the exact reproduction path a reader
+would use by hand.
+
+    python dev-scripts/dtype_parity.py [--rows 10000000] [--json]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLAGSHIP = os.path.join(HERE, "flagship_movielens.py")
+
+
+def run_one(rows: int, bf16: bool) -> dict:
+    cmd = [sys.executable, FLAGSHIP, "--rows", str(rows), "--json",
+           "--quality-only"]
+    if bf16:
+        cmd.append("--bf16")
+    out = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                         cwd=os.path.dirname(HERE), check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    def log(m):
+        print(f"[dtype-parity {time.strftime('%H:%M:%S')}] {m}",
+              file=sys.stderr, flush=True)
+
+    results = {}
+    for name, bf16 in (("float32", False), ("bfloat16", True)):
+        log(f"training {args.rows:,} rows with {name} feature storage "
+            f"(fresh subprocess)")
+        results[name] = run_one(args.rows, bf16)
+        log(f"  {name} validation AUC "
+            f"{results[name]['flagship_validation_auc']:.4f}")
+
+    a32 = results["float32"]["flagship_validation_auc"]
+    a16 = results["bfloat16"]["flagship_validation_auc"]
+    summary = {
+        "dtype_parity_rows": args.rows,
+        "auc_f32": a32,
+        "auc_bf16": a16,
+        "auc_delta_bf16_minus_f32": round(a16 - a32, 5),
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()

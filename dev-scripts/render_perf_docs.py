@@ -40,6 +40,61 @@ def load_bench(path=BENCH_JSON):
     return flat
 
 
+def load_capture_series():
+    """Every committed driver capture (BENCH_r0*.json) plus the current
+    one — so headline lines can quote the RANGE across captures instead of
+    one roll (round-4 verdict: tunnel weather moves single lines; the best
+    roll is not the number).
+
+    BENCH_r01.json is excluded: its 21.4e9 samples/s predates the
+    dependency-chain slope fix and is physically impossible (~21 TB/s
+    effective HBM) — see the measurement-discipline note in bench.py.
+    """
+    import glob
+
+    caps = []
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r0*.json"))):
+        if os.path.basename(p) == "BENCH_r01.json":
+            continue
+        try:
+            c = load_bench(p)
+        except (ValueError, KeyError):
+            continue
+        c["__file"] = os.path.basename(p)
+        caps.append(c)
+    caps.append(load_bench())
+    return caps
+
+
+# Capture lines excluded from doc ranges, each with its reason — a range
+# must span captures of the CURRENT code under CLEAN conditions:
+#   * BENCH_r03 staging_projection (42.7 s) measured the PRE-REWRITE
+#     projection algorithm (the round-4 vectorized rewrite replaced it);
+#   * BENCH_r04 staging_projection (52.05 s) is post-rewrite code but an
+#     established-dirty single-shot capture — re-measured same-code at
+#     11.8–12.1 s min-of-3 clean (round-4 verdict weak-item #1; see the
+#     PARITY "Host-side lines are min-of-3" note).
+_EXCLUDED = {
+    ("BENCH_r03.json", "staging_projection_seconds"),
+    ("BENCH_r03.json", "staging_seconds_10m_rows_1m_entities"),
+    ("BENCH_r04.json", "staging_projection_seconds"),
+    ("BENCH_r04.json", "staging_seconds_10m_rows_1m_entities"),
+}
+
+
+def _span(caps, key):
+    """(lo, hi) across captures that have the key, or None if <2 or flat.
+    Host-side lines marked contended (or excluded with reason above) are
+    dropped: their value does not describe current-code clean runs."""
+    vals = [c[key] for c in caps
+            if c.get(key) and not c.get(f"{key}_contended")
+            and (c.get("__file"), key) not in _EXCLUDED]
+    if len(vals) < 2:
+        return None
+    lo, hi = min(vals), max(vals)
+    return None if lo == hi else (lo, hi)
+
+
 def _human_rate(x):
     """365_445_753 -> '365 M'; 94_000 -> '94 k'."""
     if x >= 995e6:
@@ -52,28 +107,50 @@ def _human_rate(x):
     return f"{x:.0f}"
 
 
-def _lines(b):
-    """(readme_row, parity_bullet) pairs, None entries skipped."""
+def _lines(b, caps=()):
+    """(readme_row, parity_bullet) pairs, None entries skipped.
+
+    ``caps`` is the committed capture series; headline lines (dense step,
+    HBM fraction, sparse step, staging, 20M sweep) quote its RANGE, with
+    the current capture's value alongside."""
     out = []
 
     def row(label, value, bullet=None):
         out.append((f"| {label} | {value} |", bullet or f"{label}: {value}"))
 
+    def rate_span(key, cur, over=None):
+        s = _span(caps if over is None else over, key)
+        if s is None:
+            return f"**{_human_rate(cur)} samples/s**"
+        return (f"**{_human_rate(s[0])}–{_human_rate(s[1])} samples/s** "
+                f"across captures (this capture {_human_rate(cur)})")
+
     v = b.get("primary_samples_per_sec")
     if v:
         gbs = b.get("achieved_gbytes_per_sec")
-        extra = (f" ({gbs:.0f} GB/s ≈ {100 * gbs / HBM_PEAK_GBS:.0f}% of "
-                 f"HBM peak)" if gbs else "")
+        gspan = _span(caps, "achieved_gbytes_per_sec")
+        if gbs and gspan:
+            extra = (f" ({gspan[0]:.0f}–{gspan[1]:.0f} GB/s ≈ "
+                     f"{100 * gspan[0] / HBM_PEAK_GBS:.0f}–"
+                     f"{100 * gspan[1] / HBM_PEAK_GBS:.0f}% of HBM peak)")
+        elif gbs:
+            extra = (f" ({gbs:.0f} GB/s ≈ {100 * gbs / HBM_PEAK_GBS:.0f}% "
+                     f"of HBM peak)")
+        else:
+            extra = ""
         row("Dense f32 gradient step (n=2¹⁹, d=256)",
-            f"**{_human_rate(v)} samples/s**{extra}",
-            f"dense f32 gradient step **{_human_rate(v)} samples/s** at "
+            f"{rate_span('primary_samples_per_sec', v)}{extra}",
+            f"dense f32 gradient step "
+            f"{rate_span('primary_samples_per_sec', v)} at "
             f"n=2¹⁹, d=256{extra.replace('(', '— ').rstrip(')')} "
             f"(bandwidth-bound, as expected)")
         bf = b.get("bf16_samples_per_sec")
         if bf:
             row("…with bf16 feature storage",
-                f"**{_human_rate(bf)} samples/s** ({bf / v:.1f}× f32)",
-                f"bf16 feature storage **{_human_rate(bf)} samples/s** "
+                f"{rate_span('bf16_samples_per_sec', bf)} "
+                f"({bf / v:.1f}× f32)",
+                f"bf16 feature storage "
+                f"{rate_span('bf16_samples_per_sec', bf)} "
                 f"({bf / v:.1f}× f32: halves the streamed bytes, f32 MXU "
                 f"accumulation)")
     if b.get("lbfgs_full_iteration_ms"):
@@ -98,11 +175,17 @@ def _lines(b):
         tail = (" — hybrid hot-dense/cold-class layout riding the Zipf "
                 "head (exact objective; ELL shard_map kept for "
                 "feature-sharded runs)" if hybrid else "")
+        # Range only over captures that measured the hybrid layout — the
+        # key changed meaning when the layout landed.
+        hyb_caps = [c for c in caps
+                    if c.get("sparse_hybrid_hot_cols") is not None]
+        sp_txt = (rate_span("sparse_1m_feature_samples_per_sec", sp,
+                            over=hyb_caps)
+                  if hybrid else f"**{_human_rate(sp)} samples/s**")
         row(label,
-            f"**{_human_rate(sp)} samples/s**"
-            + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "") + vs_ell,
-            f"sparse 1M-feature gradient step **{_human_rate(sp)} "
-            f"samples/s**" + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "")
+            sp_txt + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "") + vs_ell,
+            f"sparse 1M-feature gradient step " + sp_txt
+            + (f" ({gnnz:.2f} Gnnz/s)" if gnnz else "")
             + vs_ell + tail)
         spb = b.get("sparse_bf16_samples_per_sec")
         if spb:
@@ -130,15 +213,23 @@ def _lines(b):
             f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
             f"staging{warm_txt} — the (n, d) dense matrix never exists")
     if b.get("staging_seconds_10m_rows_1m_entities") is not None:
+        tot = b["staging_seconds_10m_rows_1m_entities"]
+        ssp = _span(caps, "staging_seconds_10m_rows_1m_entities")
+        tot_txt = (f"**{ssp[0]:.0f}–{ssp[1]:.0f} s** across clean captures "
+                   f"(this capture {tot:.0f} s)" if ssp
+                   else f"**{tot:.0f} s**")
+        samples = b.get("staging_projection_seconds_samples")
+        min_txt = (f"; min of {len(samples)} runs, spread "
+                   f"{min(samples):.1f}–{max(samples):.1f} s"
+                   if samples else "")
         row("Host staging, 10M rows / 1M entities / d=1M sparse",
-            f"**{b['staging_seconds_10m_rows_1m_entities']:.0f} s** "
-            f"(bucketing + per-entity subspace projection)",
+            f"{tot_txt} (bucketing + per-entity subspace projection)",
             f"host-side staging at 10M rows / 1M entities / d=1M sparse: "
-            f"**{b['staging_seconds_10m_rows_1m_entities']:.0f} s** total "
-            f"(build_bucketing "
+            f"{tot_txt} total (build_bucketing "
             f"{b.get('staging_bucketing_seconds', 0):.1f} s + projection "
-            f"{b.get('staging_projection_seconds', 0):.1f} s) — one "
-            f"vectorized sort + segment-reduce pass, no per-entity loops")
+            f"{b.get('staging_projection_seconds', 0):.1f} s{min_txt}) — "
+            f"one vectorized sort + segment-reduce pass, no per-entity "
+            f"loops")
     pal = b.get("scatter_pallas_d512_us")
     xla = b.get("scatter_xla_d512_us")
     if pal and xla:
@@ -157,12 +248,16 @@ def _lines(b):
     if cd20 is not None:
         auc20 = b.get("flagship_validation_auc")
         auc_txt = f", validation AUC {auc20:.3f}" if auc20 else ""
+        csp = _span(caps, "game_cd_iteration_seconds_20m")
+        cd_txt = (f"**{csp[0]:.1f}–{csp[1]:.1f} s** across captures "
+                  f"(this capture {cd20:.2f} s)" if csp
+                  else f"**{cd20:.2f} s**")
         row("GAME CD sweep, MovieLens-20M shape (20M rows, 138k users × "
             "27k items)",
-            f"**{cd20:.2f} s** steady-state{auc_txt}",
+            f"{cd_txt} steady-state{auc_txt}",
             f"the MovieLens-20M north-star shape (20M rows, 138k users × "
             f"27k items, bf16 storage, 64k active-row cap): "
-            f"**{cd20:.2f} s** per CD sweep{auc_txt} — reproduce with "
+            f"{cd_txt} per CD sweep{auc_txt} — reproduce with "
             f"dev-scripts/flagship_movielens.py --bf16")
     av = b.get("avro_native_records_per_sec")
     avp = b.get("avro_python_records_per_sec")
@@ -173,8 +268,8 @@ def _lines(b):
     return out
 
 
-def render_block(b, style):
-    lines = _lines(b)
+def render_block(b, style, caps=()):
+    lines = _lines(b, caps)
     if style == "readme":
         body = ["| Workload | Number |", "|---|---|"]
         body += [r for r, _ in lines]
@@ -194,12 +289,13 @@ def splice(text, block):
 def main(argv):
     check = "--check" in argv
     b = load_bench()
+    caps = load_capture_series()
     stale = []
     for path, style in [(os.path.join(ROOT, "README.md"), "readme"),
                         (os.path.join(ROOT, "docs", "PARITY.md"), "parity")]:
         with open(path) as fh:
             text = fh.read()
-        new = splice(text, render_block(b, style))
+        new = splice(text, render_block(b, style, caps))
         if new != text:
             if check:
                 stale.append(path)

@@ -415,7 +415,10 @@ def evaluation_suite(
     shards live on other processes cannot be gathered here (np.asarray on
     it raises), and the error below says so instead of crashing opaquely.
     """
-    target = jax.devices()[0]
+    # local_devices, not devices: in a multi-process (DCN) run, global
+    # device 0 belongs to rank 0 and device_put to a non-addressable
+    # device raises on every other rank.
+    target = jax.local_devices()[0]
 
     def _single_device(x):
         if isinstance(x, np.ndarray):
