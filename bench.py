@@ -747,8 +747,6 @@ def main():
             "sparse_ell_samples_per_sec":
                 sparse["sparse_ell_samples_per_sec"],
             "sparse_hybrid_hot_cols": sparse["sparse_hybrid_hot_cols"],
-            **{k: v for k, v in sparse.items()
-               if k.startswith("sparse_hybrid_staging_seconds")},
             "sparse_hybrid_sharded_samples_per_sec":
                 sparse["sparse_hybrid_sharded_samples_per_sec"],
             **sparse_re,

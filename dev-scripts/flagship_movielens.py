@@ -179,6 +179,8 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
             per_sweep_val, 3)
         out["flagship_validation_overhead_seconds_per_sweep"] = round(
             per_sweep_val - per_sweep, 3)
+        out["flagship_validation_seconds_per_pass"] = round(
+            (per_sweep_val - per_sweep) / len(seq), 3)
         log(f"sweep incl. {len(seq)} per-update validations: "
             f"{per_sweep_val:.2f}s ({per_sweep_val - per_sweep:+.2f}s vs "
             f"training-only)")

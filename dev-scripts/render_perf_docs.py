@@ -53,7 +53,7 @@ def load_capture_series():
     import glob
 
     caps = []
-    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r0*.json"))):
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
         if os.path.basename(p) == "BENCH_r01.json":
             continue
         try:
@@ -204,14 +204,18 @@ def _lines(b, caps=()):
         warm = b.get("sparse_re_staging_warm_seconds")
         warm_txt = (f" (warm re-stage from the digest-keyed cache "
                     f"{warm:.2f} s)" if warm is not None else "")
+        stg = b.get("sparse_re_staging_seconds")
+        stg_txt = (f" + {stg:.1f} s one-time staging"
+                   if stg is not None else "")
+        stg_bullet = (f" after {stg:.1f} s one-time staging"
+                      if stg is not None else "")
         row(f"Sparse random-effect fit ({cfgs})",
-            f"{b['sparse_re_fit_seconds']:.2f} s/fit + "
-            f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
-            f"staging" + warm_txt,
+            f"{b['sparse_re_fit_seconds']:.2f} s/fit"
+            + stg_txt + warm_txt,
             f"sparse random effects ({cfgs}): "
-            f"{b['sparse_re_fit_seconds']:.2f} s per train_model after "
-            f"{b.get('sparse_re_staging_seconds', 0):.1f} s one-time "
-            f"staging{warm_txt} — the (n, d) dense matrix never exists")
+            f"{b['sparse_re_fit_seconds']:.2f} s per train_model"
+            + stg_bullet + warm_txt
+            + " — the (n, d) dense matrix never exists")
     if b.get("staging_seconds_10m_rows_1m_entities") is not None:
         tot = b["staging_seconds_10m_rows_1m_entities"]
         ssp = _span(caps, "staging_seconds_10m_rows_1m_entities")
@@ -259,6 +263,21 @@ def _lines(b, caps=()):
             f"27k items, bf16 storage, 64k active-row cap): "
             f"{cd_txt} per CD sweep{auc_txt} — reproduce with "
             f"dev-scripts/flagship_movielens.py --bf16")
+        cdv = b.get("game_cd_iteration_seconds_20m_with_validation")
+        if cdv is not None:
+            # Per-pass cost comes from the capture itself (the flagship
+            # script knows its update-sequence length); no structural
+            # knowledge duplicated here.
+            per_val = b.get("flagship_validation_seconds_per_pass",
+                            (cdv - cd20) / 3.0)
+            row("…sweep incl. per-update validation (3 × 1M held-out "
+                "rows)",
+                f"**{cdv:.2f} s** ({per_val:.2f} s per device-resident "
+                f"validation pass)",
+                f"…with per-coordinate-update validation on the 1M-row "
+                f"held-out split: **{cdv:.2f} s** per sweep "
+                f"({per_val:.2f} s per validation pass — device-resident "
+                f"end to end; reproduce with --validate-each)")
     av = b.get("avro_native_records_per_sec")
     avp = b.get("avro_python_records_per_sec")
     if av and avp:
