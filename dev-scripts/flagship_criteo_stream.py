@@ -160,10 +160,17 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         ds, chunked, "global", losses.LOGISTIC, cfg,
         pin_device_chunks=pin,
         log=lambda m: log(f"  [fe-lbfgs] {m}"))
+    # Opt-in staging cache (set PML_CRITEO_STAGING_CACHE=/path): a
+    # crash-rerun then skips the ~20-minute host projection pass
+    # (digest-keyed; safe across identical generations). Opt-in because
+    # the cache holds the FULL f32 staged buckets — tens of GB at 100M
+    # rows — and a tmpfs-backed default would eat host RAM silently.
+    cache_dir = os.environ.get("PML_CRITEO_STAGING_CACHE") or None
     t0 = time.perf_counter()
     re_coord = RandomEffectCoordinate(
         ds, "userId", "re", losses.LOGISTIC, cfg, make_mesh(),
-        lower_bound=2, upper_bound=65536, feature_dtype="bfloat16")
+        lower_bound=2, upper_bound=65536, feature_dtype="bfloat16",
+        staging_cache_dir=cache_dir)
     re_staging = time.perf_counter() - t0
     log(f"RE staging {re_staging:.1f}s; host peak {_rss_gb():.1f} GB")
 

@@ -44,6 +44,27 @@ _UNSET = object()
 _LANE_CHUNK = 65536
 
 
+@jax.jit
+def _subspace_sparse_scores(W_flat, flatpos, values):
+    """Σ_k values[i,k] · W_flat[flatpos[i,k]] with misses (flatpos ≥ |W|)
+    contributing zero — one 1-D gather per ELL slot.
+
+    The slot loop is a TPU layout constraint, not style: a single fused
+    gather with (n, k, 1)-shaped indices forces the index operand into a
+    (8, 128)-tiled copy whose minor dims pad 4→128 — at n=100M that copy
+    is 51 GB and the COMPILE itself aborts with an HBM overflow (measured
+    on v5e). Per-slot (n,) indices lay out densely; k is ELL-small, so
+    the extra gathers cost nothing against the random-access wall.
+    """
+    lim = W_flat.shape[0]
+    acc = jnp.zeros((flatpos.shape[0],), jnp.float32)
+    for j in range(flatpos.shape[1]):
+        pos = flatpos[:, j]
+        g = W_flat[jnp.minimum(pos, lim - 1)] * (pos < lim)
+        acc = acc + values[:, j].astype(jnp.float32) * g
+    return acc
+
+
 class RandomEffectCoordinate:
     """Per-entity GLMs trained as vmapped bucket solves.
 
@@ -301,7 +322,21 @@ class RandomEffectCoordinate:
                 np.argsort(perm, axis=1, kind="stable").astype(np.int32))
             if self.is_sparse:
                 # Like _sp_values: score-side arrays stay process-local.
-                self._sp_flatpos = jnp.asarray(np.asarray(sub["flat"]))
+                flat = np.asarray(sub["flat"])
+                if flat.dtype == np.int64:
+                    # Device arrays are int32 (x64 off): a silent
+                    # jnp.asarray downcast would wrap flat positions
+                    # ≥ 2^31 into valid-looking wrong indices and score
+                    # garbage. Refuse with the actionable alternatives.
+                    if flat.max(initial=0) >= np.iinfo(np.int32).max:
+                        raise ValueError(
+                            f"subspace flat positions exceed int32 "
+                            f"(E×A = {int(self.subspace_cols.size)}): "
+                            "split this random effect into smaller "
+                            "coordinates or reduce active columns "
+                            "(features_to_samples_ratio / upper_bound)")
+                    flat = flat.astype(np.int32)
+                self._sp_flatpos = jnp.asarray(flat)
                 # The raw column ids are only needed by the dense-table
                 # score path — free the device copy at scale.
                 self._sp_indices = None
@@ -635,9 +670,8 @@ class RandomEffectCoordinate:
                 # Staged join: each data nonzero's flat slot in the (E, A)
                 # table was computed once at __init__ (misses → one past
                 # the end → zero contribution).
-                safe = jnp.minimum(self._sp_flatpos, W_flat.shape[0] - 1)
-                g = W_flat[safe] * (self._sp_flatpos < W_flat.shape[0])
-                return jnp.sum(self._sp_values * g, axis=-1)
+                return _subspace_sparse_scores(W_flat, self._sp_flatpos,
+                                               self._sp_values)
             cols = jnp.asarray(self._cols_dev)[self._ids]  # (n, A)
             xa = jnp.take_along_axis(
                 self._X, jnp.maximum(cols, 0), axis=1) * (cols >= 0)
