@@ -3,35 +3,34 @@
 Reference parity: photon-api ``DistributedGLMLossFunction`` computes each
 value/gradient as one Spark pass over RDD partitions (``treeAggregate``) —
 the n axis never has to fit on any single executor. This module is the
-TPU-native equivalent: the example rows live on HOST in fixed-size chunks
-staged into a hot-dense/cold-class layout (the ``ops/hybrid_sparse.py``
-design), and every objective evaluation streams them through the chip
-with double-buffered host→device prefetch, accumulating ``(value,
-gradient)`` in f32 on device. HBM holds at most ``prefetch_depth`` chunks
-plus the accumulators, so n is bounded by host RAM (or disk, via the
-chunk iterator), not by the 16 GB of one chip.
+TPU-native equivalent: the example rows live on HOST in fixed-size chunks,
+and every objective evaluation streams them through the chip with
+double-buffered host→device prefetch, accumulating ``(value, gradient)``
+in f32 on device. HBM holds at most ``prefetch_depth`` chunks plus the
+accumulators, so n is bounded by host RAM (or disk, via the chunk
+iterator), not by the 16 GB of one chip.
 
-**Canonical chunk structure — one compiled program for the whole stream.**
-Each jit specialization is a multi-minute remote compile in this
-environment, so chunks must share ONE program. Chunk layouts are
-therefore canonicalized:
+**Chunk layout: hot-dense block + cold ELL.** Each chunk densifies its
+top-``num_hot`` columns into an (n, H) MXU block (the Zipf head is the
+bulk of the nonzeros) and keeps the remaining entries in ELL with their
+ORIGINAL column ids (hot entries become inert pad slots). Two hard
+lessons at n=100M shape this (both measured on v5e, both aborting
+COMPILATION with HBM overflows before any data moved):
 
-  * the hot block is EXACTLY ``num_hot`` columns (the chunk's top-k by
-    count — the hot/cold split is a free execution choice, any split is
-    the same objective);
-  * cold columns group into power-of-two count classes as in
-    hybrid_sparse, and each class's column count is padded UP to a power
-    of two with dummy columns (all-pad rowids — inert);
-  * dummy hot/cold slots map to an EXTENDED permuted space: ``perm`` is
-    (D',) with dummies pointing at the sentinel column ``d`` (so
-    ``w_pad[perm]`` reads 0 for them), and ``inv`` maps every original
-    column to its extended slot (absent columns → slot D', a reserved
-    zero) so gradients come back to original space by pure GATHER — no
-    d-sized scatter per chunk.
+  * gathers/scatters must be per-ELL-slot 1-D ops — an index operand
+    shaped (n, k) or (n, k, 1) is materialized in a (8, 128)-tiled
+    layout whose minor dims pad to 128 (a 51 GB copy at n=100M);
+  * no flat concatenated streams — XLA lays a 128M-element 1-D
+    intermediate out as (64M, 2) tiled, padding 2→128 (a 33 GB copy).
+    This is why the device-resident hybrid's contiguous-class layout
+    (ops/hybrid_sparse.py), which wins 6-8× at bench scale, is NOT used
+    here: its per-class flat gather/scatter streams cannot compile at
+    streamed-chunk scale, and the stream is host→device transfer-bound
+    anyway, so the cold formulation's compute rate is immaterial.
 
-Chunks are iid rows of one distribution, so the quantized shapes collide
-across chunks with overwhelming probability; a chunk that still differs
-merely triggers one extra compile (logged by ``build_chunked``).
+Every chunk has identical array shapes by construction ((n, H), (H,),
+(n, k)), so the WHOLE stream shares ONE compiled program — per-structure
+compiles are multi-minute remote operations in this environment.
 """
 
 from __future__ import annotations
@@ -52,32 +51,32 @@ Array = jax.Array
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class CanonicalChunk:
-    """One chunk in the canonical hot/cold layout (leaves may be host
-    numpy — device placement happens at stream time)."""
+    """One chunk: hot-dense block + cold ELL (leaves may be host numpy —
+    device placement happens at stream time)."""
 
-    X_hot: Array  # (n, H)
-    cold_rowids: tuple[Array, ...]  # per class: (C_pad, L) int32, pad == n
-    cold_vals: tuple[Array, ...]  # per class: (C_pad, L) f32, pad == 0
+    X_hot: Array  # (n, H) — the chunk's top-H columns, densified
+    hot_cols: Array  # (H,) int32 original column ids (pad == d)
+    cold_cols: Array  # (n, k) int32 original ids; hot/pad entries == d
+    cold_vals: Array  # (n, k); hot/pad entries == 0
     labels: Array  # (n,)
     weights: Array  # (n,); 0 marks pad rows of a short final chunk
     offsets: Array  # (n,)
-    perm: Array  # (D',) int32: extended slot -> original col (dummy == d)
-    inv: Array  # (d,) int32: original col -> extended slot (absent == D')
     num_features: int = dataclasses.field(metadata=dict(static=True))
-    num_hot: int = dataclasses.field(metadata=dict(static=True))
-    # Extended-space offset of each class (0 == first slot after hot).
-    class_starts: tuple[int, ...] = dataclasses.field(
-        metadata=dict(static=True))
 
     @property
     def num_rows(self) -> int:
         return self.labels.shape[0]
 
+    @property
+    def num_hot(self) -> int:
+        return self.X_hot.shape[1]
+
     def structure(self):
-        """Shape signature — equal signatures share one compiled program."""
-        return (self.X_hot.shape, self.num_hot,
-                tuple(r.shape for r in self.cold_rowids),
-                self.class_starts)
+        """Shape signature — equal signatures share one compiled program.
+        Identical across chunks by construction; kept for the invariant
+        test."""
+        return (self.X_hot.shape, self.cold_cols.shape,
+                self.num_features)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,116 +110,57 @@ def plan_num_hot(chunk_rows: int, hot_block_bytes: int,
     return max(8, int(hot_block_bytes) // (chunk_rows * bytes_per))
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << max(int(x) - 1, 0).bit_length()
-
-
-def _build_canonical(raw, d: int, num_hot: int, feature_dtype,
-                     min_class_cols: int = 8) -> CanonicalChunk:
-    """Stage one ELL chunk into the canonical layout (host numpy)."""
+def _build_canonical(raw, d: int, num_hot: int,
+                     feature_dtype) -> CanonicalChunk:
+    """Stage one ELL chunk into hot-dense + cold-ELL (host numpy)."""
     indices = np.asarray(raw.indices)
     values = np.asarray(raw.values)
     n = indices.shape[0]
+    H = num_hot
 
     flat_col = indices.reshape(-1)
-    flat_row = np.repeat(np.arange(n, dtype=np.int32), indices.shape[1])
     flat_val = values.reshape(-1)
     live = (flat_col < d) & (flat_val != 0.0)
     counts = np.bincount(flat_col[live], minlength=d)
-    order_desc = np.argsort(-counts, kind="stable").astype(np.int32)
+    # Top-H by count (stable → ties break on column id). Columns with
+    # count 0 may land in the tail of hot_cols on tiny chunks — their
+    # X_hot columns stay zero and their id is replaced by the sentinel.
+    order = np.argpartition(-counts, min(H, d) - 1)[:H].astype(np.int32)
+    order = order[np.argsort(-counts[order], kind="stable")]
+    hot_live = counts[order] > 0
+    hot_cols = np.where(hot_live, order, d).astype(np.int32)
+    if H > order.size:  # d < H (tiny configs): pad the hot set
+        hot_cols = np.concatenate(
+            [hot_cols, np.full(H - order.size, d, np.int32)])
 
-    H = num_hot
-    hot_cols = order_desc[:H]  # top-H by count (some may be count 0)
-    hot_live = counts[hot_cols] > 0
+    hot_slot = np.full(d + 1, -1, np.int64)
+    hot_slot[hot_cols[hot_cols < d]] = np.flatnonzero(hot_cols < d)
 
-    # inv_new: original col -> extended slot (filled as we lay out).
-    slot_of = np.full(d + 1, -1, np.int64)
-    slot_of[hot_cols] = np.arange(H)
-
-    new_col = slot_of[np.minimum(flat_col, d)]
+    flat_row = np.repeat(np.arange(n, dtype=np.int32), indices.shape[1])
+    slot = hot_slot[np.minimum(flat_col, d)]
+    hot_sel = live & (slot >= 0)
     X_hot = np.zeros((n, H), np.float32)
-    hot_sel = live & (new_col >= 0)
-    X_hot[flat_row[hot_sel], new_col[hot_sel]] = flat_val[hot_sel]
+    X_hot[flat_row[hot_sel], slot[hot_sel]] = flat_val[hot_sel]
 
-    # Cold columns: count-desc after the hot set, pow-2 count classes.
-    cold_cols = order_desc[H:]
-    cold_counts = counts[cold_cols]
-    present = int((cold_counts > 0).sum())
-    cold_cols = cold_cols[:present]
-    cold_counts = cold_counts[:present]
-
-    cold_sel = live & (new_col < 0)
-    c_col = flat_col[cold_sel]
-    c_row = flat_row[cold_sel]
-    c_val = flat_val[cold_sel]
-    # Column-contiguous cold stream (count-desc order of cold columns).
-    rank_of = np.full(d, np.iinfo(np.int64).max, np.int64)
-    rank_of[cold_cols] = np.arange(present)
-    order = np.argsort(rank_of[c_col], kind="stable")
-    c_row, c_val = c_row[order], c_val[order]
-    col_start = np.concatenate(
-        [[0], np.cumsum(cold_counts)[:-1]]).astype(np.int64)
-
-    rowids_cls: list[np.ndarray] = []
-    vals_cls: list[np.ndarray] = []
-    class_starts: list[int] = []
-    perm_cold: list[np.ndarray] = []
-    ext_off = 0
-    if present:
-        cls = np.ceil(np.log2(np.maximum(cold_counts, 1))).astype(np.int64)
-        for kk in np.unique(cls)[::-1]:
-            sel = np.flatnonzero(cls == kk)
-            L = 1 << int(kk)
-            C = sel.size
-            C_pad = max(_next_pow2(C), min_class_cols)
-            rp = np.full((C_pad, L), n, np.int32)
-            vp = np.zeros((C_pad, L), np.float32)
-            starts = col_start[sel]
-            cnts = cold_counts[sel].astype(np.int64)
-            total = int(cnts.sum())
-            colpos = np.arange(total) - np.repeat(
-                np.concatenate([[0], np.cumsum(cnts)[:-1]]), cnts)
-            src = np.repeat(starts, cnts) + colpos
-            crow = np.repeat(np.arange(C, dtype=np.int64), cnts)
-            rp[crow, colpos] = c_row[src]
-            vp[crow, colpos] = c_val[src]
-            rowids_cls.append(rp)
-            vals_cls.append(vp)
-            class_starts.append(ext_off)
-            p = np.full(C_pad, d, np.int32)  # dummies -> sentinel col d
-            p[:C] = cold_cols[sel]
-            perm_cold.append(p)
-            slot_of[cold_cols[sel]] = H + ext_off + np.arange(C)
-            ext_off += C_pad
-
-    hot_perm = np.where(hot_live, hot_cols, d).astype(np.int32)
-    perm = np.concatenate([hot_perm] + perm_cold) if perm_cold \
-        else hot_perm
-    D = perm.shape[0]
-    inv = np.where(slot_of[:d] >= 0, slot_of[:d], D).astype(np.int32)
+    # Cold ELL: the original (n, k) arrays with hot entries inert.
+    is_hot2d = (slot >= 0).reshape(indices.shape)
+    dead = is_hot2d | ~live.reshape(indices.shape)
+    cold_cols = np.where(dead, d, indices).astype(np.int32)
+    cold_vals = np.where(dead, 0.0, values).astype(np.float32)
 
     if feature_dtype == jnp.bfloat16:
         # Host-side cast halves the host→device stream — which IS the
         # steady-state cost of every streamed objective evaluation.
-        # Cold values are storage like the hot block (products upcast to
-        # f32 in-kernel), so they follow the same dtype contract.
+        # Values are storage (products upcast to f32 in-kernel).
         import ml_dtypes
 
         X_hot = X_hot.astype(ml_dtypes.bfloat16)
-        vals_cls = [v.astype(ml_dtypes.bfloat16) for v in vals_cls]
+        cold_vals = cold_vals.astype(ml_dtypes.bfloat16)
     return CanonicalChunk(
-        X_hot=X_hot,
-        cold_rowids=tuple(rowids_cls),
-        cold_vals=tuple(vals_cls),
-        labels=np.asarray(raw.labels),
-        weights=np.asarray(raw.weights),
-        offsets=np.asarray(raw.offsets),
-        perm=perm,
-        inv=inv,
-        num_features=d,
-        num_hot=H,
-        class_starts=tuple(class_starts),
-    )
+        X_hot=X_hot, hot_cols=hot_cols, cold_cols=cold_cols,
+        cold_vals=cold_vals, labels=np.asarray(raw.labels),
+        weights=np.asarray(raw.weights), offsets=np.asarray(raw.offsets),
+        num_features=d)
 
 
 def build_chunked(
@@ -229,7 +169,7 @@ def build_chunked(
     chunk_rows: int,
     num_hot: int = 512,
     feature_dtype=jnp.float32,
-    log: Callable[[str], None] = lambda m: None,
+    log: Optional[Callable[[str], None]] = None,
 ) -> ChunkedHybrid:
     """Stage a stream of ELL chunks into host-resident canonical layouts.
 
@@ -240,86 +180,44 @@ def build_chunked(
     num_hot = min(num_hot, num_features)
     chunks = []
     total = 0
+    short_at = None
     for i, raw in enumerate(chunk_iter):
+        if short_at is not None:
+            # Row bookkeeping (margins_chunked's z[:num_rows] tail drop,
+            # _offsets_for's i*chunk_rows slices) assumes pad rows exist
+            # only at the STREAM tail; a mid-stream short chunk would
+            # silently misalign residuals.
+            raise ValueError(
+                f"chunk {short_at} was short but chunk {i} follows — "
+                f"only the final chunk may have fewer than chunk_rows="
+                f"{chunk_rows} rows")
         n_i = int(np.asarray(raw.labels).shape[0])
         if n_i > chunk_rows:
             raise ValueError(f"chunk {i} has {n_i} rows > chunk_rows="
                              f"{chunk_rows}")
         total += n_i
         if n_i < chunk_rows:
+            short_at = i
             raw = _pad_chunk(raw, chunk_rows, num_features)
         ch = _build_canonical(raw, num_features, num_hot, feature_dtype)
         chunks.append(ch)
-        log(f"staged chunk {i} ({n_i:,} rows, {ch.perm.shape[0]} extended "
-            f"cols, {len(ch.cold_rowids)} cold classes)")
+        if log is not None:
+            cold_live = int((np.asarray(ch.cold_cols) <
+                             num_features).sum())
+            log(f"staged chunk {i} ({n_i:,} rows, {num_hot} hot cols, "
+                f"{cold_live:,} cold nnz)")
     if not chunks:
         raise ValueError("empty chunk stream")
-    # Reconcile to the UNION structure: pow-2 quantization alone flaps at
-    # class boundaries between iid chunks, and every distinct structure
-    # would be its own multi-minute remote compile. Pad each chunk's
-    # classes up to the union (L → max C_pad over chunks; missing classes
-    # appear as all-dummy) so the whole stream shares ONE program.
-    union: dict[int, int] = {}
-    for ch in chunks:
-        for rows in ch.cold_rowids:
-            C, L = rows.shape
-            union[L] = max(union.get(L, 0), C)
     sigs = {ch.structure() for ch in chunks}
-    if len(sigs) > 1 or any(
-            dict((r.shape[1], r.shape[0]) for r in ch.cold_rowids) != union
-            for ch in chunks):
-        log(f"reconciling {len(sigs)} chunk structures to the union "
-            f"({sorted(union.items(), reverse=True)})")
-        chunks = [_repad_to(ch, union) for ch in chunks]
-        assert len({ch.structure() for ch in chunks}) == 1
+    if len(sigs) > 1:
+        # Shapes inherit the source's ELL width — a source that pads
+        # per-chunk (varying max_nnz) breaks the one-program invariant.
+        raise ValueError(
+            f"chunks have {len(sigs)} distinct structures {sigs}; pad "
+            "every chunk's ELL to one shared max_nnz so the stream "
+            "shares a single compiled program")
     return ChunkedHybrid(chunks=tuple(chunks), num_rows=total,
                          chunk_rows=chunk_rows)
-
-
-def _repad_to(ch: CanonicalChunk, union: dict[int, int]) -> CanonicalChunk:
-    """Pad a chunk's cold classes to the union structure (L desc order).
-    Dummy columns: rowids == n (inert scatter/gather), vals 0, perm slot
-    == d (reads the sentinel 0 coefficient); inv is rebuilt from perm."""
-    n = ch.labels.shape[0]
-    d = ch.num_features
-    by_L = {r.shape[1]: (r, v)
-            for r, v in zip(ch.cold_rowids, ch.cold_vals)}
-    # Per-class perm slices of the ORIGINAL layout.
-    perm = np.asarray(ch.perm)
-    perm_by_L = {}
-    off = ch.num_hot
-    for r in ch.cold_rowids:
-        C, L = r.shape
-        perm_by_L[L] = perm[off: off + C]
-        off += C
-    rows_out, vals_out, perm_out, starts = [], [], [perm[:ch.num_hot]], []
-    ext = 0
-    for L in sorted(union, reverse=True):
-        C_t = union[L]
-        vdt = ch.cold_vals[0].dtype if ch.cold_vals else np.float32
-        r, v = by_L.get(L, (np.full((0, L), n, np.int32),
-                            np.zeros((0, L), vdt)))
-        C = r.shape[0]
-        if C < C_t:
-            r = np.concatenate(
-                [np.asarray(r), np.full((C_t - C, L), n, np.int32)])
-            v = np.concatenate(
-                [np.asarray(v), np.zeros((C_t - C, L), vdt)])
-        p = np.full(C_t, d, np.int32)
-        p[:C] = perm_by_L.get(L, np.zeros((0,), np.int32))
-        rows_out.append(np.asarray(r))
-        vals_out.append(np.asarray(v))
-        perm_out.append(p)
-        starts.append(ext)
-        ext += C_t
-    new_perm = np.concatenate(perm_out)
-    D = new_perm.shape[0]
-    inv = np.full(d, D, np.int32)
-    real = new_perm < d
-    inv[new_perm[real]] = np.flatnonzero(real).astype(np.int32)
-    return dataclasses.replace(
-        ch, cold_rowids=tuple(rows_out), cold_vals=tuple(vals_out),
-        perm=new_perm, inv=inv, class_starts=tuple(starts))
 
 
 def _pad_chunk(raw, chunk_rows: int, d: int):
@@ -354,69 +252,47 @@ def _masked(weights: Array, term: Array) -> Array:
     return jnp.where(weights > 0.0, weights * term, 0.0)
 
 
-def _ext_coefficients(ch: CanonicalChunk, w: Array) -> Array:
-    """(D',) extended-space coefficients: dummies read the sentinel 0."""
-    w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
-    return w_pad[ch.perm]
-
-
-def _chunk_margins_ext(ch: CanonicalChunk, w_ext: Array,
-                       offsets: Array) -> Array:
-    n = ch.labels.shape[0]
-    z = offsets + _hot_matvec(ch.X_hot, w_ext[:ch.num_hot])
-    if ch.cold_rowids:
-        parts = []
-        for start, rows, vals in zip(ch.class_starts, ch.cold_rowids,
-                                     ch.cold_vals):
-            C = rows.shape[0]
-            w_c = w_ext[ch.num_hot + start: ch.num_hot + start + C]
-            parts.append((w_c[:, None] * vals).reshape(-1))
-        flat_rows = jnp.concatenate(
-            [r.reshape(-1) for r in ch.cold_rowids])
-        acc = jnp.zeros((n + 1,), jnp.float32).at[flat_rows].add(
-            jnp.concatenate(parts))
-        z = z + acc[:n]
+def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array,
+                      offsets: Array) -> Array:
+    """(n,) wᵀx + offset. Hot: one MXU matvec. Cold: one 1-D gather per
+    ELL slot (per-slot, 1-D — see the module docstring's layout rules)."""
+    z = offsets + _hot_matvec(ch.X_hot, w_pad[ch.hot_cols])
+    for j in range(ch.cold_cols.shape[1]):
+        z = z + w_pad[ch.cold_cols[:, j]] * \
+            ch.cold_vals[:, j].astype(jnp.float32)
     return z
 
 
 def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
-    """Σᵢ rᵢ·xᵢ in ORIGINAL space, via the extended layout + one gather."""
-    parts = [_hot_rmatvec(ch.X_hot, r).astype(jnp.float32)]
-    if ch.cold_rowids:
-        r_pad = jnp.concatenate([r, jnp.zeros((1,), r.dtype)])
-        flat_rows = jnp.concatenate(
-            [rr.reshape(-1) for rr in ch.cold_rowids])
-        gathered = r_pad[flat_rows]
-        off = 0
-        for rows, vals in zip(ch.cold_rowids, ch.cold_vals):
-            C, L = rows.shape
-            ru = gathered[off: off + C * L].reshape(C, L)
-            parts.append(jnp.sum(ru * vals, axis=1))
-            off += C * L
-    g_ext = jnp.concatenate(parts)
-    g_ext = jnp.concatenate([g_ext, jnp.zeros((1,), jnp.float32)])
-    return g_ext[ch.inv]  # absent cols hit the reserved zero slot
+    """Σᵢ rᵢ·xᵢ in original space: hot rmatvec + one (d+1,)-table
+    scatter-add per cold ELL slot (pad entries land on the sentinel
+    column d and are dropped)."""
+    acc = jnp.zeros((ch.num_features + 1,), jnp.float32)
+    for j in range(ch.cold_cols.shape[1]):
+        acc = acc.at[ch.cold_cols[:, j]].add(
+            r * ch.cold_vals[:, j].astype(jnp.float32))
+    g_hot = _hot_rmatvec(ch.X_hot, r).astype(jnp.float32)
+    acc = acc.at[ch.hot_cols].add(g_hot)
+    return acc[:ch.num_features]
 
 
 # Kernels are cached per loss (and the margins kernel is a singleton):
 # a fresh @jax.jit wrapper per call would re-trace the chunk program on
-# every coordinate-descent update — exactly the repeated remote compile
-# the canonical structure exists to avoid.
+# every coordinate-descent update.
 _VG_KERNELS: dict = {}
 
 
 def _chunk_value_grad(loss: PointwiseLoss):
     """One jitted per-chunk pass: original-space w in, original-space
-    (value, grad) out — shared by every chunk with the same canonical
-    structure."""
+    (value, grad) out — shared by every chunk (identical structures)."""
     f = _VG_KERNELS.get(loss.name)
     if f is not None:
         return f
 
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
-        w_ext = _ext_coefficients(ch, w)
-        z = _chunk_margins_ext(ch, w_ext, offsets)
+        w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+        z = _chunk_margins_of(ch, w_pad, offsets)
         l, dl = loss.loss_and_dz(z, ch.labels)
         value = jnp.sum(_masked(ch.weights, l))
         r = _masked(ch.weights, dl)
@@ -428,7 +304,8 @@ def _chunk_value_grad(loss: PointwiseLoss):
 
 @jax.jit
 def _margins_kernel(w: Array, offsets: Array, ch: CanonicalChunk):
-    return _chunk_margins_ext(ch, _ext_coefficients(ch, w), offsets)
+    w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+    return _chunk_margins_of(ch, w_pad, offsets)
 
 
 def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
