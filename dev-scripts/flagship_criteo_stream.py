@@ -19,7 +19,7 @@ that materializes an O(n × anything) device block can hold it. This run:
     truth, and the host's peak RSS (the flat-memory claim, measured).
 
     python dev-scripts/flagship_criteo_stream.py \
-        [--rows 100000000] [--chunk-rows 10000000] [--json]
+        [--rows 100000000] [--chunk-rows 5000000] [--pin-gb 2.0] [--json]
 
 Defaults need ~35 GB host RAM (staged chunks + RE arrays) and one 16 GB
 chip (bf16 feature storage on both coordinates). Smaller sanity run:
@@ -46,8 +46,8 @@ def _rss_gb() -> float:
 
 
 def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
-                      nnz_fe=8, nnz_re=4, chunk_rows=10_000_000,
-                      hot_block_gb=1.25, pin_gb=4.0, iterations=2,
+                      nnz_fe=8, nnz_re=4, chunk_rows=5_000_000,
+                      hot_block_gb=1.25, pin_gb=2.0, iterations=2,
                       seed=11, log=lambda m: None):
     import jax
     import jax.numpy as jnp
@@ -210,7 +210,8 @@ def main():
     ap.add_argument("--rows", type=int, default=100_000_000)
     ap.add_argument("--features", type=int, default=1_000_000)
     ap.add_argument("--entities", type=int, default=1_000_000)
-    ap.add_argument("--chunk-rows", type=int, default=10_000_000)
+    ap.add_argument("--chunk-rows", type=int, default=5_000_000)
+    ap.add_argument("--pin-gb", type=float, default=2.0)
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
@@ -221,7 +222,8 @@ def main():
 
     out = run_criteo_stream(
         n_rows=args.rows, d=args.features, n_entities=args.entities,
-        chunk_rows=args.chunk_rows, iterations=args.iterations, log=log)
+        chunk_rows=args.chunk_rows, pin_gb=args.pin_gb,
+        iterations=args.iterations, log=log)
     if args.json:
         print(json.dumps(out))
     else:

@@ -122,16 +122,15 @@ def _build_canonical(raw, d: int, num_hot: int,
     flat_val = values.reshape(-1)
     live = (flat_col < d) & (flat_val != 0.0)
     counts = np.bincount(flat_col[live], minlength=d)
-    # Top-H by count (stable → ties break on column id). Columns with
-    # count 0 may land in the tail of hot_cols on tiny chunks — their
-    # X_hot columns stay zero and their id is replaced by the sentinel.
-    order = np.argpartition(-counts, min(H, d) - 1)[:H].astype(np.int32)
+    # Top-H by count (count ties at the hot boundary break arbitrarily —
+    # the hot/cold split is an execution choice, any split is the same
+    # objective). Columns with count 0 may land in the tail of hot_cols
+    # on tiny chunks — their X_hot columns stay zero and their id is
+    # replaced by the sentinel. build_chunked guarantees H <= d.
+    order = np.argpartition(-counts, H - 1)[:H].astype(np.int32)
     order = order[np.argsort(-counts[order], kind="stable")]
     hot_live = counts[order] > 0
     hot_cols = np.where(hot_live, order, d).astype(np.int32)
-    if H > order.size:  # d < H (tiny configs): pad the hot set
-        hot_cols = np.concatenate(
-            [hot_cols, np.full(H - order.size, d, np.int32)])
 
     hot_slot = np.full(d + 1, -1, np.int64)
     hot_slot[hot_cols[hot_cols < d]] = np.flatnonzero(hot_cols < d)
@@ -316,6 +315,11 @@ def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
     transfer)."""
     import collections
 
+    if depth < 1:
+        # depth=0 would silently yield no streamed chunks at all (the
+        # priming loop never fills the queue) — a zero value/gradient,
+        # not a slower one.
+        raise ValueError(f"prefetch_depth must be >= 1, got {depth}")
     for ch in pinned:
         yield ch
     q = collections.deque()
@@ -379,6 +383,14 @@ def make_value_and_gradient(
             v, g = kernel(w, _offsets_for(chunked, offsets, i, ch), ch)
             value = value + v
             grad = grad + g
+            # Barrier per chunk: the runtime holds every enqueued
+            # program's scratch from ENQUEUE time, and a full unsynced
+            # pass over the stream exhausts HBM at scale (measured: the
+            # 100M-row run died on its first evaluation). The next
+            # chunk's host→device copy is already in flight (_stream
+            # prefetch), so the barrier costs one tunnel round trip per
+            # chunk against a transfer-bound pass.
+            jax.block_until_ready(grad)
         return value, grad
 
     return value_and_grad
@@ -396,5 +408,6 @@ def margins_chunked(
     for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
         parts.append(_margins_kernel(
             w, _offsets_for(chunked, offsets, i, ch), ch))
+        jax.block_until_ready(parts[-1])  # same enqueue-scratch barrier
     z = jnp.concatenate(parts)
     return z[:chunked.num_rows]
