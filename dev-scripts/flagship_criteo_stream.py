@@ -48,7 +48,7 @@ def _rss_gb() -> float:
 def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
                       nnz_fe=8, nnz_re=4, chunk_rows=5_000_000,
                       hot_block_gb=1.25, pin_gb=2.0, iterations=2,
-                      seed=11, log=lambda m: None):
+                      fe_opt_iters=12, seed=11, log=lambda m: None):
     import jax
     import jax.numpy as jnp
 
@@ -147,6 +147,12 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
     cfg = GLMOptimizationConfiguration(
         optimizer=OptimizerConfig(max_iterations=12, tolerance=1e-6),
         regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    # FE iterations are the wall-clock knob at streamed scale (one
+    # iteration ≈ one full pass over the stream).
+    fe_cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=fe_opt_iters,
+                                  tolerance=1e-6),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
 
     # Pin as many leading chunks as the HBM budget allows: each pinned
     # chunk is stream traffic saved on EVERY objective evaluation.
@@ -157,7 +163,7 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
     log(f"chunk ≈ {chunk_bytes / 2**30:.2f} GiB on device; pinning {pin} "
         f"of {chunked.num_chunks} chunks (budget {pin_gb} GiB)")
     fe_coord = StreamingSparseFixedEffectCoordinate(
-        ds, chunked, "global", losses.LOGISTIC, cfg,
+        ds, chunked, "global", losses.LOGISTIC, fe_cfg,
         pin_device_chunks=pin,
         log=lambda m: log(f"  [fe-lbfgs] {m}"))
     # Opt-in staging cache (set PML_CRITEO_STAGING_CACHE=/path): a
@@ -211,8 +217,15 @@ def main():
     ap.add_argument("--features", type=int, default=1_000_000)
     ap.add_argument("--entities", type=int, default=1_000_000)
     ap.add_argument("--chunk-rows", type=int, default=5_000_000)
+    ap.add_argument("--hot-gb", type=float, default=0.625,
+                    help="per-chunk hot-block byte budget; scale it with "
+                         "chunk_rows so the TOTAL hot bytes (and the "
+                         "per-evaluation stream) stay constant")
     ap.add_argument("--pin-gb", type=float, default=2.0)
     ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--fe-iters", type=int, default=12,
+                    help="FE L-BFGS iterations (each is a full pass "
+                         "over the stream)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -222,8 +235,9 @@ def main():
 
     out = run_criteo_stream(
         n_rows=args.rows, d=args.features, n_entities=args.entities,
-        chunk_rows=args.chunk_rows, pin_gb=args.pin_gb,
-        iterations=args.iterations, log=log)
+        chunk_rows=args.chunk_rows, hot_block_gb=args.hot_gb,
+        pin_gb=args.pin_gb, iterations=args.iterations,
+        fe_opt_iters=args.fe_iters, log=log)
     if args.json:
         print(json.dumps(out))
     else:
