@@ -217,10 +217,12 @@ def main():
     ap.add_argument("--features", type=int, default=1_000_000)
     ap.add_argument("--entities", type=int, default=1_000_000)
     ap.add_argument("--chunk-rows", type=int, default=5_000_000)
-    ap.add_argument("--hot-gb", type=float, default=0.625,
-                    help="per-chunk hot-block byte budget; scale it with "
-                         "chunk_rows so the TOTAL hot bytes (and the "
-                         "per-evaluation stream) stay constant")
+    ap.add_argument("--hot-gb", type=float, default=None,
+                    help="per-chunk hot-block byte budget (default: the "
+                         "run_criteo_stream default scaled by "
+                         "chunk_rows/10M, so the TOTAL hot bytes and the "
+                         "per-evaluation stream stay constant across "
+                         "chunk sizes)")
     ap.add_argument("--pin-gb", type=float, default=2.0)
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--fe-iters", type=int, default=12,
@@ -233,9 +235,14 @@ def main():
         print(f"[criteo-stream {time.strftime('%H:%M:%S')}] {m}",
               file=sys.stderr, flush=True)
 
+    # One source of truth for the hot budget: the function default is
+    # per-10M-row-chunk; scale it so total hot bytes are chunk-size
+    # invariant unless the caller overrides explicitly.
+    hot_gb = (args.hot_gb if args.hot_gb is not None
+              else 1.25 * args.chunk_rows / 10_000_000)
     out = run_criteo_stream(
         n_rows=args.rows, d=args.features, n_entities=args.entities,
-        chunk_rows=args.chunk_rows, hot_block_gb=args.hot_gb,
+        chunk_rows=args.chunk_rows, hot_block_gb=hot_gb,
         pin_gb=args.pin_gb, iterations=args.iterations,
         fe_opt_iters=args.fe_iters, log=log)
     if args.json:

@@ -36,6 +36,7 @@ compiles are multi-minute remote operations in this environment.
 from __future__ import annotations
 
 import dataclasses
+import gc
 from typing import Callable, Iterable, Optional
 
 import jax
@@ -377,8 +378,6 @@ def make_value_and_gradient(
     kernel = _chunk_value_grad(loss)
 
     def value_and_grad(w: Array, offsets: Optional[Array] = None):
-        import gc
-
         value = jnp.zeros((), jnp.float32)
         grad = jnp.zeros((chunked.dim,), jnp.float32)
         for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
@@ -422,8 +421,6 @@ def margins_chunked(
     pinned=(),
 ) -> Array:
     """(num_rows,) margins (wᵀx + offset), streamed; pad rows dropped."""
-    import gc
-
     parts = []
     for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
         parts.append(_margins_kernel(
