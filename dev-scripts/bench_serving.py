@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Serving bench: synthetic traffic against a resident ScoringService.
+
+Drives Zipf-skewed request traffic (realistic per-user activity — the same
+skew the training bucketing exploits) through the full serving path:
+micro-batcher → shape-bucketed jitted scorer → LRU random-effect cache.
+Emits one BENCH-style JSON line, like bench.py:
+
+    JAX_PLATFORMS=cpu python dev-scripts/bench_serving.py
+
+Reported: request p50/p95/p99 latency (submit → result, closed-loop
+clients), steady-state throughput, batch-fill ratio, RE-cache hit rate,
+and — the compile-discipline check — steady-state recompiles, which must
+be ZERO (warmup owns every bucket shape).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-entities", type=int, default=20000)
+    p.add_argument("--d-global", type=int, default=32)
+    p.add_argument("--d-re", type=int, default=16)
+    p.add_argument("--cache-entities", type=int, default=2048)
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=1.0)
+    p.add_argument("--clients", type=int, default=8,
+                   help="closed-loop client threads")
+    p.add_argument("--requests-per-client", type=int, default=400)
+    p.add_argument("--entity-skew", type=float, default=1.2,
+                   help="Zipf exponent of the entity draw")
+    p.add_argument("--unseen-frac", type=float, default=0.02,
+                   help="fraction of requests with unknown entities")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving import ScoringRequest, ScoringService
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    rng = np.random.default_rng(args.seed)
+    E, dg, dr = args.num_entities, args.d_global, args.d_re
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray((rng.normal(size=(E, dr)) * 0.5
+                         ).astype(np.float32))),
+    })
+    t0 = time.perf_counter()
+    service = ScoringService(
+        model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        cache_entities=args.cache_entities)
+    load_seconds = time.perf_counter() - t0
+
+    p = 1.0 / np.arange(1, E + 1) ** args.entity_skew
+    p /= p.sum()
+
+    def make_request(r):
+        if r.random() < args.unseen_frac:
+            eid = E + int(r.integers(0, 1000))
+        else:
+            eid = int(r.choice(E, p=p))
+        return ScoringRequest(
+            features={"global": r.normal(size=dg).astype(np.float32),
+                      "re_userId": r.normal(size=dr).astype(np.float32)},
+            entity_ids={"userId": eid})
+
+    def client(cid, count, record):
+        r = np.random.default_rng(args.seed + 1000 + cid)
+        reqs = [make_request(r) for _ in range(count)]
+        for req in reqs:
+            t = time.perf_counter()
+            service.submit(req).result(timeout=60)
+            if record is not None:
+                record.append(time.perf_counter() - t)
+
+    # Warmup: touch every bucket shape (lone requests through the deadline
+    # path + full concurrent batches) so steady state owns its programs.
+    warm_rng = np.random.default_rng(args.seed + 99)
+    for n in (1, 2, 4, 8):
+        for req in [make_request(warm_rng) for _ in range(n)]:
+            service.submit(req)
+        time.sleep(0.05)
+    with concurrent.futures.ThreadPoolExecutor(args.clients) as ex:
+        list(ex.map(lambda c: client(c, 40, None), range(args.clients)))
+    compiles_after_warmup = service.metrics.snapshot()["compiles_total"]
+    rows_after_warmup = service.metrics.snapshot()["rows_total"]
+
+    # Measured steady-state phase.
+    latencies: list[float] = []
+    t0 = time.perf_counter()
+    threads = [threading.Thread(
+        target=client, args=(c, args.requests_per_client, latencies))
+        for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    snap = service.metrics.snapshot()
+    service.close()
+    lat = np.asarray(latencies) * 1e3
+    total = len(latencies)
+    out = {
+        "metric": "serving_p99_latency_ms",
+        "value": round(float(np.percentile(lat, 99)), 4),
+        "unit": "ms",
+        "secondary": {
+            "p50_latency_ms": round(float(np.percentile(lat, 50)), 4),
+            "p95_latency_ms": round(float(np.percentile(lat, 95)), 4),
+            "mean_latency_ms": round(float(lat.mean()), 4),
+            "throughput_rows_per_sec": round(total / wall, 1),
+            "steady_state_seconds": round(wall, 3),
+            "steady_state_requests": total,
+            "batch_fill_ratio": round(snap["batch_fill_ratio"], 4),
+            "re_cache_hit_rate": round(
+                snap["re_cache"]["per-user"]["hit_rate"], 4),
+            "re_cache_evictions": snap["re_cache"]["per-user"]["evictions"],
+            "unseen_rows": snap["re_cache"]["per-user"]["unseen"],
+            "compiles_total": snap["compiles_total"],
+            "steady_state_recompiles":
+                snap["compiles_total"] - compiles_after_warmup,
+            "warmup_rows": rows_after_warmup,
+            "model_load_seconds": round(load_seconds, 3),
+            "clients": args.clients,
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "cache_entities": args.cache_entities,
+            "num_entities": E,
+            "config": f"E={E} d_global={dg} d_re={dr} "
+                      f"skew={args.entity_skew}",
+        },
+    }
+    if out["secondary"]["steady_state_recompiles"] != 0:
+        print("WARNING: steady state recompiled — bucketing is broken",
+              file=sys.stderr)
+    json.dump(out, sys.stdout)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@ GameModel + data → scores, with optional evaluation
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
@@ -18,6 +19,7 @@ from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.evaluation import evaluators as ev
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.utils.events import ScoringBatch, default_emitter
 
 Array = jax.Array
 
@@ -47,7 +49,11 @@ class GameTransformer:
 
     def transform(self, data: GameDataset,
                   as_mean: bool = False) -> ScoringResult:
+        t0 = time.time()
         scores = self.model.score(data)
+        default_emitter.emit(ScoringBatch(
+            source="game_score", rows=data.num_rows,
+            padded_rows=data.num_rows, seconds=time.time() - t0))
         if as_mean:
             loss = losses_mod.loss_for_task(self.model.task)
             scores = loss.mean(scores)
@@ -75,10 +81,17 @@ class GameTransformer:
                                                  iter_row_chunks,
                                                  stage_dataset)
 
-        parts = [self.model.score(staged)
-                 for staged in device_prefetch(
-                     iter_row_chunks(data, batch_rows),
-                     depth=prefetch_depth, place=stage_dataset)]
+        parts = []
+        for staged in device_prefetch(iter_row_chunks(data, batch_rows),
+                                      depth=prefetch_depth,
+                                      place=stage_dataset):
+            t0 = time.time()
+            parts.append(self.model.score(staged))
+            # seconds is dispatch time, not device time — scoring is async
+            # under the prefetch pipeline by design.
+            default_emitter.emit(ScoringBatch(
+                source="game_score", rows=staged.num_rows,
+                padded_rows=staged.num_rows, seconds=time.time() - t0))
         scores = np.concatenate([np.asarray(p) for p in parts]) \
             if parts else np.zeros(0, np.float32)
         if as_mean:

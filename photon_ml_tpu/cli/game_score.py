@@ -19,6 +19,8 @@ from photon_ml_tpu.api.transformer import GameTransformer
 from photon_ml_tpu.data.io import load_game_dataset
 from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+from photon_ml_tpu.utils.events import (ScoringFinish, ScoringStart,
+                                        default_emitter)
 from photon_ml_tpu.utils.logging import setup_logging
 
 logger = logging.getLogger("photon_ml_tpu.cli")
@@ -126,6 +128,8 @@ def run(args) -> dict:
     transformer = GameTransformer(model, evaluators)
 
     os.makedirs(args.output_dir, exist_ok=True)
+    default_emitter.emit(ScoringStart(source="game_score",
+                                      num_rows=data.num_rows))
     summary = {"num_rows": data.num_rows}
     if evaluators:
         result, evaluation = transformer.transform_and_evaluate(
@@ -161,6 +165,9 @@ def run(args) -> dict:
             result.scores, uids=result.uids, labels=result.labels,
             weights=result.weights, offsets=result.offsets)
     summary["wall_seconds"] = time.time() - t0
+    default_emitter.emit(ScoringFinish(source="game_score",
+                                       num_rows=data.num_rows,
+                                       wall_seconds=summary["wall_seconds"]))
     with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
     logger.info("wrote %s", args.output_dir)

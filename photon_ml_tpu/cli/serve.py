@@ -1,0 +1,131 @@
+"""Online scoring service driver.
+
+Reference parity: none — the reference stops at batch scoring
+(GameScoringDriver); this driver is the serving half the ROADMAP's
+"heavy traffic" north star needs. Loads a trained GameModel once, keeps it
+resident (photon_ml_tpu/serving/), and answers JSON-over-HTTP scoring
+requests at low latency with micro-batching and a metrics endpoint.
+
+Quickstart (docs/SERVING.md):
+
+    photon-game-serve --model-dir out/best --port 8080
+    curl -s localhost:8080/score -d '{"requests": [{"features": \
+        {"global": [0.1, ...]}, "entity_ids": {"userId": 7}}]}'
+    curl -s localhost:8080/metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.serving.service import ScoringService, make_http_server
+from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model-dir", required=True, help="GameModel directory")
+    p.add_argument("--model-format", default="NPZ",
+                   choices=["NPZ", "AVRO"],
+                   help="AVRO loads a best-avro directory through "
+                        "--feature-index-dir (same contract as game_score)")
+    p.add_argument("--feature-index-dir",
+                   help="REQUIRED with --model-format AVRO: the training "
+                        "run's saved index maps")
+    p.add_argument("--entity-vocabs",
+                   help="entity-vocabs.json mapping raw entity keys to "
+                        "vocabulary rows; lets requests carry raw string "
+                        "ids. Auto-discovered beside --feature-index-dir "
+                        "when present")
+    p.add_argument("--as-mean", action="store_true",
+                   help="serve probabilities/rates (inverse link) instead "
+                        "of raw linear scores")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 picks a free port (printed at startup)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="micro-batch flush size (also the largest padded "
+                        "batch shape)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="max time a queued request waits for batch-mates")
+    p.add_argument("--cache-entities", type=int, default=4096,
+                   help="per-coordinate LRU device cache capacity "
+                        "(random-effect rows)")
+    p.add_argument("--store-shards", type=int, default=8,
+                   help="hash shards of the host-resident random-effect "
+                        "store")
+    return p
+
+
+def load_model(args):
+    """Load (model, entity_vocabs) per the driver's format flags."""
+    vocabs = None
+    if args.entity_vocabs:
+        with open(args.entity_vocabs) as f:
+            vocabs = json.load(f)
+    if args.model_format == "AVRO":
+        from photon_ml_tpu.avro.model_io import (load_game_model_avro,
+                                                 load_index_maps)
+
+        if not args.feature_index_dir:
+            raise ValueError(
+                "--model-format AVRO needs --feature-index-dir (the "
+                "model's feature space)")
+        imaps = load_index_maps(args.feature_index_dir)
+        if vocabs is None:
+            vocab_path = os.path.join(
+                os.path.dirname(args.feature_index_dir.rstrip("/")),
+                "entity-vocabs.json")
+            if os.path.exists(vocab_path):
+                with open(vocab_path) as f:
+                    vocabs = json.load(f)
+        return load_game_model_avro(args.model_dir, imaps,
+                                    entity_vocabs=vocabs), vocabs
+    # host=True: random-effect tables go straight to the host store —
+    # never staged through device memory on the way in.
+    return model_io.load_game_model(args.model_dir, host=True), vocabs
+
+
+def create_server(args):
+    """Build the resident service + bound HTTP server (not yet serving).
+
+    Split from ``main`` so tests and embedding callers can drive the
+    server loop themselves; returns (server, service)."""
+    enable_compilation_cache()
+    model, vocabs = load_model(args)
+    service = ScoringService(
+        model, as_mean=args.as_mean, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, cache_entities=args.cache_entities,
+        store_shards=args.store_shards, entity_vocabs=vocabs)
+    server = make_http_server(service, host=args.host, port=args.port)
+    return server, service
+
+
+def run(args) -> None:
+    setup_logging()
+    server, service = create_server(args)
+    host, port = server.server_address[:2]
+    logger.info("serving %s on http://%s:%d (POST /score, GET /metrics)",
+                args.model_dir, host, port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        server.server_close()
+        service.close()
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

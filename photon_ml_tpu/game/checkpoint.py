@@ -10,6 +10,15 @@ Layout under the checkpoint directory::
 
     state.json            # progress counters + history + fingerprint
     model/                # models/io.py GameModel directory (newest state)
+    residuals.npz         # the descent loop's (n,) score total at the
+                          # committed step — restoring it (instead of
+                          # re-summing per-coordinate scores) makes resume
+                          # BIT-EXACT: fresh summation changes the f32
+                          # accumulation order, and nonconvex coordinates
+                          # (factored alternation) amplify that ~1e-7
+                          # offset perturbation into ~1e-3 coefficient
+                          # drift. Optional: checkpoints without it (older
+                          # layouts) fall back to re-summation.
 
 Crash-consistency model: every file write is atomic (tmp + ``os.replace``)
 and ``state.json`` is the COMMIT POINT, written last. A kill mid-save
@@ -38,6 +47,8 @@ import logging
 import os
 from typing import Optional
 
+import numpy as np
+
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.types import TaskType
@@ -46,6 +57,7 @@ logger = logging.getLogger("photon_ml_tpu.game")
 
 _STATE = "state.json"
 _MODEL = "model"
+_RESIDUALS = "residuals.npz"
 
 
 @dataclasses.dataclass
@@ -57,6 +69,7 @@ class CheckpointState:
     records: list[dict]  # CoordinateDescentHistory records so far
     complete: bool  # descent finished; models are the final result
     fingerprint: Optional[dict]  # config the checkpoint was written under
+    residual_total: Optional["np.ndarray"] = None  # (n,) score total
 
 
 class CheckpointManager:
@@ -83,6 +96,7 @@ class CheckpointManager:
         complete: bool = False,
         fingerprint: Optional[dict] = None,
         updated: Optional[list[str]] = None,
+        residual_total: Optional["np.ndarray"] = None,
     ) -> None:
         """Persist state. ``updated`` names the coordinates whose
         coefficients changed since the last save (all, if None or if the
@@ -108,6 +122,16 @@ class CheckpointManager:
             else:
                 meta[cid] = model_io.coordinate_meta(m)
         model_io.write_metadata(model_dir, task, meta)
+        # Residuals before the commit point, atomically; stale files are
+        # removed rather than left to pair with a state they don't match.
+        res_path = os.path.join(self.directory, _RESIDUALS)
+        if residual_total is not None:
+            tmp = res_path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, total=np.asarray(residual_total))
+            os.replace(tmp, res_path)
+        elif os.path.exists(res_path):
+            os.remove(res_path)
         # Commit point: state.json last, atomically.
         tmp = os.path.join(self.directory, _STATE + ".tmp")
         with open(tmp, "w") as f:
@@ -143,10 +167,16 @@ class CheckpointManager:
                 self.directory, saved_fp, expected_fingerprint)
             return None
         game = model_io.load_game_model(os.path.join(self.directory, _MODEL))
+        residual_total = None
+        res_path = os.path.join(self.directory, _RESIDUALS)
+        if os.path.exists(res_path):
+            with np.load(res_path) as z:
+                residual_total = z["total"]
         return CheckpointState(
             models=dict(game.models),
             done_steps=int(state["done_steps"]),
             records=list(state["records"]),
             complete=bool(state["complete"]),
             fingerprint=saved_fp,
+            residual_total=residual_total,
         )

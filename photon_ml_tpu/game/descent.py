@@ -76,9 +76,14 @@ def run(
     after every coordinate update and, when an existing checkpoint is found
     under its directory, resumes from it: already-completed (iteration,
     coordinate) updates are skipped and the checkpointed models replace the
-    warm starts. Restart state is models + a linear step counter — the
-    residual bookkeeping below is recomputed from the models at startup, so
-    a resumed run produces the same final model as an uninterrupted one.
+    warm starts. Restart state is models + a linear step counter + the
+    (n,) residual score total. Restoring the saved total (instead of
+    re-summing per-coordinate scores, which changes the f32 accumulation
+    order) makes a resumed run BIT-exact with an uninterrupted one; the
+    restored total is validated against the re-summed one and discarded if
+    they disagree beyond accumulation noise (a kill between the model and
+    residual writes can leave a newer model directory with older
+    residuals — re-summation is always consistent with the model files).
     """
     seq = list(config.update_sequence)
     unknown = [c for c in seq if c not in coordinates]
@@ -175,6 +180,21 @@ def run(
         total = total + s
         _sync(total)
 
+    if resume is not None and resume.residual_total is not None:
+        restored = np.asarray(resume.residual_total)
+        # Benign mismatch vs the fresh sum is f32 accumulation-order noise
+        # (~1e-6); a kill between the model-dir and residual writes leaves
+        # a step-sized gap instead. Restore only in the former case — the
+        # fresh sum is always consistent with the model files.
+        if restored.shape == total.shape and np.allclose(
+                np.asarray(total), restored, rtol=1e-5, atol=1e-5):
+            total = jnp.asarray(restored)
+        else:
+            logger.warning(
+                "checkpoint residuals disagree with re-summed scores; "
+                "using the re-summed total (resume stays correct but is "
+                "no longer bit-exact)")
+
     emitter = ev_mod.default_emitter
     emitter.emit(ev_mod.TrainingStart(
         task=TaskType(task).value, update_sequence=tuple(seq),
@@ -214,14 +234,15 @@ def run(
                 checkpoint_manager.save(
                     task, models, done_steps=step,
                     records=history.records, fingerprint=fingerprint,
-                    updated=[cid])
+                    updated=[cid], residual_total=np.asarray(total))
 
     emitter.emit(ev_mod.TrainingFinish(task=TaskType(task).value,
                                        total_updates=step))
     if checkpoint_manager is not None:
         checkpoint_manager.save(task, models, done_steps=step,
                                 records=history.records, complete=True,
-                                fingerprint=fingerprint)
+                                fingerprint=fingerprint,
+                                residual_total=np.asarray(total))
     return GameModel(task=task, models=models), history
 
 

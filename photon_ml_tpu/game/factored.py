@@ -81,6 +81,14 @@ class FactoredRandomEffectModel:
     def rank(self) -> int:
         return self.projection.shape[1]
 
+    def entity_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Dense (len(ids), dim) implied coefficient rows ``A z_e``
+        (RandomEffectModel's ``entity_rows`` contract) — materializes only
+        the requested entities, not the full (E, d) table."""
+        ids = np.asarray(ids, np.int64)
+        return (np.asarray(self.factors, np.float32)[ids]
+                @ np.asarray(self.projection, np.float32).T)
+
     def score(self, dataset: GameDataset) -> Array:
         X = jnp.asarray(dataset.feature_shards[self.shard_id])
         ids = jnp.asarray(dataset.entity_ids[self.re_type])

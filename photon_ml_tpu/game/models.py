@@ -72,6 +72,13 @@ class RandomEffectModel:
     def dim(self) -> int:
         return self.means.shape[1]
 
+    def entity_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Dense (len(ids), dim) coefficient rows for trained entities —
+        the host-side fetch contract shared by every random-effect model
+        type (serving/model_store.py's cache-fill path). Caller guarantees
+        0 <= id < num_entities."""
+        return np.asarray(self.means)[np.asarray(ids, np.int64)]
+
     def score(self, dataset: GameDataset) -> Array:
         from photon_ml_tpu.data.game_data import SparseShard
 
@@ -94,6 +101,23 @@ class RandomEffectModel:
             contrib = jnp.einsum("nd,nd->n", jnp.asarray(shard),
                                  self.means[safe])
         return jnp.where(ids < self.means.shape[0], contrib, 0.0)
+
+
+def dense_rows_from_subspace(cols: np.ndarray, means: np.ndarray,
+                             num_features: int) -> np.ndarray:
+    """Scatter (k, A) subspace rows into dense (k, num_features) rows.
+
+    THE densification semantic for subspace coefficients — shared by
+    ``SubspaceRandomEffectModel.entity_rows`` and the serving host store's
+    cache-fill path, which densifies only the hot entities it fetches
+    (never the whole (E, d) table).
+    """
+    cols = np.asarray(cols)
+    means = np.asarray(means, np.float32)
+    W = np.zeros((cols.shape[0], num_features), np.float32)
+    r, c = np.nonzero(cols >= 0)
+    W[r, cols[r, c]] = means[r, c]
+    return W
 
 
 def sort_subspace_rows(cols: np.ndarray, *tables: Optional[np.ndarray]):
@@ -184,6 +208,14 @@ class SubspaceRandomEffectModel:
     @property
     def subspace_dim(self) -> int:
         return self.cols.shape[1]
+
+    def entity_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Dense (len(ids), num_features) rows (RandomEffectModel's
+        ``entity_rows`` contract) — densifies ONLY the requested entities."""
+        ids = np.asarray(ids, np.int64)
+        return dense_rows_from_subspace(
+            np.asarray(self.cols)[ids], np.asarray(self.means)[ids],
+            self.num_features)
 
     def score(self, dataset: GameDataset) -> Array:
         """Score without ever materializing (E, d).

@@ -104,8 +104,17 @@ def save_game_model(model: GameModel, path: str) -> None:
     write_metadata(path, model.task, meta)
 
 
-def load_game_model(path: str) -> GameModel:
-    """Inverse of save_game_model (reference: loadGameModelFromHDFS)."""
+def load_game_model(path: str, host: bool = False) -> GameModel:
+    """Inverse of save_game_model (reference: loadGameModelFromHDFS).
+
+    ``host=True`` keeps every coefficient table as host numpy instead of
+    committing it to the default device — the serving path's loader
+    (serving/model_store.py re-shards random-effect tables onto the host
+    anyway; staging a multi-GB (E, d) table through device memory first
+    would defeat the residency design). Scoring works either way
+    (``score`` does its own ``jnp.asarray``).
+    """
+    put = np.asarray if host else jnp.asarray
     with open(os.path.join(path, _METADATA)) as f:
         meta = json.load(f)
     models = {}
@@ -114,8 +123,8 @@ def load_game_model(path: str) -> GameModel:
             z = np.load(os.path.join(path, "fixed-effect", cid,
                                      "coefficients.npz"))
             coef = Coefficients(
-                means=jnp.asarray(z["means"]),
-                variances=(jnp.asarray(z["variances"])
+                means=put(z["means"]),
+                variances=(put(z["variances"])
                            if "variances" in z else None))
             models[cid] = FixedEffectModel(shard_id=info["shard_id"],
                                            coefficients=coef)
@@ -126,25 +135,25 @@ def load_game_model(path: str) -> GameModel:
                                      "coefficients.npz"))
             models[cid] = FactoredRandomEffectModel(
                 re_type=info["re_type"], shard_id=info["shard_id"],
-                projection=jnp.asarray(z["projection"]),
-                factors=jnp.asarray(z["factors"]))
+                projection=put(z["projection"]),
+                factors=put(z["factors"]))
         elif info["type"] == "random-subspace":
             z = np.load(os.path.join(path, "random-effect", cid,
                                      "coefficients.npz"))
             models[cid] = SubspaceRandomEffectModel(
                 re_type=info["re_type"], shard_id=info["shard_id"],
                 num_features=int(info["dim"]),
-                cols=jnp.asarray(z["cols"]),
-                means=jnp.asarray(z["means"]),
-                variances=(jnp.asarray(z["variances"])
+                cols=put(z["cols"]),
+                means=put(z["means"]),
+                variances=(put(z["variances"])
                            if "variances" in z else None))
         else:
             z = np.load(os.path.join(path, "random-effect", cid,
                                      "coefficients.npz"))
             models[cid] = RandomEffectModel(
                 re_type=info["re_type"], shard_id=info["shard_id"],
-                means=jnp.asarray(z["means"]),
-                variances=(jnp.asarray(z["variances"])
+                means=put(z["means"]),
+                variances=(put(z["variances"])
                            if "variances" in z else None))
     return GameModel(task=TaskType(meta["task"]), models=models)
 

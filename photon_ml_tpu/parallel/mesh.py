@@ -47,6 +47,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger("photon_ml_tpu.parallel")
 
+# ``shard_map`` moved to the jax top level (jax >= 0.4.38); earlier
+# releases only ship it under jax.experimental. Resolve once here so every
+# call site (parallel/objective.py, parallel/sparse_objective.py, tests)
+# stays version-agnostic.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 

@@ -31,7 +31,7 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops import aggregators as agg
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.parallel.mesh import DATA_AXIS
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 Array = jax.Array
 
@@ -57,7 +57,7 @@ def make_value_and_gradient(
     """
     specs = _batch_specs(batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), specs), out_specs=(P(), P()))
     def _vg(w, b):
         v, g = agg.value_and_gradient(loss, w, b, norm)
@@ -75,7 +75,7 @@ def make_hvp(
     """(w, v) → Σ H·v over the full sharded batch (TRON's inner product)."""
     specs = _batch_specs(batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), P(), specs), out_specs=P())
     def _hvp(w, v, b):
         return lax.psum(agg.hessian_vector(loss, w, v, b, norm), DATA_AXIS)
@@ -91,7 +91,7 @@ def make_hessian_diagonal(
 ):
     specs = _batch_specs(batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), specs), out_specs=P())
     def _hd(w, b):
         return lax.psum(agg.hessian_diagonal(loss, w, b, norm), DATA_AXIS)
@@ -107,7 +107,7 @@ def make_hessian_matrix(
 ):
     specs = _batch_specs(batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), specs), out_specs=P())
     def _hm(w, b):
         return lax.psum(agg.hessian_matrix(loss, w, b, norm), DATA_AXIS)

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from photon_ml_tpu.data.sparse import SparseBatch
 from photon_ml_tpu.ops import sparse_aggregators as sagg
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 Array = jax.Array
 
@@ -81,7 +81,7 @@ def make_value_and_gradient(
     specs = _batch_specs(batch)
 
     if not feature_sharded:
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P(), specs), out_specs=(P(), P()))
         def _vg(w, b):
             v, g = sagg.value_and_gradient(loss, w, b)
@@ -89,7 +89,7 @@ def make_value_and_gradient(
 
         return lambda w: _vg(w, batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(MODEL_AXIS), specs),
                        out_specs=(P(), P(MODEL_AXIS)))
     def _vg_sharded(w_local, b):
@@ -118,14 +118,14 @@ def make_hvp(
     specs = _batch_specs(batch)
 
     if not feature_sharded:
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P(), P(), specs), out_specs=P())
         def _hvp(w, v, b):
             return lax.psum(sagg.hessian_vector(loss, w, v, b), DATA_AXIS)
 
         return lambda w, v: _hvp(w, v, batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(MODEL_AXIS), P(MODEL_AXIS), specs),
                        out_specs=P(MODEL_AXIS))
     def _hvp_sharded(w_local, v_local, b):
@@ -165,7 +165,7 @@ def make_hybrid_value_and_gradient(loss: PointwiseLoss, mesh: Mesh, shb):
 
     leaves = _hybrid_leaves(shb)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), _hybrid_specs(leaves)),
                        out_specs=(P(), P()))
     def _vg(w, lv):
@@ -182,7 +182,7 @@ def make_hybrid_hvp(loss: PointwiseLoss, mesh: Mesh, shb):
 
     leaves = _hybrid_leaves(shb)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), P(), _hybrid_specs(leaves)),
                        out_specs=P())
     def _hvp(w, v, lv):
@@ -198,7 +198,7 @@ def make_hybrid_hessian_diagonal(loss: PointwiseLoss, mesh: Mesh, shb):
 
     leaves = _hybrid_leaves(shb)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), _hybrid_specs(leaves)),
                        out_specs=P())
     def _hd(w, lv):
@@ -218,7 +218,7 @@ def make_hybrid_margins(mesh: Mesh, shb):
 
     leaves = _hybrid_leaves(shb)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), _hybrid_specs(leaves)),
                        out_specs=P(DATA_AXIS))
     def _margins(w, lv):
@@ -237,14 +237,14 @@ def make_hessian_diagonal(
     specs = _batch_specs(batch)
 
     if not feature_sharded:
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P(), specs), out_specs=P())
         def _hd(w, b):
             return lax.psum(sagg.hessian_diagonal(loss, w, b), DATA_AXIS)
 
         return lambda w: _hd(w, batch)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(MODEL_AXIS), specs),
                        out_specs=P(MODEL_AXIS))
     def _hd_sharded(w_local, b):

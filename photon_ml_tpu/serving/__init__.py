@@ -1,0 +1,28 @@
+"""Online inference subsystem: resident model store, micro-batched
+low-latency scoring, serving metrics (docs/SERVING.md).
+
+The offline path (cli/game_score.py) loads a model per job; this package
+keeps one loaded GameModel resident — fixed effects on device, random
+effects hash-sharded on host with an LRU device cache for hot entities —
+and streams micro-batched requests through a shape-bucketed jitted scorer.
+"""
+
+from photon_ml_tpu.serving.batcher import MicroBatcher, bucket_batch
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.model_store import (HashShardedStore,
+                                               ResidentModelStore)
+from photon_ml_tpu.serving.service import (ScoringRequest, ScoringService,
+                                           make_http_server,
+                                           requests_from_dataset)
+
+__all__ = [
+    "MicroBatcher",
+    "bucket_batch",
+    "ServingMetrics",
+    "HashShardedStore",
+    "ResidentModelStore",
+    "ScoringRequest",
+    "ScoringService",
+    "make_http_server",
+    "requests_from_dataset",
+]

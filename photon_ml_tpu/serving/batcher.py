@@ -1,0 +1,108 @@
+"""Micro-batcher: queue scoring requests, flush on size or deadline.
+
+The latency/throughput knob of the serving layer (the Snap ML-style
+streaming tradeoff): a flush happens when ``max_batch`` requests are queued
+(throughput bound) or when the OLDEST queued request has waited
+``max_wait_ms`` (latency bound) — so an idle service scores a lone request
+after at most one wait window, and a busy one always ships full batches.
+
+Batch SHAPES are the flush function's concern (the service pads each flush
+to a bucketed size so the jitted scorer never sees a new shape in steady
+state); the batcher's concern is time: one worker thread, one condition
+variable, futures for the callers. ``submit`` is thread-safe and returns a
+``concurrent.futures.Future`` resolving to that request's score.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+
+@dataclass
+class _Entry:
+    request: object
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.time)
+
+
+def bucket_batch(n: int, max_batch: int) -> int:
+    """Padded batch size for ``n`` requests: next power of two, capped at
+    ``max_batch`` — a log-sized set of shapes, so the jitted scorer
+    compiles O(log max_batch) programs total and then never again."""
+    if n >= max_batch:
+        return max_batch
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class MicroBatcher:
+    """Background flusher over a bounded-delay request queue.
+
+    ``flush_fn(entries)`` scores ``entries`` (a list of _Entry; at most
+    ``max_batch``) and returns one float per entry, in order. It runs on
+    the worker thread; exceptions propagate to every future in the flush.
+    """
+
+    def __init__(
+        self,
+        flush_fn: Callable[[Sequence[_Entry]], Sequence[float]],
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self._queue: list[_Entry] = []
+        self._cond = threading.Condition()
+        self._running = True
+        self._worker = threading.Thread(target=self._loop,
+                                        name="photon-serving-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    def submit(self, request) -> Future:
+        entry = _Entry(request)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is closed")
+            self._queue.append(entry)
+            self._cond.notify()
+        return entry.future
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._queue:
+                    self._cond.wait()
+                if not self._running and not self._queue:
+                    return
+                # Wait out the remainder of the oldest entry's window
+                # unless the batch is already full (or we're draining).
+                deadline = self._queue[0].enqueued_at + self.max_wait
+                while (self._running
+                       and len(self._queue) < self.max_batch
+                       and (left := deadline - time.time()) > 0):
+                    self._cond.wait(timeout=left)
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+            try:
+                scores = self._flush_fn(batch)
+                for entry, score in zip(batch, scores):
+                    entry.future.set_result(score)
+            except Exception as exc:  # propagate to callers, keep serving
+                for entry in batch:
+                    if not entry.future.done():
+                        entry.future.set_exception(exc)
+
+    def close(self) -> None:
+        """Drain the queue, then stop the worker (idempotent)."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._worker.is_alive():
+            self._worker.join()

@@ -1,0 +1,174 @@
+"""Serving metrics: latency percentiles, throughput, batch fill, RE cache.
+
+Reference parity: none — the reference has no online story at all (its
+scoring driver is a batch job). The shape here follows standard model-server
+practice (latency histograms + counters behind a text endpoint) so the
+subsystem is observable from the first request: every micro-batch flush
+records device latency and fill, every queued request records end-to-end
+latency, and the random-effect device cache reports hit/miss/unseen/eviction
+counts per coordinate.
+
+All methods are thread-safe (one lock; the HTTP front end and the batcher
+worker record concurrently).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+# Ring size for the latency reservoirs: large enough that p99 over recent
+# traffic is stable, small enough that percentile() stays trivial.
+_RING = 8192
+
+
+class LatencyHistogram:
+    """Percentiles over the most recent ``size`` observations (seconds)."""
+
+    def __init__(self, size: int = _RING):
+        self._buf = np.zeros(size, np.float64)
+        self._n = 0  # total ever recorded
+        self._sum = 0.0
+
+    def record(self, seconds: float) -> None:
+        self._buf[self._n % self._buf.shape[0]] = seconds
+        self._n += 1
+        self._sum += seconds
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def percentile(self, p: float) -> float:
+        k = min(self._n, self._buf.shape[0])
+        if k == 0:
+            return 0.0
+        return float(np.percentile(self._buf[:k], p))
+
+    def mean(self) -> float:
+        return self._sum / self._n if self._n else 0.0
+
+    def summary(self) -> dict:
+        return {"count": self._n, "mean_ms": self.mean() * 1e3,
+                "p50_ms": self.percentile(50) * 1e3,
+                "p95_ms": self.percentile(95) * 1e3,
+                "p99_ms": self.percentile(99) * 1e3}
+
+
+class CacheCounters:
+    """Per-coordinate random-effect device-cache counters."""
+
+    def __init__(self):
+        self.hits = 0  # rows whose entity was already device-resident
+        self.misses = 0  # rows whose entity was fetched from the host store
+        self.unseen = 0  # rows scored fixed-effect-only (entity unknown)
+        self.evictions = 0  # LRU slots reclaimed
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def summary(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "unseen": self.unseen, "evictions": self.evictions,
+                "hit_rate": self.hit_rate()}
+
+
+class ServingMetrics:
+    """One scoreboard per ScoringService."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        self.request_latency = LatencyHistogram()  # submit → result
+        self.batch_latency = LatencyHistogram()  # one device flush
+        self.rows_total = 0
+        self.padded_rows_total = 0
+        self.batches_total = 0
+        self.compiles_total = 0  # distinct jitted batch shapes built
+        self.cache: dict[str, CacheCounters] = {}  # coordinate id → counts
+
+    def coordinate(self, cid: str) -> CacheCounters:
+        with self._lock:
+            return self.cache.setdefault(cid, CacheCounters())
+
+    def record_batch(self, rows: int, padded_rows: int,
+                     seconds: float) -> None:
+        with self._lock:
+            self.rows_total += rows
+            self.padded_rows_total += padded_rows
+            self.batches_total += 1
+            self.batch_latency.record(seconds)
+
+    def record_request_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.request_latency.record(seconds)
+
+    def record_compile(self) -> None:
+        with self._lock:
+            self.compiles_total += 1
+
+    def record_cache(self, cid: str, hits: int = 0, misses: int = 0,
+                     unseen: int = 0, evictions: int = 0) -> None:
+        c = self.coordinate(cid)
+        with self._lock:
+            c.hits += hits
+            c.misses += misses
+            c.unseen += unseen
+            c.evictions += evictions
+
+    # -- views -------------------------------------------------------------
+
+    def fill_ratio(self) -> float:
+        return (self.rows_total / self.padded_rows_total
+                if self.padded_rows_total else 0.0)
+
+    def throughput_rows_per_sec(self) -> float:
+        dt = time.time() - self.started_at
+        return self.rows_total / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "uptime_seconds": time.time() - self.started_at,
+                "rows_total": self.rows_total,
+                "batches_total": self.batches_total,
+                "padded_rows_total": self.padded_rows_total,
+                "batch_fill_ratio": self.fill_ratio(),
+                "throughput_rows_per_sec": self.throughput_rows_per_sec(),
+                "compiles_total": self.compiles_total,
+                "request_latency": self.request_latency.summary(),
+                "batch_latency": self.batch_latency.summary(),
+                "re_cache": {cid: c.summary()
+                             for cid, c in self.cache.items()},
+            }
+
+    def render_text(self) -> str:
+        """Prometheus-style text exposition (the /metrics endpoint body)."""
+        s = self.snapshot()
+        lines = [
+            f"photon_serving_uptime_seconds {s['uptime_seconds']:.3f}",
+            f"photon_serving_rows_total {s['rows_total']}",
+            f"photon_serving_batches_total {s['batches_total']}",
+            f"photon_serving_batch_fill_ratio {s['batch_fill_ratio']:.6f}",
+            f"photon_serving_throughput_rows_per_sec "
+            f"{s['throughput_rows_per_sec']:.3f}",
+            f"photon_serving_compiles_total {s['compiles_total']}",
+        ]
+        for name, h in (("request", s["request_latency"]),
+                        ("batch", s["batch_latency"])):
+            lines.append(f"photon_serving_{name}_latency_count {h['count']}")
+            for q in ("p50", "p95", "p99"):
+                lines.append(f"photon_serving_{name}_latency_ms"
+                             f"{{quantile=\"{q}\"}} {h[q + '_ms']:.4f}")
+        for cid, c in s["re_cache"].items():
+            for k in ("hits", "misses", "unseen", "evictions"):
+                lines.append(
+                    f"photon_serving_re_cache_{k}{{coordinate=\"{cid}\"}} "
+                    f"{c[k]}")
+            lines.append(
+                f"photon_serving_re_cache_hit_rate{{coordinate=\"{cid}\"}} "
+                f"{c['hit_rate']:.6f}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,280 @@
+"""Resident GAME model store: the online-serving memory hierarchy.
+
+Reference parity: none — the reference's GameScoringDriver reloads the whole
+model per batch job. The serving design instead follows the Snap ML /
+GPU-stochastic-learning observation (PAPERS.md): keep model state resident
+next to the accelerator and stream requests through it. Three tiers:
+
+- **Fixed effects** are tiny (one (d,) vector per coordinate) and hot on
+  every request → device-resident for the service lifetime, placed once at
+  load ("broadcast" is just replication, as everywhere in this rebuild).
+- **Random effects** are the big tier (an (E, d)-shaped table per
+  coordinate, E up to millions) → a hash-sharded HOST store in the model's
+  own representation (dense rows, subspace cols+means, or latent factors —
+  never densified wholesale), plus an **LRU device cache** of densified
+  rows for the hot entities actually being scored. Zipf-skewed traffic
+  (the realistic per-user activity distribution — same skew the training
+  bucketing exploits) makes a small cache absorb most rows.
+- **Unseen entities** (ids outside the table, unknown vocabulary keys,
+  requests that omit the id) resolve to a permanent all-zero fallback row:
+  scores degrade gracefully to fixed-effect-only, exactly the offline
+  ``game_score`` semantics for unseen entities.
+
+The cache table has C+1 rows; row C is the zero fallback row and is never
+written (cache-fill scatters pad with zero rows into slot C, which keeps it
+zero by construction — no masks in the scoring gather).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel,
+                                       SubspaceRandomEffectModel,
+                                       dense_rows_from_subspace)
+
+logger = logging.getLogger("photon_ml_tpu.serving")
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class HashShardedStore:
+    """Host-resident per-entity coefficients, hash-sharded by entity id.
+
+    Sharding is ``id % num_shards`` (ids are already the dense vocabulary
+    rows, so the modulo IS the hash); because every row 0..E-1 exists
+    (untrained entities hold zero rows), an entity's position within its
+    shard is ``id // num_shards`` — O(1) lookup, no per-shard index. The
+    shard structure matches the multi-host layout this store is the
+    single-process degenerate case of: shard s would live on host s and
+    ``fetch`` would become a host s RPC, with everything above unchanged.
+
+    Payloads stay in the model's own representation per shard; densification
+    happens per fetched row batch via the model-type helpers in
+    game/models.py / game/factored.py.
+    """
+
+    def __init__(self, model, num_shards: int = 8):
+        self.num_shards = int(num_shards)
+        self.num_entities = int(model.num_entities)
+        if isinstance(model, SubspaceRandomEffectModel):
+            self.dim = int(model.num_features)
+        else:
+            self.dim = int(model.dim)
+        ids = np.arange(self.num_entities, dtype=np.int64)
+        part = [ids[ids % self.num_shards == s]
+                for s in range(self.num_shards)]
+        if isinstance(model, RandomEffectModel):
+            means = np.asarray(model.means, np.float32)
+            self._shards = [(means[p],) for p in part]
+            self._densify = lambda payload, pos: payload[0][pos]
+        elif isinstance(model, SubspaceRandomEffectModel):
+            cols = np.asarray(model.cols)
+            means = np.asarray(model.means, np.float32)
+            nf = int(model.num_features)
+            self._shards = [(cols[p], means[p]) for p in part]
+            self._densify = lambda payload, pos: dense_rows_from_subspace(
+                payload[0][pos], payload[1][pos], nf)
+        elif isinstance(model, FactoredRandomEffectModel):
+            factors = np.asarray(model.factors, np.float32)
+            proj_t = np.asarray(model.projection, np.float32).T
+            self._shards = [(factors[p],) for p in part]
+            self._densify = lambda payload, pos: payload[0][pos] @ proj_t
+        else:
+            raise TypeError(f"unsupported random-effect model type "
+                            f"{type(model).__name__}")
+
+    def fetch(self, ids: np.ndarray) -> np.ndarray:
+        """Dense (len(ids), dim) rows for in-table ids (the cache-fill
+        path). Grouped by shard; result rows follow the input order."""
+        ids = np.asarray(ids, np.int64)
+        out = np.zeros((ids.shape[0], self.dim), np.float32)
+        sid = ids % self.num_shards
+        for s in np.unique(sid):
+            m = sid == s
+            out[m] = self._densify(self._shards[s],
+                                   ids[m] // self.num_shards)
+        return out
+
+    def host_bytes(self) -> int:
+        return sum(int(a.nbytes) for payload in self._shards
+                   for a in payload)
+
+
+class REServingState:
+    """One random-effect coordinate's host store + LRU device cache."""
+
+    def __init__(self, cid: str, model, cache_entities: int,
+                 store_shards: int):
+        self.cid = cid
+        self.re_type = model.re_type
+        self.shard_id = model.shard_id
+        self.store = HashShardedStore(model, num_shards=store_shards)
+        self.num_entities = self.store.num_entities
+        self.dim = self.store.dim
+        # Cache size never exceeds the entity table (plus the fallback row).
+        self.capacity = max(1, min(int(cache_entities), self.num_entities))
+        self.fallback_slot = self.capacity
+        self.cache = jnp.zeros((self.capacity + 1, self.dim), jnp.float32)
+        self._lru: collections.OrderedDict[int, int] = \
+            collections.OrderedDict()  # entity id → slot, oldest first
+        self._free = list(range(self.capacity))
+        # cache.at[slots].set(rows): one scatter per fill, insert count
+        # padded to power-of-two buckets so steady state never recompiles.
+        # Padding rows are zeros aimed at the fallback slot — which is what
+        # keeps that row zero forever.
+        self._insert = jax.jit(
+            lambda cache, slots, rows: cache.at[slots].set(rows))
+
+    def resolve(self, ids: np.ndarray) -> tuple[np.ndarray, dict]:
+        """Entity ids → device-cache slots, filling the cache for misses.
+
+        Returns (slots int32 (n,), counters dict). Ids outside [0, E) map
+        to the fallback slot. The batch's own entities are PINNED for the
+        duration of the resolve — eviction only reclaims slots no row of
+        this batch reads, so one flush can never overwrite a slot it is
+        about to gather (the caller guarantees a batch's unique entities
+        fit: capacity >= max_batch). NOT thread-safe on its own — the
+        service serializes resolve+score (the device is serial anyway).
+        """
+        ids = np.asarray(ids, np.int64)
+        slots = np.full(ids.shape[0], self.fallback_slot, np.int32)
+        stats = {"hits": 0, "misses": 0, "unseen": 0, "evictions": 0}
+        pinned = {int(e) for e in ids if 0 <= int(e) < self.num_entities}
+        if len(pinned) > self.capacity:
+            raise ValueError(
+                f"batch references {len(pinned)} distinct entities of "
+                f"coordinate {self.cid!r} but the device cache holds "
+                f"{self.capacity} — raise cache_entities or lower "
+                f"max_batch")
+        miss_ids: list[int] = []
+        miss_rows: list[int] = []
+        for i, e in enumerate(ids):
+            e = int(e)
+            if e < 0 or e >= self.num_entities:
+                stats["unseen"] += 1
+                continue
+            slot = self._lru.get(e)
+            if slot is not None:
+                self._lru.move_to_end(e)
+                slots[i] = slot
+                stats["hits"] += 1
+            else:
+                stats["misses"] += 1
+                miss_ids.append(e)
+                miss_rows.append(i)
+        if miss_ids:
+            # Assign slots to the unique missed entities (a batch may
+            # repeat an entity), evicting the oldest UNPINNED entries.
+            unique: dict[int, int] = {}
+            for e in miss_ids:
+                if e in unique:
+                    continue
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    victim = next(v for v in self._lru if v not in pinned)
+                    slot = self._lru.pop(victim)
+                    stats["evictions"] += 1
+                unique[e] = slot
+                self._lru[e] = slot
+            fetch_ids = np.fromiter(unique, np.int64, len(unique))
+            rows = self.store.fetch(fetch_ids)
+            k = _next_pow2(len(unique))
+            ins_slots = np.full(k, self.fallback_slot, np.int32)
+            ins_rows = np.zeros((k, self.dim), np.float32)
+            ins_slots[: len(unique)] = list(unique.values())
+            ins_rows[: len(unique)] = rows
+            self.cache = self._insert(self.cache, jnp.asarray(ins_slots),
+                                      jnp.asarray(ins_rows))
+            for i in miss_rows:
+                slots[i] = unique[int(ids[i])]
+        return slots, stats
+
+    def cached_entities(self) -> list[int]:
+        return list(self._lru)
+
+
+class ResidentModelStore:
+    """A loaded GameModel arranged for low-latency online scoring."""
+
+    def __init__(
+        self,
+        model: GameModel,
+        cache_entities: int = 4096,
+        store_shards: int = 8,
+        entity_vocabs: Optional[dict[str, dict]] = None,
+    ):
+        self.task = model.task
+        self.entity_vocabs = entity_vocabs or {}
+        self.fixed: list[tuple[str, str, jax.Array]] = []
+        self.random: list[REServingState] = []
+        self.shard_dims: dict[str, int] = {}
+        self._lock = threading.Lock()
+        for cid, m in model.models.items():
+            if isinstance(m, FixedEffectModel):
+                w = jax.device_put(jnp.asarray(m.coefficients.means,
+                                               jnp.float32))
+                self.fixed.append((cid, m.shard_id, w))
+                self._claim_dim(m.shard_id, int(m.dim))
+            else:
+                st = REServingState(cid, m, cache_entities, store_shards)
+                self.random.append(st)
+                self._claim_dim(m.shard_id, st.dim)
+        host = sum(st.store.host_bytes() for st in self.random)
+        device = sum(int(np.prod(w.shape)) * 4 for _, _, w in self.fixed) \
+            + sum((st.capacity + 1) * st.dim * 4 for st in self.random)
+        logger.info(
+            "model store resident: %d fixed + %d random coordinates, "
+            "%.1f MB host store, %.1f MB device (coefficients + caches)",
+            len(self.fixed), len(self.random), host / 2**20, device / 2**20)
+
+    def _claim_dim(self, shard_id: str, dim: int) -> None:
+        prev = self.shard_dims.setdefault(shard_id, dim)
+        if prev != dim:
+            raise ValueError(
+                f"feature shard {shard_id!r} used at two dimensions "
+                f"({prev} and {dim}) — model metadata is inconsistent")
+
+    def entity_row_id(self, re_type: str, key) -> int:
+        """A request's raw entity id → vocabulary row (−1 = unseen).
+
+        Integers index the entity table directly (the NPZ-model contract);
+        anything else goes through the serving vocabularies (the
+        entity-vocabs.json written by Avro-format training).
+        """
+        if key is None:
+            return -1
+        if isinstance(key, (int, np.integer)) \
+                and not isinstance(key, bool):
+            return int(key)
+        vocab = self.entity_vocabs.get(re_type)
+        if vocab is None:
+            return -1
+        return int(vocab.get(str(key), -1))
+
+    def resolve_slots(self, ids_by_cid: dict[str, np.ndarray],
+                      metrics=None) -> dict[str, np.ndarray]:
+        """Per-coordinate entity ids → cache slots (filling caches)."""
+        out = {}
+        with self._lock:
+            for st in self.random:
+                slots, stats = st.resolve(ids_by_cid[st.cid])
+                if metrics is not None:
+                    metrics.record_cache(st.cid, **stats)
+                out[st.cid] = slots
+        return out
+
+    def caches(self) -> dict[str, jax.Array]:
+        return {st.cid: st.cache for st in self.random}

@@ -1,0 +1,323 @@
+"""ScoringService: the online-inference front door.
+
+Reference parity: none — this is the layer the reference never had (its
+GameScoringDriver is a batch job). One service owns the whole serving
+pipeline:
+
+    requests → micro-batcher → shape-bucketed padded batch
+             → RE cache resolve (host store → LRU device cache)
+             → ONE jitted scoring program → scores
+
+The jitted program is a function of (feature matrices, offsets, cache
+slots, cache tables) with fixed-effect coefficients closed over as
+device-resident constants. Batch sizes are padded to power-of-two buckets
+(``batcher.bucket_batch``), so the program compiles once per bucket —
+O(log max_batch) programs, persisted across processes by
+utils/compile_cache — and steady state NEVER recompiles (asserted by
+tests and reported by dev-scripts/bench_serving.py).
+
+Scoring semantics match offline ``cli/game_score.py`` exactly: scores are
+offsets + Σ coordinate contributions, unseen entities contribute zero
+(fixed-effect-only fallback), ``as_mean`` applies the task's inverse link.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset, SparseShard
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.serving.batcher import MicroBatcher, bucket_batch
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.model_store import ResidentModelStore
+from photon_ml_tpu.utils.events import (ScoringBatch, ScoringFinish,
+                                        ScoringStart, default_emitter)
+
+logger = logging.getLogger("photon_ml_tpu.serving")
+
+
+@dataclasses.dataclass
+class ScoringRequest:
+    """One example to score.
+
+    ``features``: shard id → dense (d,) vector, or a sparse mapping
+    ``{"indices": ..., "values": ...}`` (ELL row contract: out-of-range
+    indices are padding and are dropped). Shards the model never reads may
+    be omitted; omitted shards contribute zero.
+    ``entity_ids``: RE type → entity id — an int vocabulary row, or a raw
+    key resolved through the serving vocabularies. Unknown/missing ids
+    fall back to fixed-effect-only scoring.
+    """
+
+    features: dict[str, object]
+    entity_ids: dict[str, object] = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+    uid: object = None
+
+
+def requests_from_dataset(data: GameDataset) -> list[ScoringRequest]:
+    """A GameDataset's rows as ScoringRequests (tests, benches, replays)."""
+    out = []
+    for i in range(data.num_rows):
+        feats: dict[str, object] = {}
+        for sid, shard in data.feature_shards.items():
+            if isinstance(shard, SparseShard):
+                feats[sid] = {"indices": shard.indices[i],
+                              "values": shard.values[i]}
+            else:
+                feats[sid] = np.asarray(shard[i])
+        out.append(ScoringRequest(
+            features=feats,
+            entity_ids={rt: int(ids[i])
+                        for rt, ids in data.entity_ids.items()},
+            offset=float(data.offsets[i]),
+            uid=i,
+        ))
+    return out
+
+
+class ScoringService:
+    """Low-latency scoring over a resident GameModel."""
+
+    def __init__(
+        self,
+        model: GameModel,
+        as_mean: bool = False,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        cache_entities: int = 4096,
+        store_shards: int = 8,
+        entity_vocabs: Optional[dict[str, dict]] = None,
+        emitter=default_emitter,
+    ):
+        # A flush's unique entities must fit the cache simultaneously
+        # (model_store pins them during resolve), so the effective budget
+        # is at least max_batch.
+        self.store = ResidentModelStore(
+            model, cache_entities=max(int(cache_entities), int(max_batch)),
+            store_shards=store_shards, entity_vocabs=entity_vocabs)
+        self.as_mean = bool(as_mean)
+        self.max_batch = int(max_batch)
+        self.metrics = ServingMetrics()
+        self.emitter = emitter
+        self._lock = threading.Lock()  # serializes resolve+score per flush
+        self._compile_keys: set[int] = set()
+        self._score_fn = self._build_score_fn()
+        self.batcher = MicroBatcher(self._flush, max_batch=max_batch,
+                                    max_wait_ms=max_wait_ms)
+        self._closed = False
+        emitter.emit(ScoringStart(source="serving", num_rows=None))
+
+    # -- jitted scorer -----------------------------------------------------
+
+    def _build_score_fn(self):
+        fixed = tuple(self.store.fixed)
+        random = tuple((st.cid, st.shard_id) for st in self.store.random)
+        mean_fn = (losses_mod.loss_for_task(self.store.task).mean
+                   if self.as_mean else None)
+
+        def score(mats, offsets, slots, caches):
+            total = jnp.asarray(offsets)
+            for _cid, sid, w in fixed:
+                total = total + mats[sid] @ w
+            for cid, sid in random:
+                total = total + jnp.einsum(
+                    "nd,nd->n", mats[sid], caches[cid][slots[cid]])
+            return mean_fn(total) if mean_fn is not None else total
+
+        return jax.jit(score)
+
+    # -- batch assembly ----------------------------------------------------
+
+    def _assemble(self, requests: Sequence[ScoringRequest], padded: int):
+        store = self.store
+        mats = {sid: np.zeros((padded, dim), np.float32)
+                for sid, dim in store.shard_dims.items()}
+        offsets = np.zeros(padded, np.float32)
+        ids = {st.cid: np.full(len(requests), -1, np.int64)
+               for st in store.random}
+        for i, req in enumerate(requests):
+            offsets[i] = req.offset
+            for sid, feats in (req.features or {}).items():
+                mat = mats.get(sid)
+                if mat is None:
+                    raise ValueError(
+                        f"request {req.uid!r} carries unknown feature "
+                        f"shard {sid!r} (model reads "
+                        f"{sorted(store.shard_dims)})")
+                d = mat.shape[1]
+                if isinstance(feats, dict):
+                    fi = np.asarray(feats["indices"], np.int64).reshape(-1)
+                    fv = np.asarray(feats["values"], np.float32).reshape(-1)
+                elif isinstance(feats, tuple):
+                    fi = np.asarray(feats[0], np.int64).reshape(-1)
+                    fv = np.asarray(feats[1], np.float32).reshape(-1)
+                else:
+                    v = np.asarray(feats, np.float32).reshape(-1)
+                    if v.shape[0] != d:
+                        raise ValueError(
+                            f"request {req.uid!r} shard {sid!r}: expected "
+                            f"{d} features, got {v.shape[0]}")
+                    mat[i] = v
+                    continue
+                valid = (fi >= 0) & (fi < d)
+                np.add.at(mat[i], fi[valid], fv[valid])
+            ent = req.entity_ids or {}
+            for st in store.random:
+                ids[st.cid][i] = store.entity_row_id(
+                    st.re_type, ent.get(st.re_type))
+        return mats, offsets, ids
+
+    # -- scoring paths -----------------------------------------------------
+
+    def _score_chunk(self, requests: Sequence[ScoringRequest]) -> np.ndarray:
+        n = len(requests)
+        with self._lock:
+            padded = bucket_batch(n, self.max_batch)
+            mats, offsets, ids = self._assemble(requests, padded)
+            slots = self.store.resolve_slots(ids, metrics=self.metrics)
+            slots_full = {
+                st.cid: np.concatenate([
+                    slots[st.cid],
+                    np.full(padded - n, st.fallback_slot, np.int32)])
+                for st in self.store.random}
+            if padded not in self._compile_keys:
+                self._compile_keys.add(padded)
+                self.metrics.record_compile()
+            t0 = time.perf_counter()
+            out = self._score_fn(mats, offsets, slots_full,
+                                 self.store.caches())
+            out = np.asarray(jax.block_until_ready(out))
+            dt = time.perf_counter() - t0
+        self.metrics.record_batch(n, padded, dt)
+        self.emitter.emit(ScoringBatch(source="serving", rows=n,
+                                       padded_rows=padded, seconds=dt))
+        return out[:n]
+
+    def score(self, requests: Sequence[ScoringRequest]) -> np.ndarray:
+        """Programmatic batch API: score now, bypassing the queue (the
+        device path — bucketing, cache, metrics — is identical)."""
+        scores = np.empty(len(requests), np.float32)
+        for lo in range(0, len(requests), self.max_batch):
+            chunk = requests[lo: lo + self.max_batch]
+            scores[lo: lo + len(chunk)] = self._score_chunk(chunk)
+        return scores
+
+    def submit(self, request: ScoringRequest):
+        """Queue one request through the micro-batcher; returns a Future
+        resolving to its score (cross-caller batching happens here)."""
+        return self.batcher.submit(request)
+
+    def _flush(self, entries):
+        scores = self._score_chunk([e.request for e in entries])
+        done = time.time()
+        for e in entries:
+            self.metrics.record_request_latency(done - e.enqueued_at)
+        return scores
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        return self.metrics.render_text()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close()
+        self.emitter.emit(ScoringFinish(
+            source="serving", num_rows=self.metrics.rows_total,
+            wall_seconds=time.time() - self.metrics.started_at))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- JSON-over-HTTP front end ----------------------------------------------
+
+def _parse_request(obj: dict) -> ScoringRequest:
+    return ScoringRequest(
+        features=obj.get("features") or {},
+        entity_ids=obj.get("entity_ids") or {},
+        offset=float(obj.get("offset", 0.0)),
+        uid=obj.get("uid"),
+    )
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    """Minimal stdlib handler: POST /score, GET /metrics, GET /healthz.
+
+    Each POSTed request is submitted through the micro-batcher, so
+    concurrent HTTP callers coalesce into shared device batches — the
+    ThreadingHTTPServer thread-per-connection model is exactly what makes
+    the batcher useful here.
+    """
+
+    service: ScoringService = None  # set by make_http_server
+    result_timeout = 60.0
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, payload: dict) -> None:
+        self._respond(code, json.dumps(payload).encode(),
+                      "application/json")
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            self._respond(200, self.service.metrics_text().encode(),
+                          "text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            self._json(200, {"status": "ok"})
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/score":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            reqs = [_parse_request(o) for o in payload.get("requests", [])]
+            if not reqs:
+                self._json(400, {"error": "no requests"})
+                return
+            futures = [self.service.submit(r) for r in reqs]
+            scores = [float(f.result(timeout=self.result_timeout))
+                      for f in futures]
+            self._json(200, {"scores": scores,
+                             "uids": [r.uid for r in reqs]})
+        except Exception as exc:
+            logger.exception("scoring request failed")
+            self._json(400, {"error": str(exc)})
+
+    def log_message(self, fmt, *args):  # route access logs off stderr
+        logger.debug("http: " + fmt, *args)
+
+
+def make_http_server(service: ScoringService, host: str = "127.0.0.1",
+                     port: int = 8080) -> ThreadingHTTPServer:
+    """Bind (not yet serving — call ``serve_forever``). ``port=0`` picks a
+    free port (tests); the bound port is ``server.server_address[1]``."""
+    handler = type("BoundServingHandler", (_ServingHandler,),
+                   {"service": service})
+    return ThreadingHTTPServer((host, port), handler)

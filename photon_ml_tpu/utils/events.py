@@ -49,6 +49,35 @@ class TrainingFinish(Event):
     total_updates: int
 
 
+@dataclasses.dataclass(frozen=True)
+class ScoringStart(Event):
+    """A scoring lifecycle begins — one offline driver run (``source=
+    "game_score"``) or one online service coming up (``source="serving"``,
+    ``num_rows`` None: the stream is unbounded)."""
+
+    source: str
+    num_rows: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringBatch(Event):
+    """One device scoring batch finished: ``rows`` real rows scored inside
+    a ``padded_rows``-shaped program (shape-bucketing pads; padded_rows ==
+    rows on the unbatched offline path)."""
+
+    source: str
+    rows: int
+    padded_rows: int
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringFinish(Event):
+    source: str
+    num_rows: int
+    wall_seconds: float
+
+
 class EventEmitter:
     """Synchronous listener registry (EventEmitter trait parity)."""
 

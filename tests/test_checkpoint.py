@@ -2,9 +2,11 @@
 recovery — the Spark-lineage replacement).
 
 Kill-and-resume: a descent killed mid-run and restarted from its checkpoint
-must produce the same final model as an uninterrupted run (up to f32
-rounding: the resumed run rebuilds the score totals by fresh summation
-while the uninterrupted one updates them incrementally).
+must produce the same final model as an uninterrupted run. The checkpoint
+persists the (n,) residual score total, so resume continues the exact f32
+accumulation chain of the interrupted run (tolerances below predate that
+and are now conservative; checkpoints without residuals fall back to fresh
+summation, which is same-model-correct but not bit-exact).
 """
 
 import numpy as np

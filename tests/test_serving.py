@@ -1,0 +1,473 @@
+"""Online serving subsystem tests (photon_ml_tpu/serving/).
+
+The contract under test: served scores are the offline ``game_score``
+scores — same model, same rows, same numbers — while the serving layer
+adds residency (LRU random-effect cache over a hash-sharded host store),
+micro-batching with shape bucketing (no steady-state recompiles), and
+unseen-entity fixed-effect fallback.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import game_score, game_train
+from photon_ml_tpu.data import synthetic
+from photon_ml_tpu.data.game_data import GameDataset, from_synthetic
+from photon_ml_tpu.data.io import save_game_dataset
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel,
+                                       SubspaceRandomEffectModel,
+                                       sort_subspace_rows)
+from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.serving import (HashShardedStore, MicroBatcher,
+                                   ScoringRequest, ScoringService,
+                                   bucket_batch, make_http_server,
+                                   requests_from_dataset)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import events as ev
+
+
+def _tiny_game_model(rng, d_global=6, d_re=4, num_entities=12,
+                     task=TaskType.LOGISTIC_REGRESSION):
+    return GameModel(task=task, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=d_global).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(num_entities, d_re)
+                                   ).astype(np.float32))),
+    })
+
+
+def _dataset_for(rng, model, n=40, unseen_extra=0):
+    dg = model.models["fixed"].dim
+    dr = model.models["per-user"].dim
+    E = model.models["per-user"].num_entities
+    ids = rng.integers(0, E + unseen_extra, n).astype(np.int32)
+    return GameDataset(
+        response=np.zeros(n, np.float32),
+        offsets=rng.normal(size=n).astype(np.float32),
+        weights=np.ones(n, np.float32),
+        feature_shards={
+            "global": rng.normal(size=(n, dg)).astype(np.float32),
+            "re_userId": rng.normal(size=(n, dr)).astype(np.float32)},
+        entity_ids={"userId": ids}, num_entities={"userId": E},
+        intercept_index={})
+
+
+# -- end-to-end: train via the CLI, serve, compare with game_score ----------
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One CLI-trained mixed-effects model shared by the e2e tests."""
+    tmp = tmp_path_factory.mktemp("serving-e2e")
+    rng = np.random.default_rng(7)
+    syn = synthetic.game_data(rng, n=900, d_global=8,
+                              re_specs={"userId": (20, 4)})
+    ds = from_synthetic(syn)
+    idx = rng.permutation(900)
+    train_dir, val_dir = str(tmp / "train"), str(tmp / "val")
+    save_game_dataset(ds.subset(idx[:700]), train_dir)
+    # Rewrite a third of the validation ids as UNSEEN entities (beyond the
+    # trained table) — both scoring paths must fall back to fixed-only.
+    val = ds.subset(idx[700:])
+    val.entity_ids["userId"] = val.entity_ids["userId"].copy()
+    val.entity_ids["userId"][::3] = 20 + (idx[700:][::3] % 5).astype(np.int32)
+    val.num_entities = {"userId": 25}
+    save_game_dataset(val, val_dir)
+    out = str(tmp / "out")
+    game_train.run(game_train.build_parser().parse_args([
+        "--train", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId,min_samples=2",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", "2",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--output-dir", out,
+    ]))
+    return os.path.join(out, "best"), val_dir, str(tmp)
+
+
+def test_served_scores_match_offline_game_score(trained):
+    model_dir, val_dir, tmp = trained
+    score_out = os.path.join(tmp, "scores")
+    game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", model_dir,
+        "--output-dir", score_out,
+    ]))
+    offline = np.load(os.path.join(score_out, "scores.npz"))["score"]
+    from photon_ml_tpu.data.io import load_game_dataset
+
+    data = load_game_dataset(val_dir)
+    with ScoringService(model_io.load_game_model(model_dir),
+                        max_batch=32, cache_entities=64) as svc:
+        served = svc.score(requests_from_dataset(data))
+    np.testing.assert_allclose(served, offline, rtol=1e-6, atol=1e-6)
+
+
+def test_served_as_mean_matches_offline(trained):
+    model_dir, val_dir, tmp = trained
+    score_out = os.path.join(tmp, "scores-mean")
+    game_score.run(game_score.build_parser().parse_args([
+        "--data", val_dir, "--model-dir", model_dir,
+        "--output-dir", score_out, "--as-mean",
+    ]))
+    offline = np.load(os.path.join(score_out, "scores.npz"))["score"]
+    from photon_ml_tpu.data.io import load_game_dataset
+
+    data = load_game_dataset(val_dir)
+    with ScoringService(model_io.load_game_model(model_dir), as_mean=True,
+                        max_batch=32) as svc:
+        served = svc.score(requests_from_dataset(data))
+    assert served.min() >= 0.0 and served.max() <= 1.0
+    np.testing.assert_allclose(served, offline, rtol=1e-6, atol=1e-6)
+
+
+# -- unseen-entity fallback -------------------------------------------------
+
+def test_unseen_entity_fixed_effect_fallback(rng):
+    model = _tiny_game_model(rng)
+    w = np.asarray(model.models["fixed"].coefficients.means)
+    x = rng.normal(size=w.shape[0]).astype(np.float32)
+    xr = rng.normal(size=model.models["per-user"].dim).astype(np.float32)
+    fixed_only = float(x @ w) + 0.25
+    with ScoringService(model, max_batch=4) as svc:
+        feats = {"global": x, "re_userId": xr}
+        got = svc.score([
+            # id beyond the table, negative id, missing key, raw string
+            # key with no vocabulary: all fall back to fixed-effect-only.
+            ScoringRequest(feats, {"userId": 999}, offset=0.25),
+            ScoringRequest(feats, {"userId": -1}, offset=0.25),
+            ScoringRequest(feats, {}, offset=0.25),
+            ScoringRequest(feats, {"userId": "stranger"}, offset=0.25),
+            # a seen entity for contrast
+            ScoringRequest(feats, {"userId": 3}, offset=0.25),
+        ])
+    np.testing.assert_allclose(got[:4], fixed_only, rtol=1e-6)
+    re_part = float(xr @ np.asarray(model.models["per-user"].means)[3])
+    np.testing.assert_allclose(got[4], fixed_only + re_part, rtol=1e-5)
+    assert svc.metrics.snapshot()["re_cache"]["per-user"]["unseen"] == 4
+
+
+def test_entity_vocab_resolution(rng):
+    model = _tiny_game_model(rng)
+    x = np.zeros(model.models["fixed"].dim, np.float32)
+    xr = np.eye(model.models["per-user"].dim, dtype=np.float32)[0]
+    with ScoringService(model, max_batch=2,
+                        entity_vocabs={"userId": {"alice": 5}}) as svc:
+        got = svc.score([
+            ScoringRequest({"global": x, "re_userId": xr},
+                           {"userId": "alice"}),
+            ScoringRequest({"global": x, "re_userId": xr}, {"userId": 5}),
+            ScoringRequest({"global": x, "re_userId": xr},
+                           {"userId": "bob"}),
+        ])
+    W = np.asarray(model.models["per-user"].means)
+    np.testing.assert_allclose(got[0], W[5, 0], rtol=1e-6)
+    np.testing.assert_allclose(got[1], got[0], rtol=1e-6)
+    np.testing.assert_allclose(got[2], 0.0, atol=1e-7)
+
+
+# -- padding / bucketing invariance -----------------------------------------
+
+def test_bucketing_invariance_across_batch_compositions(rng):
+    model = _tiny_game_model(rng)
+    data = _dataset_for(rng, model, n=53, unseen_extra=4)
+    requests = requests_from_dataset(data)
+    offline = np.asarray(model.score(data))
+    with ScoringService(model, max_batch=16, cache_entities=64) as svc:
+        whole = svc.score(requests)
+        np.testing.assert_allclose(whole, offline, rtol=1e-5, atol=1e-6)
+        one_by_one = np.concatenate(
+            [svc.score([r]) for r in requests])
+        # Ragged chunking hits every bucket shape (1, 2, 4, 8, 16).
+        ragged = []
+        i = 0
+        for size in (1, 2, 3, 5, 7, 11, 16, 8):
+            ragged.append(svc.score(requests[i: i + size]))
+            i += size
+        ragged = np.concatenate(ragged)
+    np.testing.assert_allclose(one_by_one, whole, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ragged, whole[: ragged.shape[0]],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bucket_batch_shapes():
+    assert [bucket_batch(n, 16) for n in (1, 2, 3, 4, 5, 9, 16, 99)] \
+        == [1, 2, 4, 4, 8, 16, 16, 16]
+
+
+# -- LRU cache --------------------------------------------------------------
+
+def test_lru_eviction_correctness_tiny_budget(rng):
+    model = _tiny_game_model(rng, num_entities=9)
+    data = _dataset_for(rng, model, n=120)
+    offline = np.asarray(model.score(data))
+    # Budget of 2 resident entities against 9 live ones: constant churn.
+    with ScoringService(model, max_batch=2, cache_entities=2) as svc:
+        got = svc.score(requests_from_dataset(data))
+        stats = svc.metrics.snapshot()["re_cache"]["per-user"]
+        resident = svc.store.random[0].cached_entities()
+    np.testing.assert_allclose(got, offline, rtol=1e-5, atol=1e-6)
+    assert len(resident) <= 2
+    assert stats["evictions"] > 0
+    assert stats["hits"] + stats["misses"] == 120
+    assert stats["misses"] > stats["hits"]  # thrashing regime
+
+
+def test_lru_repeat_entity_hits(rng):
+    model = _tiny_game_model(rng)
+    x = np.zeros(model.models["fixed"].dim, np.float32)
+    xr = np.ones(model.models["per-user"].dim, np.float32)
+    req = ScoringRequest({"global": x, "re_userId": xr}, {"userId": 2})
+    with ScoringService(model, max_batch=1, cache_entities=4) as svc:
+        first = svc.score([req])
+        again = svc.score([req])
+        stats = svc.metrics.snapshot()["re_cache"]["per-user"]
+    np.testing.assert_array_equal(first, again)
+    assert stats == {"hits": 1, "misses": 1, "unseen": 0, "evictions": 0,
+                     "hit_rate": 0.5}
+
+
+def test_hash_sharded_store_fetch_matches_entity_rows(rng):
+    E, d, A = 23, 11, 4
+    dense = RandomEffectModel(
+        "u", "s", jnp.asarray(rng.normal(size=(E, d)).astype(np.float32)))
+    cols = np.stack([rng.choice(d, A, replace=False)
+                     for _ in range(E)]).astype(np.int32)
+    cols[1, -1] = -1
+    cols_s, _, means_s = sort_subspace_rows(
+        cols, rng.normal(size=(E, A)).astype(np.float32))
+    sub = SubspaceRandomEffectModel(
+        "u", "s", d, jnp.asarray(cols_s), jnp.asarray(means_s))
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+
+    fac = FactoredRandomEffectModel(
+        "u", "s", jnp.asarray(rng.normal(size=(d, 3)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(E, 3)).astype(np.float32)))
+    ids = rng.permutation(E)[:13]
+    for m in (dense, sub, fac):
+        store = HashShardedStore(m, num_shards=4)
+        np.testing.assert_allclose(store.fetch(ids), m.entity_rows(ids),
+                                   rtol=1e-6)
+        assert store.dim == d and store.num_entities == E
+
+
+# -- micro-batcher timing ---------------------------------------------------
+
+def test_batcher_flushes_full_batches_and_on_deadline():
+    sizes = []
+    done = threading.Event()
+
+    def flush(entries):
+        sizes.append(len(entries))
+        if sum(sizes) >= 9:
+            done.set()
+        return [float(e.request) for e in entries]
+
+    b = MicroBatcher(flush, max_batch=4, max_wait_ms=30.0)
+    try:
+        futs = [b.submit(i) for i in range(8)]  # two full flushes
+        tail = b.submit(99)  # lone request: must flush on the deadline
+        assert tail.result(timeout=5.0) == 99.0
+        assert [f.result(timeout=5.0) for f in futs] == [float(i)
+                                                         for i in range(8)]
+        assert done.wait(timeout=5.0)
+    finally:
+        b.close()
+    assert max(sizes) == 4 and sizes[-1] == 1
+
+
+def test_batcher_propagates_flush_errors():
+    def flush(entries):
+        raise RuntimeError("boom")
+
+    b = MicroBatcher(flush, max_batch=2, max_wait_ms=1.0)
+    try:
+        fut = b.submit(1)
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5.0)
+    finally:
+        b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(2)
+
+
+# -- steady-state compile behavior ------------------------------------------
+
+def test_zero_steady_state_recompiles(rng):
+    model = _tiny_game_model(rng)
+    data = _dataset_for(rng, model, n=200, unseen_extra=3)
+    requests = requests_from_dataset(data)
+    with ScoringService(model, max_batch=8, cache_entities=16) as svc:
+        i = 0
+        for size in (1, 2, 4, 8, 3, 5):  # warmup: every bucket shape
+            svc.score(requests[i: i + size])
+            i += size
+        warm = svc.metrics.snapshot()["compiles_total"]
+        while i < len(requests):
+            size = int(rng.integers(1, 9))
+            svc.score(requests[i: i + size])
+            i += size
+        steady = svc.metrics.snapshot()["compiles_total"]
+    assert warm == 4  # buckets 1, 2, 4, 8
+    assert steady == warm  # ZERO steady-state recompiles
+
+
+# -- lifecycle events -------------------------------------------------------
+
+def test_service_emits_scoring_lifecycle(rng):
+    emitter = ev.EventEmitter()
+    seen = []
+    emitter.register(seen.append)
+    model = _tiny_game_model(rng)
+    data = _dataset_for(rng, model, n=10)
+    svc = ScoringService(model, max_batch=4, emitter=emitter)
+    svc.score(requests_from_dataset(data))
+    svc.close()
+    kinds = [type(e).__name__ for e in seen]
+    assert kinds[0] == "ScoringStart" and kinds[-1] == "ScoringFinish"
+    batches = [e for e in seen if isinstance(e, ev.ScoringBatch)]
+    assert sum(b.rows for b in batches) == 10
+    assert all(b.source == "serving" and b.padded_rows >= b.rows
+               for b in batches)
+    assert seen[-1].num_rows == 10
+
+
+def test_game_score_emits_scoring_lifecycle(trained):
+    model_dir, val_dir, tmp = trained
+    seen = []
+    ev.default_emitter.register(seen.append)
+    try:
+        game_score.run(game_score.build_parser().parse_args([
+            "--data", val_dir, "--model-dir", model_dir,
+            "--output-dir", os.path.join(tmp, "scores-events"),
+        ]))
+    finally:
+        ev.default_emitter.unregister(seen.append)
+    kinds = [type(e).__name__ for e in seen]
+    assert "ScoringStart" in kinds and "ScoringFinish" in kinds
+    assert any(isinstance(e, ev.ScoringBatch) and e.source == "game_score"
+               for e in seen)
+
+
+# -- HTTP front end ---------------------------------------------------------
+
+def test_http_score_and_metrics_endpoints(rng):
+    model = _tiny_game_model(rng)
+    data = _dataset_for(rng, model, n=6, unseen_extra=2)
+    offline = np.asarray(model.score(data))
+    svc = ScoringService(model, max_batch=4, max_wait_ms=1.0)
+    server = make_http_server(svc, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        reqs = []
+        for i, r in enumerate(requests_from_dataset(data)):
+            reqs.append({
+                "features": {k: np.asarray(v).tolist()
+                             for k, v in r.features.items()},
+                "entity_ids": r.entity_ids, "offset": r.offset, "uid": i})
+        body = json.dumps({"requests": reqs}).encode()
+        resp = json.loads(urllib.request.urlopen(
+            urllib.request.Request(f"http://127.0.0.1:{port}/score",
+                                   data=body), timeout=30).read())
+        np.testing.assert_allclose(resp["scores"], offline,
+                                   rtol=1e-5, atol=1e-6)
+        assert resp["uids"] == list(range(6))
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        assert "photon_serving_rows_total 6" in text
+        assert "photon_serving_re_cache_hit_rate" in text
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+        assert health == {"status": "ok"}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/score", data=b"{}"), timeout=30)
+        assert ei.value.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
+
+
+# -- serve CLI --------------------------------------------------------------
+
+def test_serve_cli_end_to_end(trained):
+    from photon_ml_tpu.cli import serve
+
+    model_dir, val_dir, tmp = trained
+    server, svc = serve.create_server(serve.build_parser().parse_args([
+        "--model-dir", model_dir, "--port", "0", "--max-batch", "8",
+        "--max-wait-ms", "1.0",
+    ]))
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        from photon_ml_tpu.data.io import load_game_dataset
+
+        data = load_game_dataset(val_dir)
+        r = requests_from_dataset(data)[0]
+        body = json.dumps({"requests": [{
+            "features": {k: np.asarray(v).tolist()
+                         for k, v in r.features.items()},
+            "entity_ids": r.entity_ids, "offset": r.offset}]}).encode()
+        resp = json.loads(urllib.request.urlopen(
+            urllib.request.Request(f"http://127.0.0.1:{port}/score",
+                                   data=body), timeout=30).read())
+        offline = np.asarray(
+            model_io.load_game_model(model_dir).score(data))[0]
+        np.testing.assert_allclose(resp["scores"][0], offline,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
+
+
+def test_host_loaded_model_serves_identically(trained):
+    """``load_game_model(host=True)`` (the serve driver's loader) keeps
+    coefficients as host numpy and scores identically."""
+    model_dir, val_dir, tmp = trained
+    host_model = model_io.load_game_model(model_dir, host=True)
+    assert isinstance(np.asarray(host_model.models["per-user"].means),
+                      np.ndarray)
+    assert type(host_model.models["per-user"].means) is np.ndarray
+    from photon_ml_tpu.data.io import load_game_dataset
+
+    data = load_game_dataset(val_dir)
+    offline = np.asarray(
+        model_io.load_game_model(model_dir).score(data))
+    with ScoringService(host_model, max_batch=16) as svc:
+        served = svc.score(requests_from_dataset(data))
+    np.testing.assert_allclose(served, offline, rtol=1e-6, atol=1e-6)
+
+
+# -- sparse request features ------------------------------------------------
+
+def test_sparse_requests_match_offline(rng):
+    from photon_ml_tpu.data import sparse as sparse_mod
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+
+    batch, w_true = sparse_mod.synthetic_sparse(60, 32, 6, seed=5,
+                                                zipf=False)
+    ds = from_sparse_batch(batch)
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(np.asarray(w_true, np.float32))))})
+    offline = np.asarray(model.score(ds))
+    with ScoringService(model, max_batch=16) as svc:
+        served = svc.score(requests_from_dataset(ds))
+    np.testing.assert_allclose(served, offline, rtol=1e-5, atol=1e-6)
