@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Fail if the staging lines of a fresh bench tail regress >20% vs the
+committed round baseline (BENCH_r05.json).
+
+The guarded lines are the host-staging costs the parallel pipeline
+(photon_ml_tpu/game/staging.py, docs/STAGING.md) exists to bound:
+
+  staging_bucketing_seconds            build_bucketing at 10M/1M scale
+  staging_projection_seconds           SERIAL whole-bucket projection
+                                       (comparable across rounds)
+  staging_seconds_10m_rows_1m_entities bucketing + serial projection
+  sparse_re_staging_seconds            cold RE coordinate staging
+  sparse_re_staging_warm_seconds       staging-cache warm restage
+
+plus one cross-line invariant: the NEW parallel projection line
+(staging_projection_parallel_seconds, absent from baselines before r06)
+must not regress the wall the serial pass set — it may never exceed the
+committed serial time by more than the same 20% band, whatever the
+worker count (at workers=1 parallel ≈ serial; at workers=N it should be
+far below).
+
+Usage:
+  check_bench_regression.py --fresh TAIL.json [--baseline BENCH_r05.json]
+  check_bench_regression.py --run-staging     [--baseline BENCH_r05.json]
+
+--fresh takes either a raw bench.py stdout object ({"metric": ...,
+"secondary": {...}}) or a bare section dict (the bench_fresh_host_suite
+return value). --run-staging measures a fresh tail itself by running
+bench.bench_fresh_host_suite in a subprocess (several minutes at the
+10M-row design scale; this is the opt-in PML_CHECK_BENCH=1 step of
+dev-scripts/run_tier1.sh). Exit 0 = within band, 1 = regression,
+2 = usage/baseline error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOLERANCE = 0.20
+GUARDED = [
+    "staging_bucketing_seconds",
+    "staging_projection_seconds",
+    "staging_seconds_10m_rows_1m_entities",
+    "sparse_re_staging_seconds",
+    "sparse_re_staging_warm_seconds",
+]
+
+
+def _lines(obj: dict) -> dict:
+    """Accept a raw bench stdout object or a bare section dict."""
+    if "secondary" in obj and isinstance(obj["secondary"], dict):
+        return obj["secondary"]
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        return _lines(obj["parsed"])
+    return obj
+
+
+def _fresh_from_run() -> dict:
+    # Same fresh-process discipline as bench.main(): device-runtime state
+    # accumulated in a long-lived parent skews the host sorts ~3x.
+    with tempfile.NamedTemporaryFile(mode="r", suffix=".json") as f:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import json, sys, bench;"
+             " json.dump(bench.bench_fresh_host_suite(),"
+             " open(sys.argv[1], 'w'))", f.name],
+            cwd=REPO, check=True)
+        return json.load(f)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--fresh", help="path to a fresh bench tail JSON")
+    src.add_argument("--run-staging", action="store_true",
+                     help="measure a fresh staging tail now (slow)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "BENCH_r05.json"))
+    ap.add_argument("--tolerance", type=float, default=TOLERANCE,
+                    help="allowed fractional regression (default 0.20)")
+    args = ap.parse_args()
+
+    try:
+        with open(args.baseline) as f:
+            base = _lines(json.load(f))
+    except (OSError, ValueError) as e:
+        print(f"cannot load baseline {args.baseline}: {e}")
+        return 2
+    if args.fresh:
+        try:
+            with open(args.fresh) as f:
+                fresh = _lines(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"cannot load fresh tail {args.fresh}: {e}")
+            return 2
+    else:
+        fresh = _lines(_fresh_from_run())
+
+    failures = []
+    band = 1.0 + args.tolerance
+    for key in GUARDED:
+        if key not in base:
+            continue  # line did not exist in that round
+        if key not in fresh:
+            failures.append(f"{key}: missing from fresh tail "
+                            f"(baseline {base[key]})")
+            continue
+        b, v = float(base[key]), float(fresh[key])
+        verdict = "OK" if v <= b * band else "REGRESSION"
+        print(f"{key}: fresh {v:g} vs baseline {b:g} "
+              f"(limit {b * band:.3g}) {verdict}")
+        if v > b * band:
+            failures.append(f"{key}: {v:g} > {b * band:.3g} "
+                            f"(baseline {b:g} +{args.tolerance:.0%})")
+    par = fresh.get("staging_projection_parallel_seconds")
+    serial_base = base.get("staging_projection_seconds")
+    if par is not None and serial_base is not None:
+        b, v = float(serial_base), float(par)
+        verdict = "OK" if v <= b * band else "REGRESSION"
+        print(f"staging_projection_parallel_seconds "
+              f"(workers={fresh.get('staging_workers', '?')}): fresh "
+              f"{v:g} vs serial baseline {b:g} (limit {b * band:.3g}) "
+              f"{verdict}")
+        if v > b * band:
+            failures.append(
+                f"staging_projection_parallel_seconds: {v:g} > "
+                f"{b * band:.3g} — the parallel pipeline is slower than "
+                f"the committed serial wall")
+
+    if failures:
+        print(f"\n{len(failures)} staging regression(s) vs "
+              f"{os.path.basename(args.baseline)}:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print("\nstaging bench lines within "
+          f"{args.tolerance:.0%} of {os.path.basename(args.baseline)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,4 +4,13 @@
 # wrapper; the ROADMAP line is the contract.
 cd "$(dirname "$0")/.."
 
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
+# staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
+# regression of the guarded staging lines vs the committed round
+# baseline fails the run. See dev-scripts/check_bench_regression.py.
+if [ "$rc" -eq 0 ] && [ "${PML_CHECK_BENCH:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/check_bench_regression.py --run-staging; rc=$?
+fi
+exit $rc

@@ -14,10 +14,23 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Union
 
+from photon_ml_tpu.game.staging import StagingConfig
 from photon_ml_tpu.optim import (OptimizerConfig, OptimizerType,
                                  RegularizationContext, RegularizationType)
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType)
+
+__all__ = [
+    "CoordinateConfiguration",
+    "CoordinateDataConfiguration",
+    "FactoredRandomEffectDataConfiguration",
+    "FixedEffectDataConfiguration",
+    "RandomEffectDataConfiguration",
+    "StagingConfig",
+    "parse_kv",
+    "parse_optimizer_config",
+    "parse_staging_config",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +184,29 @@ def parse_kv(spec: str) -> dict[str, str]:
             raise ValueError(f"bad config token {part!r} in {spec!r}")
         kv[k.strip()] = v.strip()
     return kv
+
+
+def parse_staging_config(spec: str) -> StagingConfig:
+    """Parse ``key=value,...`` mini-DSL for the random-effect staging
+    pipeline (game/staging.py).
+
+    Keys: workers (pool size; default = host cores), mode
+    (thread|process), depth (max staged-but-unconsumed shard blocks),
+    shard_entities (entity lanes per staged shard).
+    """
+    kv = parse_kv(spec)
+    known = {"workers", "mode", "depth", "shard_entities"}
+    unknown = set(kv) - known
+    if unknown:
+        raise ValueError(f"unknown staging keys {sorted(unknown)}; "
+                         f"expected {sorted(known)}")
+    return StagingConfig(
+        workers=int(kv["workers"]) if "workers" in kv else None,
+        mode=kv.get("mode", "thread").lower(),
+        pipeline_depth=int(kv["depth"]) if "depth" in kv else None,
+        shard_entities=(int(kv["shard_entities"])
+                        if "shard_entities" in kv else None),
+    )
 
 
 def parse_optimizer_config(spec: str) -> GLMOptimizationConfiguration:

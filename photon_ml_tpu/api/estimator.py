@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
-                                       RandomEffectDataConfiguration)
+                                       RandomEffectDataConfiguration,
+                                       StagingConfig)
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.evaluation import evaluators as ev
 from photon_ml_tpu.game import descent
@@ -63,6 +64,7 @@ class GameEstimator:
         normalization: Optional[dict[str, NormalizationContext]] = None,
         compute_variances_at_end: bool = True,
         staging_cache_dir: Optional[str] = None,
+        staging: Optional[StagingConfig] = None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -77,6 +79,9 @@ class GameEstimator:
         # fresh process memory-maps the staged blocks instead of re-paying
         # the projection pass.
         self.staging_cache_dir = staging_cache_dir
+        # Parallel staging pipeline knobs (game/staging.py), shared by
+        # every projected random-effect coordinate this estimator builds.
+        self.staging = staging
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
@@ -146,7 +151,8 @@ class GameEstimator:
                         cc.data.features_to_samples_ratio),
                     subspace_model=cc.data.subspace_model,
                     staging_cache_dir=self.staging_cache_dir,
-                    feature_dtype=cc.data.feature_dtype)
+                    feature_dtype=cc.data.feature_dtype,
+                    staging=self.staging)
             elif isinstance(cc.data, FactoredRandomEffectDataConfiguration):
                 if cc.data.feature_shard_id in self.normalization:
                     raise ValueError(

@@ -28,7 +28,8 @@ from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
                                        RandomEffectDataConfiguration,
-                                       parse_kv, parse_optimizer_config)
+                                       parse_kv, parse_optimizer_config,
+                                       parse_staging_config)
 from photon_ml_tpu.api.estimator import GameEstimator
 from photon_ml_tpu.data.io import load_game_dataset
 from photon_ml_tpu.data.validators import (DataValidationLevel,
@@ -143,7 +144,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist projected random-effect staging artifacts "
                         "here, keyed by dataset content digest — a re-run "
                         "on the same data memory-maps the staged blocks "
-                        "instead of re-paying the projection pass")
+                        "(shard-granular: a killed run resumes with "
+                        "partial credit) instead of re-paying the "
+                        "projection pass")
+    p.add_argument("--staging",
+                   help="parallel staging pipeline knobs, "
+                        "'workers=8,mode=thread|process,depth=10,"
+                        "shard_entities=65536' (docs/STAGING.md); "
+                        "default: one worker per host core, thread mode, "
+                        "depth=workers+2")
     return p
 
 
@@ -355,7 +364,9 @@ def run(args) -> dict:
         mesh=make_mesh(distributed=getattr(args, "distributed", False)),
         descent_iterations=args.iterations,
         validation_evaluators=evaluators,
-        staging_cache_dir=args.staging_cache_dir)
+        staging_cache_dir=args.staging_cache_dir,
+        staging=(parse_staging_config(args.staging)
+                 if getattr(args, "staging", None) else None))
 
     initial_models = None
     if args.model_input_dir:

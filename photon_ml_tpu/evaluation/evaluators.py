@@ -426,6 +426,17 @@ def evaluation_suite(
         if isinstance(x, jax.Array):
             dset = x.sharding.device_set
             if len(dset) == 1:
+                if not x.is_fully_addressable:
+                    # A DCN rank with ONE local device still hands other
+                    # ranks' arrays here as single-device shardings; the
+                    # device-to-device re-place below would fail opaquely
+                    # deep inside XLA instead of saying what to do.
+                    raise ValueError(
+                        "evaluation_suite needs addressable or fully-"
+                        "replicated arrays; got a single-device array "
+                        "owned by another process. Multi-host callers "
+                        "must all-gather (or replicate) scores/labels "
+                        "before evaluating.")
                 # Already single-device: skip the host round trip. Re-place
                 # only if committed elsewhere (device-to-device, no host) —
                 # mixed-device inputs would crash the eager metric math.

@@ -18,6 +18,7 @@ from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game import buckets as bkt
 from photon_ml_tpu.game import projector as prj
+from photon_ml_tpu.game import staging as stg
 from photon_ml_tpu.game.models import (RandomEffectModel,
                                        SubspaceRandomEffectModel,
                                        _subspace_positions,
@@ -40,8 +41,10 @@ _UNSET = object()
 # Max entity lanes per vmapped random-effect solve dispatch: the solver's
 # carry/line-search temps scale with lanes, and one dispatch over ~600k
 # lanes OOMs a 16 GB chip. 64k lanes keeps temps ~100 MB at typical widths
-# while staying large enough to saturate the chip.
-_LANE_CHUNK = 65536
+# while staying large enough to saturate the chip. Shared with the
+# staging pipeline so staged shards == device dispatch chunks (one
+# host→device put per produced shard, no re-slicing).
+_LANE_CHUNK = stg.LANE_CHUNK
 
 
 @jax.jit
@@ -101,6 +104,7 @@ class RandomEffectCoordinate:
         subspace_model: Optional[bool] = None,
         staging_cache_dir: Optional[str] = None,
         feature_dtype: str = "float32",
+        staging: Optional[stg.StagingConfig] = None,
     ):
         from photon_ml_tpu.data.game_data import SparseShard
         if feature_dtype not in ("float32", "bfloat16"):
@@ -170,16 +174,18 @@ class RandomEffectCoordinate:
         # entity axis is sharded over the mesh's data axis (P2) when the
         # padded entity count divides it. With projection on, features are
         # staged directly at (E_b, cap, d_active) and each tuple carries the
-        # (E_b, d_active) column map plus projected normalization arrays.
+        # (E_b, d_active) column map plus projected normalization arrays —
+        # produced by the parallel pipelined stager (game/staging.py) and
+        # consumed lazily by the fit stream (_iter_bucket_data), so the
+        # first per-entity fits dispatch while later shards still project.
         self._bucket_data = []
+        self._pending = None
+        self._stager = None
+        self.staging = staging or stg.StagingConfig()
+        self.feature_dtype = feature_dtype
         ds = dataset
         X = ds.feature_shards[shard_id]
-        n_data = mesh.shape[DATA_AXIS]
-
-        def put(a):
-            if a.shape[0] % n_data == 0:
-                return jax.device_put(a, data_sharded(mesh, a.ndim))
-            return jnp.asarray(a)
+        self._n_data = mesh.shape[DATA_AXIS]
 
         # Shifts without factors cannot occur via build_normalization; guard
         # the manual case so the projected solve has one layout.
@@ -189,12 +195,12 @@ class RandomEffectCoordinate:
             f_full = np.ones_like(s_full)
 
         # Projected staging products persist on disk keyed by dataset
-        # content + staging params (photon_ml_tpu/game/staging_cache.py):
-        # a warm re-fit of the same data memory-maps the staged blocks
-        # instead of re-paying the projection sort/segment pass.
+        # content + staging params (photon_ml_tpu/game/staging_cache.py),
+        # shard-granular: a warm re-fit of the same data memory-maps the
+        # staged blocks instead of re-paying the projection pass, and a
+        # partial entry (killed run) restages only its missing shards.
         from photon_ml_tpu.game import staging_cache
 
-        cached = None
         self._staging_cache_key = None
         if staging_cache_dir and self.projection:
             self._staging_cache_key = staging_cache.staging_key(
@@ -205,108 +211,83 @@ class RandomEffectCoordinate:
                 intercept=self.intercept_index, subspace=self.subspace,
                 # Declared dimensions the array digest cannot see: the
                 # staged entity tables and the subspace join sentinels
-                # depend on both.
-                num_entities=self.num_entities, dim=self.dim)
-            cached = staging_cache.load(staging_cache_dir,
-                                        self._staging_cache_key)
+                # depend on both. The shard size shapes the per-shard
+                # file layout, so it keys too.
+                num_entities=self.num_entities, dim=self.dim,
+                shard_entities=stg.resolved_shard_entities(
+                    self.staging, self.bucketing.entity_pad_multiple))
 
-        if cached is not None:
-            host_buckets, sub = cached
+        if self.projection:
+            self._stager = stg.ProjectionStager(
+                bucketing=self.bucketing, X=X,
+                response=np.asarray(ds.response),
+                weights=np.asarray(ds.weights),
+                intercept_index=self.intercept_index,
+                features_to_samples_ratio=self.features_to_samples_ratio,
+                factors=f_full, shifts=s_full,
+                config=self.staging,
+                cache_dir=staging_cache_dir,
+                cache_key=self._staging_cache_key,
+                expect_subspace=self.subspace,
+                label=f"{re_type}:{shard_id}")
+            self._pending = self._stager.shards()
+            sub = {}
+            if self.subspace:
+                sub = self._stager.cached_subspace()
+                if sub is not None and self.is_sparse and "flat" not in sub:
+                    sub = None  # incomplete record: recompute
+                if sub is None:
+                    # (E, A) active-column table: each entity lives in
+                    # exactly one bucket, so its model row is its bucket
+                    # row padded to the widest bucket's d_active. The
+                    # PUBLIC model layout sorts each row by column id
+                    # (padding last) so SubspaceRandomEffectModel.score
+                    # can join new datasets with a device-side
+                    # searchsorted; the bucket-internal layout (intercept
+                    # slot 0) is reached through the stored permutation
+                    # at the train/warm-start boundary. Blocks only on
+                    # the pipeline's pair-extraction phase — the feature
+                    # gathers keep overlapping with whatever runs next.
+                    shard_cols = self._stager.cols_list()
+                    A = max((c.shape[1] for c in shard_cols), default=1)
+                    cols_tab = np.full((self.num_entities, A), -1,
+                                       np.int32)
+                    for (bi, lo, hi), c in zip(self._stager.plan,
+                                               shard_cols):
+                        rows_s = self.bucketing.buckets[bi].entity_rows[
+                            lo:hi]
+                        live = rows_s >= 0
+                        cols_tab[rows_s[live], : c.shape[1]] = c[live]
+                    cols_sorted, perm = sort_subspace_rows(cols_tab)
+                    sub = {"cols": cols_sorted, "perm": perm}
+                    if self.is_sparse:
+                        # Stage the score-side join ONCE: data nonzeros →
+                        # flat slots of the (E, A) table (E*A = miss/
+                        # passive → 0).
+                        flat = _subspace_positions(
+                            cols_sorted, self.dim,
+                            np.asarray(ds.entity_ids[re_type]),
+                            np.asarray(
+                                dataset.feature_shards[shard_id].indices))
+                        fp_dtype = (np.int32
+                                    if cols_sorted.size < 2**31 - 1
+                                    else np.int64)
+                        sub["flat"] = flat.astype(fp_dtype)
+                self._stager.set_subspace(sub)
         else:
-            coo = prj.shard_coo(X) if self.projection else None
-            trips = (prj.all_bucket_triplets(self.bucketing.buckets, X, coo)
-                     if self.projection else None)
-            bucket_cols: list[np.ndarray] = []  # per-bucket (E_b, d_active)
+            # Unprojected path: dense gathers, cheap relative to the
+            # projection wall — staged eagerly as before.
             host_buckets: list[tuple] = []
-            for bi, b in enumerate(self.bucketing.buckets):
+            for b in self.bucketing.buckets:
                 wb = bkt.bucket_weights(b, ds.weights)
                 ex = b.example_idx.astype(np.int32)  # (E_b, cap); -1 pad
                 rows = b.entity_rows  # (E_b,) int32; -1 padding
-                if self.projection:
-                    trip = trips[bi]
-                    proj = prj.build_bucket_projection(
-                        b, X, self.intercept_index,
-                        labels=np.asarray(ds.response),
-                        features_to_samples_ratio=(
-                            self.features_to_samples_ratio),
-                        triplets=trip)
-                    Xb = prj.gather_projected_features(b, proj, X,
-                                                       triplets=trip)
-                    (yb,) = bkt.gather_bucket_arrays(b, ds.response)
-                    f_p, s_p = prj.project_norm_arrays(proj, f_full, s_full)
-                    bucket_cols.append(proj.cols)
-                    extra = [proj.cols]
-                    if f_full is not None:
-                        extra.append(f_p)
-                    if s_full is not None:
-                        extra.append(s_p)
-                    host_buckets.append((Xb, yb, wb, ex, rows, *extra))
-                else:
-                    Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
-                    host_buckets.append((Xb, yb, wb, ex, rows))
+                Xb, yb = bkt.gather_bucket_arrays(b, X, ds.response)
+                host_buckets.append((Xb, yb, wb, ex, rows))
             sub = {}
-            if self.subspace:
-                # (E, A) active-column table: each entity lives in exactly
-                # one bucket, so its model row is its bucket row padded to
-                # the widest bucket's d_active. The PUBLIC model layout
-                # sorts each row by column id (padding last) so
-                # SubspaceRandomEffectModel.score can join new datasets
-                # with a device-side searchsorted; the bucket-internal
-                # layout (intercept slot 0) is reached through the stored
-                # permutation at the train/warm-start boundary.
-                A = max((c.shape[1] for c in bucket_cols), default=1)
-                cols_tab = np.full((self.num_entities, A), -1, np.int32)
-                for b, c in zip(self.bucketing.buckets, bucket_cols):
-                    live = b.entity_rows >= 0
-                    cols_tab[b.entity_rows[live], : c.shape[1]] = c[live]
-                cols_sorted, perm = sort_subspace_rows(cols_tab)  # ← bucket
-                sub = {"cols": cols_sorted, "perm": perm}
-                if self.is_sparse:
-                    # Stage the score-side join ONCE: data nonzeros → flat
-                    # slots of the (E, A) table (E*A = miss/passive → 0).
-                    flat = _subspace_positions(
-                        cols_sorted, self.dim,
-                        np.asarray(ds.entity_ids[re_type]),
-                        np.asarray(dataset.feature_shards[shard_id].indices))
-                    fp_dtype = (np.int32 if cols_sorted.size < 2**31 - 1
-                                else np.int64)
-                    sub["flat"] = flat.astype(fp_dtype)
-            if self._staging_cache_key is not None:
-                staging_cache.save(staging_cache_dir,
-                                   self._staging_cache_key,
-                                   host_buckets, sub)
-
-        # bf16 feature STORAGE (same contract as the dense fixed path:
-        # aggregators accumulate in f32 via preferred_element_type). The
-        # cast happens here — after the staging cache, which stays f32 and
-        # dtype-independent — so only the staged bucket blocks shrink; the
-        # scoring-side (n, d) shard keeps full precision.
-        self.feature_dtype = feature_dtype
-        feat_cast = jnp.bfloat16 if feature_dtype == "bfloat16" else None
-
-        for arrays in host_buckets:
-            # Bound the vmapped-solve footprint: a single dispatch over
-            # hundreds of thousands of entity lanes exhausts HBM on solver
-            # temps (the L-BFGS carry and line-search buffers scale with
-            # lanes), so buckets split into ~_LANE_CHUNK-entity pieces.
-            # The chunk size is rounded UP to a multiple of this
-            # coordinate's entity pad so every slice (bucket sizes are pad
-            # multiples, so the tail slice included) keeps the divisibility
-            # put() needs to shard — a fixed 65536 would silently
-            # replicate previously-sharded buckets on non-power-of-two
-            # data axes.
-            pad = self.bucketing.entity_pad_multiple
-            chunk = ((_LANE_CHUNK + pad - 1) // pad) * pad
-            E_b = arrays[4].shape[0]
-            for lo in range(0, E_b, chunk):
-                hi = min(lo + chunk, E_b)
-                tup = []
-                for ai, a in enumerate(arrays):
-                    a = np.asarray(a)[lo:hi]
-                    if ai == 0 and feat_cast is not None:  # Xb block
-                        a = a.astype(feat_cast)
-                    tup.append(put(a))
-                self._bucket_data.append(tuple(tup))
+            for arrays in host_buckets:
+                self._stage_host_tuple(arrays)
+            self._pending = None
         if self.subspace:
             cols_sorted = np.asarray(sub["cols"])
             perm = np.asarray(sub["perm"])
@@ -341,6 +322,77 @@ class RandomEffectCoordinate:
                 # score path — free the device copy at scale.
                 self._sp_indices = None
         self._build_fits()
+
+    def _put(self, a):
+        if a.shape[0] % self._n_data == 0:
+            return jax.device_put(a, data_sharded(self.mesh, a.ndim))
+        return jnp.asarray(a)
+
+    def _stage_host_tuple(self, arrays) -> None:
+        """Split one staged host tuple into ≤ _LANE_CHUNK-lane device
+        tuples appended to the fit stream.
+
+        The lane bound caps the vmapped-solve footprint: a single
+        dispatch over hundreds of thousands of entity lanes exhausts HBM
+        on solver temps (the L-BFGS carry and line-search buffers scale
+        with lanes). The chunk is rounded UP to a multiple of this
+        coordinate's entity pad so every slice keeps the divisibility
+        _put() needs to shard. Pipeline shards default to exactly this
+        chunk, making the split a no-op slice; bigger explicit
+        shard_entities still re-split here.
+
+        bf16 feature STORAGE happens here (same contract as the dense
+        fixed path: aggregators accumulate in f32) — after the staging
+        cache, which stays f32 and dtype-independent, so only the staged
+        bucket blocks shrink."""
+        feat_cast = (jnp.bfloat16 if self.feature_dtype == "bfloat16"
+                     else None)
+        pad = self.bucketing.entity_pad_multiple
+        chunk = ((_LANE_CHUNK + pad - 1) // pad) * pad
+        E_b = arrays[4].shape[0]
+        for lo in range(0, E_b, chunk):
+            hi = min(lo + chunk, E_b)
+            tup = []
+            for ai, a in enumerate(arrays):
+                a = np.asarray(a)[lo:hi]
+                if ai == 0 and feat_cast is not None:  # Xb block
+                    a = a.astype(feat_cast)
+                tup.append(self._put(a))
+            self._bucket_data.append(tuple(tup))
+
+    def _iter_bucket_data(self):
+        """The fit stream: already-staged device tuples first, then — on
+        the first full pass — the remaining pipeline shards in plan
+        order, device-put as each arrives. This is the consumer side of
+        the bounded producer/consumer handoff: while the device fits
+        shard i, the worker pool is still projecting shards > i, and at
+        most pipeline_depth staged-but-unconsumed host blocks exist.
+        Single-consumer by contract (coordinate descent trains
+        coordinates sequentially)."""
+        i = 0
+        while True:
+            if i < len(self._bucket_data):
+                yield self._bucket_data[i]
+                i += 1
+                continue
+            if self._pending is None:
+                return
+            try:
+                host = next(self._pending)
+            except StopIteration:
+                self._pending = None
+                return
+            self._stage_host_tuple(host)
+
+    def wait_staged(self) -> "RandomEffectCoordinate":
+        """Barrier: drain the staging pipeline onto the device without
+        fitting anything (the pre-pipelining behavior; also what tests
+        use to compare pipelined vs barrier staging)."""
+        for _ in self._iter_bucket_data():
+            pass
+        if self._stager is not None:
+            self._stager.join()  # staging-cache writes included
+        return self
 
     def _build_fits(self):
         """(Re)build the cached jitted per-bucket fit/variance programs.
@@ -621,7 +673,7 @@ class RandomEffectCoordinate:
             W = jnp.array(
                 self.norm.model_to_transformed_space(initial.means), copy=True)
         offsets = jnp.asarray(offsets)
-        for arrays in self._bucket_data:
+        for arrays in self._iter_bucket_data():
             W = self._fit_bucket(W, offsets, *arrays)
         if self.subspace:
             return SubspaceRandomEffectModel(
@@ -651,7 +703,7 @@ class RandomEffectCoordinate:
             W = jnp.asarray(self.norm.model_to_transformed_space(model.means))
         V = jnp.zeros(model.means.shape, jnp.float32)
         offsets = jnp.asarray(offsets)
-        for arrays in self._bucket_data:
+        for arrays in self._iter_bucket_data():
             V = self._var_bucket(W, V, offsets, *arrays)
         if not self.projection and (self.norm.factors is not None
                                     or self.norm.shifts is not None):

@@ -37,7 +37,8 @@ from photon_ml_tpu.ops import streaming_sparse as ss
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType)
-from photon_ml_tpu.optim.regularization import intercept_mask, with_l2
+from photon_ml_tpu.optim.regularization import (intercept_mask, with_l2,
+                                                with_l2_value)
 from photon_ml_tpu.optim.streaming import minimize_streaming
 
 Array = jax.Array
@@ -62,6 +63,24 @@ class StreamingSparseFixedEffectCoordinate:
             raise ValueError(
                 f"chunk stream has {chunked.num_rows} rows, dataset "
                 f"{dataset.num_rows}")
+        for i, ch in enumerate(chunked.chunks):
+            # Enforce the documented staging contract at construction
+            # (ADVICE r5): a chunk staged with nonzero offsets would
+            # silently DOUBLE-COUNT residuals in coordinate descent —
+            # score() must return pure wᵀx margins while train_model
+            # receives the full residual via its offsets argument. The
+            # check is one cheap host pass over (chunk_rows,) arrays.
+            off = np.asarray(ch.offsets)
+            if off.size and np.any(off != 0.0):
+                raise ValueError(
+                    f"chunk {i} was staged with nonzero offsets. "
+                    "Streaming contract: the chunks must be staged with "
+                    "ZERO offsets — in coordinate descent the full "
+                    "residual (base offsets + other coordinates' scores) "
+                    "arrives as the ``offsets`` argument of "
+                    "``train_model``, and ``score`` must return pure "
+                    "wᵀx margins; staged offsets would be double-counted."
+                )
         if config.regularization.l1_weight() != 0.0:
             raise ValueError(
                 "L1/OWL-QN is not supported on the streaming path (the "
@@ -89,6 +108,11 @@ class StreamingSparseFixedEffectCoordinate:
         self._vg = ss.make_value_and_gradient(
             loss, chunked, prefetch_depth=prefetch_depth,
             pinned=self._pinned)
+        # Value-only streamed pass for Armijo probes: rejected steps skip
+        # the gradient half of the chunk kernel (optim/streaming.py).
+        self._v = ss.make_value_only(
+            loss, chunked, prefetch_depth=prefetch_depth,
+            pinned=self._pinned)
         self._prefetch_depth = prefetch_depth
         self._padded_n = chunked.num_chunks * chunked.chunk_rows
 
@@ -113,10 +137,11 @@ class StreamingSparseFixedEffectCoordinate:
               else jnp.zeros((self.dim,), jnp.float32))
         off = self._pad_offsets(offsets)
         mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
-        vg = with_l2(lambda w: self._vg(w, off),
-                     self.config.regularization.l2_weight(), mask)
+        l2 = self.config.regularization.l2_weight()
+        vg = with_l2(lambda w: self._vg(w, off), l2, mask)
+        v = with_l2_value(lambda w: self._v(w, off), l2, mask)
         result = minimize_streaming(vg, w0, self.config.optimizer,
-                                    log=self._log)
+                                    log=self._log, value_only=v)
         return FixedEffectModel(shard_id=self.shard_id,
                                 coefficients=Coefficients(result.w))
 

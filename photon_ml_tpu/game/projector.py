@@ -122,14 +122,22 @@ class BucketTriplets:
     """One bucket's slice of a shard's nonzero triplets plus the reverse
     example-row maps — computed once per bucket and shared by
     ``build_bucket_projection`` and ``gather_projected_features`` so the
-    O(n_rows) map build and O(nnz) filtering run once, not twice."""
+    O(n_rows) map build and O(nnz) filtering run once, not twice.
 
-    lane_of: np.ndarray  # (n_rows,) int32 bucket lane; -1 outside
-    cappos_of: np.ndarray  # (n_rows,) int32 slot within the lane's cap
+    The parallel staging pipeline (game/staging.py) builds these for lane
+    SLICES of a bucket: ``lanes`` are then local to the slice, the map
+    arrays are None, and the per-triplet ``cappos`` carries what
+    ``cappos_of[rows]`` would have gathered — the slice never needs the
+    O(n_rows) global maps (which would have to be pickled per task in
+    process mode)."""
+
     rows: np.ndarray  # filtered triplet rows (this bucket's kept examples)
     cols: np.ndarray  # int64 global columns
     vals: np.ndarray  # shard-dtype values
     lanes: np.ndarray  # int64 lane per triplet
+    lane_of: Optional[np.ndarray] = None  # (n_rows,) int32 lane; -1 outside
+    cappos_of: Optional[np.ndarray] = None  # (n_rows,) int32 slot within cap
+    cappos: Optional[np.ndarray] = None  # per-triplet slot (replaces map)
 
 
 def bucket_triplets(
@@ -248,13 +256,56 @@ def build_bucket_projection(
     _, d = _shard_shape(X)
     ex = bucket.example_idx  # (E_b, cap), -1 pad
     E_b = ex.shape[0]
-    kept = ex >= 0
     if triplets is None:
         triplets = bucket_triplets(bucket, X, coo)
-    rows_b, c, v, l = (triplets.rows, triplets.cols, triplets.vals,
-                       triplets.lanes)
     live = np.flatnonzero(np.asarray(bucket.entity_rows) >= 0).astype(
         np.int64)
+    t_y = None
+    yb = None
+    y0 = 0.0
+    if features_to_samples_ratio is not None:
+        y = np.asarray(labels, np.float64)
+        t_y = y[triplets.rows]
+        y0 = float(y[0]) if y.size else 0.0
+        yb = y[np.maximum(ex, 0)]
+        yb[ex < 0] = 0.0
+    u_lane, u_col = active_pairs(
+        E_b, d, intercept_index, live,
+        triplets.cols, triplets.vals, triplets.lanes,
+        ratio=features_to_samples_ratio, t_y=t_y, y0=y0, yb=yb,
+        kept=ex >= 0)
+    d_active = projection_width(
+        active_lane_counts(u_lane, E_b), d, min_dim)
+    cols = fill_cols(u_lane, u_col, E_b, d_active, intercept_index)
+    return BucketProjection(cols=cols, d_active=int(d_active))
+
+
+def active_pairs(
+    E_b: int,
+    d: int,
+    intercept_index: Optional[int],
+    live: np.ndarray,
+    c: np.ndarray,
+    v: np.ndarray,
+    l: np.ndarray,
+    ratio: Optional[float] = None,
+    t_y: Optional[np.ndarray] = None,
+    y0: float = 0.0,
+    yb: Optional[np.ndarray] = None,
+    kept: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Unique active (lane, col) pairs of one bucket — or of any lane
+    SLICE of one bucket, which is what makes the staging pipeline's
+    entity-axis sharding exact: every computation here is per-lane
+    (sorted runs never span lanes), so pairs of a slice are exactly the
+    full bucket's pairs restricted to the slice's lanes.
+
+    ``c``/``v``/``l`` are the slice's nonzero triplets (lanes LOCAL to the
+    slice); ``live`` the local lanes holding a real entity. The Pearson
+    cap (``ratio``) additionally needs per-triplet labels ``t_y``, the
+    label of example 0 (``y0``, for the synthetic intercept entries), and
+    the slice's bucket-layout labels ``yb`` + kept mask.
+    """
     if intercept_index is not None:
         # Force the intercept active for every live entity via synthetic
         # zero-valued entries (harmless: the intercept's Pearson score is
@@ -263,7 +314,8 @@ def build_bucket_projection(
         c = np.concatenate(
             [c, np.full(live.shape, intercept_index, np.int64)])
         v = np.concatenate([v, np.zeros(live.shape, np.float32)])
-        rows_b = np.concatenate([rows_b, np.zeros(live.shape, np.int32)])
+        if t_y is not None:
+            t_y = np.concatenate([t_y, np.full(live.shape, y0, np.float64)])
 
     # Unique (lane, col) pairs in (lane, col)-ascending order; key_s is
     # already sorted, so run boundaries replace a second sort in unique().
@@ -281,13 +333,17 @@ def build_bucket_projection(
     else:  # astronomically wide: keep the exact multiplicative packing
         shift = None
         key = l * np.int64(d + 1) + c
-    if features_to_samples_ratio is None:
+    if ratio is None:
         key_s = np.sort(key)
     else:
         # The Pearson pass additionally needs triplet values/labels in
-        # sorted order, so keep the permutation. (Equal keys may land in
-        # any order; the per-pair moment sums are commutative.)
-        order = np.argsort(key)
+        # sorted order, so keep the permutation. STABLE sort: equal keys
+        # (several examples of one entity hitting one column) keep their
+        # original triplet order, making the per-pair reduceat moment
+        # sums reproducible to the BIT between the whole-bucket build and
+        # the lane-sharded parallel build (fp addition is order-
+        # sensitive; introsort's equal-key order depends on array size).
+        order = np.argsort(key, kind="stable")
         key_s = key[order]
     newrun_k = np.ones(key_s.shape, bool)
     if key_s.size:
@@ -301,7 +357,7 @@ def build_bucket_projection(
         u_lane = (uniq // (d + 1)).astype(np.int64)
         u_col = (uniq % (d + 1)).astype(np.int64)
 
-    if features_to_samples_ratio is not None and uniq.size:
+    if ratio is not None and uniq.size:
         # Centered (two-pass) Pearson moments, the stable computation the
         # reference's stableComputePearsonCorrelationScore / the dense
         # ``pearson_scores`` use: every accumulated term is a centered
@@ -310,13 +366,11 @@ def build_bucket_projection(
         # the centered sums analytically: Σ_all (x−mx)² =
         # Σ_nz (x−mx)² + n_zero·mx², and Σ_all (x−mx)(y−my) =
         # Σ_nz (x−mx)(y−my) − mx·(Σ_zero y − n_zero·my).
-        y = np.asarray(labels, np.float64)
         inv = np.cumsum(newrun_k) - 1  # sorted entry -> pair id
         v_s = v[order].astype(np.float64)
-        y_s = y[rows_b[order]]
+        y_s = t_y[order]
         cnt = np.diff(np.append(first, key_s.shape[0])).astype(np.float64)
-        yb = y[np.maximum(ex, 0)]
-        yb[~kept] = 0.0
+        yb = np.where(kept, yb, 0.0)
         n_e = kept.sum(axis=1).astype(np.float64)
         ne_safe = np.maximum(n_e, 1.0)
         sy = yb.sum(axis=1)
@@ -340,7 +394,7 @@ def build_bucket_projection(
         if intercept_index is not None:
             score[u_col == intercept_index] = np.inf
         keep_e = np.maximum(
-            1, np.ceil(features_to_samples_ratio * n_e)).astype(np.int64)
+            1, np.ceil(ratio * n_e)).astype(np.int64)
         # Within each lane order by (-score, col) — ties break on the lower
         # column id deterministically — and keep the first keep_e.
         ordr = np.lexsort((u_col, -score, u_lane))
@@ -354,13 +408,35 @@ def build_bucket_projection(
         kept_idx = np.sort(ordr[rank < keep_e[lane_o]])
         u_lane = u_lane[kept_idx]
         u_col = u_col[kept_idx]
+    return u_lane, u_col
 
-    seg_counts = np.bincount(u_lane, minlength=E_b) if uniq.size else \
-        np.zeros(E_b, np.int64)
+
+def active_lane_counts(u_lane: np.ndarray, E_b: int) -> np.ndarray:
+    """Active-column count per lane from the unique-pair lane ids."""
+    return (np.bincount(u_lane, minlength=E_b) if u_lane.size
+            else np.zeros(E_b, np.int64))
+
+
+def projection_width(seg_counts: np.ndarray, d: int, min_dim: int = 8
+                     ) -> int:
+    """Bucket-wide projected width: pow-2 of the max per-lane active
+    count, floored at ``min_dim``, capped at ``d``. An entity with more
+    active columns than d_active cannot be truncated — widen (can only
+    happen via the min() cap, where d_active == d)."""
     max_active = max(1, int(seg_counts.max()) if seg_counts.size else 1)
-    d_active = min(d, max(min_dim, _next_pow2(max_active)))
-    # An entity with more active columns than d_active cannot be truncated —
-    # widen (can only happen via min() capping above, where d_active == d).
+    return min(d, max(min_dim, _next_pow2(max_active)))
+
+
+def fill_cols(
+    u_lane: np.ndarray,
+    u_col: np.ndarray,
+    E_b: int,
+    d_active: int,
+    intercept_index: Optional[int],
+) -> np.ndarray:
+    """(E_b, d_active) column map from sorted unique pairs, intercept
+    pinned to slot 0. Pure per-lane math — exact on any lane slice."""
+    seg_counts = active_lane_counts(u_lane, E_b)
     starts = np.concatenate([[0], np.cumsum(seg_counts)[:-1]])
     pos = np.arange(u_lane.shape[0]) - starts[u_lane]
     if intercept_index is not None and u_lane.size:
@@ -375,7 +451,7 @@ def build_bucket_projection(
         slot = pos
     cols = np.full((E_b, d_active), -1, np.int32)
     cols[u_lane, slot] = u_col.astype(np.int32)
-    return BucketProjection(cols=cols, d_active=int(d_active))
+    return cols
 
 
 def gather_projected_features(
@@ -406,12 +482,27 @@ def gather_projected_features(
 
     _, d = X.shape
     E_b, cap = bucket.example_idx.shape
-    d_active = projection.d_active
     if triplets is None:
         triplets = bucket_triplets(bucket, X, coo)
-    cappos_of = triplets.cappos_of
-    r, c, v, l = (triplets.rows, triplets.cols, triplets.vals,
-                  triplets.lanes)
+    return scatter_projected(E_b, cap, d, projection, triplets)
+
+
+def scatter_projected(
+    E_b: int,
+    cap: int,
+    d: int,
+    projection: BucketProjection,
+    triplets: BucketTriplets,
+) -> np.ndarray:
+    """Sparse-shard projected gather over explicit triplets: per-lane
+    math only, so it is exact on lane slices of a bucket (the parallel
+    staging path calls it with slice-local triplets and never needs the
+    shard arrays themselves)."""
+    d_active = projection.d_active
+    c, v, l = triplets.cols, triplets.vals, triplets.lanes
+    cp = triplets.cappos
+    if cp is None:
+        cp = triplets.cappos_of[triplets.rows]
     # Map (lane, global col) → projected slot through each lane's SORTED
     # active set: the flattened (lane-major, within-lane ascending) key
     # array is globally sorted, so one searchsorted resolves every entry;
@@ -426,10 +517,10 @@ def gather_projected_features(
     want = l * np.int64(d + 2) + c
     gpos = np.searchsorted(flat_keys, want)
     inset = flat_keys[np.minimum(gpos, flat_keys.size - 1)] == want
-    r, v, l, gpos = r[inset], v[inset], l[inset], gpos[inset]
+    cp, v, l, gpos = cp[inset], v[inset], l[inset], gpos[inset]
     slot = perm[l, gpos - l * d_active]
     Xp = np.zeros((E_b, cap, d_active), np.float32)
-    Xp[l, cappos_of[r], slot] = v.astype(np.float32)
+    Xp[l, cp, slot] = v.astype(np.float32)
     return Xp
 
 

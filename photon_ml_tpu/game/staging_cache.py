@@ -1,24 +1,33 @@
-"""Persistent staging cache for projected random-effect coordinates.
+"""Persistent, shard-granular staging cache for projected random effects.
 
 Reference parity note: the reference pays its RandomEffectDataset build
 (partition + projector construction) inside every Spark job and relies on
 RDD caching within the job; re-running the driver re-pays it. Here the
-host-side staging products (per-bucket projected feature blocks + column
+host-side staging products (per-shard projected feature blocks + column
 maps + subspace join tables) persist on disk keyed by the DATASET CONTENT
 DIGEST (game/descent._dataset_digest) plus every staging parameter, so a
 re-fit of the same data in a fresh process skips the projection pass
 entirely — at the 10M-row / 1M-entity flagship config that pass is tens of
 seconds of sort/segment work per coordinate.
 
-Layout: ``<cache_dir>/<key>/`` holding ``meta.json`` (bucket tuple arity)
-and one ``.npy`` per staged array. Writers stage into a temp directory and
-``os.rename`` it into place (atomic on one filesystem), so readers never
-observe a half-written entry. Loads memory-map the arrays: the host copy
-is never materialized — bytes stream straight from the page cache into the
-device transfer the coordinate performs anyway.
+Layout (v2, shard-granular): ``<cache_dir>/<key>/`` holding
 
-Anything unreadable (version skew, partial copy, foreign files) is treated
-as a miss — the caller restages and overwrites.
+- ``s<i>_<j>.npy`` — array j of staged shard i (one shard = one lane
+  slice of one bucket, the unit the parallel pipeline produces);
+- ``s<i>.ok`` — shard i's commit marker (JSON ``{"arity": k}``), written
+  LAST via atomic rename, so a reader never trusts a half-written shard;
+- ``sub_<name>.npy`` + ``meta.json`` — the subspace join arrays and the
+  entry's completion record, written once every shard exists.
+
+Shards are written **as they are produced** by the staging pipeline
+(game/staging.py): a killed run leaves a partial entry whose valid shards
+are reused on restart — only the missing/corrupt ones restage. Loads
+memory-map the arrays: the host copy is never materialized — bytes stream
+straight from the page cache into the device transfer the coordinate
+performs anyway.
+
+Anything unreadable (version skew, partial copy, foreign files) is
+treated as a per-shard miss — the caller restages and overwrites.
 """
 
 from __future__ import annotations
@@ -26,19 +35,19 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import shutil
 import tempfile
 from typing import Optional
 
 import numpy as np
 
-# Bump when the staged representation changes shape/meaning.
-STAGING_VERSION = 1
+# Bump when the staged representation changes shape/meaning. v2: whole-
+# bucket tuples became per-shard (lane-slice) tuples with commit markers.
+STAGING_VERSION = 2
 
 
 def staging_key(dataset, norm, **params) -> str:
     """Cache key: dataset content digest + normalization digest + every
-    staging parameter (bounds, seed, projection flags, …)."""
+    staging parameter (bounds, seed, projection flags, shard size, …)."""
     from photon_ml_tpu.game.descent import (_dataset_digest,
                                             normalization_digest)
 
@@ -51,66 +60,125 @@ def staging_key(dataset, norm, **params) -> str:
     return h.hexdigest()
 
 
-def save(cache_dir: str, key: str,
-         bucket_arrays: list[tuple[np.ndarray, ...]],
-         subspace: Optional[dict[str, np.ndarray]] = None) -> None:
-    """Persist one coordinate's staged host arrays (atomic rename)."""
-    os.makedirs(cache_dir, exist_ok=True)
-    tmp = tempfile.mkdtemp(dir=cache_dir, prefix=f".{key}.tmp")
+def _atomic_write(path: str, write_fn) -> None:
+    """Write via a temp file + os.replace (atomic on one filesystem)."""
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.")
     try:
-        meta = {"version": STAGING_VERSION,
-                "arity": [len(t) for t in bucket_arrays],
-                "subspace": sorted(subspace) if subspace else []}
-        for i, t in enumerate(bucket_arrays):
-            for j, a in enumerate(t):
-                np.save(os.path.join(tmp, f"b{i}_{j}.npy"),
-                        np.asarray(a), allow_pickle=False)
-        for name, a in (subspace or {}).items():
-            np.save(os.path.join(tmp, f"sub_{name}.npy"),
-                    np.asarray(a), allow_pickle=False)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        final = os.path.join(cache_dir, key)
-        if os.path.isdir(final):
-            # Replace, never keep: the caller just restaged because load()
-            # missed, so whatever sits here is stale or corrupt (a
-            # concurrent GOOD writer produced identical content — swapping
-            # it is harmless). Move aside first so readers only ever see a
-            # complete entry at ``final``.
-            old = tempfile.mkdtemp(dir=cache_dir, prefix=f".{key}.old")
-            os.rename(final, os.path.join(old, "entry"))
-            shutil.rmtree(old, ignore_errors=True)
-        try:
-            os.rename(tmp, final)
-        except OSError:
-            shutil.rmtree(tmp, ignore_errors=True)
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+        os.replace(tmp, path)
     except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         raise
+
+
+def save_shard(cache_dir: str, key: str, index: int,
+               arrays: tuple[np.ndarray, ...]) -> None:
+    """Persist one staged shard; the ``.ok`` marker commits it last."""
+    path = os.path.join(cache_dir, key)
+    os.makedirs(path, exist_ok=True)
+    for j, a in enumerate(arrays):
+        _atomic_write(os.path.join(path, f"s{index}_{j}.npy"),
+                      lambda f, _a=a: np.save(f, np.asarray(_a),
+                                              allow_pickle=False))
+    marker = json.dumps({"arity": len(arrays),
+                         "version": STAGING_VERSION}).encode()
+    _atomic_write(os.path.join(path, f"s{index}.ok"),
+                  lambda f: f.write(marker))
+
+
+def load_shard(cache_dir: str, key: str, index: int
+               ) -> Optional[tuple[np.ndarray, ...]]:
+    """One staged shard (memory-mapped, read-only), or None on any miss:
+    no marker, version skew, or unreadable arrays (truncation included —
+    np.load validates the header)."""
+    path = os.path.join(cache_dir, key)
+    try:
+        with open(os.path.join(path, f"s{index}.ok")) as f:
+            marker = json.load(f)
+        if marker.get("version") != STAGING_VERSION:
+            return None
+        return tuple(
+            np.load(os.path.join(path, f"s{index}_{j}.npy"),
+                    mmap_mode="r", allow_pickle=False)
+            for j in range(int(marker["arity"])))
+    except Exception:
+        return None
+
+
+def save_meta(cache_dir: str, key: str, num_shards: int,
+              subspace: Optional[dict] = None) -> None:
+    """Finalize an entry: subspace join arrays + the completion record
+    (``meta.json``, written last — its presence means COMPLETE)."""
+    path = os.path.join(cache_dir, key)
+    os.makedirs(path, exist_ok=True)
+    for name, a in (subspace or {}).items():
+        _atomic_write(os.path.join(path, f"sub_{name}.npy"),
+                      lambda f, _a=a: np.save(f, np.asarray(_a),
+                                              allow_pickle=False))
+    meta = json.dumps({"version": STAGING_VERSION,
+                       "num_shards": int(num_shards),
+                       "subspace": sorted(subspace or {})}).encode()
+    _atomic_write(os.path.join(path, "meta.json"),
+                  lambda f: f.write(meta))
+
+
+def load_subspace(cache_dir: str, key: str,
+                  expected_shards: Optional[int] = None
+                  ) -> Optional[dict]:
+    """The subspace arrays of a COMPLETE entry (None when the entry is
+    absent, partial, version-skewed, or shaped for a different plan)."""
+    path = os.path.join(cache_dir, key)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("version") != STAGING_VERSION:
+            return None
+        if (expected_shards is not None
+                and meta.get("num_shards") != expected_shards):
+            return None
+        return {name: np.load(os.path.join(path, f"sub_{name}.npy"),
+                              mmap_mode="r", allow_pickle=False)
+                for name in meta["subspace"]}
+    except Exception:
+        return None
+
+
+def save(cache_dir: str, key: str,
+         shard_arrays: list[tuple[np.ndarray, ...]],
+         subspace: Optional[dict] = None) -> None:
+    """Convenience: persist a complete entry in one call."""
+    for i, t in enumerate(shard_arrays):
+        save_shard(cache_dir, key, i, t)
+    save_meta(cache_dir, key, len(shard_arrays), subspace)
 
 
 def load(cache_dir: str, key: str
          ) -> Optional[tuple[list[tuple[np.ndarray, ...]],
                              dict[str, np.ndarray]]]:
-    """(bucket_arrays, subspace) for a cached key, or None on any miss.
-
-    Arrays come back memory-mapped (read-only)."""
+    """(shard_arrays, subspace) of a COMPLETE entry, or None on any miss
+    (a single bad shard fails the whole-entry load; the pipeline's
+    per-shard probing is what gives partial credit)."""
     path = os.path.join(cache_dir, key)
-    meta_path = os.path.join(path, "meta.json")
     try:
-        with open(meta_path) as f:
+        with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         if meta.get("version") != STAGING_VERSION:
             return None
-        bucket_arrays = [
-            tuple(np.load(os.path.join(path, f"b{i}_{j}.npy"),
-                          mmap_mode="r", allow_pickle=False)
-                  for j in range(arity))
-            for i, arity in enumerate(meta["arity"])]
+        shards = []
+        for i in range(int(meta["num_shards"])):
+            t = load_shard(cache_dir, key, i)
+            if t is None:
+                return None
+            shards.append(t)
         subspace = {
             name: np.load(os.path.join(path, f"sub_{name}.npy"),
                           mmap_mode="r", allow_pickle=False)
             for name in meta["subspace"]}
-        return bucket_arrays, subspace
+        return shards, subspace
     except Exception:
         return None

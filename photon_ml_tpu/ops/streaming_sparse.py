@@ -280,6 +280,7 @@ def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
 # a fresh @jax.jit wrapper per call would re-trace the chunk program on
 # every coordinate-descent update.
 _VG_KERNELS: dict = {}
+_V_KERNELS: dict = {}
 
 
 def _chunk_value_grad(loss: PointwiseLoss):
@@ -299,6 +300,28 @@ def _chunk_value_grad(loss: PointwiseLoss):
         return value, _chunk_rowterm_grad(ch, r)
 
     _VG_KERNELS[loss.name] = f
+    return f
+
+
+def _chunk_value(loss: PointwiseLoss):
+    """Value-ONLY per-chunk pass: the margins + loss sum of
+    ``_chunk_value_grad`` without the gradient half (the hot rmatvec and
+    the per-slot cold scatter-adds — the dominant compute of a chunk
+    pass). Armijo line-search probes only need the value to gate
+    acceptance (ADVICE r5), so probing with this kernel skips the
+    gradient work on every rejected step."""
+    f = _V_KERNELS.get(loss.name)
+    if f is not None:
+        return f
+
+    @jax.jit
+    def f(w: Array, offsets: Array, ch: CanonicalChunk):
+        w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+        z = _chunk_margins_of(ch, w_pad, offsets)
+        l, _ = loss.loss_and_dz(z, ch.labels)
+        return jnp.sum(_masked(ch.weights, l))
+
+    _V_KERNELS[loss.name] = f
     return f
 
 
@@ -401,6 +424,30 @@ def make_value_and_gradient(
         return value, grad
 
     return value_and_grad
+
+
+def make_value_only(
+    loss: PointwiseLoss,
+    chunked: ChunkedHybrid,
+    prefetch_depth: int = 2,
+    pinned=(),
+) -> Callable[[Array, Optional[Array]], Array]:
+    """Streamed Σ-over-chunks VALUE in original column space — the
+    line-search probe companion of :func:`make_value_and_gradient` (same
+    streaming discipline: prefetch, per-chunk barrier, eager release)."""
+    kernel = _chunk_value(loss)
+
+    def value_only(w: Array, offsets: Optional[Array] = None):
+        value = jnp.zeros((), jnp.float32)
+        for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
+            v = kernel(w, _offsets_for(chunked, offsets, i, ch), ch)
+            value = value + v
+            jax.block_until_ready(value)  # same enqueue-scratch barrier
+            _release(ch, i, pinned)
+        gc.collect()
+        return value
+
+    return value_only
 
 
 def _release(ch, i: int, pinned) -> None:

@@ -81,6 +81,23 @@ def with_l2(
     return wrapped
 
 
+def with_l2_value(
+    value_fn: Callable[[Array], Array],
+    l2_weight: float,
+    reg_mask: Optional[Array] = None,
+) -> Callable[[Array], Array]:
+    """Value-only companion of :func:`with_l2` — for streamed line-search
+    probes where the gradient pass is deferred to acceptance."""
+    if l2_weight == 0.0:
+        return value_fn
+
+    def wrapped(w: Array) -> Array:
+        wm = w if reg_mask is None else w * reg_mask
+        return value_fn(w) + 0.5 * l2_weight * jnp.sum(wm * wm, axis=-1)
+
+    return wrapped
+
+
 def with_l2_hvp(
     hvp: Callable[[Array, Array], Array],
     l2_weight: float,

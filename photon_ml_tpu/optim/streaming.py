@@ -85,6 +85,7 @@ def minimize_streaming(
     w0: Array,
     config: OptimizerConfig,
     log: Callable[[str], None] = lambda m: None,
+    value_only: Optional[Callable[[Array], Array]] = None,
 ) -> OptResult:
     """Driver-loop L-BFGS: minimize a host-driven (value, grad) callable.
 
@@ -92,7 +93,17 @@ def minimize_streaming(
     line-search probe; everything it returns stays on device until the
     final host read of the convergence scalars (one small sync per
     iteration — the stream itself is the dominant cost by orders of
-    magnitude)."""
+    magnitude).
+
+    ``value_only``, when given, is a cheaper streamed pass computing just
+    the objective value; Armijo probes then use it — only the VALUE gates
+    acceptance — and the gradient pass runs once per iteration, on the
+    accepted point (ADVICE r5: without this, every backtracking probe
+    paid the full gradient stream only to discard it). Probe cost per
+    iteration drops from ``k·cost(vg)`` to ``k·cost(v) + cost(vg)``; on
+    the hybrid-sparse chunk kernels the gradient half (hot rmatvec +
+    per-slot cold scatter-adds) dominates compute, so cost(v) ≪
+    cost(vg) and the win grows with every backtrack."""
     d = int(w0.shape[0])
     M = config.history_length
     w = jnp.asarray(w0, jnp.float32)
@@ -122,8 +133,11 @@ def minimize_streaming(
         accepted = False
         for _ in range(config.max_line_search_steps):
             w_try = w + step * direction
-            f_try, g_try = value_and_grad(w_try)
-            f_try_h = float(f_try)
+            if value_only is None:
+                f_try, g_try = value_and_grad(w_try)
+                f_try_h = float(f_try)
+            else:
+                f_try_h = float(value_only(w_try))
             if np.isfinite(f_try_h) and \
                     f_try_h <= fv + config.wolfe_c1 * step * dg:
                 accepted = True
@@ -132,6 +146,10 @@ def minimize_streaming(
         if not accepted:
             log(f"iter {it}: line search failed (f={fv:.6g}); stopping")
             break
+        if value_only is not None:
+            # Gradient pass only on acceptance (the curvature pair and
+            # the next direction need it; rejected probes never did).
+            _, g_try = value_and_grad(w_try)
         s = w_try - w
         y = g_try - g
         sy = float(jnp.dot(s, y))

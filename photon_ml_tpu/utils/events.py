@@ -50,6 +50,46 @@ class TrainingFinish(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class StagingStart(Event):
+    """One random-effect staging pipeline starting: ``num_shards`` staged
+    bucket groups over ``workers`` pool workers (``mode`` "thread" or
+    "process"); ``cached_shards`` of them will come from the staging cache
+    without restaging."""
+
+    label: str  # "<re_type>:<shard_id>"
+    num_shards: int
+    workers: int
+    mode: str
+    cached_shards: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StagingShard(Event):
+    """One staged bucket group became available to the fit stream.
+    ``source`` is "staged" (projected now) or "cache" (memory-mapped from
+    the staging cache); ``seconds`` is the projection+gather time for
+    staged shards (0.0 for cache hits)."""
+
+    label: str
+    index: int
+    bucket: int
+    entities: int
+    seconds: float
+    source: str
+
+
+@dataclasses.dataclass(frozen=True)
+class StagingFinish(Event):
+    """Every shard of one staging pipeline is produced (NOT necessarily
+    consumed — consumption is the fit stream's side of the handoff)."""
+
+    label: str
+    num_shards: int
+    cached_shards: int
+    wall_seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
 class ScoringStart(Event):
     """A scoring lifecycle begins — one offline driver run (``source=
     "game_score"``) or one online service coming up (``source="serving"``,

@@ -288,3 +288,57 @@ def test_evaluation_suite_input_placements_agree(rng):
         out = ev.evaluation_suite(["AUC", "RMSE"], s, y)
         for k, v in base.metrics.items():
             assert abs(out.metrics[k] - v) < 1e-5, (name, k)
+
+
+def test_evaluation_suite_rejects_nonaddressable_single_device(rng):
+    """ADVICE r5: a SINGLE-device array owned by another process (a DCN
+    rank with one local device) must hit the same actionable error as
+    the multi-device sharded case — not fail opaquely inside the
+    device-to-device device_put."""
+    import jax
+
+    class _ForeignSingleDeviceArray(jax.Array):
+        """Shape/sharding facade of another rank's one-device array."""
+
+        def __init__(self, n):
+            self._n = n
+
+        class _Sharding:
+            device_set = {object()}  # one device — not ours
+
+        sharding = _Sharding()
+        is_fully_addressable = False
+        is_fully_replicated = True  # trivially, over its one device
+
+        # Abstract surface jax.Array demands; never consulted before the
+        # guard fires.
+        dtype = np.dtype(np.float32)
+        ndim = 1
+        committed = True
+        device = None
+
+        @property
+        def shape(self):
+            return (self._n,)
+
+        @property
+        def size(self):
+            return self._n
+
+        def addressable_data(self, index):  # pragma: no cover
+            raise RuntimeError("non-addressable")
+
+        @property
+        def addressable_shards(self):  # pragma: no cover
+            return []
+
+        @property
+        def global_shards(self):  # pragma: no cover
+            return []
+
+        def copy_to_host_async(self):  # pragma: no cover
+            raise RuntimeError("non-addressable")
+
+    labels = rng.integers(0, 2, size=64).astype(np.float32)
+    with pytest.raises(ValueError, match="another process"):
+        ev.evaluation_suite(["AUC"], _ForeignSingleDeviceArray(64), labels)

@@ -679,31 +679,36 @@ def test_game_train_accepts_libsvm_file(rng, tmp_path):
     assert summary["best_metrics"]["AUC"] > 0.7
 
 
-def test_staging_cache_roundtrip(mesh, tmp_path, monkeypatch):
+def test_staging_cache_roundtrip(mesh, tmp_path):
     """Warm staging (digest-keyed disk cache) skips the projection pass
     and reproduces the cold coordinate exactly — staged arrays, trained
     model, scores, and the subspace join tables."""
-    from photon_ml_tpu.game import projector as prj
+    from photon_ml_tpu.utils import events as ev
 
     sparse_ds, _ = _sparse_re_data()
     cfg = _opt()
     cache = str(tmp_path / "stage")
-    calls = {"n": 0}
-    real = prj.build_bucket_projection
-
-    def counting(*a, **k):
-        calls["n"] += 1
-        return real(*a, **k)
-
-    monkeypatch.setattr(prj, "build_bucket_projection", counting)
-    kw = dict(staging_cache_dir=cache, subspace_model=True)
-    cold = RandomEffectCoordinate(sparse_ds, "userId", "re",
-                                  losses.LOGISTIC, cfg, mesh, **kw)
-    n_cold = calls["n"]
-    assert n_cold > 0
-    warm = RandomEffectCoordinate(sparse_ds, "userId", "re",
-                                  losses.LOGISTIC, cfg, mesh, **kw)
-    assert calls["n"] == n_cold  # no projection work on the warm path
+    seen = []
+    ev.default_emitter.register(seen.append)
+    try:
+        kw = dict(staging_cache_dir=cache, subspace_model=True)
+        cold = RandomEffectCoordinate(sparse_ds, "userId", "re",
+                                      losses.LOGISTIC, cfg, mesh,
+                                      **kw).wait_staged()
+        n_staged = sum(1 for e in seen
+                       if isinstance(e, ev.StagingShard)
+                       and e.source == "staged")
+        assert n_staged > 0
+        seen.clear()
+        warm = RandomEffectCoordinate(sparse_ds, "userId", "re",
+                                      losses.LOGISTIC, cfg, mesh,
+                                      **kw).wait_staged()
+        # No projection work on the warm path: every shard a cache hit.
+        shard_events = [e for e in seen if isinstance(e, ev.StagingShard)]
+        assert shard_events and all(e.source == "cache"
+                                    for e in shard_events)
+    finally:
+        ev.default_emitter.unregister(seen.append)
     assert len(warm._bucket_data) == len(cold._bucket_data)
     for tc, tw in zip(cold._bucket_data, warm._bucket_data):
         assert len(tc) == len(tw)
@@ -771,7 +776,7 @@ def test_random_effect_bf16_feature_storage(mesh):
                                      cfg, mesh, projection=proj)
         c16 = RandomEffectCoordinate(ds_, "userId", "re", losses.LOGISTIC,
                                      cfg, mesh, projection=proj,
-                                     feature_dtype="bfloat16")
+                                     feature_dtype="bfloat16").wait_staged()
         assert c16._bucket_data[0][0].dtype == jnp.bfloat16
         m32 = c32.train_model(off)
         m16 = c16.train_model(off)

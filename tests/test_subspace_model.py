@@ -251,14 +251,14 @@ def test_lane_chunking_matches_unchunked(mesh, monkeypatch):
     for sub in (False, True):
         c = RandomEffectCoordinate(
             sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
-            subspace_model=sub)
+            subspace_model=sub).wait_staged()
         assert len(c._bucket_data) == len(c.bucketing.buckets)
         base[sub] = c.train_model(off)
     monkeypatch.setattr(coord_mod, "_LANE_CHUNK", 8)
     for sub in (False, True):
         c = RandomEffectCoordinate(
             sparse_ds, "userId", "re", losses.LOGISTIC, _opt(), mesh,
-            subspace_model=sub)
+            subspace_model=sub).wait_staged()
         assert len(c._bucket_data) > len(c.bucketing.buckets)
         m = c.train_model(off)
         np.testing.assert_allclose(np.asarray(m.means),
