@@ -4,6 +4,11 @@
 # wrapper; the ROADMAP line is the contract.
 cd "$(dirname "$0")/.."
 
+# Static-analysis gate first: pure AST, no JAX import, seconds repo-wide.
+# Findings (or a reasonless suppression/baseline entry) fail the run
+# before any test spins up. See docs/ANALYSIS.md.
+python -m photon_ml_tpu.cli.lint photon_ml_tpu/ || exit $?
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row

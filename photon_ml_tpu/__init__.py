@@ -31,6 +31,15 @@ Layer map (mirrors SURVEY.md §1, re-architected):
 
 __version__ = "0.1.0"
 
-from photon_ml_tpu.types import TaskType
-
 __all__ = ["TaskType", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy: importing the bare package must not pull in JAX — the
+    # photon-lint gate (analysis/ + cli/lint.py) is pure stdlib and runs
+    # where no accelerator stack exists. ``from photon_ml_tpu import
+    # TaskType`` still works through this hook.
+    if name == "TaskType":
+        from photon_ml_tpu.types import TaskType
+        return TaskType
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,32 @@
+"""photon-lint: AST static analysis that mechanizes this repo's
+hard-won JAX/concurrency bug classes.
+
+Seven rules, each derived from a bug this codebase actually shipped and
+debugged (see docs/ANALYSIS.md for the before/after stories):
+
+- PML001  host-device sync in hot paths
+- PML002  recompilation hazards at jit boundaries
+- PML003  tracer leaks out of traced functions
+- PML004  wall-clock durations/deadlines
+- PML005  unguarded shared mutable state on thread seams
+- PML006  nondeterministic numeric accumulation
+- PML007  unbalanced lifecycle events
+
+Entry points: the ``photon-lint`` console script (cli/lint.py), or
+``lint_paths()`` here. Pure stdlib — no JAX import, repo-wide in seconds.
+"""
+
+from photon_ml_tpu.analysis.baseline import (BaselineEntry, DEFAULT_BASELINE,
+                                             entries_from_findings,
+                                             load_baseline, save_baseline)
+from photon_ml_tpu.analysis.engine import (LintResult, iter_python_files,
+                                           lint_file, lint_paths)
+from photon_ml_tpu.analysis.findings import Finding, fingerprint_findings
+from photon_ml_tpu.analysis.rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES", "BaselineEntry", "DEFAULT_BASELINE", "Finding",
+    "LintResult", "entries_from_findings", "fingerprint_findings",
+    "iter_python_files", "lint_file", "lint_paths", "load_baseline",
+    "save_baseline",
+]

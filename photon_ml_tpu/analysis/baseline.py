@@ -1,0 +1,101 @@
+"""Committed baseline: grandfather existing findings so the gate starts
+green on day one, then ratchets — new findings fail, fixed findings turn
+their baseline entry STALE (reported so the entry gets deleted, keeping
+the debt ledger honest).
+
+Format (JSON, committed at the repo root as ``.photon-lint-baseline.json``):
+
+    {"version": 1,
+     "entries": [{"fingerprint": "…", "rule": "PML006", "path": "…",
+                  "snippet": "…", "reason": "why this is grandfathered"}]}
+
+Every entry carries a reason, same contract as inline suppressions; an
+entry without one is reported as PML000 and fails the gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from photon_ml_tpu.analysis.findings import Finding, fingerprint_findings
+
+DEFAULT_BASELINE = ".photon-lint-baseline.json"
+_VERSION = 1
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    rule: str
+    path: str
+    snippet: str
+    reason: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class BaselineResult:
+    kept: list[Finding]  # findings NOT in the baseline (these gate)
+    matched: int  # findings absorbed by the baseline
+    stale: list[BaselineEntry]  # entries whose finding no longer exists
+    meta: list[Finding]  # PML000 for reasonless entries
+
+
+def load_baseline(path: str) -> list[BaselineEntry]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline version "
+                         f"{doc.get('version')!r} in {path}")
+    return [BaselineEntry(
+        fingerprint=e["fingerprint"], rule=e["rule"], path=e["path"],
+        snippet=e.get("snippet", ""), reason=e.get("reason", ""))
+        for e in doc.get("entries", [])]
+
+
+def save_baseline(path: str, entries: list[BaselineEntry]) -> None:
+    doc = {"version": _VERSION,
+           "entries": [e.to_json() for e in
+                       sorted(entries, key=lambda e: (e.path, e.rule,
+                                                      e.fingerprint))]}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def entries_from_findings(findings: list[Finding],
+                          reason: str) -> list[BaselineEntry]:
+    return [BaselineEntry(fingerprint=fp, rule=f.rule, path=f.path,
+                          snippet=f.snippet, reason=reason)
+            for fp, f in fingerprint_findings(findings)]
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[BaselineEntry],
+                   baseline_path: str) -> BaselineResult:
+    by_fp = {fp: f for fp, f in fingerprint_findings(findings)}
+    matched_fps = set()
+    stale = []
+    meta = []
+    for e in entries:
+        if not e.reason.strip():
+            meta.append(Finding(
+                rule="PML000", path=baseline_path, line=0, col=0,
+                message=f"baseline entry {e.fingerprint} ({e.rule} in "
+                        f"{e.path}) carries no reason",
+                snippet=e.snippet))
+            continue
+        if e.fingerprint in by_fp:
+            matched_fps.add(e.fingerprint)
+        else:
+            stale.append(e)
+    kept = [f for fp, f in fingerprint_findings(findings)
+            if fp not in matched_fps]
+    return BaselineResult(kept=kept, matched=len(matched_fps),
+                          stale=stale, meta=meta)

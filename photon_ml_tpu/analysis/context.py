@@ -1,0 +1,33 @@
+"""ModuleContext: one parsed file, shared by every rule."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from photon_ml_tpu.analysis.findings import Finding
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    path: str  # repo-relative posix path
+    source: str
+    lines: list[str]
+    tree: ast.Module
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "ModuleContext":
+        return cls(path=path, source=source,
+                   lines=source.splitlines(),
+                   tree=ast.parse(source, filename=path))
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, snippet=self.snippet(line))

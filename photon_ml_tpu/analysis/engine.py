@@ -1,0 +1,132 @@
+"""The photon-lint engine: walk files, run rules, apply suppressions and
+the baseline, report.
+
+Pure stdlib + AST — importing this package must NEVER import JAX (the
+lint gate runs before/without a working accelerator stack and finishes in
+seconds on the whole repo; tests assert the no-JAX property).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Optional
+
+from photon_ml_tpu.analysis import baseline as bl
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.rules import ALL_RULES
+from photon_ml_tpu.analysis.suppressions import (apply_suppressions,
+                                                 next_code_lines,
+                                                 parse_suppressions)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", ".pytest_cache",
+              "node_modules"}
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # gating findings (not suppressed/baselined)
+    files: int
+    baselined: int = 0
+    stale_baseline: list[bl.BaselineEntry] = \
+        dataclasses.field(default_factory=list)
+    unused_suppressions: list[tuple[str, int]] = \
+        dataclasses.field(default_factory=list)  # (path, line)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return sorted(dict.fromkeys(os.path.normpath(p) for p in out))
+
+
+def _rule_items(select: Optional[set[str]], ignore: Optional[set[str]]):
+    items = []
+    for rid, (check, _doc) in ALL_RULES.items():
+        if select and rid not in select:
+            continue
+        if ignore and rid in ignore:
+            continue
+        items.append((rid, check))
+    return items
+
+
+def lint_file(path: str, select: Optional[set[str]] = None,
+              ignore: Optional[set[str]] = None
+              ) -> tuple[list[Finding], list[tuple[str, int]]]:
+    """(findings, unused-suppression sites) for one file. Findings
+    include PML000 meta-diagnostics (reasonless allows, parse errors)."""
+    rel = os.path.relpath(path).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    sups, meta = parse_suppressions(rel, source)
+    try:
+        ctx = ModuleContext.parse(rel, source)
+    except SyntaxError as exc:
+        meta.append(Finding(
+            rule="PML000", path=rel, line=exc.lineno or 0, col=0,
+            message=f"file does not parse: {exc.msg}"))
+        return meta, []
+    findings: list[Finding] = []
+    for rid, check in _rule_items(select, ignore):
+        try:
+            findings.extend(check(ctx))
+        except Exception as exc:  # a broken rule must fail loud, not pass
+            findings.append(Finding(
+                rule="PML000", path=rel, line=0, col=0,
+                message=f"rule {rid} crashed on this file: "
+                        f"{type(exc).__name__}: {exc}"))
+    code_after = next_code_lines(lines)
+    kept = apply_suppressions(findings, sups, code_after)
+    unused = [(rel, s.line) for s in sups if not s.used]
+    kept.extend(meta)  # meta-diagnostics are never suppressible
+    return kept, unused
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[set[str]] = None,
+               ignore: Optional[set[str]] = None,
+               baseline_path: Optional[str] = None) -> LintResult:
+    files = iter_python_files(paths)
+    findings: list[Finding] = []
+    unused: list[tuple[str, int]] = []
+    for path in files:
+        f, u = lint_file(path, select=select, ignore=ignore)
+        findings.extend(f)
+        unused.extend(u)
+    result = LintResult(findings=findings, files=len(files),
+                        unused_suppressions=unused)
+    if baseline_path and os.path.exists(baseline_path):
+        entries = bl.load_baseline(baseline_path)
+        res = bl.apply_baseline(findings, entries, baseline_path)
+        result.findings = res.kept + res.meta
+        result.baselined = res.matched
+        result.stale_baseline = res.stale
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result
+
+
+def parse_ok(source: str) -> bool:
+    """Cheap helper for tests: does this fixture even parse?"""
+    try:
+        ast.parse(source)
+        return True
+    except SyntaxError:
+        return False

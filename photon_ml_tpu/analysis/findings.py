@@ -1,0 +1,60 @@
+"""Finding: one lint diagnostic, with a drift-stable fingerprint.
+
+A finding is located by (path, line, col) for humans, but the BASELINE
+matches findings by fingerprint: rule + path + the normalized source
+snippet + the occurrence index of that snippet within the file. Line
+numbers are deliberately excluded — inserting a docstring above a
+grandfathered finding must not invalidate the whole baseline (the lesson
+of every lint rollout that tried to pin line numbers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # "PML001" … "PML007" ("PML000" = meta: broken suppression)
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    snippet: str = ""  # stripped source line at ``line``
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+    def render(self) -> str:
+        tail = f"  [{self.snippet}]" if self.snippet else ""
+        return f"{self.location()}: {self.rule} {self.message}{tail}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+
+def normalize_snippet(snippet: str) -> str:
+    """Whitespace-insensitive snippet form (re-indenting a block must not
+    rotate its fingerprint)."""
+    return " ".join(snippet.split())
+
+
+def fingerprint(rule: str, path: str, snippet: str, occurrence: int) -> str:
+    key = f"{rule}|{path}|{normalize_snippet(snippet)}|{occurrence}"
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
+def fingerprint_findings(findings: list[Finding]) -> list[tuple[str, Finding]]:
+    """(fingerprint, finding) pairs; occurrence indices disambiguate
+    repeated identical snippets within one file."""
+    seen: dict[tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, normalize_snippet(f.snippet))
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out.append((fingerprint(f.rule, f.path, f.snippet, occ), f))
+    return out

@@ -1,0 +1,26 @@
+"""Rule registry. Each rule is a callable ``check(ctx) -> list[Finding]``
+registered under its PML id; the engine runs every registered rule unless
+the CLI selects/ignores a subset."""
+
+from __future__ import annotations
+
+from photon_ml_tpu.analysis.rules import (concurrency, device, lifecycle,
+                                          numeric, timeclock)
+
+# id → (check, one-line summary). Order is report order.
+ALL_RULES = {
+    "PML001": (device.check_host_sync,
+               "host-device sync inside a loop or jit-adjacent hot path"),
+    "PML002": (device.check_recompile_hazard,
+               "shape-/scalar-varying argument reaching a jitted callee"),
+    "PML003": (device.check_tracer_leak,
+               "tracer stored on self/global from inside a traced function"),
+    "PML004": (timeclock.check_wall_clock_duration,
+               "duration or deadline computed from the wall clock"),
+    "PML005": (concurrency.check_unguarded_shared_state,
+               "thread-reachable write to shared state outside the lock"),
+    "PML006": (numeric.check_nondeterministic_accumulation,
+               "numeric accumulation with unpinned order"),
+    "PML007": (lifecycle.check_unbalanced_lifecycle,
+               "*Start event without a guaranteed matching *Finish"),
+}

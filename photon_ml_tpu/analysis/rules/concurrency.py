@@ -1,0 +1,190 @@
+"""PML005 — a lightweight intra-class race detector.
+
+The bug class: a class starts worker threads (``threading.Thread``,
+executor ``submit``/``map``, future callbacks) and some attribute write
+reachable from a worker entrypoint happens OUTSIDE the class's lock while
+the same attribute is read from the caller side — the staging/serving
+threading seams PR 1/PR 2 debugged dynamically, made a lint query:
+
+1. find the class's lock attributes (``self._lock = threading.Lock()`` /
+   ``RLock`` / ``Condition``);
+2. find its thread/worker ENTRYPOINTS (``target=self.m``,
+   ``pool.submit(self.m, …)``, ``fut.add_done_callback(self.m)``,
+   ``Executor.map(self.m, …)``, ``self.m`` handed to a constructor);
+3. close the ``self.m()`` call graph over the entrypoints (nested
+   callback defs count as part of their enclosing method);
+4. flag every ``self.attr = …`` (or ``self.attr[i] = …``) in the
+   reachable set that is not dominated by ``with self.<lock>:`` — when
+   the attribute is also touched by a method OUTSIDE the reachable set,
+   i.e. actually shared with the caller thread.
+
+Single-writer seams published through ``threading.Event`` are real and
+safe — that is what inline suppressions with reasons are for; the lint's
+job is to make the invariant visible, not to forbid the pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.rules._walk import self_attribute
+from photon_ml_tpu.analysis.taint import call_func_name
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+
+
+def _method_map(cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _lock_attrs(methods: dict[str, ast.FunctionDef]) -> set[str]:
+    out = set()
+    for fn in methods.values():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                leaf = (call_func_name(node.value) or "").rsplit(".", 1)[-1]
+                if leaf in _LOCK_TYPES:
+                    for t in node.targets:
+                        attr = self_attribute(t)
+                        if attr:
+                            out.add(attr)
+    return out
+
+
+def _self_methods_in(node: ast.AST) -> set[str]:
+    """Method names referenced as ``self.m`` anywhere under ``node``
+    (unwraps functools.partial by just walking everything)."""
+    return {attr for n in ast.walk(node)
+            if (attr := self_attribute(n)) is not None}
+
+
+def _entrypoints(cls: ast.ClassDef,
+                 methods: dict[str, ast.FunctionDef]) -> set[str]:
+    eps: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        # target=self.m (Thread/Timer/anything with a worker target)
+        for kw in node.keywords:
+            if kw.arg == "target":
+                eps |= _self_methods_in(kw.value)
+        name = call_func_name(node) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("submit", "map", "apply_async") and node.args:
+            eps |= _self_methods_in(node.args[0])
+        if leaf == "add_done_callback" and node.args:
+            eps |= _self_methods_in(node.args[0])
+        # self.m handed to a constructor (e.g. MicroBatcher(self._flush)):
+        # conservatively treat a bound method escaping into another
+        # object as a worker entrypoint.
+        if leaf[:1].isupper():
+            for a in node.args:
+                attr = self_attribute(a)
+                if attr:
+                    eps.add(attr)
+    return {e for e in eps if e in methods}
+
+
+def _reachable(methods: dict[str, ast.FunctionDef],
+               roots: set[str]) -> set[str]:
+    seen = set()
+    frontier = list(roots)
+    while frontier:
+        m = frontier.pop()
+        if m in seen or m not in methods:
+            continue
+        seen.add(m)
+        for node in ast.walk(methods[m]):
+            if isinstance(node, ast.Call):
+                attr = self_attribute(node.func)
+                if attr and attr in methods and attr not in seen:
+                    frontier.append(attr)
+    return seen
+
+
+def _written_attr(target: ast.AST) -> Optional[str]:
+    """self.X = …  or  self.X[i] = …  → 'X'."""
+    attr = self_attribute(target)
+    if attr is not None:
+        return attr
+    if isinstance(target, ast.Subscript):
+        return self_attribute(target.value)
+    return None
+
+
+def _touched_attrs(fn: ast.FunctionDef) -> set[str]:
+    return {attr for n in ast.walk(fn)
+            if (attr := self_attribute(n)) is not None}
+
+
+def _collect_writes(fn: ast.FunctionDef, lock_attrs: set[str]
+                    ) -> list[tuple[str, ast.stmt, bool]]:
+    """(attr, node, dominated_by_lock) for every self-attribute write in
+    ``fn``, nested defs included (callbacks run on worker threads too)."""
+    out: list[tuple[str, ast.stmt, bool]] = []
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            now_locked = locked or any(
+                self_attribute(item.context_expr) in lock_attrs
+                for item in node.items)
+            for child in node.body:
+                visit(child, now_locked)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _written_attr(t)
+                if attr is not None:
+                    out.append((attr, node, locked))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                visit(child, locked)
+            elif not isinstance(child, ast.expr):
+                visit(child, locked)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return out
+
+
+def check_unguarded_shared_state(ctx: ModuleContext) -> list[Finding]:
+    out = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = _method_map(cls)
+        eps = _entrypoints(cls, methods)
+        if not eps:
+            continue
+        locks = _lock_attrs(methods)
+        reachable = _reachable(methods, eps)
+        outside = {name: fn for name, fn in methods.items()
+                   if name not in reachable and name != "__init__"}
+        shared_attrs = set()
+        for fn in outside.values():
+            shared_attrs |= _touched_attrs(fn)
+        for name in sorted(reachable):
+            if name == "__init__":
+                continue  # runs before any worker thread exists
+            for attr, node, locked in _collect_writes(methods[name],
+                                                      locks):
+                if locked or attr in locks or attr not in shared_attrs:
+                    continue
+                why = (f"held lock (class locks: "
+                       f"{', '.join(sorted('self.' + a for a in locks))})"
+                       if locks else
+                       "any lock (the class defines none)")
+                out.append(ctx.finding(
+                    "PML005", node,
+                    f"{cls.name}.{name}() runs on a worker thread "
+                    f"(entrypoints: {', '.join(sorted(eps))}) and writes "
+                    f"self.{attr} — also used from caller-side methods — "
+                    f"without {why}"))
+    return out

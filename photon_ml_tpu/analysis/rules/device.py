@@ -1,0 +1,344 @@
+"""PML001/002/003 — the JAX host/device seam.
+
+These mechanize the bug classes PR 1/PR 2 paid for by hand: a stray
+``float()`` in a descent loop serializes the device pipeline once per
+iteration; a Python scalar that varies per call re-specializes a jitted
+program every iteration; a tracer stored on ``self`` from inside a traced
+function escapes its trace and detonates at the next use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.rules._walk import (assigned_names,
+                                                scope_statements,
+                                                self_attribute,
+                                                statement_exprs)
+from photon_ml_tpu.analysis.taint import (TRANSFORM_FACTORIES, TaintScope,
+                                          call_func_name, dotted_name,
+                                          function_bodies)
+
+_SYNC_CASTS = {"float", "int", "bool"}
+_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_STATIC_KW = {"static_argnames", "static_argnums"}
+
+
+# ---------------------------------------------------------------- PML001
+
+
+def check_host_sync(ctx: ModuleContext) -> list[Finding]:
+    """``float()``/``.item()``/``np.asarray()`` on a device value inside a
+    loop: each call blocks the host on the device stream — the dispatch
+    pipelining that makes the descent/serving hot paths fast dies there."""
+    out = []
+    for _owner, body in function_bodies(ctx.tree):
+        scope = TaintScope(body)
+        for stmt, depth in scope_statements(body):
+            if depth == 0:
+                continue
+            for node in statement_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _sync_call_message(node, scope)
+                if msg:
+                    out.append(ctx.finding("PML001", node, msg))
+    return out
+
+
+def _sync_call_message(call: ast.Call, scope: TaintScope) -> Optional[str]:
+    name = call_func_name(call)
+    arg0 = call.args[0] if call.args else None
+    if name in _SYNC_CASTS and arg0 is not None \
+            and scope.is_device(arg0):
+        return (f"{name}() on a device value inside a loop forces a "
+                f"host-device sync every iteration; hoist it out of the "
+                f"loop or keep the reduction on device")
+    if name in _SYNC_NP and arg0 is not None and scope.is_device(arg0):
+        return (f"{name}() on a device value inside a loop copies "
+                f"device->host every iteration; batch the transfer "
+                f"outside the loop")
+    if name is not None and name.rsplit(".", 1)[-1] == "device_get":
+        return ("jax.device_get inside a loop syncs every iteration; "
+                "batch the transfer outside the loop")
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+            and not call.args and scope.is_device(call.func.value):
+        return (".item() on a device value inside a loop forces a "
+                "host-device sync every iteration")
+    return None
+
+
+# ---------------------------------------------------------------- PML002
+
+
+def _jit_call_parts(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` call inside ``node``, unwrapping
+    ``partial(jax.jit, ...)``; None when node isn't a jit application."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_func_name(node)
+    if name in ("jax.jit", "jit"):
+        return node
+    if name in ("partial", "functools.partial") and node.args:
+        inner = dotted_name(node.args[0])
+        if inner in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+def _has_static_args(jit_call: ast.Call) -> bool:
+    return any(k.arg in _STATIC_KW for k in jit_call.keywords)
+
+
+def _jitted_registry(tree: ast.Module) -> dict[str, bool]:
+    """Callable name (possibly dotted, e.g. ``self._insert``) → whether
+    its jit application declares static_argnames/argnums."""
+    reg: dict[str, bool] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            jit = _jit_call_parts(node.value)
+            if jit is not None:
+                static = _has_static_args(jit)
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name:
+                        reg[name] = static
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted_name(dec) in ("jax.jit", "jit"):
+                    reg[node.name] = False
+                else:
+                    jit = _jit_call_parts(dec)
+                    if jit is not None:
+                        reg[node.name] = _has_static_args(jit)
+    return reg
+
+
+class _LoopVariance:
+    """Names that change per iteration of the enclosing loop(s), split by
+    whether they are provably Python-scalar-ish (range/enumerate targets,
+    len()/shape-derived)."""
+
+    def __init__(self):
+        self.variant: set[str] = set()
+        self.scalarish: set[str] = set()
+
+    def enter_loop(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = _names_of_target(stmt.target)
+            self.variant |= targets
+            itn = call_func_name(stmt.iter) \
+                if isinstance(stmt.iter, ast.Call) else None
+            if itn == "range":
+                self.scalarish |= targets
+            elif itn == "enumerate" and isinstance(stmt.target, ast.Tuple) \
+                    and stmt.target.elts:
+                self.scalarish |= _names_of_target(stmt.target.elts[0])
+
+    def absorb_assignment(self, stmt: ast.stmt) -> None:
+        names = assigned_names(stmt)
+        if not names:
+            return
+        self.variant |= names
+        value = getattr(stmt, "value", None)
+        if value is not None and self.is_scalarish(value):
+            self.scalarish |= names
+
+    def is_scalarish(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.scalarish
+        if isinstance(node, ast.Call):
+            name = call_func_name(node)
+            return name in ("len", "int")
+        if isinstance(node, ast.Attribute):
+            return node.attr in ("shape", "size", "ndim")
+        if isinstance(node, ast.Subscript):
+            return self.is_scalarish(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_scalarish(node.left) \
+                or self.is_scalarish(node.right)
+        return False
+
+    def is_variant(self, node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in self.variant
+                   for n in ast.walk(node))
+
+
+def _names_of_target(t: ast.AST) -> set[str]:
+    out: set[str] = set()
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            out |= _names_of_target(e)
+    elif isinstance(t, ast.Starred):
+        out |= _names_of_target(t.value)
+    return out
+
+
+def check_recompile_hazard(ctx: ModuleContext) -> list[Finding]:
+    """Calls to jitted functions, inside loops, fed a loop-varying Python
+    scalar (or a slice whose bound varies): every distinct value/shape
+    builds a fresh XLA program. Declaring static_argnames is the opt-in
+    that makes the specialization intentional."""
+    reg = _jitted_registry(ctx.tree)
+    out = []
+    for _owner, body in function_bodies(ctx.tree):
+        out.extend(_scan_scope_for_recompiles(ctx, body, reg))
+    return out
+
+
+def _scan_scope_for_recompiles(ctx, body, reg) -> list[Finding]:
+    out = []
+
+    def scan(stmts, var: Optional[_LoopVariance]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if var is not None:
+                var.absorb_assignment(stmt)
+                for node in statement_exprs(stmt):
+                    if isinstance(node, ast.Call):
+                        f = _flag_call(node, var)
+                        if f is not None:
+                            out.append(f)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                inner = _LoopVariance()
+                if var is not None:
+                    inner.variant |= var.variant
+                    inner.scalarish |= var.scalarish
+                inner.enter_loop(stmt)
+                # Pre-pass: names assigned anywhere in the body vary.
+                for s, _ in scope_statements(stmt.body):
+                    inner.variant |= assigned_names(s)
+                scan(stmt.body, inner)
+                scan(stmt.orelse, var)
+            elif isinstance(stmt, ast.If):
+                scan(stmt.body, var)
+                scan(stmt.orelse, var)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                scan(stmt.body, var)
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body, var)
+                for h in stmt.handlers:
+                    scan(h.body, var)
+                scan(stmt.orelse, var)
+                scan(stmt.finalbody, var)
+
+    def _flag_call(call: ast.Call, var: _LoopVariance
+                   ) -> Optional[Finding]:
+        if _jit_call_parts(call) is not None:
+            return ctx.finding(
+                "PML002", call,
+                "jax.jit applied inside a loop builds a new wrapper "
+                "(and cache entry) per iteration; hoist the jit out")
+        name = call_func_name(call)
+        if name is None or name not in reg or reg[name]:
+            return None
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            if var.is_variant(arg) and var.is_scalarish(arg):
+                return ctx.finding(
+                    "PML002", call,
+                    f"jitted {name}() receives a loop-varying Python "
+                    f"scalar — every distinct value compiles a new "
+                    f"program; mark it in static_argnames (intentional "
+                    f"specialization) or pass it as a device array")
+            if isinstance(arg, ast.Subscript) \
+                    and isinstance(arg.slice, ast.Slice) \
+                    and any(b is not None and var.is_variant(b)
+                            for b in (arg.slice.lower, arg.slice.upper)):
+                return ctx.finding(
+                    "PML002", call,
+                    f"jitted {name}() receives a slice whose bound varies "
+                    f"per iteration — a new SHAPE (and program) every "
+                    f"call; pad to a bucketed size instead")
+        return None
+
+    scan(body, None)
+    return out
+
+
+# ---------------------------------------------------------------- PML003
+
+
+def _traced_functions(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Functions whose body runs under a JAX trace: decorated with a
+    transform, or passed by name to one anywhere in the module."""
+    traced_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_func_name(node)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf in TRANSFORM_FACTORIES:
+                args = list(node.args)
+                if name in ("partial", "functools.partial"):
+                    args = args[1:]
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        traced_names.add(a.id)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in traced_names:
+            out.append(node)
+            continue
+        for dec in node.decorator_list:
+            leaf = (dotted_name(dec) or "").rsplit(".", 1)[-1]
+            if leaf in TRANSFORM_FACTORIES \
+                    or _jit_call_parts(dec) is not None:
+                out.append(node)
+                break
+            if isinstance(dec, ast.Call):
+                dleaf = (call_func_name(dec) or "").rsplit(".", 1)[-1]
+                if dleaf in TRANSFORM_FACTORIES:
+                    out.append(node)
+                    break
+    return out
+
+
+def check_tracer_leak(ctx: ModuleContext) -> list[Finding]:
+    """Inside a traced function, a tracer assigned to ``self.*`` or a
+    ``global`` outlives its trace — the stored object is an abstract
+    tracer, not an array, and the NEXT trace (or plain host code) that
+    touches it fails far from here."""
+    out = []
+    for fn in _traced_functions(ctx.tree):
+        params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                                  + fn.args.kwonlyargs)}
+        params.discard("self")
+        scope = TaintScope(fn.body, pre_tainted=params)
+        globals_declared: set[str] = {
+            n for node in ast.walk(fn) if isinstance(node, ast.Global)
+            for n in node.names}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            value = node.value
+            if not (scope.is_device(value) or _mentions(value, params)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if self_attribute(t) is not None:
+                    out.append(ctx.finding(
+                        "PML003", node,
+                        f"traced function {fn.name}() stores a traced "
+                        f"value on self.{self_attribute(t)} — the tracer "
+                        f"escapes its trace; return it instead"))
+                elif isinstance(t, ast.Name) and t.id in globals_declared:
+                    out.append(ctx.finding(
+                        "PML003", node,
+                        f"traced function {fn.name}() stores a traced "
+                        f"value in global {t.id} — the tracer escapes "
+                        f"its trace; return it instead"))
+    return out
+
+
+def _mentions(node: ast.AST, names: set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))

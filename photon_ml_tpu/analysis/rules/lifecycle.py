@@ -1,0 +1,97 @@
+"""PML007 — unbalanced lifecycle events.
+
+The events module's contract (utils/events.py) is balanced scopes: every
+``*Start`` emit eventually gets its ``*Finish``, or listeners tracking
+open scopes (progress reporting, audit logs) leak one forever. The bug
+shape is an emit pair in one function with an exception path between
+them: the Start fires, the body raises, the Finish never does. The rule:
+
+- a ``*Start`` emit whose matching ``*Finish`` is emitted in the SAME
+  function must have that Finish inside a ``finally`` block that covers
+  the region after the Start — otherwise any raise in between leaks the
+  scope;
+- a ``*Start`` with no matching ``*Finish`` anywhere in the module is
+  flagged outright (object-lifetime pairs that span methods — Start in
+  ``__init__``, Finish in ``close()`` — match at module scope and are
+  fine).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.taint import dotted_name, function_bodies
+
+_START_RE = re.compile(r"(\w+)Start$")
+_FINISH_RE = re.compile(r"(\w+)Finish$")
+
+
+def _emitted_event(node: ast.AST) -> Optional[str]:
+    """'StagingStart' when node is ``<anything>.emit(StagingStart(...))``
+    or ``emit(ev.StagingStart(...))``; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    leaf = (dotted_name(func) or "").rsplit(".", 1)[-1]
+    if leaf != "emit" or not node.args:
+        return None
+    arg = node.args[0]
+    if not isinstance(arg, ast.Call):
+        return None
+    return (dotted_name(arg.func) or "").rsplit(".", 1)[-1]
+
+
+def _scan_emits(root: ast.AST) -> list[tuple[str, ast.Call]]:
+    return [(name, node) for node in ast.walk(root)
+            if (name := _emitted_event(node)) is not None]
+
+
+def _finally_protected(fn_body: list[ast.stmt], start: ast.Call,
+                       finish: ast.Call) -> bool:
+    """True when ``finish`` sits in the finalbody of a Try and ``start``
+    is not lexically after that Try (so every path from the Start's
+    region runs the Finish)."""
+    for node in ast.walk(ast.Module(body=fn_body, type_ignores=[])):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        in_final = any(finish is n for s in node.finalbody
+                       for n in ast.walk(s))
+        if in_final and start.lineno <= node.end_lineno:
+            return True
+    return False
+
+
+def check_unbalanced_lifecycle(ctx: ModuleContext) -> list[Finding]:
+    module_finishes = {m.group(1) for name, _ in _scan_emits(ctx.tree)
+                       if (m := _FINISH_RE.match(name))}
+    out = []
+    for owner, body in function_bodies(ctx.tree):
+        if isinstance(owner, ast.Module):
+            continue
+        emits = _scan_emits(owner)
+        starts = [(m.group(1), node) for name, node in emits
+                  if (m := _START_RE.match(name))]
+        finishes = {m.group(1): node for name, node in emits
+                    if (m := _FINISH_RE.match(name))}
+        for prefix, snode in starts:
+            fnode = finishes.get(prefix)
+            if fnode is not None:
+                if not _finally_protected(owner.body, snode, fnode):
+                    out.append(ctx.finding(
+                        "PML007", snode,
+                        f"{prefix}Start is emitted here but the matching "
+                        f"{prefix}Finish in {owner.name}() is not "
+                        f"finally-guaranteed — a raise in between leaks "
+                        f"the scope; move the Finish emit into a "
+                        f"finally block"))
+            elif prefix not in module_finishes:
+                out.append(ctx.finding(
+                    "PML007", snode,
+                    f"{prefix}Start is emitted but no {prefix}Finish "
+                    f"emit exists in this module — every lifecycle "
+                    f"scope needs a guaranteed close"))
+    return out

@@ -1,0 +1,116 @@
+"""PML006 — numeric accumulation whose order isn't pinned.
+
+f32 addition is not associative: the same values summed in a different
+order produce a different last bit, and PR 1's checkpoint-resume parity
+broke exactly this way (a re-summation regrouped an f32 accumulation and
+drifted ~1e-3 through the factored alternation). Statically visible
+shapes of the hazard:
+
+- Python ``sum()`` (or ``functools.reduce`` over ``+``) where the terms
+  are arrays/device values: the grouping is whatever the iterable
+  happens to be — stack the terms and use one pinned ``np.sum``/
+  ``jnp.sum`` reduction instead;
+- any reduction or ``+=`` accumulation driven by an UNORDERED container
+  (``set``/``frozenset`` literals and calls, set algebra results,
+  ``os.listdir``/``glob.glob`` filesystem order): iteration order — and
+  therefore the float result — varies run to run; sort first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.rules._walk import scope_statements, \
+    statement_exprs
+from photon_ml_tpu.analysis.taint import TaintScope, call_func_name, \
+    function_bodies
+
+_SET_CALLS = {"set", "frozenset"}
+_SET_METHODS = {"union", "intersection", "difference",
+                "symmetric_difference"}
+_FS_ORDER_CALLS = {"os.listdir", "listdir", "glob.glob", "glob.iglob"}
+
+
+def _is_unordered(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = call_func_name(node)
+        if name in _SET_CALLS or name in _FS_ORDER_CALLS:
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SET_METHODS:
+            return True
+    if isinstance(node, ast.Name):
+        return False  # aliasing is out of scope for a one-pass lint
+    return False
+
+
+def _comprehension_sources(node: ast.AST) -> list[ast.AST]:
+    if isinstance(node, (ast.GeneratorExp, ast.ListComp)):
+        return [g.iter for g in node.generators]
+    return [node]
+
+
+def check_nondeterministic_accumulation(ctx: ModuleContext
+                                        ) -> list[Finding]:
+    out = []
+    for _owner, body in function_bodies(ctx.tree):
+        scope = TaintScope(body)
+        for stmt, _depth in scope_statements(body):
+            for node in statement_exprs(stmt):
+                if isinstance(node, ast.Call):
+                    f = _flag_reduction(ctx, node, scope)
+                    if f is not None:
+                        out.append(f)
+            # acc += … inside `for x in <unordered>`
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                    and _is_unordered(stmt.iter):
+                for s, _ in scope_statements(stmt.body):
+                    if isinstance(s, ast.AugAssign) \
+                            and isinstance(s.op, ast.Add):
+                        out.append(ctx.finding(
+                            "PML006", s,
+                            "accumulation over an unordered container — "
+                            "iteration order (and the f32 result) varies "
+                            "run to run; iterate sorted(...) instead"))
+    return out
+
+
+def _flag_reduction(ctx: ModuleContext, call: ast.Call,
+                    scope: TaintScope):
+    name = call_func_name(call)
+    is_sum = name == "sum"
+    is_reduce = name in ("reduce", "functools.reduce")
+    if not (is_sum or is_reduce):
+        return None
+    arg = call.args[1] if is_reduce and len(call.args) > 1 \
+        else (call.args[0] if call.args else None)
+    if arg is None:
+        return None
+    for src in _comprehension_sources(arg):
+        if _is_unordered(src):
+            return ctx.finding(
+                "PML006", call,
+                "reduction over an unordered container — iteration "
+                "order (and the f32 result) varies run to run; sort "
+                "the terms before reducing")
+    element = arg.elt if isinstance(arg, (ast.GeneratorExp, ast.ListComp)) \
+        else arg
+    if is_sum and (scope.is_device(element) or _elements_device(
+            arg, scope)):
+        return ctx.finding(
+            "PML006", call,
+            "Python sum() over array terms accumulates left-to-right "
+            "in f32 with whatever grouping the iterable has — "
+            "checkpoint-resume bit-parity dies here; stack the terms "
+            "and use one np.sum/jnp.sum reduction with a pinned order")
+    return None
+
+
+def _elements_device(arg: ast.AST, scope: TaintScope) -> bool:
+    if isinstance(arg, (ast.List, ast.Tuple)):
+        return any(scope.is_device(e) for e in arg.elts)
+    return False

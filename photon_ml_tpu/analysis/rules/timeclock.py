@@ -1,0 +1,91 @@
+"""PML004 — durations and deadlines measured with the wall clock.
+
+``time.time()`` is a TIMESTAMP source: it steps when NTP corrects the
+clock, jumps across suspend, and can run backwards. A duration computed
+as a difference of wall-clock reads (or a deadline compared against one)
+silently absorbs those steps — the serving batcher's flush window, uptime
+counters, and bench numbers all drifted this way before the clocks were
+split. Durations belong to ``time.perf_counter()`` / ``time.monotonic()``;
+wall time is for timestamps only.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.rules._walk import statement_exprs
+from photon_ml_tpu.analysis.taint import call_func_name, function_bodies
+
+_WALL_CALLS = {"time.time", "datetime.now", "datetime.datetime.now",
+               "datetime.utcnow", "datetime.datetime.utcnow"}
+
+
+def _is_wall_call(node: ast.AST, wall_aliases: set[str]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_func_name(node)
+    return name in _WALL_CALLS or name in wall_aliases
+
+
+def _module_wall_aliases(tree: ast.Module) -> set[str]:
+    """Bare names bound to the wall clock by imports:
+    ``from time import time`` / ``from time import time as now``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def check_wall_clock_duration(ctx: ModuleContext) -> list[Finding]:
+    aliases = _module_wall_aliases(ctx.tree)
+    out = []
+    for _owner, body in function_bodies(ctx.tree):
+        # Names assigned from a wall-clock read in this scope.
+        wall_names: set[str] = set()
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(node, ast.Assign) \
+                    and _is_wall_call(node.value, aliases):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wall_names.add(t.id)
+
+        def wallish(node: ast.AST) -> bool:
+            return _is_wall_call(node, aliases) or (
+                isinstance(node, ast.Name) and node.id in wall_names)
+
+        for stmt in body:
+            for node in _all_exprs(stmt):
+                if isinstance(node, ast.BinOp) \
+                        and isinstance(node.op, ast.Sub) \
+                        and (wallish(node.left) or wallish(node.right)):
+                    out.append(ctx.finding(
+                        "PML004",
+                        node,
+                        "duration computed from the wall clock — an NTP "
+                        "step or suspend skews it; use "
+                        "time.perf_counter()/time.monotonic() for "
+                        "durations and deadlines, keep time.time() for "
+                        "timestamps"))
+    return out
+
+
+def _all_exprs(stmt: ast.stmt):
+    """statement_exprs plus recursion into nested blocks of this stmt
+    (but still not into nested function/class bodies)."""
+    yield from statement_exprs(stmt)
+    blocks = []
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.If)):
+        blocks = [stmt.body, stmt.orelse]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        blocks = [stmt.body]
+    elif isinstance(stmt, ast.Try):
+        blocks = [stmt.body, stmt.orelse, stmt.finalbody] \
+            + [h.body for h in stmt.handlers]
+    for b in blocks:
+        for s in b:
+            yield from _all_exprs(s)

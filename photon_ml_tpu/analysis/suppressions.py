@@ -1,0 +1,110 @@
+"""Inline suppressions: ``# pml: allow[PML00N] reason``.
+
+A suppression covers findings of the named rule(s) on its own physical
+line, or — when the comment stands alone — on the next non-blank line
+(so multi-call statements can carry one justification above them).
+Multiple rules: ``# pml: allow[PML001,PML006] reason``.
+
+The reason is MANDATORY: a reasonless allow is itself reported (PML000),
+so the suppression inventory stays reviewable — every silenced finding
+says why it is safe, in the line that silences it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+
+from photon_ml_tpu.analysis.findings import Finding
+
+_ALLOW_RE = re.compile(
+    r"#\s*pml:\s*allow\[(?P<rules>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)\]"
+    r"\s*(?P<reason>.*)$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the comment sits on (1-based)
+    rules: tuple[str, ...]
+    reason: str
+    standalone: bool  # comment-only line → also covers the next code line
+    used: bool = False
+
+    def covers(self, rule: str, line: int, next_code_line: int) -> bool:
+        if rule not in self.rules:
+            return False
+        if line == self.line:
+            return True
+        return self.standalone and line == next_code_line
+
+
+def _comment_tokens(source: str):
+    """(line, col, text) of every real COMMENT token — tokenizing (not
+    line-regexing) keeps allow-syntax examples inside docstrings from
+    registering as suppressions."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        return [(t.start[0], t.start[1], t.string) for t in toks
+                if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+
+
+def parse_suppressions(path: str, source: str
+                       ) -> tuple[list[Suppression], list[Finding]]:
+    """(suppressions, meta-findings). Meta-findings are PML000 diagnostics
+    for allows with no reason — those never silence anything."""
+    sups: list[Suppression] = []
+    meta: list[Finding] = []
+    for line, col, text in _comment_tokens(source):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        reason = m.group("reason").strip()
+        if not reason:
+            meta.append(Finding(
+                rule="PML000", path=path, line=line, col=col,
+                message=f"suppression of {','.join(rules)} carries no "
+                        f"reason — every allow must say why it is safe",
+                snippet=text.strip()))
+            continue
+        sups.append(Suppression(line=line, rules=rules, reason=reason,
+                                standalone=_standalone(source, line)))
+    return sups, meta
+
+
+def _standalone(source: str, line: int) -> bool:
+    lines = source.splitlines()
+    return 1 <= line <= len(lines) and lines[line - 1].lstrip().startswith("#")
+
+
+def next_code_lines(lines: list[str]) -> dict[int, int]:
+    """line → the next non-blank, non-comment-only line after it (for
+    standalone suppression coverage)."""
+    out: dict[int, int] = {}
+    nxt = 0
+    for i in range(len(lines), 0, -1):
+        out[i] = nxt
+        stripped = lines[i - 1].strip()
+        if stripped and not stripped.startswith("#"):
+            nxt = i
+    return out
+
+
+def apply_suppressions(findings: list[Finding], sups: list[Suppression],
+                       code_after: dict[int, int]) -> list[Finding]:
+    """Drop findings covered by a suppression (marking it used)."""
+    kept = []
+    for f in findings:
+        covered = False
+        for s in sups:
+            if s.covers(f.rule, f.line, code_after.get(s.line, 0)):
+                s.used = True
+                covered = True
+                break
+        if not covered:
+            kept.append(f)
+    return kept

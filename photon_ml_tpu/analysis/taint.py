@@ -1,0 +1,162 @@
+"""Device-value taint: which expressions plausibly hold JAX arrays.
+
+Pure-AST heuristic (no imports resolved, no types): an expression is
+"device-ish" when it is built from ``jnp.*`` / ``jax.*`` / ``lax.*``
+calls, from names assigned such values earlier in the same scope, or from
+calls fed a device-ish argument (functions over device values generally
+return device values — the propagation that makes ``f, g =
+value_and_grad(w)`` device-ish when ``w`` is). Host casts
+(``float``/``int``/``np.*``/``.item()``/``jax.device_get``) launder the
+taint: their RESULT is host — the cast itself is where PML001 fires.
+
+The scope model is deliberately simple: one taint set per function body
+(module top level counts as one body), computed by two forward passes so
+loop-carried assignments converge; nested function bodies are analyzed
+independently. Over-taint is acceptable — rules pair taint with a second
+signal (inside a loop, stored on self, …) before flagging.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+DEVICE_MODULES = {"jnp", "jax", "lax"}
+HOST_CASTS = {"float", "int", "bool", "complex", "str", "len", "repr"}
+HOST_MODULES = {"np", "numpy", "math", "os", "time", "json", "logging"}
+# jax.* attributes that return CALLABLES (transform factories), not arrays.
+TRANSFORM_FACTORIES = {"jit", "vmap", "pmap", "grad", "value_and_grad",
+                       "checkpoint", "custom_jvp", "custom_vjp",
+                       "named_call", "shard_map"}
+# Methods/calls whose result lands on the host.
+HOST_SINK_METHODS = {"item", "tolist", "device_get"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.numpy.dot' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_func_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+class TaintScope:
+    """Tainted names within one function (or module) body."""
+
+    def __init__(self, body: list[ast.stmt],
+                 pre_tainted: Optional[set[str]] = None):
+        self.tainted: set[str] = set(pre_tainted or ())
+        for _ in range(2):  # two passes ≈ fixpoint for loop-carried taint
+            for stmt in body:
+                self._visit_stmt(stmt)
+
+    # -- expression classification ---------------------------------------
+
+    def is_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            # x.T / x.dtype-ish chains on a device value; bare module
+            # attributes (jnp.float32) are not values.
+            return self.is_device(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_device(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_device(node.left) or self.is_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_device(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_device(node.left) or any(
+                self.is_device(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.is_device(node.body) or self.is_device(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_device(e) for e in node.elts)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.is_device(node.elt)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_device(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_is_device(node)
+        return False
+
+    def _call_is_device(self, call: ast.Call) -> bool:
+        name = call_func_name(call)
+        if name is not None:
+            root, _, rest = name.partition(".")
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in HOST_SINK_METHODS or name in HOST_CASTS:
+                return False
+            if root in DEVICE_MODULES:
+                # jax.jit(f) yields a callable; jnp.dot(...) yields device.
+                return leaf not in TRANSFORM_FACTORIES
+            if root in HOST_MODULES:
+                return False
+        # Method call on a device value (x.sum()) or any call fed a
+        # device argument: propagate.
+        if isinstance(call.func, ast.Attribute) \
+                and self.is_device(call.func.value):
+            return True
+        return any(self.is_device(a) for a in call.args) or any(
+            self.is_device(k.value) for k in call.keywords)
+
+    # -- statement walk ----------------------------------------------------
+
+    def _taint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_target(e)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested bodies get their own scope
+        if isinstance(stmt, ast.Assign):
+            if self.is_device(stmt.value):
+                for t in stmt.targets:
+                    self._taint_target(t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None and self.is_device(stmt.value):
+                self._taint_target(stmt.target)
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_device(stmt.value) or self.is_device(stmt.target):
+                self._taint_target(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if self.is_device(stmt.iter):
+                self._taint_target(stmt.target)
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+        elif isinstance(stmt, ast.While):
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+        elif isinstance(stmt, ast.If):
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for s in stmt.body:
+                self._visit_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody
+                      + [h for hh in stmt.handlers for h in hh.body]):
+                self._visit_stmt(s)
+
+
+def function_bodies(tree: ast.Module):
+    """Yield (node, body) for the module and every (async) function in it
+    — the per-scope unit rules iterate."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body

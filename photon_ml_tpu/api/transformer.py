@@ -49,11 +49,11 @@ class GameTransformer:
 
     def transform(self, data: GameDataset,
                   as_mean: bool = False) -> ScoringResult:
-        t0 = time.time()
+        t0 = time.perf_counter()
         scores = self.model.score(data)
         default_emitter.emit(ScoringBatch(
             source="game_score", rows=data.num_rows,
-            padded_rows=data.num_rows, seconds=time.time() - t0))
+            padded_rows=data.num_rows, seconds=time.perf_counter() - t0))
         if as_mean:
             loss = losses_mod.loss_for_task(self.model.task)
             scores = loss.mean(scores)
@@ -85,13 +85,13 @@ class GameTransformer:
         for staged in device_prefetch(iter_row_chunks(data, batch_rows),
                                       depth=prefetch_depth,
                                       place=stage_dataset):
-            t0 = time.time()
+            t0 = time.perf_counter()
             parts.append(self.model.score(staged))
             # seconds is dispatch time, not device time — scoring is async
             # under the prefetch pipeline by design.
             default_emitter.emit(ScoringBatch(
                 source="game_score", rows=staged.num_rows,
-                padded_rows=staged.num_rows, seconds=time.time() - t0))
+                padded_rows=staged.num_rows, seconds=time.perf_counter() - t0))
         scores = np.concatenate([np.asarray(p) for p in parts]) \
             if parts else np.zeros(0, np.float32)
         if as_mean:

@@ -70,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
 def run(args) -> dict:
     setup_logging()
     enable_compilation_cache()
-    t0 = time.time()
+    t0 = time.perf_counter()  # duration base — wall time only for stamps
     imaps = vocabs = None
     if args.avro_feature_shard:
         from photon_ml_tpu.avro.data_reader import AvroDataReader
@@ -131,43 +131,47 @@ def run(args) -> dict:
     default_emitter.emit(ScoringStart(source="game_score",
                                       num_rows=data.num_rows))
     summary = {"num_rows": data.num_rows}
-    if evaluators:
-        result, evaluation = transformer.transform_and_evaluate(
-            data, as_mean=args.as_mean, batch_rows=args.batch_rows)
-        summary["metrics"] = evaluation.metrics
-    else:
-        result = (transformer.transform_batched(
-                      data, args.batch_rows, as_mean=args.as_mean)
-                  if args.batch_rows
-                  else transformer.transform(data, as_mean=args.as_mean))
-    if args.avro_feature_shard:
-        # Preserve the input records' real uids (ReadMeta) so downstream
-        # joins of the scoring output back to the source data hold — the
-        # transformer only knows row indices.
-        import dataclasses
+    try:
+        if evaluators:
+            result, evaluation = transformer.transform_and_evaluate(
+                data, as_mean=args.as_mean, batch_rows=args.batch_rows)
+            summary["metrics"] = evaluation.metrics
+        else:
+            result = (transformer.transform_batched(
+                          data, args.batch_rows, as_mean=args.as_mean)
+                      if args.batch_rows
+                      else transformer.transform(data, as_mean=args.as_mean))
+        if args.avro_feature_shard:
+            # Preserve the input records' real uids (ReadMeta) so downstream
+            # joins of the scoring output back to the source data hold — the
+            # transformer only knows row indices.
+            import dataclasses
 
-        result = dataclasses.replace(result, uids=read_meta.uids)
-    if args.output_format in ("NPZ", "BOTH"):
-        uids = result.uids
-        if uids.dtype == object:
-            # Mixed int/str uids (Avro input): store as strings so the
-            # npz needs no pickle to load.
-            uids = np.asarray([str(u) for u in uids])
-        np.savez_compressed(
-            os.path.join(args.output_dir, "scores.npz"),
-            uid=uids, score=result.scores, label=result.labels,
-            offset=result.offsets, weight=result.weights)
-    if args.output_format in ("AVRO", "BOTH"):
-        from photon_ml_tpu.avro.scoring import write_scoring_results
+            result = dataclasses.replace(result, uids=read_meta.uids)
+        if args.output_format in ("NPZ", "BOTH"):
+            uids = result.uids
+            if uids.dtype == object:
+                # Mixed int/str uids (Avro input): store as strings so the
+                # npz needs no pickle to load.
+                uids = np.asarray([str(u) for u in uids])
+            np.savez_compressed(
+                os.path.join(args.output_dir, "scores.npz"),
+                uid=uids, score=result.scores, label=result.labels,
+                offset=result.offsets, weight=result.weights)
+        if args.output_format in ("AVRO", "BOTH"):
+            from photon_ml_tpu.avro.scoring import write_scoring_results
 
-        write_scoring_results(
-            os.path.join(args.output_dir, "scores.avro"),
-            result.scores, uids=result.uids, labels=result.labels,
-            weights=result.weights, offsets=result.offsets)
-    summary["wall_seconds"] = time.time() - t0
-    default_emitter.emit(ScoringFinish(source="game_score",
-                                       num_rows=data.num_rows,
-                                       wall_seconds=summary["wall_seconds"]))
+            write_scoring_results(
+                os.path.join(args.output_dir, "scores.avro"),
+                result.scores, uids=result.uids, labels=result.labels,
+                weights=result.weights, offsets=result.offsets)
+    finally:
+        # Balanced lifecycle (PML007): listeners tracking open scoring
+        # scopes must see the Finish even when the run raises mid-write.
+        summary["wall_seconds"] = time.perf_counter() - t0
+        default_emitter.emit(ScoringFinish(
+            source="game_score", num_rows=data.num_rows,
+            wall_seconds=summary["wall_seconds"]))
     with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
     logger.info("wrote %s", args.output_dir)

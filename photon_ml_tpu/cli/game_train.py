@@ -235,7 +235,7 @@ def _load_avro_inputs(args):
 def run(args) -> dict:
     setup_logging()
     enable_compilation_cache()
-    t0 = time.time()
+    t0 = time.perf_counter()  # duration base (PML004)
     task = TaskType(args.task)
     if (args.model_output_format in ("AVRO", "BOTH")
             and not args.avro_feature_shard):
@@ -490,7 +490,7 @@ def run(args) -> dict:
             for r in results],
         "best_metrics": (best.evaluation.metrics if best.evaluation else None),
         "tuning": tuning_summary,
-        "wall_seconds": time.time() - t0,
+        "wall_seconds": time.perf_counter() - t0,
     }
     if is_primary:
         with open(os.path.join(args.output_dir, "summary.json"), "w") as f:

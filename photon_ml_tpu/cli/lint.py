@@ -1,0 +1,142 @@
+"""photon-lint — the repo's static-analysis gate.
+
+    photon-lint photon_ml_tpu/                 # human output, exit 0/1
+    photon-lint --format json photon_ml_tpu/   # machine output
+    photon-lint --write-baseline --reason "…"  # grandfather current findings
+
+Exit codes: 0 clean (baselined findings and stale-entry warnings do not
+gate), 1 findings, 2 usage/internal error. The baseline defaults to
+``.photon-lint-baseline.json`` in the working directory when present.
+
+Deliberately JAX-free: this module (and everything under analysis/) is
+pure stdlib, so the gate runs in seconds anywhere — CI sets it before the
+test matrix, dev-scripts/run_tier1.sh runs it before pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from photon_ml_tpu.analysis import (ALL_RULES, DEFAULT_BASELINE,
+                                    entries_from_findings, lint_paths,
+                                    save_baseline)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-lint",
+        description="AST lint for this repo's JAX/concurrency bug "
+                    "classes (PML001-PML007)")
+    p.add_argument("paths", nargs="*", default=["photon_ml_tpu"],
+                   help="files/directories to lint "
+                        "(default: photon_ml_tpu)")
+    p.add_argument("--format", default="human",
+                   choices=["human", "json"])
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        f"when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "and exit 0 (requires --reason)")
+    p.add_argument("--reason", default="",
+                   help="justification recorded on each baseline entry "
+                        "written by --write-baseline")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _rule_set(spec: str) -> Optional[set[str]]:
+    ids = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    if not ids:
+        return None
+    unknown = ids - set(ALL_RULES)
+    if unknown:
+        raise SystemExit(
+            f"photon-lint: unknown rule id(s): {', '.join(sorted(unknown))}"
+            f" (known: {', '.join(ALL_RULES)})")
+    return ids
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, (_check, doc) in ALL_RULES.items():
+            print(f"{rid}  {doc}")
+        return 0
+    baseline = None if args.no_baseline else (
+        args.baseline or (DEFAULT_BASELINE
+                          if os.path.exists(DEFAULT_BASELINE) else None))
+    try:
+        select = _rule_set(args.select)
+        ignore = _rule_set(args.ignore)
+        if args.write_baseline:
+            if not args.reason.strip():
+                print("photon-lint: --write-baseline requires --reason "
+                      "(every grandfathered finding must say why)",
+                      file=sys.stderr)
+                return 2
+            result = lint_paths(args.paths, select=select, ignore=ignore,
+                                baseline_path=None)
+            target = args.baseline or DEFAULT_BASELINE
+            save_baseline(target, entries_from_findings(result.findings,
+                                                        args.reason))
+            print(f"photon-lint: wrote {len(result.findings)} entr"
+                  f"{'y' if len(result.findings) == 1 else 'ies'} "
+                  f"to {target}")
+            return 0
+        result = lint_paths(args.paths, select=select, ignore=ignore,
+                            baseline_path=baseline)
+    except SystemExit:
+        raise
+    except Exception as exc:
+        print(f"photon-lint: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": result.files,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": result.baselined,
+            "stale_baseline": [e.to_json()
+                               for e in result.stale_baseline],
+            "unused_suppressions": [
+                {"path": p, "line": ln}
+                for p, ln in result.unused_suppressions],
+            "exit_code": result.exit_code,
+        }, indent=2))
+        return result.exit_code
+
+    for f in result.findings:
+        print(f.render())
+    for e in result.stale_baseline:
+        print(f"stale baseline entry: {e.rule} in {e.path} "
+              f"({e.fingerprint}) — finding no longer exists; delete "
+              f"the entry  [{e.snippet}]")
+    for path, line in result.unused_suppressions:
+        print(f"unused suppression: {path}:{line} silences nothing — "
+              f"delete it")
+    n = len(result.findings)
+    bits = [f"{result.files} files", f"{n} finding{'s' * (n != 1)}"]
+    if result.baselined:
+        bits.append(f"{result.baselined} baselined")
+    if result.stale_baseline:
+        bits.append(f"{len(result.stale_baseline)} stale baseline "
+                    f"entr{'y' if len(result.stale_baseline) == 1 else 'ies'}")
+    print(f"photon-lint: {', '.join(bits)}")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

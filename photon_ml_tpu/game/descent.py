@@ -201,43 +201,49 @@ def run(
         iterations=config.iterations))
 
     step = 0
-    for it in range(config.iterations):
-        for cid in seq:
-            if cid in locked:
-                continue
-            step += 1
-            if step <= done_steps:
-                continue  # already covered by the checkpoint
-            coord = coordinates[cid]
-            t0 = time.monotonic()
-            # Residual offsets: everything except this coordinate.
-            offsets = base + total - scores[cid]
-            model = coord.train_model(offsets, initial=models[cid])
-            new_scores = coord.score(model)
-            total = total + new_scores - scores[cid]
-            scores[cid] = new_scores
-            models[cid] = model
-            _sync(total)
-            elapsed = time.monotonic() - t0
-            rec = {"iteration": it, "coordinate": cid,
-                   "train_seconds": elapsed}
-            if validation_fn is not None:
-                rec["validation"] = validation_fn(
-                    GameModel(task=task, models=dict(models)))
-            logger.info("CD iter %d coordinate %s: %.2fs %s", it, cid,
-                        elapsed, rec.get("validation", ""))
-            history.records.append(rec)
-            emitter.emit(ev_mod.CoordinateUpdate(
-                iteration=it, coordinate=cid, train_seconds=elapsed,
-                validation=rec.get("validation")))
-            if checkpoint_manager is not None:
-                checkpoint_manager.save(
-                    task, models, done_steps=step,
-                    records=history.records, fingerprint=fingerprint,
-                    updated=[cid], residual_total=np.asarray(total))
-
-    emitter.emit(ev_mod.TrainingFinish(task=TaskType(task).value,
-                                       total_updates=step))
+    try:
+        for it in range(config.iterations):
+            for cid in seq:
+                if cid in locked:
+                    continue
+                step += 1
+                if step <= done_steps:
+                    continue  # already covered by the checkpoint
+                coord = coordinates[cid]
+                t0 = time.monotonic()
+                # Residual offsets: everything except this coordinate.
+                offsets = base + total - scores[cid]
+                model = coord.train_model(offsets, initial=models[cid])
+                new_scores = coord.score(model)
+                total = total + new_scores - scores[cid]
+                scores[cid] = new_scores
+                models[cid] = model
+                _sync(total)
+                elapsed = time.monotonic() - t0
+                rec = {"iteration": it, "coordinate": cid,
+                       "train_seconds": elapsed}
+                if validation_fn is not None:
+                    rec["validation"] = validation_fn(
+                        GameModel(task=task, models=dict(models)))
+                logger.info("CD iter %d coordinate %s: %.2fs %s", it, cid,
+                            elapsed, rec.get("validation", ""))
+                history.records.append(rec)
+                emitter.emit(ev_mod.CoordinateUpdate(
+                    iteration=it, coordinate=cid, train_seconds=elapsed,
+                    validation=rec.get("validation")))
+                if checkpoint_manager is not None:
+                    checkpoint_manager.save(
+                        task, models, done_steps=step,
+                        records=history.records, fingerprint=fingerprint,
+                        # pml: allow[PML001] checkpoint persistence NEEDS the
+                        # host copy, once per coordinate update (seconds of
+                        # device work), and _sync already drained the stream
+                        updated=[cid], residual_total=np.asarray(total))
+    finally:
+        # Balanced lifecycle (PML007): a raise mid-descent must still
+        # close the training scope for listeners tracking it.
+        emitter.emit(ev_mod.TrainingFinish(task=TaskType(task).value,
+                                           total_updates=step))
     if checkpoint_manager is not None:
         checkpoint_manager.save(task, models, done_steps=step,
                                 records=history.records, complete=True,

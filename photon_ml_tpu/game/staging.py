@@ -460,6 +460,9 @@ class ProjectionStager:
         try:
             self._stage_missing()
         except BaseException as e:  # propagate to every waiter
+            # pml: allow[PML005] single-writer seam: _error is written only
+            # here, before _cols_ready.set(); Event.set() publishes it
+            # (happens-before) to the cols_list() reader.
             self._error = e
             self._cols_ready.set()
             for f in self._futures:
@@ -534,6 +537,9 @@ class ProjectionStager:
                     if i not in self._cached:
                         u_lane, u_col = pairs.pop(i)
                         lo, hi = self.plan[i][1], self.plan[i][2]
+                        # pml: allow[PML005] single-writer seam: _cols slots
+                        # are filled only by this scheduler thread before
+                        # _cols_ready.set(); the Event publishes them.
                         self._cols[i] = prj.fill_cols(
                             u_lane, u_col, hi - lo, width, self._ii)
             self._cols_ready.set()

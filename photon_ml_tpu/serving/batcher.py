@@ -26,7 +26,10 @@ from typing import Callable, Sequence
 class _Entry:
     request: object
     future: Future = field(default_factory=Future)
-    enqueued_at: float = field(default_factory=time.time)
+    # Monotonic, not wall: the flush deadline and the request-latency
+    # metric are DURATIONS — an NTP step against time.time() here either
+    # starved flushes or fired them instantly (PML004).
+    enqueued_at: float = field(default_factory=time.monotonic)
 
 
 def bucket_batch(n: int, max_batch: int) -> int:
@@ -86,7 +89,7 @@ class MicroBatcher:
                 deadline = self._queue[0].enqueued_at + self.max_wait
                 while (self._running
                        and len(self._queue) < self.max_batch
-                       and (left := deadline - time.time()) > 0):
+                       and (left := deadline - time.monotonic()) > 0):
                     self._cond.wait(timeout=left)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]

@@ -81,7 +81,11 @@ class ServingMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # Wall clock is for the TIMESTAMP only; uptime/throughput are
+        # durations and come off the monotonic clock (an NTP step must
+        # not dent the rates — PML004).
         self.started_at = time.time()
+        self._started_mono = time.monotonic()
         self.request_latency = LatencyHistogram()  # submit → result
         self.batch_latency = LatencyHistogram()  # one device flush
         self.rows_total = 0
@@ -125,14 +129,17 @@ class ServingMetrics:
         return (self.rows_total / self.padded_rows_total
                 if self.padded_rows_total else 0.0)
 
+    def uptime_seconds(self) -> float:
+        return time.monotonic() - self._started_mono
+
     def throughput_rows_per_sec(self) -> float:
-        dt = time.time() - self.started_at
+        dt = self.uptime_seconds()
         return self.rows_total / dt if dt > 0 else 0.0
 
     def snapshot(self) -> dict:
         with self._lock:
             return {
-                "uptime_seconds": time.time() - self.started_at,
+                "uptime_seconds": self.uptime_seconds(),
                 "rows_total": self.rows_total,
                 "batches_total": self.batches_total,
                 "padded_rows_total": self.padded_rows_total,

@@ -221,7 +221,7 @@ class ScoringService:
 
     def _flush(self, entries):
         scores = self._score_chunk([e.request for e in entries])
-        done = time.time()
+        done = time.monotonic()  # same clock as _Entry.enqueued_at
         for e in entries:
             self.metrics.record_request_latency(done - e.enqueued_at)
         return scores
@@ -238,7 +238,7 @@ class ScoringService:
         self.batcher.close()
         self.emitter.emit(ScoringFinish(
             source="serving", num_rows=self.metrics.rows_total,
-            wall_seconds=time.time() - self.metrics.started_at))
+            wall_seconds=self.metrics.uptime_seconds()))
 
     def __enter__(self):
         return self
