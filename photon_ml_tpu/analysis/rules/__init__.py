@@ -5,7 +5,7 @@ the CLI selects/ignores a subset."""
 from __future__ import annotations
 
 from photon_ml_tpu.analysis.rules import (concurrency, device, lifecycle,
-                                          numeric, timeclock)
+                                          numeric, robustness, timeclock)
 
 # id → (check, one-line summary). Order is report order.
 ALL_RULES = {
@@ -23,4 +23,6 @@ ALL_RULES = {
                "numeric accumulation with unpinned order"),
     "PML007": (lifecycle.check_unbalanced_lifecycle,
                "*Start event without a guaranteed matching *Finish"),
+    "PML008": (robustness.check_swallowed_exception,
+               "broad except that swallows the error silently"),
 }

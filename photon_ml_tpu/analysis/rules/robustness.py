@@ -1,0 +1,104 @@
+"""PML008 — swallowed broad exceptions.
+
+The robustness pass (docs/ROBUSTNESS.md) hardened three layers against
+faults, and the recurring anti-pattern it had to undo was the silent
+swallow: ``except: pass`` / ``except Exception: <no raise, no log>``.
+A handler like that converts a real fault (a dead worker, a corrupt
+file, a failed flush) into nothing — the run continues wrong, and the
+chaos suite cannot even observe that the fault happened. The rule:
+
+- a handler that catches EVERYTHING (bare ``except``, ``Exception``,
+  ``BaseException``, or a tuple containing one of those) must visibly
+  handle the error: re-raise (bare ``raise`` or raising a new error),
+  log it (``logger.*`` / ``logging.*`` / ``warnings.warn`` /
+  ``traceback.print_exc``), hand it to a waiter
+  (``future.set_exception``), or at minimum REFERENCE the bound
+  exception (``except Exception as e: queue.put(e)`` routes the error
+  somewhere; ``except Exception: pass`` routes it nowhere);
+- narrow handlers (``except OSError: pass``) are out of scope — catching
+  a SPECIFIC exception and deciding it is benign is a legitimate,
+  reviewable decision; catching everything and ignoring it is not.
+
+Deliberate broad-swallow contracts (a cache whose misses are silent by
+design) carry ``# pml: allow[PML008] <reason>`` like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.taint import dotted_name
+
+_BROAD = {"Exception", "BaseException"}
+
+_LOG_LEAVES = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log", "print_exc"}
+
+
+def _is_broad(type_node) -> bool:
+    """True for bare ``except``, Exception/BaseException (any dotting),
+    or a tuple containing one of those."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    leaf = (dotted_name(type_node) or "").rsplit(".", 1)[-1]
+    return leaf in _BROAD
+
+
+def _call_handles(node: ast.Call) -> bool:
+    """Calls that count as visible handling: logging-ish calls, and
+    handing the error to a waiter via ``set_exception``."""
+    if isinstance(node.func, ast.Attribute):
+        leaf = node.func.attr
+        base = ast.unparse(node.func.value)
+    else:
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        base = name.rsplit(".", 1)[0] if "." in name else ""
+    if leaf == "set_exception":
+        return True
+    if leaf not in _LOG_LEAVES:
+        return False
+    # ``logger.warning`` / ``logging.error`` / ``self._log.debug`` /
+    # ``logging.getLogger(...).debug`` / ``warnings.warn`` — anything
+    # whose base smells like a logging seam. A bare ``warn()``/``log()``
+    # call counts too.
+    return (base == "" or "log" in base.lower()
+            or base.rsplit(".", 1)[-1] in ("warnings", "traceback"))
+
+
+def _handler_handles(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and _call_handles(node):
+            return True
+        if (handler.name is not None and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True  # the error is read/routed, not dropped
+    return False
+
+
+def check_swallowed_exception(ctx: ModuleContext) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _is_broad(handler.type):
+                continue
+            if _handler_handles(handler):
+                continue
+            caught = ("bare except" if handler.type is None else
+                      f"except {ast.unparse(handler.type)}")
+            out.append(ctx.finding(
+                "PML008", handler,
+                f"{caught} swallows the error without re-raise, "
+                f"logging, or set_exception — a real fault (dead "
+                f"worker, corrupt file) vanishes here; log it, narrow "
+                f"the type, or re-raise"))
+    return out

@@ -192,20 +192,31 @@ def parse_staging_config(spec: str) -> StagingConfig:
 
     Keys: workers (pool size; default = host cores), mode
     (thread|process), depth (max staged-but-unconsumed shard blocks),
-    shard_entities (entity lanes per staged shard).
+    shard_entities (entity lanes per staged shard), retries (bounded
+    per-shard retry budget), backoff (base seconds of the jittered
+    retry backoff), straggler (straggler deadline in seconds — exceeded
+    shards re-stage serially; see docs/ROBUSTNESS.md).
     """
     kv = parse_kv(spec)
-    known = {"workers", "mode", "depth", "shard_entities"}
+    known = {"workers", "mode", "depth", "shard_entities", "retries",
+             "backoff", "straggler"}
     unknown = set(kv) - known
     if unknown:
         raise ValueError(f"unknown staging keys {sorted(unknown)}; "
                          f"expected {sorted(known)}")
+    defaults = StagingConfig()
     return StagingConfig(
         workers=int(kv["workers"]) if "workers" in kv else None,
         mode=kv.get("mode", "thread").lower(),
         pipeline_depth=int(kv["depth"]) if "depth" in kv else None,
         shard_entities=(int(kv["shard_entities"])
                         if "shard_entities" in kv else None),
+        max_retries=(int(kv["retries"]) if "retries" in kv
+                     else defaults.max_retries),
+        retry_backoff_s=(float(kv["backoff"]) if "backoff" in kv
+                         else defaults.retry_backoff_s),
+        straggler_timeout_s=(float(kv["straggler"])
+                             if "straggler" in kv else None),
     )
 
 

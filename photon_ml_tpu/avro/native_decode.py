@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import logging
 from typing import Optional
 
 import numpy as np
@@ -99,6 +100,9 @@ def _load():
         lib.pavro_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception:
+        logging.getLogger("photon_ml_tpu.avro").debug(
+            "native Avro decoder unavailable — using the Python path",
+            exc_info=True)
         _lib_failed = True
     return _lib
 

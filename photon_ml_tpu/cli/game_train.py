@@ -150,9 +150,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--staging",
                    help="parallel staging pipeline knobs, "
                         "'workers=8,mode=thread|process,depth=10,"
-                        "shard_entities=65536' (docs/STAGING.md); "
-                        "default: one worker per host core, thread mode, "
-                        "depth=workers+2")
+                        "shard_entities=65536,retries=2,backoff=0.05,"
+                        "straggler=30' (docs/STAGING.md, "
+                        "docs/ROBUSTNESS.md); default: one worker per "
+                        "host core, thread mode, depth=workers+2")
+    p.add_argument("--fault-plan",
+                   help="TESTING ONLY: install a deterministic "
+                        "fault-injection plan (photon_ml_tpu/faults "
+                        "FaultPlan JSON) for this run — the chaos "
+                        "suite's process-level kill/corruption drills "
+                        "drive the trainer through this flag "
+                        "(docs/ROBUSTNESS.md)")
     return p
 
 
@@ -235,6 +243,14 @@ def _load_avro_inputs(args):
 def run(args) -> dict:
     setup_logging()
     enable_compilation_cache()
+    if getattr(args, "fault_plan", None):
+        from photon_ml_tpu import faults
+
+        with open(args.fault_plan) as f:
+            faults.install(faults.FaultPlan.from_json(f.read()))
+        logger.warning("fault injection ACTIVE from %s — this run will "
+                       "deliberately fail in the planned ways",
+                       args.fault_plan)
     t0 = time.perf_counter()  # duration base (PML004)
     task = TaskType(args.task)
     if (args.model_output_format in ("AVRO", "BOTH")

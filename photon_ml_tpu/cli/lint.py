@@ -29,8 +29,8 @@ from photon_ml_tpu.analysis import (ALL_RULES, DEFAULT_BASELINE,
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="photon-lint",
-        description="AST lint for this repo's JAX/concurrency bug "
-                    "classes (PML001-PML007)")
+        description="AST lint for this repo's JAX/concurrency/robustness "
+                    "bug classes (PML001-PML008)")
     p.add_argument("paths", nargs="*", default=["photon_ml_tpu"],
                    help="files/directories to lint "
                         "(default: photon_ml_tpu)")

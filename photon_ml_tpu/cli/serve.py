@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store-shards", type=int, default=8,
                    help="hash shards of the host-resident random-effect "
                         "store")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission-control bound on queued requests "
+                        "(default 16×max-batch); overflow sheds with "
+                        "HTTP 503 instead of buffering unboundedly "
+                        "(docs/ROBUSTNESS.md)")
+    p.add_argument("--request-deadline-s", type=float, default=30.0,
+                   help="per-request deadline: a request still queued "
+                        "past this fails fast with 504 instead of "
+                        "waiting forever (0 disables)")
     return p
 
 
@@ -103,7 +112,9 @@ def create_server(args):
     service = ScoringService(
         model, as_mean=args.as_mean, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, cache_entities=args.cache_entities,
-        store_shards=args.store_shards, entity_vocabs=vocabs)
+        store_shards=args.store_shards, entity_vocabs=vocabs,
+        max_queue=args.max_queue,
+        request_deadline_s=(args.request_deadline_s or None))
     server = make_http_server(service, host=args.host, port=args.port)
     return server, service
 

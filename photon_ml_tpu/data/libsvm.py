@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import logging
 from typing import Optional
 
 import numpy as np
@@ -55,6 +56,9 @@ def _load_native():
         lib.lsvm_free.argtypes = [ctypes.c_void_p]
         _native_lib = lib
     except Exception:
+        logging.getLogger("photon_ml_tpu.data").debug(
+            "native LIBSVM parser unavailable — using the Python path",
+            exc_info=True)
         _native_failed = True
     return _native_lib
 

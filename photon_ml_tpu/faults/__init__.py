@@ -1,0 +1,28 @@
+"""photon-fault: deterministic, seeded fault injection
+(docs/ROBUSTNESS.md).
+
+Pure stdlib — importable from process-pool workers and the lint-adjacent
+tooling without dragging JAX in.
+"""
+
+from photon_ml_tpu.faults.injector import (FaultInjector, FaultPlan,
+                                           FaultSpec, InjectedFault,
+                                           InjectedIOError,
+                                           InjectedThreadDeath, active,
+                                           corrupt_file, current_plan,
+                                           fire, install, installed)
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedIOError",
+    "InjectedThreadDeath",
+    "active",
+    "corrupt_file",
+    "current_plan",
+    "fire",
+    "install",
+    "installed",
+]

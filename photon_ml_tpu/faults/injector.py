@@ -1,0 +1,287 @@
+"""Deterministic fault injection: the testable-failure seam.
+
+Reference parity: none in photon-ml itself — the Spark lineage got fault
+coverage "for free" from the cluster manager, and Snap ML (PAPERS.md)
+treats executor failure and stragglers as first-class events of the
+hierarchical training loop. XLA gives us neither, so resilience here has
+to be engineered explicitly — and engineered resilience that cannot be
+exercised on demand is dead code. This module is the on-demand part.
+
+Model
+-----
+Production code is instrumented with **fault sites**: named points where
+a failure can physically happen (a staging worker body, a cache write, a
+batcher flush). Each call to a site is an **occurrence**, counted per
+site; many sites also pass a stable **index** (the shard number, say).
+A ``FaultSpec`` addresses ``(site, occurrence index and/or call index)``
+and says what happens there:
+
+- ``raise``        — raise an exception (worker crash, transient I/O);
+- ``sleep``        — delay ``seconds`` (slow shard / straggler);
+- ``kill``         — SIGKILL the calling process (worker/driver death);
+- ``corrupt``      — garble the bytes of the file a save-site just wrote
+                     (corrupted cache shard / checkpoint artifact);
+- ``thread_death`` — raise ``InjectedThreadDeath`` (a BaseException, so
+                     it sails past ``except Exception`` and kills the
+                     thread — the scoring-worker-death fault class).
+
+Everything is deterministic: specs address exact occurrences, corruption
+bytes come from ``random.Random(plan.seed)``, and the injector records
+every firing so tests can assert the fault actually happened. A
+``FaultPlan`` is plain picklable data — it crosses the spawn boundary to
+process-pool staging workers and serializes to JSON for the
+``game_train --fault-plan`` flag (docs/ROBUSTNESS.md).
+
+When no plan is installed every hook is a no-op behind one ``is None``
+check — the production hot paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    """An injector-raised failure (the generic worker-crash class)."""
+
+
+class InjectedIOError(OSError):
+    """An injector-raised transient I/O failure."""
+
+
+class InjectedThreadDeath(BaseException):
+    """Deliberately NOT an Exception: escapes ``except Exception``
+    handlers the way a real interpreter-level thread death (MemoryError,
+    SystemExit in a callback) does, killing the worker thread it fires
+    on. Supervisors must recover from exactly this."""
+
+
+_EXC_TYPES = {
+    "InjectedFault": InjectedFault,
+    "InjectedIOError": InjectedIOError,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "IOError": OSError,
+    "ValueError": ValueError,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One addressed fault.
+
+    ``site``: the instrumentation point's name (docs/ROBUSTNESS.md lists
+    them). ``occurrences``: 0-based per-site call numbers to fire at
+    (empty = every call). ``indices``: site-supplied stable indices (e.g.
+    shard numbers) to fire at (empty = any). Both filters must match.
+    ``kind``: raise | sleep | kill | corrupt | thread_death. ``exc``:
+    exception type name for ``raise`` (picklable as a string).
+    ``seconds``: sleep duration. ``max_fires``: stop firing after this
+    many hits (None = unlimited) — a once-only transient fault is
+    ``max_fires=1`` with no occurrence filter. ``scope``: "any" (default)
+    fires wherever the site is hit; "worker" only inside pool worker
+    processes; "driver" only in the main process — a worker-kill spec
+    must not also kill the driver when the quarantined work re-runs
+    serially there.
+    """
+
+    site: str
+    kind: str = "raise"
+    occurrences: tuple[int, ...] = ()
+    indices: tuple[int, ...] = ()
+    exc: str = "InjectedFault"
+    message: str = "injected fault"
+    seconds: float = 0.0
+    max_fires: Optional[int] = None
+    scope: str = "any"
+
+    def __post_init__(self):
+        if self.kind not in ("raise", "sleep", "kill", "corrupt",
+                             "thread_death"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.scope not in ("any", "worker", "driver"):
+            raise ValueError(f"unknown fault scope {self.scope!r}")
+        if self.kind == "raise" and self.exc not in _EXC_TYPES:
+            raise ValueError(
+                f"unknown exception type {self.exc!r} "
+                f"(known: {sorted(_EXC_TYPES)})")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A deterministic set of faults + the seed for corruption bytes."""
+
+    specs: tuple[FaultSpec, ...] = ()
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "specs": [dataclasses.asdict(s) for s in self.specs],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        obj = json.loads(text)
+        specs = []
+        for s in obj.get("specs", []):
+            s = dict(s)
+            s["occurrences"] = tuple(s.get("occurrences", ()))
+            s["indices"] = tuple(s.get("indices", ()))
+            specs.append(FaultSpec(**s))
+        return cls(specs=tuple(specs), seed=int(obj.get("seed", 0)))
+
+
+class FaultInjector:
+    """Counts site occurrences and fires matching specs (thread-safe).
+
+    ``worker=True`` marks an injector living inside a pool worker
+    process (installed by the pool initializer) — it arms "worker"-scoped
+    specs and disarms "driver"-scoped ones.
+    """
+
+    def __init__(self, plan: FaultPlan, worker: bool = False):
+        self.plan = plan
+        self.is_worker = bool(worker)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._spec_fires: dict[int, int] = {}
+        self.fired: list[tuple[str, int, Optional[int], str]] = []
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def occurrences(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def fires(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            return len([f for f in self.fired
+                        if site is None or f[0] == site])
+
+    def _match(self, site: str, index: Optional[int],
+               kinds: tuple[str, ...]) -> Optional[FaultSpec]:
+        """Count one occurrence of ``site`` and return the firing spec,
+        if any, recording the hit."""
+        with self._lock:
+            occ = self._counts.get(site, 0)
+            self._counts[site] = occ + 1
+            my_scope = "worker" if self.is_worker else "driver"
+            for si, spec in enumerate(self.plan.specs):
+                if spec.site != site or spec.kind not in kinds:
+                    continue
+                if spec.scope not in ("any", my_scope):
+                    continue
+                if spec.occurrences and occ not in spec.occurrences:
+                    continue
+                if spec.indices and (index is None
+                                     or index not in spec.indices):
+                    continue
+                hits = self._spec_fires.get(si, 0)
+                if spec.max_fires is not None and hits >= spec.max_fires:
+                    continue
+                self._spec_fires[si] = hits + 1
+                self.fired.append((site, occ, index, spec.kind))
+                return spec
+        return None
+
+    # -- the hooks production code calls -----------------------------------
+
+    def fire(self, site: str, index: Optional[int] = None) -> None:
+        """Crash/delay/kill hook: every instrumented execution point
+        calls this once per occurrence."""
+        spec = self._match(site, index,
+                           ("raise", "sleep", "kill", "thread_death"))
+        if spec is None:
+            return
+        if spec.kind == "sleep":
+            time.sleep(spec.seconds)
+        elif spec.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.kind == "thread_death":
+            raise InjectedThreadDeath(f"{spec.message} [site={site}]")
+        else:
+            raise _EXC_TYPES[spec.exc](f"{spec.message} [site={site}]")
+
+    def corrupt_file(self, site: str, path: str,
+                     index: Optional[int] = None) -> bool:
+        """Corruption hook for save-sites: garble ``path`` in place when
+        a ``corrupt`` spec matches. Deterministic: the overwritten bytes
+        come from ``Random(seed, site, occurrence)``. Returns True when
+        the file was corrupted."""
+        spec = self._match(site, index, ("corrupt",))
+        if spec is None:
+            return False
+        size = os.path.getsize(path)
+        rng = random.Random(
+            f"{self.plan.seed}|{site}|{self._counts.get(site, 0)}")
+        n = max(1, min(64, size))
+        blob = bytes(rng.randrange(256) for _ in range(n))
+        with open(path, "r+b") as f:
+            f.seek(max(0, size // 2 - n // 2))
+            f.write(blob)
+        return True
+
+
+# -- process-global seam -----------------------------------------------------
+#
+# One injector per process, installed explicitly (tests, --fault-plan) or
+# shipped to pool workers through their initializer ctx. Reads are a single
+# None check when no faults are active.
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(plan: Optional[FaultPlan],
+            worker: bool = False) -> Optional[FaultInjector]:
+    """Install ``plan`` process-wide (None uninstalls); returns the
+    injector so tests can assert on its firing record. ``worker=True``
+    is set by pool-worker initializers (arms "worker"-scoped specs)."""
+    global _ACTIVE
+    _ACTIVE = (FaultInjector(plan, worker=worker)
+               if plan is not None else None)
+    return _ACTIVE
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The installed plan, if any — picklable, for shipping to workers."""
+    return _ACTIVE.plan if _ACTIVE is not None else None
+
+
+class installed:
+    """Context-manager install: ``with faults.installed(plan) as inj:``
+    — uninstalls on exit even when the body raises."""
+
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+        self.injector: Optional[FaultInjector] = None
+
+    def __enter__(self) -> FaultInjector:
+        self.injector = install(self._plan)
+        return self.injector
+
+    def __exit__(self, *exc):
+        install(None)
+
+
+def fire(site: str, index: Optional[int] = None) -> None:
+    """Module-level hook: no-op unless a plan is installed."""
+    if _ACTIVE is not None:
+        _ACTIVE.fire(site, index)
+
+
+def corrupt_file(site: str, path: str, index: Optional[int] = None) -> bool:
+    if _ACTIVE is not None:
+        return _ACTIVE.corrupt_file(site, path, index)
+    return False

@@ -9,7 +9,11 @@ from the newest checkpoint (``--resume`` in ``cli/game_train.py``).
 Layout under the checkpoint directory::
 
     state.json            # progress counters + history + fingerprint
+                          # + per-artifact CRC32 map (the commit point)
+    state.json.prev       # the PREVIOUS committed state (recovery)
     model/                # models/io.py GameModel directory (newest state)
+    <artifact>.prev       # previous generation of every file the newest
+                          # commit rewrote (hardlinks: one inode, no copy)
     residuals.npz         # the descent loop's (n,) score total at the
                           # committed step — restoring it (instead of
                           # re-summing per-coordinate scores) makes resume
@@ -26,6 +30,18 @@ leaves either the previous state.json (the step is simply retrained on
 resume — coefficient files newer than the committed step only change the
 warm start of that retraining) or the new one (fully committed). There is
 never a moment without a readable checkpoint.
+
+Corruption model (docs/ROBUSTNESS.md): atomicity cannot defend against
+bit rot, torn pages, or a partial copy restored from backup — corruption
+that keeps files readable but wrong. Every committed artifact's CRC32
+rides in ``state.json``; ``load`` verifies before trusting. On a
+mismatch (or an unparseable state/model file) the manager FALLS BACK to
+the previous committed generation — each save first hardlinks the files
+it is about to rewrite to ``<name>.prev``, so generation N-1 survives
+commit N at zero copy cost — emits a ``CheckpointRecovered`` event, and
+resumes from there (the lost step is simply retrained). Both generations
+corrupt → train from scratch with a warning: recovery degrades, it never
+resumes silently wrong state.
 
 Each save rewrites only the coordinate(s) that changed — the others'
 coefficient files are already current on disk — so per-step checkpoint
@@ -45,19 +61,24 @@ import dataclasses
 import json
 import logging
 import os
+import shutil
 from typing import Optional
 
 import numpy as np
 
+from photon_ml_tpu import faults as flt
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
+from photon_ml_tpu.game.staging_cache import file_crc32
 from photon_ml_tpu.models import io as model_io
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import events as ev_mod
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
 _STATE = "state.json"
 _MODEL = "model"
 _RESIDUALS = "residuals.npz"
+_PREV = ".prev"
 
 
 @dataclasses.dataclass
@@ -70,6 +91,8 @@ class CheckpointState:
     complete: bool  # descent finished; models are the final result
     fingerprint: Optional[dict]  # config the checkpoint was written under
     residual_total: Optional["np.ndarray"] = None  # (n,) score total
+    recovered: bool = False  # True when this state came from the .prev
+    #                          generation after a corruption fallback
 
 
 class CheckpointManager:
@@ -83,6 +106,39 @@ class CheckpointManager:
         # unrelated earlier run contaminating coordinates that this run's
         # `updated` lists haven't touched yet.
         self._full_snapshot_written = False
+        # rel artifact path → CRC32 of its committed bytes. Complete by
+        # construction: the first save of a process is a full snapshot.
+        self._crcs: dict[str, int] = {}
+
+    # -- path helpers --------------------------------------------------------
+
+    def _abs(self, rel: str) -> str:
+        return os.path.join(self.directory, rel.replace("/", os.sep))
+
+    def _preserve(self, rel: str) -> None:
+        """Keep the committed generation of ``rel`` alive as ``rel.prev``
+        before a rewrite. Hardlink (one inode, no copy); a filesystem
+        without hardlinks falls back to a copy."""
+        path = self._abs(rel)
+        if not os.path.exists(path):
+            return
+        prev = path + _PREV
+        try:
+            os.unlink(prev)
+        except OSError:
+            pass  # absent or unremovable; os.link/copy below decides
+        try:
+            os.link(path, prev)
+        except OSError:
+            shutil.copy2(path, prev)
+
+    def _commit_file(self, rel: str) -> None:
+        """Record one just-written artifact's CRC. Injected bit rot
+        lands AFTER the checksum was taken over the good bytes (the
+        corruption shape the CRC must catch later)."""
+        path = self._abs(rel)
+        self._crcs[rel] = file_crc32(path)
+        flt.corrupt_file("checkpoint.artifact", path)
 
     # -- write -------------------------------------------------------------
 
@@ -110,6 +166,7 @@ class CheckpointManager:
 
         if jax.process_index() != 0:
             return
+        flt.fire("checkpoint.save")
         model_dir = os.path.join(self.directory, _MODEL)
         os.makedirs(model_dir, exist_ok=True)
         write_set = (set(models)
@@ -117,22 +174,39 @@ class CheckpointManager:
                      else set(updated))
         meta = {}
         for cid, m in models.items():
+            cmeta = model_io.coordinate_meta(m)
+            sub = ("fixed-effect" if cmeta["type"] == "fixed"
+                   else "random-effect")
+            rel = f"{_MODEL}/{sub}/{cid}/coefficients.npz"
             if cid in write_set:
+                self._preserve(rel)
                 meta[cid] = model_io.save_coordinate(model_dir, cid, m)
+                self._commit_file(rel)
             else:
-                meta[cid] = model_io.coordinate_meta(m)
+                meta[cid] = cmeta
+                if rel not in self._crcs and os.path.exists(self._abs(rel)):
+                    self._crcs[rel] = file_crc32(self._abs(rel))
+        meta_rel = f"{_MODEL}/metadata.json"
+        self._preserve(meta_rel)
         model_io.write_metadata(model_dir, task, meta)
+        self._commit_file(meta_rel)
         # Residuals before the commit point, atomically; stale files are
         # removed rather than left to pair with a state they don't match.
         res_path = os.path.join(self.directory, _RESIDUALS)
+        self._preserve(_RESIDUALS)
         if residual_total is not None:
             tmp = res_path + ".tmp"
             with open(tmp, "wb") as f:
                 np.savez(f, total=np.asarray(residual_total))
             os.replace(tmp, res_path)
-        elif os.path.exists(res_path):
-            os.remove(res_path)
-        # Commit point: state.json last, atomically.
+            self._commit_file(_RESIDUALS)
+        else:
+            if os.path.exists(res_path):
+                os.remove(res_path)
+            self._crcs.pop(_RESIDUALS, None)
+        # Commit point: state.json last, atomically — carrying the CRC of
+        # every artifact this generation consists of.
+        self._preserve(_STATE)
         tmp = os.path.join(self.directory, _STATE + ".tmp")
         with open(tmp, "w") as f:
             json.dump({
@@ -140,6 +214,7 @@ class CheckpointManager:
                 "records": records,
                 "complete": complete,
                 "fingerprint": fingerprint,
+                "artifacts": self._crcs,
             }, f, indent=2)
         os.replace(tmp, os.path.join(self.directory, _STATE))
         self._full_snapshot_written = True
@@ -148,15 +223,100 @@ class CheckpointManager:
 
     # -- read --------------------------------------------------------------
 
+    def _read_state(self, path: str) -> Optional[dict]:
+        """Parse one state file; unreadable/unparseable → None (a
+        corruption signal for the caller, never an exception)."""
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("checkpoint state %s is unreadable (%s: %s)",
+                           path, type(e).__name__, e)
+            return None
+
+    def _bad_artifacts(self, state: dict) -> list[str]:
+        """Artifacts of ``state`` whose on-disk bytes fail their
+        committed CRC32 (missing counts as failed). Checkpoints from
+        layouts without CRCs verify vacuously."""
+        bad = []
+        for rel, want in (state.get("artifacts") or {}).items():
+            path = self._abs(rel)
+            try:
+                ok = file_crc32(path) == want
+            except OSError:
+                ok = False
+            if not ok:
+                bad.append(rel)
+        return bad
+
+    def _recover(self) -> Optional[dict]:
+        """Fall back to the previous committed generation: restore every
+        ``.prev`` artifact the previous state's CRC map vouches for, then
+        re-verify. Returns the recovered state, or None when the previous
+        generation is unusable too (→ train from scratch)."""
+        prev_state_path = os.path.join(self.directory, _STATE + _PREV)
+        prev = self._read_state(prev_state_path)
+        if prev is None:
+            return None
+        for rel, want in (prev.get("artifacts") or {}).items():
+            path = self._abs(rel)
+            try:
+                if os.path.exists(path) and file_crc32(path) == want:
+                    continue  # current file already IS the prev content
+                prev_file = path + _PREV
+                if (os.path.exists(prev_file)
+                        and file_crc32(prev_file) == want):
+                    os.replace(prev_file, path)
+            except OSError as e:
+                logger.warning("checkpoint recovery could not restore %s "
+                               "(%s: %s)", rel, type(e).__name__, e)
+        if self._bad_artifacts(prev):
+            return None
+        # The previous generation is now THE committed generation.
+        try:
+            os.replace(prev_state_path,
+                       os.path.join(self.directory, _STATE))
+        except OSError:
+            pass  # another rank won the race; the content is identical
+        return prev
+
     def load(self, expected_fingerprint: Optional[dict] = None
              ) -> Optional[CheckpointState]:
         """Return the committed state, or None if absent or written under a
-        different configuration than ``expected_fingerprint``."""
+        different configuration than ``expected_fingerprint``.
+
+        Verifies every artifact's CRC32 first. Corruption (CRC mismatch,
+        unparseable state.json, an unloadable model file) triggers ONE
+        fallback to the previous committed generation — logged and
+        announced with a ``CheckpointRecovered`` event; if that
+        generation is unusable too, returns None (train from scratch).
+        """
+        flt.fire("checkpoint.load")
         state_path = os.path.join(self.directory, _STATE)
-        if not os.path.exists(state_path):
+        if not os.path.exists(state_path) \
+                and not os.path.exists(state_path + _PREV):
             return None
-        with open(state_path) as f:
-            state = json.load(f)
+        state = self._read_state(state_path)
+        recovered = False
+        reason = ""
+        if state is not None:
+            bad = self._bad_artifacts(state)
+            if bad:
+                reason = f"artifact CRC mismatch: {sorted(bad)}"
+                state = None
+        else:
+            reason = "state.json unreadable"
+        if state is None:
+            state = self._recover()
+            recovered = state is not None
+            if not recovered:
+                logger.error(
+                    "checkpoint at %s is corrupt (%s) and the previous "
+                    "generation is unusable — training from scratch",
+                    self.directory, reason or "no committed state")
+                return None
         saved_fp = state.get("fingerprint")
         if (expected_fingerprint is not None and saved_fp is not None
                 and saved_fp != expected_fingerprint):
@@ -166,12 +326,61 @@ class CheckpointManager:
                 "(saved=%s expected=%s)",
                 self.directory, saved_fp, expected_fingerprint)
             return None
-        game = model_io.load_game_model(os.path.join(self.directory, _MODEL))
+        try:
+            game = model_io.load_game_model(
+                os.path.join(self.directory, _MODEL))
+        except Exception as e:
+            # CRC-less layouts (or a corrupt file both generations
+            # share): one recovery attempt, then give up cleanly.
+            if recovered:
+                logger.error("recovered checkpoint at %s still does not "
+                             "load (%s: %s) — training from scratch",
+                             self.directory, type(e).__name__, e)
+                return None
+            reason = f"model load failed: {type(e).__name__}: {e}"
+            state = self._recover()
+            if state is None:
+                logger.error(
+                    "checkpoint at %s is corrupt (%s) and the previous "
+                    "generation is unusable — training from scratch",
+                    self.directory, reason)
+                return None
+            recovered = True
+            saved_fp = state.get("fingerprint")
+            try:
+                game = model_io.load_game_model(
+                    os.path.join(self.directory, _MODEL))
+            except Exception as e2:
+                logger.error("recovered checkpoint at %s still does not "
+                             "load (%s: %s) — training from scratch",
+                             self.directory, type(e2).__name__, e2)
+                return None
+        if recovered:
+            logger.warning(
+                "checkpoint at %s was corrupt (%s); recovered the "
+                "previous committed generation (%d step(s)) — the lost "
+                "step retrains on resume",
+                self.directory, reason, int(state["done_steps"]))
+            ev_mod.default_emitter.emit(ev_mod.CheckpointRecovered(
+                directory=self.directory,
+                done_steps=int(state["done_steps"]),
+                reason=reason))
         residual_total = None
         res_path = os.path.join(self.directory, _RESIDUALS)
         if os.path.exists(res_path):
-            with np.load(res_path) as z:
-                residual_total = z["total"]
+            try:
+                with np.load(res_path) as z:
+                    residual_total = z["total"]
+            except Exception as e:
+                # Descent re-sums scores when residuals are unusable —
+                # correct, just not bit-exact (descent logs that path).
+                logger.warning(
+                    "checkpoint residuals at %s are unreadable (%s: %s) "
+                    "— falling back to re-summation", res_path,
+                    type(e).__name__, e)
+        # Seed the CRC ledger so this process's next incremental save
+        # carries forward the artifacts it does not rewrite.
+        self._crcs = dict(state.get("artifacts") or {})
         return CheckpointState(
             models=dict(game.models),
             done_steps=int(state["done_steps"]),
@@ -179,4 +388,5 @@ class CheckpointManager:
             complete=bool(state["complete"]),
             fingerprint=saved_fp,
             residual_total=residual_total,
+            recovered=recovered,
         )

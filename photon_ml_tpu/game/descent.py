@@ -282,8 +282,8 @@ def _dataset_digest(ds) -> str:
     digest = h.hexdigest()
     try:
         ds._content_digest = digest
-    except Exception:  # frozen/slotted datasets: just recompute next time
-        pass
+    except (AttributeError, TypeError):
+        pass  # frozen/slotted datasets: just recompute next time
     return digest
 
 

@@ -48,6 +48,18 @@ the pool; pool tasks never block on futures or semaphores — so there is
 no lost-wakeup/deadlock topology. If the consumer never drains the
 stream, staging stalls at the depth bound and the daemon scheduler dies
 with the process.
+
+Failure contract (docs/ROBUSTNESS.md): every shard task is wrapped in a
+degradation ladder — bounded retry with deterministic jittered backoff,
+then (for a crashed worker that broke the pool) QUARANTINE of the pool
+and serial re-staging inline on the scheduler thread. Content never
+depends on which rung produced it (the parity tests' core property), so
+recovery is bit-identical. A shard that exceeds
+``StagingConfig.straggler_timeout_s`` is re-staged serially instead of
+stalling the consumer (the late pool result is discarded); every retry /
+straggler emits an event and counts in ``ProjectionStager.fault_stats``.
+Faults are injectable at the ``staging.phase_a`` / ``staging.phase_b``
+sites (photon_ml_tpu/faults) — the chaos suite drives every rung.
 """
 
 from __future__ import annotations
@@ -55,12 +67,19 @@ from __future__ import annotations
 import concurrent.futures as cf
 import dataclasses
 import functools
+import logging
 import os
+import queue
+import random
 import threading
 import time
 from typing import Optional
 
 import numpy as np
+
+from photon_ml_tpu import faults as flt
+
+logger = logging.getLogger("photon_ml_tpu.game")
 
 from photon_ml_tpu.game import buckets as bkt
 from photon_ml_tpu.game import projector as prj
@@ -87,12 +106,24 @@ class StagingConfig:
     shard blocks (None → workers + 2). ``shard_entities``: lanes per
     shard (None → LANE_CHUNK; rounded up to the bucketing's entity pad
     multiple so device sharding survives).
+
+    Resilience knobs (docs/ROBUSTNESS.md): ``max_retries`` bounds the
+    per-shard retry ladder (0 = fail on first error);
+    ``retry_backoff_s`` is the base of the exponential jittered backoff
+    between attempts (jitter is deterministic in (seed, shard, attempt));
+    ``straggler_timeout_s`` re-stages a shard serially when its pool task
+    exceeds the deadline instead of stalling the consumer (None = wait
+    forever, the pre-hardening behavior).
     """
 
     workers: Optional[int] = None
     mode: str = "thread"
     pipeline_depth: Optional[int] = None
     shard_entities: Optional[int] = None
+    max_retries: int = 2
+    retry_backoff_s: float = 0.05
+    straggler_timeout_s: Optional[float] = None
+    retry_jitter_seed: int = 0
 
     def __post_init__(self):
         if self.mode not in ("thread", "process"):
@@ -103,6 +134,16 @@ class StagingConfig:
             v = getattr(self, name)
             if v is not None and v < 1:
                 raise ValueError(f"staging {name} must be >= 1, got {v}")
+        if self.max_retries < 0:
+            raise ValueError(
+                f"staging max_retries must be >= 0, got {self.max_retries}")
+        if self.retry_backoff_s < 0:
+            raise ValueError(f"staging retry_backoff_s must be >= 0, "
+                             f"got {self.retry_backoff_s}")
+        if (self.straggler_timeout_s is not None
+                and self.straggler_timeout_s <= 0):
+            raise ValueError(f"staging straggler_timeout_s must be > 0, "
+                             f"got {self.straggler_timeout_s}")
 
     def resolved_workers(self) -> int:
         return max(1, self.workers or os.cpu_count() or 1)
@@ -238,12 +279,27 @@ _WORKER_CTX: dict = {}
 
 def _init_worker(ctx: dict) -> None:
     _WORKER_CTX.update(ctx)
+    # Process-pool workers are fresh interpreters: the driver's fault
+    # plan rides the ctx so injected worker crashes/kills happen in the
+    # worker process, exactly where a real one would.
+    plan = ctx.get("fault_plan")
+    if plan is not None:
+        flt.install(plan, worker=True)
+
+
+def _retry_delay(base: float, attempt: int, seed: int, index: int) -> float:
+    """Exponential backoff with DETERMINISTIC jitter: attempt k waits
+    ``base * 2^(k-1) * uniform[0.5, 1.5)`` where the uniform draw is
+    seeded by (seed, shard, attempt) — chaos tests replay identically."""
+    r = random.Random(f"{seed}|{index}|{attempt}").random()
+    return base * (2.0 ** (attempt - 1)) * (0.5 + r)
 
 
 def _phase_a(task: ShardTask, d: int, intercept_index: Optional[int],
              ratio: Optional[float]):
     """Unique active (lane, col) pairs of one shard + the lane-count max
     that feeds the bucket's d_active reduce."""
+    flt.fire("staging.phase_a", index=task.index)
     live = np.flatnonzero(np.asarray(task.entity_rows) >= 0).astype(
         np.int64)
     u_lane, u_col = prj.active_pairs(
@@ -259,6 +315,7 @@ def _phase_b(task: ShardTask, cols: np.ndarray, d_active: int,
              ctx: Optional[dict] = None):
     """One shard's staged tuple, laid out exactly as the serial
     coordinate staging: (Xb, yb, wb, ex, rows[, cols][, f_p][, s_p])."""
+    flt.fire("staging.phase_b", index=task.index)
     if ctx is None:
         ctx = _WORKER_CTX
     sub = bkt.EntityBucket(entity_rows=task.entity_rows,
@@ -375,6 +432,16 @@ class ProjectionStager:
         self._finalized = False
         self._complete = threading.Event()  # scheduler fully retired
         self._t0 = time.monotonic()
+        # Degradation-ladder bookkeeping. Writes happen on the scheduler
+        # thread (completion callbacks only ENQUEUE failures); tests read
+        # after join(), which publishes via self._complete.
+        self._quarantined = False
+        # Shards claimed by exactly one producer (pool callback, retry,
+        # or straggler restage) — the loser of any race discards.
+        self._claimed: set[int] = set()
+        self._claim_lock = threading.Lock()
+        self.fault_stats = {"retries": 0, "serial_restages": 0,
+                            "stragglers": 0, "quarantined": False}
 
         # Probe the shard-granular cache: valid shards skip phases A+B
         # entirely (their column map rides in the cached tuple).
@@ -488,6 +555,10 @@ class ProjectionStager:
             "d": self._d,
             "dense_X": None if self._is_sparse else np.asarray(self._X),
         }
+        plan = flt.current_plan()
+        if plan is not None:
+            # Injected faults must reach spawn-fresh process workers too.
+            ctx["fault_plan"] = plan
         labels = (self._response if self._ratio is not None else None)
         tasks = split_shard_triplets(self._bucketing, self.plan, self._X,
                                      labels=labels)
@@ -503,8 +574,9 @@ class ProjectionStager:
             pool_a = _make_pool("thread", workers, ctx)
             pool_b = _make_pool("thread", workers, ctx)
         try:
-            a_futs = {i: pool_a.submit(_phase_a, tasks[i], self._d,
-                                       self._ii, self._ratio)
+            a_futs = {i: self._submit(pool_a, _phase_a,
+                                      (tasks[i], self._d, self._ii,
+                                       self._ratio), i)
                       for i in missing}
             # Per-bucket width reduce + column-map fill (cheap, in this
             # thread), publishing cols for cols_list() BEFORE any
@@ -522,7 +594,9 @@ class ProjectionStager:
                         w = int(self._cached[i][5].shape[1])
                         cached_width = max(cached_width or 0, w)
                     else:
-                        u_lane, u_col, mx = a_futs.pop(i).result()
+                        u_lane, u_col, mx = self._shard_result(
+                            i, a_futs.pop(i), pool_a, _phase_a,
+                            (tasks[i], self._d, self._ii, self._ratio))
                         pairs[i] = (u_lane, u_col)
                         max_active = max(max_active, mx)
                 width = prj.projection_width(
@@ -543,59 +617,242 @@ class ProjectionStager:
                         self._cols[i] = prj.fill_cols(
                             u_lane, u_col, hi - lo, width, self._ii)
             self._cols_ready.set()
-            # Depth-bounded phase-B submission in plan order; completion
-            # callbacks hand each staged shard to the consumer and the
-            # cache the moment it exists.
-            done = threading.Event()
-            pending = len(missing)
-            if pending == 0:
-                done.set()
-            lock = threading.Lock()
-
-            def _on_b(i, t_submit, fut):
-                nonlocal pending
-                try:
-                    res = fut.result()
-                except BaseException as e:
-                    if not self._futures[i].done():
-                        self._futures[i].set_exception(e)
-                else:
-                    # Hand off to the consumer FIRST (the fit stream is
-                    # latency-sensitive), then persist to the cache.
-                    self._futures[i].set_result(("staged", res))
-                    bi, lo, hi = self.plan[i]
-                    self._emitter.emit(ev_mod.StagingShard(
-                        label=self._label, index=i, bucket=bi,
-                        entities=hi - lo,
-                        seconds=time.monotonic() - t_submit,
-                        source="staged"))
-                    if self._cache_dir:
-                        try:
-                            staging_cache.save_shard(
-                                self._cache_dir, self._cache_key, i, res)
-                        except OSError:
-                            pass  # cache is best-effort, staging is not
-                    self._shard_done()
-                with lock:
-                    pending -= 1
-                    if pending == 0:
-                        done.set()
-
-            for i in missing:
-                self._sem.acquire()
-                t_submit = time.monotonic()
-                args = (tasks[i], self._cols[i],
-                        int(self._cols[i].shape[1]))
-                if not is_process:
-                    args = args + (ctx,)
-                fut = pool_b.submit(_phase_b, *args)
-                fut.add_done_callback(
-                    functools.partial(_on_b, i, t_submit))
-            done.wait()
+            self._run_phase_b(tasks, missing, pool_b, ctx, is_process)
         finally:
             pool_a.shutdown(wait=False)
             if pool_b is not pool_a:
                 pool_b.shutdown(wait=False)
+
+    # -- degradation ladder (docs/ROBUSTNESS.md) ---------------------------
+
+    def _submit(self, pool, fn, args, i):
+        """Pool submission, or None when the pool is quarantined/broken —
+        the caller then runs the task inline (serial fallback)."""
+        if self._quarantined:
+            return None
+        try:
+            return pool.submit(fn, *args)
+        except RuntimeError as e:  # BrokenExecutor / shut-down pool
+            self._note_quarantine(i, e)
+            return None
+
+    def _note_quarantine(self, i, exc) -> None:
+        if not self._quarantined:
+            self._quarantined = True
+            self.fault_stats["quarantined"] = True
+            logger.warning(
+                "staging[%s]: worker pool broken at shard %d (%s: %s) — "
+                "quarantining the pool; remaining shards re-stage "
+                "serially (bit-identical, slower)",
+                self._label, i, type(exc).__name__, exc)
+
+    def _note_retry(self, i, attempt, exc) -> None:
+        self.fault_stats["retries"] += 1
+        logger.warning(
+            "staging[%s]: shard %d attempt %d failed (%s: %s) — retrying",
+            self._label, i, attempt, type(exc).__name__, exc)
+        self._emitter.emit(ev_mod.StagingRetry(
+            label=self._label, index=i, attempt=attempt,
+            error=f"{type(exc).__name__}: {exc}"))
+
+    def _shard_result(self, i, fut, pool, fn, args):
+        """One shard task's result, walking the ladder: pooled attempts
+        with deterministic jittered backoff → quarantine when a crashed
+        worker broke the pool → inline serial execution on this thread.
+        Raises only when every rung failed (a deterministic task bug,
+        not an execution fault)."""
+        attempt = 0
+        while True:
+            try:
+                if fut is None:
+                    self.fault_stats["serial_restages"] += 1
+                    return fn(*args)
+                return fut.result()
+            except cf.BrokenExecutor as e:
+                # A crashed worker takes the whole pool down. That is not
+                # this task's fault — no retry budget burned.
+                self._note_quarantine(i, e)
+                fut = None
+            except Exception as e:
+                attempt += 1
+                if attempt > self.config.max_retries:
+                    raise
+                self._note_retry(i, attempt, e)
+                delay = _retry_delay(self.config.retry_backoff_s, attempt,
+                                     self.config.retry_jitter_seed, i)
+                if delay > 0:
+                    time.sleep(delay)
+                fut = self._submit(pool, fn, args, i)
+
+    def _publish_b(self, i, t_submit, res) -> None:
+        """Phase-B success path (pool callback thread, retry, or
+        straggler restage): the FIRST producer wins the claim, hands the
+        shard to the consumer (the fit stream is latency-sensitive), then
+        persists it; any later duplicate producer discards silently."""
+        with self._claim_lock:
+            if i in self._claimed:
+                return
+            self._claimed.add(i)
+        self._futures[i].set_result(("staged", res))
+        bi, lo, hi = self.plan[i]
+        self._emitter.emit(ev_mod.StagingShard(
+            label=self._label, index=i, bucket=bi,
+            entities=hi - lo,
+            seconds=time.monotonic() - t_submit,
+            source="staged"))
+        if self._cache_dir:
+            try:
+                staging_cache.save_shard(
+                    self._cache_dir, self._cache_key, i, res)
+            except OSError as e:
+                # Cache is best-effort, staging is not.
+                logger.warning(
+                    "staging[%s]: cache write for shard %d failed "
+                    "(%s: %s); staging continues", self._label, i,
+                    type(e).__name__, e)
+        self._shard_done()
+
+    def _run_phase_b(self, tasks, missing, pool_b, ctx, is_process):
+        """Depth-bounded phase-B dispatch in plan order. One scheduler
+        loop (this thread) owns submissions, backoff retries, quarantine
+        fallback, and the straggler deadline; pool completion callbacks
+        take the low-latency success handoff directly and only enqueue
+        FAILURES back here."""
+        if not missing:
+            return
+        cfg = self.config
+        failures: queue.Queue = queue.Queue()
+        remaining = set(missing)
+        to_submit = list(missing)
+        inflight: dict[int, float] = {}  # shard → latest dispatch time
+        retry_at: list[tuple[float, int]] = []  # (due time, shard)
+        attempts: dict[int, int] = {}
+
+        def _b_args(i):
+            args = (tasks[i], self._cols[i], int(self._cols[i].shape[1]))
+            return args if is_process else args + (ctx,)
+
+        def _is_claimed(i):
+            with self._claim_lock:
+                return i in self._claimed
+
+        def _fail(i, e):
+            with self._claim_lock:
+                if i in self._claimed:
+                    return
+                self._claimed.add(i)
+            logger.error(
+                "staging[%s]: shard %d failed after %d attempt(s): "
+                "%s: %s", self._label, i, attempts.get(i, 0) + 1,
+                type(e).__name__, e)
+            if not self._futures[i].done():
+                self._futures[i].set_exception(e)
+
+        def _serial(i, t_submit):
+            self.fault_stats["serial_restages"] += 1
+            try:
+                # Inline runs in the DRIVER process, where the process
+                # pool's _WORKER_CTX initializer never ran — always pass
+                # the ctx explicitly.
+                res = _phase_b(tasks[i], self._cols[i],
+                               int(self._cols[i].shape[1]), ctx)
+            except Exception as e:
+                _handle_failure(i, e)
+            else:
+                self._publish_b(i, t_submit, res)
+
+        def _handle_failure(i, e):
+            if not (i in remaining and not _is_claimed(i)):
+                return  # another producer already settled this shard
+            now = time.monotonic()
+            inflight.pop(i, None)
+            if isinstance(e, cf.BrokenExecutor):
+                self._note_quarantine(i, e)
+                _serial(i, now)
+                return
+            att = attempts.get(i, 0) + 1
+            attempts[i] = att
+            if att > cfg.max_retries:
+                _fail(i, e)
+                return
+            self._note_retry(i, att, e)
+            retry_at.append((now + _retry_delay(
+                cfg.retry_backoff_s, att, cfg.retry_jitter_seed, i), i))
+
+        def _dispatch(i):
+            now = time.monotonic()
+            fut = self._submit(pool_b, _phase_b, _b_args(i), i)
+            if fut is None:  # quarantined → serial fallback, right now
+                _serial(i, now)
+                return
+            inflight[i] = now
+            fut.add_done_callback(functools.partial(_on_b, i, now))
+
+        def _on_b(i, t_submit, fut):  # pool callback thread
+            try:
+                res = fut.result()
+            except BaseException as e:
+                failures.put((i, e))
+            else:
+                self._publish_b(i, t_submit, res)
+
+        while True:
+            with self._claim_lock:
+                remaining -= self._claimed
+            if not remaining:
+                return
+            now = time.monotonic()
+            # Due retries first: a recovering shard is the consumer's
+            # critical path (shards() yields in plan order).
+            due = [i for t, i in retry_at if t <= now]
+            retry_at[:] = [(t, i) for t, i in retry_at if t > now]
+            for i in due:
+                if i in remaining and not _is_claimed(i):
+                    _dispatch(i)
+            while True:
+                try:
+                    i, e = failures.get_nowait()
+                except queue.Empty:
+                    break
+                _handle_failure(i, e)
+            if cfg.straggler_timeout_s is not None:
+                for i in sorted(remaining):
+                    t0 = inflight.get(i)
+                    if (t0 is None or _is_claimed(i)
+                            or now - t0 <= cfg.straggler_timeout_s):
+                        continue
+                    waited = now - t0
+                    inflight.pop(i, None)
+                    self.fault_stats["stragglers"] += 1
+                    logger.warning(
+                        "staging[%s]: shard %d exceeded the straggler "
+                        "deadline (%.2fs > %.2fs) — re-staging serially; "
+                        "the late pool result will be discarded",
+                        self._label, i, waited, cfg.straggler_timeout_s)
+                    self._emitter.emit(ev_mod.StagingStraggler(
+                        label=self._label, index=i,
+                        waited_seconds=waited))
+                    _serial(i, t0)
+            # Depth-bounded submission in plan order; when submission is
+            # blocked on the depth bound, keep ticking so retries and
+            # straggler checks stay live (a blocking acquire here would
+            # freeze the ladder while the consumer catches up).
+            if to_submit:
+                if self._sem.acquire(timeout=0.05):
+                    _dispatch(to_submit.pop(0))
+                continue
+            timeout = 0.1
+            if retry_at:
+                timeout = min(timeout,
+                              max(0.005, min(t for t, _ in retry_at) - now))
+            if cfg.straggler_timeout_s is not None:
+                timeout = min(timeout,
+                              max(0.005, cfg.straggler_timeout_s / 4))
+            try:
+                i, e = failures.get(timeout=timeout)
+            except queue.Empty:
+                continue
+            _handle_failure(i, e)
 
     def _shard_done(self):
         with self._state_lock:

@@ -28,21 +28,43 @@ performs anyway.
 
 Anything unreadable (version skew, partial copy, foreign files) is
 treated as a per-shard miss — the caller restages and overwrites.
+Corruption that keeps a parseable npy header (bit rot, a torn page, an
+injected fault) is caught by the per-file CRC32 recorded in the commit
+marker: loads verify every array's checksum before trusting the shard
+(docs/ROBUSTNESS.md), so a corrupt shard degrades to a restage of
+exactly that shard — never silently wrong staged bytes.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import tempfile
+import zlib
 from typing import Optional
 
 import numpy as np
 
+from photon_ml_tpu import faults as flt
+
+logger = logging.getLogger("photon_ml_tpu.game")
+
 # Bump when the staged representation changes shape/meaning. v2: whole-
 # bucket tuples became per-shard (lane-slice) tuples with commit markers.
-STAGING_VERSION = 2
+# v3: markers carry per-file CRC32s; loads verify before trusting.
+STAGING_VERSION = 3
+
+
+def file_crc32(path: str) -> int:
+    """CRC32 of a file's bytes (chunked; the integrity check of cache
+    shards and checkpoint artifacts)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while chunk := f.read(1 << 20):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 
 def staging_key(dataset, norm, **params) -> str:
@@ -78,14 +100,22 @@ def _atomic_write(path: str, write_fn) -> None:
 
 def save_shard(cache_dir: str, key: str, index: int,
                arrays: tuple[np.ndarray, ...]) -> None:
-    """Persist one staged shard; the ``.ok`` marker commits it last."""
+    """Persist one staged shard; the ``.ok`` marker (carrying each
+    array file's CRC32) commits it last."""
+    flt.fire("staging_cache.save_shard", index=index)
     path = os.path.join(cache_dir, key)
     os.makedirs(path, exist_ok=True)
+    crcs = []
     for j, a in enumerate(arrays):
-        _atomic_write(os.path.join(path, f"s{index}_{j}.npy"),
+        fpath = os.path.join(path, f"s{index}_{j}.npy")
+        _atomic_write(fpath,
                       lambda f, _a=a: np.save(f, np.asarray(_a),
                                               allow_pickle=False))
-    marker = json.dumps({"arity": len(arrays),
+        crcs.append(file_crc32(fpath))
+        # Injected bit rot lands AFTER the checksum was taken over the
+        # good bytes — the torn-page/bit-rot shape CRC must catch.
+        flt.corrupt_file("staging_cache.shard_file", fpath, index=index)
+    marker = json.dumps({"arity": len(arrays), "crc": crcs,
                          "version": STAGING_VERSION}).encode()
     _atomic_write(os.path.join(path, f"s{index}.ok"),
                   lambda f: f.write(marker))
@@ -94,19 +124,32 @@ def save_shard(cache_dir: str, key: str, index: int,
 def load_shard(cache_dir: str, key: str, index: int
                ) -> Optional[tuple[np.ndarray, ...]]:
     """One staged shard (memory-mapped, read-only), or None on any miss:
-    no marker, version skew, or unreadable arrays (truncation included —
-    np.load validates the header)."""
+    no marker, version skew, unreadable arrays (truncation included —
+    np.load validates the header), or a CRC mismatch against the commit
+    marker (silent corruption)."""
     path = os.path.join(cache_dir, key)
     try:
+        flt.fire("staging_cache.load_shard", index=index)
         with open(os.path.join(path, f"s{index}.ok")) as f:
             marker = json.load(f)
         if marker.get("version") != STAGING_VERSION:
             return None
-        return tuple(
-            np.load(os.path.join(path, f"s{index}_{j}.npy"),
-                    mmap_mode="r", allow_pickle=False)
-            for j in range(int(marker["arity"])))
+        crcs = marker["crc"]
+        files = [os.path.join(path, f"s{index}_{j}.npy")
+                 for j in range(int(marker["arity"]))]
+        for fpath, want in zip(files, crcs):
+            got = file_crc32(fpath)
+            if got != want:
+                logger.warning(
+                    "staging cache shard %s is corrupt (crc %08x != "
+                    "committed %08x) — treating as a miss and restaging",
+                    fpath, got, want)
+                return None
+        return tuple(np.load(fpath, mmap_mode="r", allow_pickle=False)
+                     for fpath in files)
     except Exception:
+        logger.debug("staging cache miss for %s shard %d",
+                     key, index, exc_info=True)
         return None
 
 
@@ -145,6 +188,8 @@ def load_subspace(cache_dir: str, key: str,
                               mmap_mode="r", allow_pickle=False)
                 for name in meta["subspace"]}
     except Exception:
+        logger.debug("staging cache subspace miss for %s", key,
+                     exc_info=True)
         return None
 
 
@@ -181,4 +226,6 @@ def load(cache_dir: str, key: str
             for name in meta["subspace"]}
         return shards, subspace
     except Exception:
+        logger.debug("staging cache whole-entry miss for %s", key,
+                     exc_info=True)
         return None

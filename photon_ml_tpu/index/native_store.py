@@ -15,6 +15,7 @@ on-disk format, documented in pidx.cc.
 from __future__ import annotations
 
 import ctypes
+import logging
 import mmap
 import os
 import struct
@@ -184,6 +185,9 @@ class NativeIndexMap(IndexMap):
             try:
                 self._reader = _CppReader(path)
             except Exception:  # no g++ / load failure → same format, Python
+                logging.getLogger("photon_ml_tpu.index").debug(
+                    "native .pidx reader unavailable for %s — using the "
+                    "Python reader", path, exc_info=True)
                 self._reader = _PyReader(path)
 
     def get_index(self, key: str) -> int:

@@ -7,7 +7,9 @@ effects hash-sharded on host with an LRU device cache for hot entities —
 and streams micro-batched requests through a shape-bucketed jitted scorer.
 """
 
-from photon_ml_tpu.serving.batcher import MicroBatcher, bucket_batch
+from photon_ml_tpu.serving.batcher import (BatcherDied, BatcherQueueFull,
+                                           DeadlineExceeded, MicroBatcher,
+                                           bucket_batch)
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.model_store import (HashShardedStore,
                                                ResidentModelStore)
@@ -16,6 +18,9 @@ from photon_ml_tpu.serving.service import (ScoringRequest, ScoringService,
                                            requests_from_dataset)
 
 __all__ = [
+    "BatcherDied",
+    "BatcherQueueFull",
+    "DeadlineExceeded",
     "MicroBatcher",
     "bucket_batch",
     "ServingMetrics",

@@ -11,18 +11,54 @@ to a bucketed size so the jitted scorer never sees a new shape in steady
 state); the batcher's concern is time: one worker thread, one condition
 variable, futures for the callers. ``submit`` is thread-safe and returns a
 ``concurrent.futures.Future`` resolving to that request's score.
+
+Failure contract (docs/ROBUSTNESS.md) — a future returned by ``submit``
+ALWAYS resolves; nothing a flush does can strand a caller:
+
+- a flush that raises fails exactly its batch's futures and the loop
+  keeps serving;
+- a flush that returns the wrong number of scores fails the batch with a
+  defined error instead of leaving the unzipped tail pending forever;
+- the worker thread is SUPERVISED: if it dies anyway (a BaseException —
+  the injectable ``scoring-thread death`` fault class), every pending
+  future fails fast with ``BatcherDied`` and a fresh worker is started
+  (``restarts`` counts them; ``on_worker_death`` notifies the owner);
+- each request carries a deadline (``default_deadline_s`` /
+  per-``submit`` override): an entry that expires in the queue fails
+  with ``DeadlineExceeded`` rather than waiting unboundedly;
+- the queue is bounded (``max_queue``): when it is full, ``submit``
+  raises ``BatcherQueueFull`` immediately — admission control (load
+  shedding) instead of unbounded buffering.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
+
+logger = logging.getLogger("photon_ml_tpu.serving")
 
 
-@dataclass
+class BatcherQueueFull(RuntimeError):
+    """Admission control: the request queue is at ``max_queue``; the
+    caller should shed load (HTTP: 503) rather than buffer unboundedly."""
+
+
+class BatcherDied(RuntimeError):
+    """The worker thread died while this request was pending; the
+    request was NOT scored. The batcher restarts its worker — retrying
+    the request is safe."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before it was scored."""
+
+
+@dataclass(eq=False)  # identity semantics: requests may hold numpy arrays
 class _Entry:
     request: object
     future: Future = field(default_factory=Future)
@@ -30,6 +66,7 @@ class _Entry:
     # metric are DURATIONS — an NTP step against time.time() here either
     # starved flushes or fired them instantly (PML004).
     enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: Optional[float] = None  # monotonic; None = no deadline
 
 
 def bucket_batch(n: int, max_batch: int) -> int:
@@ -42,11 +79,17 @@ def bucket_batch(n: int, max_batch: int) -> int:
 
 
 class MicroBatcher:
-    """Background flusher over a bounded-delay request queue.
+    """Supervised background flusher over a bounded-delay request queue.
 
     ``flush_fn(entries)`` scores ``entries`` (a list of _Entry; at most
     ``max_batch``) and returns one float per entry, in order. It runs on
     the worker thread; exceptions propagate to every future in the flush.
+
+    ``max_queue`` bounds queued-but-unflushed entries (None = unbounded,
+    the pre-hardening behavior). ``default_deadline_s`` bounds how long
+    any entry may wait end-to-end (None = forever). ``on_worker_death``
+    is called (exception) after a worker-thread death, once per restart —
+    the service counts recoveries through it.
     """
 
     def __init__(
@@ -54,30 +97,114 @@ class MicroBatcher:
         flush_fn: Callable[[Sequence[_Entry]], Sequence[float]],
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        max_queue: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        on_worker_death: Optional[Callable[[BaseException], None]] = None,
+        on_deadline: Optional[Callable[[int], None]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._flush_fn = flush_fn
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait_ms) / 1e3
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.default_deadline = (None if default_deadline_s is None
+                                 else float(default_deadline_s))
+        self._on_worker_death = on_worker_death
+        self._on_deadline = on_deadline
         self._queue: list[_Entry] = []
+        self._inflight: list[_Entry] = []  # batch being flushed right now
         self._cond = threading.Condition()
         self._running = True
-        self._worker = threading.Thread(target=self._loop,
-                                        name="photon-serving-batcher",
-                                        daemon=True)
-        self._worker.start()
+        self.restarts = 0  # worker deaths recovered from
+        self.expired = 0  # entries failed on their deadline
+        self._worker = self._spawn_worker()
 
-    def submit(self, request) -> Future:
+    def _spawn_worker(self) -> threading.Thread:
+        t = threading.Thread(target=self._loop,
+                             name="photon-serving-batcher",
+                             daemon=True)
+        t.start()
+        return t
+
+    def submit(self, request, deadline_s: Optional[float] = None) -> Future:
+        """Queue one request. Raises ``BatcherQueueFull`` when admission
+        control rejects it; otherwise the returned future ALWAYS
+        resolves — with the score, the flush error, ``DeadlineExceeded``,
+        or ``BatcherDied``."""
         entry = _Entry(request)
+        ttl = self.default_deadline if deadline_s is None else deadline_s
+        if ttl is not None:
+            entry.deadline = entry.enqueued_at + float(ttl)
         with self._cond:
             if not self._running:
                 raise RuntimeError("batcher is closed")
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                raise BatcherQueueFull(
+                    f"scoring queue is full ({self.max_queue} pending); "
+                    f"shedding load")
             self._queue.append(entry)
             self._cond.notify()
         return entry.future
 
+    # -- worker ------------------------------------------------------------
+
+    def _expire_locked(self, now: float) -> list[_Entry]:
+        """Remove queued entries whose deadline passed (caller holds the
+        lock); their futures are failed OUTSIDE the lock by the caller."""
+        if not any(e.deadline is not None for e in self._queue):
+            return []
+        expired = [e for e in self._queue
+                   if e.deadline is not None and now >= e.deadline]
+        if expired:
+            dead = {id(e) for e in expired}
+            # pml: allow[PML005] every caller holds self._cond (the
+            # _locked suffix is the contract; asserted in tests)
+            self._queue = [e for e in self._queue if id(e) not in dead]
+        return expired
+
+    def _fail_entries(self, entries: Sequence[_Entry],
+                      exc: BaseException) -> None:
+        for e in entries:
+            if not e.future.done():
+                e.future.set_exception(exc)
+
     def _loop(self) -> None:
+        # Supervision wrapper: _serve only exits cleanly on close().
+        # ANYTHING escaping it — including BaseExceptions that sail past
+        # the per-flush handler — fails every pending future fast and
+        # restarts the worker, so no submitter ever hangs on a dead
+        # thread.
+        try:
+            self._serve()
+        except BaseException as exc:
+            self._recover(exc)
+
+    def _recover(self, exc: BaseException) -> None:
+        logger.exception("batcher worker died (%s) — failing pending "
+                         "futures and restarting", type(exc).__name__)
+        with self._cond:
+            # The batch that was mid-flush when the thread died is no
+            # longer queued — it must fail fast too, or its callers hang.
+            pending = self._inflight + self._queue
+            self._inflight = []
+            self._queue = []
+            restart = self._running
+            if restart:
+                self.restarts += 1
+                self._worker = self._spawn_worker()
+        self._fail_entries(pending, BatcherDied(
+            f"batcher worker died: {type(exc).__name__}: {exc}"))
+        if restart and self._on_worker_death is not None:
+            try:
+                self._on_worker_death(exc)
+            except Exception:
+                logger.exception("on_worker_death callback failed")
+
+    def _serve(self) -> None:
         while True:
             with self._cond:
                 while self._running and not self._queue:
@@ -85,27 +212,61 @@ class MicroBatcher:
                 if not self._running and not self._queue:
                     return
                 # Wait out the remainder of the oldest entry's window
-                # unless the batch is already full (or we're draining).
-                deadline = self._queue[0].enqueued_at + self.max_wait
-                while (self._running
+                # unless the batch is already full (or we're draining);
+                # entries whose own deadline expires first are failed,
+                # not flushed.
+                expired = self._expire_locked(time.monotonic())
+                deadline = (self._queue[0].enqueued_at + self.max_wait
+                            if self._queue else 0.0)
+                while (self._running and self._queue
                        and len(self._queue) < self.max_batch
                        and (left := deadline - time.monotonic()) > 0):
-                    self._cond.wait(timeout=left)
+                    entry_deadlines = [e.deadline for e in self._queue
+                                       if e.deadline is not None]
+                    if entry_deadlines:
+                        left = min(left, max(
+                            0.0, min(entry_deadlines) - time.monotonic()))
+                    self._cond.wait(timeout=max(left, 1e-4))
+                    expired.extend(self._expire_locked(time.monotonic()))
+                    deadline = (self._queue[0].enqueued_at + self.max_wait
+                                if self._queue else 0.0)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
+                self._inflight = batch
+            if expired:
+                self.expired += len(expired)
+                self._fail_entries(expired, DeadlineExceeded(
+                    "request expired in the scoring queue"))
+                if self._on_deadline is not None:
+                    try:
+                        self._on_deadline(len(expired))
+                    except Exception:
+                        logger.exception("on_deadline callback failed")
+            if not batch:
+                continue
             try:
                 scores = self._flush_fn(batch)
+                if len(scores) != len(batch):
+                    # A silent zip() over a short result left the tail
+                    # pending FOREVER pre-hardening; fail loudly instead.
+                    raise RuntimeError(
+                        f"flush returned {len(scores)} scores for "
+                        f"{len(batch)} requests")
                 for entry, score in zip(batch, scores):
-                    entry.future.set_result(score)
-            except Exception as exc:  # propagate to callers, keep serving
-                for entry in batch:
                     if not entry.future.done():
-                        entry.future.set_exception(exc)
+                        entry.future.set_result(score)
+            except Exception as exc:  # propagate to callers, keep serving
+                self._fail_entries(batch, exc)
+            # NOT a finally: a BaseException must leave _inflight set so
+            # the supervisor (_recover) can fail this batch fast.
+            with self._cond:
+                self._inflight = []
 
     def close(self) -> None:
         """Drain the queue, then stop the worker (idempotent)."""
         with self._cond:
             self._running = False
+            worker = self._worker
             self._cond.notify_all()
-        if self._worker.is_alive():
-            self._worker.join()
+        if worker.is_alive():
+            worker.join()

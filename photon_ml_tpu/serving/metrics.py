@@ -93,6 +93,14 @@ class ServingMetrics:
         self.batches_total = 0
         self.compiles_total = 0  # distinct jitted batch shapes built
         self.cache: dict[str, CacheCounters] = {}  # coordinate id → counts
+        # Robustness counters (docs/ROBUSTNESS.md): every degradation is
+        # observable, or the hardening is unverifiable in production.
+        self.shed_total = 0  # requests rejected by admission control
+        self.deadline_exceeded_total = 0  # requests expired in the queue
+        self.flush_errors_total = 0  # batches whose flush raised
+        self.retries_total = 0  # transient host-store fetch retries
+        self.recoveries_total = 0  # batcher worker deaths recovered from
+        self.http_errors_total: dict[int, int] = {}  # status code → count
 
     def coordinate(self, cid: str) -> CacheCounters:
         with self._lock:
@@ -113,6 +121,31 @@ class ServingMetrics:
     def record_compile(self) -> None:
         with self._lock:
             self.compiles_total += 1
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed_total += n
+
+    def record_deadline_exceeded(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_exceeded_total += n
+
+    def record_flush_error(self) -> None:
+        with self._lock:
+            self.flush_errors_total += 1
+
+    def record_retry(self, n: int = 1) -> None:
+        with self._lock:
+            self.retries_total += n
+
+    def record_recovery(self) -> None:
+        with self._lock:
+            self.recoveries_total += 1
+
+    def record_http_error(self, code: int) -> None:
+        with self._lock:
+            self.http_errors_total[code] = \
+                self.http_errors_total.get(code, 0) + 1
 
     def record_cache(self, cid: str, hits: int = 0, misses: int = 0,
                      unseen: int = 0, evictions: int = 0) -> None:
@@ -146,6 +179,12 @@ class ServingMetrics:
                 "batch_fill_ratio": self.fill_ratio(),
                 "throughput_rows_per_sec": self.throughput_rows_per_sec(),
                 "compiles_total": self.compiles_total,
+                "shed_total": self.shed_total,
+                "deadline_exceeded_total": self.deadline_exceeded_total,
+                "flush_errors_total": self.flush_errors_total,
+                "retries_total": self.retries_total,
+                "recoveries_total": self.recoveries_total,
+                "http_errors_total": dict(self.http_errors_total),
                 "request_latency": self.request_latency.summary(),
                 "batch_latency": self.batch_latency.summary(),
                 "re_cache": {cid: c.summary()
@@ -163,7 +202,16 @@ class ServingMetrics:
             f"photon_serving_throughput_rows_per_sec "
             f"{s['throughput_rows_per_sec']:.3f}",
             f"photon_serving_compiles_total {s['compiles_total']}",
+            f"photon_serving_shed_total {s['shed_total']}",
+            f"photon_serving_deadline_exceeded_total "
+            f"{s['deadline_exceeded_total']}",
+            f"photon_serving_flush_errors_total {s['flush_errors_total']}",
+            f"photon_serving_retries_total {s['retries_total']}",
+            f"photon_serving_recoveries_total {s['recoveries_total']}",
         ]
+        for code, n in sorted(s["http_errors_total"].items()):
+            lines.append(
+                f"photon_serving_http_errors_total{{code=\"{code}\"}} {n}")
         for name, h in (("request", s["request_latency"]),
                         ("batch", s["batch_latency"])):
             lines.append(f"photon_serving_{name}_latency_count {h['count']}")

@@ -30,11 +30,14 @@ from __future__ import annotations
 import collections
 import logging
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu import faults as flt
 
 from photon_ml_tpu.game.factored import FactoredRandomEffectModel
 from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
@@ -112,6 +115,34 @@ class HashShardedStore:
                    for a in payload)
 
 
+# The single-process HashShardedStore cannot really fail, but its fetch
+# is the seam that becomes a host RPC in the multi-host layout — so the
+# retry contract lives HERE, where the distributed failure will surface.
+_FETCH_RETRIES = 2
+_FETCH_BACKOFF_S = 0.01
+
+
+def _fetch_with_retry(store: "HashShardedStore", ids: np.ndarray,
+                      on_retry: Optional[Callable[[int], None]] = None
+                      ) -> np.ndarray:
+    """Bounded retry around the host-store fetch (transient I/O fault
+    class; ``serving.fetch`` is the injection site)."""
+    attempt = 0
+    while True:
+        try:
+            flt.fire("serving.fetch")
+            return store.fetch(ids)
+        except OSError as e:
+            attempt += 1
+            if attempt > _FETCH_RETRIES:
+                raise
+            logger.warning("host-store fetch attempt %d failed (%s: %s) "
+                           "— retrying", attempt, type(e).__name__, e)
+            if on_retry is not None:
+                on_retry(1)
+            time.sleep(_FETCH_BACKOFF_S * attempt)
+
+
 class REServingState:
     """One random-effect coordinate's host store + LRU device cache."""
 
@@ -137,7 +168,9 @@ class REServingState:
         self._insert = jax.jit(
             lambda cache, slots, rows: cache.at[slots].set(rows))
 
-    def resolve(self, ids: np.ndarray) -> tuple[np.ndarray, dict]:
+    def resolve(self, ids: np.ndarray,
+                on_retry: Optional[Callable[[int], None]] = None
+                ) -> tuple[np.ndarray, dict]:
         """Entity ids → device-cache slots, filling the cache for misses.
 
         Returns (slots int32 (n,), counters dict). Ids outside [0, E) map
@@ -190,7 +223,8 @@ class REServingState:
                 unique[e] = slot
                 self._lru[e] = slot
             fetch_ids = np.fromiter(unique, np.int64, len(unique))
-            rows = self.store.fetch(fetch_ids)
+            rows = _fetch_with_retry(self.store, fetch_ids,
+                                     on_retry=on_retry)
             k = _next_pow2(len(unique))
             ins_slots = np.full(k, self.fallback_slot, np.int32)
             ins_rows = np.zeros((k, self.dim), np.float32)
@@ -215,9 +249,11 @@ class ResidentModelStore:
         cache_entities: int = 4096,
         store_shards: int = 8,
         entity_vocabs: Optional[dict[str, dict]] = None,
+        metrics_retry: Optional[Callable[[int], None]] = None,
     ):
         self.task = model.task
         self.entity_vocabs = entity_vocabs or {}
+        self._metrics_retry = metrics_retry
         self.fixed: list[tuple[str, str, jax.Array]] = []
         self.random: list[REServingState] = []
         self.shard_dims: dict[str, int] = {}
@@ -270,7 +306,8 @@ class ResidentModelStore:
         out = {}
         with self._lock:
             for st in self.random:
-                slots, stats = st.resolve(ids_by_cid[st.cid])
+                slots, stats = st.resolve(ids_by_cid[st.cid],
+                                          on_retry=self._metrics_retry)
                 if metrics is not None:
                     metrics.record_cache(st.cid, **stats)
                 out[st.cid] = slots

@@ -28,6 +28,7 @@ import json
 import logging
 import threading
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
@@ -35,10 +36,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import faults as flt
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops import losses as losses_mod
-from photon_ml_tpu.serving.batcher import MicroBatcher, bucket_batch
+from photon_ml_tpu.serving.batcher import (BatcherQueueFull,
+                                           DeadlineExceeded, MicroBatcher,
+                                           bucket_batch)
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.model_store import ResidentModelStore
 from photon_ml_tpu.utils.events import (ScoringBatch, ScoringFinish,
@@ -99,6 +103,8 @@ class ScoringService:
         cache_entities: int = 4096,
         store_shards: int = 8,
         entity_vocabs: Optional[dict[str, dict]] = None,
+        max_queue: Optional[int] = None,
+        request_deadline_s: Optional[float] = 30.0,
         emitter=default_emitter,
     ):
         # A flush's unique entities must fit the cache simultaneously
@@ -106,7 +112,8 @@ class ScoringService:
         # is at least max_batch.
         self.store = ResidentModelStore(
             model, cache_entities=max(int(cache_entities), int(max_batch)),
-            store_shards=store_shards, entity_vocabs=entity_vocabs)
+            store_shards=store_shards, entity_vocabs=entity_vocabs,
+            metrics_retry=self._record_store_retry)
         self.as_mean = bool(as_mean)
         self.max_batch = int(max_batch)
         self.metrics = ServingMetrics()
@@ -114,10 +121,29 @@ class ScoringService:
         self._lock = threading.Lock()  # serializes resolve+score per flush
         self._compile_keys: set[int] = set()
         self._score_fn = self._build_score_fn()
-        self.batcher = MicroBatcher(self._flush, max_batch=max_batch,
-                                    max_wait_ms=max_wait_ms)
+        # Admission control default: a queue much deeper than 16 full
+        # batches only buys latency nobody asked for — shed instead
+        # (docs/ROBUSTNESS.md degradation ladder).
+        self.max_queue = (16 * self.max_batch if max_queue is None
+                          else int(max_queue))
+        self.request_deadline_s = request_deadline_s
+        self.batcher = MicroBatcher(
+            self._flush, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue=self.max_queue,
+            default_deadline_s=request_deadline_s,
+            on_worker_death=self._on_worker_death,
+            on_deadline=self.metrics.record_deadline_exceeded)
         self._closed = False
         emitter.emit(ScoringStart(source="serving", num_rows=None))
+
+    def _on_worker_death(self, exc: BaseException) -> None:
+        self.metrics.record_recovery()
+        logger.error("scoring worker died (%s: %s) — pending requests "
+                     "failed fast, worker restarted", type(exc).__name__,
+                     exc)
+
+    def _record_store_retry(self, n: int = 1) -> None:
+        self.metrics.record_retry(n)
 
     # -- jitted scorer -----------------------------------------------------
 
@@ -214,13 +240,29 @@ class ScoringService:
             scores[lo: lo + len(chunk)] = self._score_chunk(chunk)
         return scores
 
-    def submit(self, request: ScoringRequest):
+    def submit(self, request: ScoringRequest,
+               deadline_s: Optional[float] = None):
         """Queue one request through the micro-batcher; returns a Future
-        resolving to its score (cross-caller batching happens here)."""
-        return self.batcher.submit(request)
+        resolving to its score (cross-caller batching happens here).
+        Raises ``BatcherQueueFull`` when admission control sheds the
+        request (counted in ``shed_total``); the returned future always
+        resolves — score, error, or ``DeadlineExceeded``."""
+        try:
+            return self.batcher.submit(request, deadline_s=deadline_s)
+        except BatcherQueueFull:
+            self.metrics.record_shed()
+            raise
 
     def _flush(self, entries):
-        scores = self._score_chunk([e.request for e in entries])
+        try:
+            # Injection site first: a fault here is indistinguishable
+            # from the scorer failing (InjectedThreadDeath, being a
+            # BaseException, still sails through to the supervisor).
+            flt.fire("serving.flush")
+            scores = self._score_chunk([e.request for e in entries])
+        except Exception:
+            self.metrics.record_flush_error()
+            raise
         done = time.monotonic()  # same clock as _Entry.enqueued_at
         for e in entries:
             self.metrics.record_request_latency(done - e.enqueued_at)
@@ -290,25 +332,50 @@ class _ServingHandler(BaseHTTPRequestHandler):
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
+    def _error(self, code: int, message: str) -> None:
+        """One JSON error body + one metrics increment — every failure
+        leaves through here, never as an unhandled exception on the
+        handler thread (which would reset the connection with no body
+        and no count)."""
+        self.service.metrics.record_http_error(code)
+        self._json(code, {"error": message})
+
     def do_POST(self):
         if self.path != "/score":
-            self._json(404, {"error": f"unknown path {self.path}"})
+            self._error(404, f"unknown path {self.path}")
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("request body must be a JSON object")
             reqs = [_parse_request(o) for o in payload.get("requests", [])]
-            if not reqs:
-                self._json(400, {"error": "no requests"})
-                return
+        except (ValueError, TypeError, AttributeError, KeyError) as exc:
+            # Malformed JSON / wrong shapes: the CALLER's fault — 400.
+            logger.warning("malformed scoring request: %s", exc)
+            self._error(400, f"malformed request: {exc}")
+            return
+        if not reqs:
+            self._error(400, "no requests")
+            return
+        try:
             futures = [self.service.submit(r) for r in reqs]
+        except BatcherQueueFull as exc:
+            # Admission control: shed with a Retry-After signal instead
+            # of buffering unboundedly (shed_total counts it).
+            self._error(503, str(exc))
+            return
+        try:
             scores = [float(f.result(timeout=self.result_timeout))
                       for f in futures]
-            self._json(200, {"scores": scores,
-                             "uids": [r.uid for r in reqs]})
-        except Exception as exc:
+        except (DeadlineExceeded, TimeoutError, _FutureTimeout) as exc:
+            self._error(504, f"scoring deadline exceeded: {exc}")
+            return
+        except Exception as exc:  # scoring/batcher error → 500 + count
             logger.exception("scoring request failed")
-            self._json(400, {"error": str(exc)})
+            self._error(500, f"scoring failed: {exc}")
+            return
+        self._json(200, {"scores": scores, "uids": [r.uid for r in reqs]})
 
     def log_message(self, fmt, *args):  # route access logs off stderr
         logger.debug("http: " + fmt, *args)

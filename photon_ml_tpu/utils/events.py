@@ -79,6 +79,39 @@ class StagingShard(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class StagingRetry(Event):
+    """One staged shard's task failed and is being retried (bounded,
+    jittered backoff — docs/ROBUSTNESS.md). ``attempt`` is 1-based."""
+
+    label: str
+    index: int
+    attempt: int
+    error: str
+
+
+@dataclasses.dataclass(frozen=True)
+class StagingStraggler(Event):
+    """One staged shard exceeded the straggler deadline and was
+    re-staged serially; the late pool result is discarded (content is
+    scheduling-independent, so either producer's bytes are THE bytes)."""
+
+    label: str
+    index: int
+    waited_seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointRecovered(Event):
+    """A corrupted checkpoint artifact failed its CRC and the manager
+    fell back to the previous committed generation (game/checkpoint.py).
+    ``done_steps`` is the step count of the RECOVERED state."""
+
+    directory: str
+    done_steps: int
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
 class StagingFinish(Event):
     """Every shard of one staging pipeline is produced (NOT necessarily
     consumed — consumption is the fit stream's side of the handoff)."""

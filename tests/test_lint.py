@@ -430,6 +430,107 @@ def test_pml007_clean_with_finally_and_cross_method_lifecycle():
     assert findings_for("PML007", src) == []
 
 
+# ---------------------------------------------------------------- PML008
+
+
+def test_pml008_flags_bare_except_pass_and_broad_swallows():
+    src = """
+        def load(path):
+            try:
+                return open(path).read()
+            except:
+                pass
+
+        def probe(fn):
+            try:
+                return fn()
+            except Exception:
+                return None
+
+        def sweep(fns):
+            out = []
+            for fn in fns:
+                try:
+                    out.append(fn())
+                except (ValueError, Exception):
+                    continue
+            return out
+    """
+    out = findings_for("PML008", src)
+    assert len(out) == 3
+    assert all(f.rule == "PML008" for f in out)
+    assert "bare except" in out[0].message
+
+
+def test_pml008_clean_when_raised_logged_routed_or_narrow():
+    src = """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def relayed(fn, q):
+            try:
+                return fn()
+            except BaseException as e:
+                q.put(e)              # routed to a supervisor
+
+        def logged(fn):
+            try:
+                return fn()
+            except Exception:
+                logger.exception("fn failed")
+                return None
+
+        def wrapped(fn):
+            try:
+                return fn()
+            except Exception as e:
+                raise RuntimeError("fn failed") from e
+
+        def futures(fn, fut):
+            try:
+                fut.set_result(fn())
+            except BaseException as exc:
+                fut.set_exception(exc)
+
+        def narrow(path):
+            try:
+                import os
+                os.unlink(path)
+            except OSError:
+                pass              # specific type: a reviewable decision
+    """
+    assert findings_for("PML008", src) == []
+
+
+def test_pml008_allow_comment_with_reason(tmp_path):
+    src = """
+        def probe(fn):
+            try:
+                return fn()
+            except Exception:  # pml: allow[PML008] miss-is-silent contract
+                return None
+    """
+    findings, unused = lint_source(tmp_path, src)
+    assert findings == [] and unused == []
+
+
+def test_pml008_flags_injected_regression_in_real_staging_cache(tmp_path):
+    """The real staging_cache.py is PML008-clean; strip its debug
+    logging from a load handler and the gate flips."""
+    real = os.path.join(REPO, "photon_ml_tpu", "game", "staging_cache.py")
+    src = open(real).read()
+    findings, _ = lint_source(tmp_path, src, name="staging_cache_ok.py")
+    assert [f for f in findings if f.rule == "PML008"] == []
+    broken = src.replace(
+        'logger.debug("staging cache miss for %s shard %d",\n'
+        '                     key, index, exc_info=True)', "pass", 1)
+    assert broken != src
+    findings, _ = lint_source(tmp_path, broken,
+                              name="staging_cache_broken.py")
+    assert any(f.rule == "PML008" for f in findings)
+
+
 # ------------------------------------------------------ suppressions
 
 
@@ -631,6 +732,6 @@ def test_cli_rejects_unknown_rule_and_reasonless_baseline_write(tmp_path):
 
 
 def test_rule_catalog_is_complete():
-    assert sorted(ALL_RULES) == [f"PML00{i}" for i in range(1, 8)]
+    assert sorted(ALL_RULES) == [f"PML00{i}" for i in range(1, 9)]
     for rid, (check, doc) in ALL_RULES.items():
         assert callable(check) and doc
