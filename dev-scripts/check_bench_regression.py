@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Fail if the staging lines of a fresh bench tail regress >20% vs the
-committed round baseline (BENCH_r05.json).
+"""Fail if the staging/ingest lines of a fresh bench tail regress >20%
+vs the committed round baseline (BENCH_r05.json).
 
-The guarded lines are the host-staging costs the parallel pipeline
-(photon_ml_tpu/game/staging.py, docs/STAGING.md) exists to bound:
+The guarded lines are the host-side cold-fit costs the parallel
+pipelines (photon_ml_tpu/game/staging.py + photon_ml_tpu/ingest,
+docs/STAGING.md + docs/INGEST.md) exist to bound:
 
   staging_bucketing_seconds            build_bucketing at 10M/1M scale
   staging_projection_seconds           SERIAL whole-bucket projection
@@ -12,12 +13,21 @@ The guarded lines are the host-staging costs the parallel pipeline
   sparse_re_staging_seconds            cold RE coordinate staging
   sparse_re_staging_warm_seconds       staging-cache warm restage
 
-plus one cross-line invariant: the NEW parallel projection line
-(staging_projection_parallel_seconds, absent from baselines before r06)
-must not regress the wall the serial pass set — it may never exceed the
-committed serial time by more than the same 20% band, whatever the
-worker count (at workers=1 parallel ≈ serial; at workers=N it should be
-far below).
+plus cross-line invariants computed within the fresh tail itself:
+
+  - the parallel projection line (staging_projection_parallel_seconds)
+    may never exceed the committed serial wall by more than the band;
+  - the parallel ingest rate (ingest_records_per_sec) may never fall
+    more than the band below the serial native rate measured in the
+    SAME tail (parallelism must not regress the serial wall);
+  - the columnar ingest cache's decode-layer warm speedup
+    (ingest_warm_cache_speedup) must stay >= 5x, band-adjusted — the
+    "warm restarts skip Avro decode" contract;
+  - the ingestion overlap invariant: end_to_end_cold_fit_seconds <=
+    1.15 x max(ingest_cold_seconds, staging_plus_fit_seconds).
+    Enforced on hosts with >= 4 cores (where parallel decode can
+    actually shrink the decode wall); reported-only on the 1-core CI
+    box, the same caveat as the staging multi-worker scaling note.
 
 Usage:
   check_bench_regression.py --fresh TAIL.json [--baseline BENCH_r05.json]
@@ -133,13 +143,57 @@ def main() -> int:
                 f"{b * band:.3g} — the parallel pipeline is slower than "
                 f"the committed serial wall")
 
+    # --- ingestion invariants (docs/INGEST.md), within the fresh tail ---
+    par_rate = fresh.get("ingest_records_per_sec")
+    serial_rate = fresh.get("avro_native_records_per_sec")
+    if par_rate is not None and serial_rate is not None:
+        floor = float(serial_rate) / band
+        verdict = "OK" if float(par_rate) >= floor else "REGRESSION"
+        print(f"ingest_records_per_sec "
+              f"(workers={fresh.get('ingest_workers', '?')}): fresh "
+              f"{par_rate:g} vs serial-native {serial_rate:g} "
+              f"(floor {floor:.3g}) {verdict}")
+        if float(par_rate) < floor:
+            failures.append(
+                f"ingest_records_per_sec: {par_rate:g} < {floor:.3g} — "
+                f"parallel ingest is slower than the serial native wall")
+    warm = fresh.get("ingest_warm_cache_speedup")
+    if warm is not None:
+        floor = 5.0 / band
+        verdict = "OK" if float(warm) >= floor else "REGRESSION"
+        print(f"ingest_warm_cache_speedup: fresh {warm:g}x vs the >= 5x "
+              f"contract (floor {floor:.3g}x) {verdict}")
+        if float(warm) < floor:
+            failures.append(
+                f"ingest_warm_cache_speedup: {warm:g}x < {floor:.3g}x — "
+                f"the warm mmap path no longer beats decode >= 5x")
+    e2e = fresh.get("end_to_end_cold_fit_seconds")
+    t_ing = fresh.get("ingest_cold_seconds")
+    t_fit = fresh.get("staging_plus_fit_seconds")
+    if e2e is not None and t_ing is not None and t_fit is not None:
+        limit = 1.15 * max(float(t_ing), float(t_fit))
+        cores = int(fresh.get("ingest_bench_cores", 0))
+        ok = float(e2e) <= limit
+        enforced = cores >= 4
+        verdict = ("OK" if ok else
+                   "REGRESSION" if enforced else
+                   "over limit (reported only: "
+                   f"{cores}-core host cannot shrink the decode wall)")
+        print(f"end_to_end_cold_fit_seconds: fresh {e2e:g} vs "
+              f"1.15 x max(ingest {t_ing:g}, staging+fit {t_fit:g}) "
+              f"= {limit:.3g} {verdict}")
+        if enforced and not ok:
+            failures.append(
+                f"end_to_end_cold_fit_seconds: {e2e:g} > {limit:.3g} — "
+                f"ingestion is serializing in front of the fit again")
+
     if failures:
         print(f"\n{len(failures)} staging regression(s) vs "
               f"{os.path.basename(args.baseline)}:")
         for f_ in failures:
             print(f"  - {f_}")
         return 1
-    print("\nstaging bench lines within "
+    print("\nstaging/ingest bench lines within "
           f"{args.tolerance:.0%} of {os.path.basename(args.baseline)}")
     return 0
 

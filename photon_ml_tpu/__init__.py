@@ -23,6 +23,7 @@ Layer map (mirrors SURVEY.md §1, re-architected):
 - ``optim/``      L-BFGS, OWL-QN, TRON, regularization, state tracking
 - ``parallel/``   mesh conventions + distributed objectives (the "comm backend")
 - ``data/``       LIBSVM/Avro ingestion, GameData columnar batches, bucketing
+- ``ingest/``     block-parallel Avro decode pipeline + columnar mmap cache
 - ``evaluation/`` AUC/RMSE/Poisson/precision@k + grouped (per-entity) metrics
 - ``game/``       coordinates + coordinate descent + scoring
 - ``api/``        GameEstimator / GameTransformer front doors

@@ -15,6 +15,7 @@ import dataclasses
 from typing import Optional, Union
 
 from photon_ml_tpu.game.staging import StagingConfig
+from photon_ml_tpu.ingest import IngestConfig
 from photon_ml_tpu.optim import (OptimizerConfig, OptimizerType,
                                  RegularizationContext, RegularizationType)
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
@@ -25,8 +26,10 @@ __all__ = [
     "CoordinateDataConfiguration",
     "FactoredRandomEffectDataConfiguration",
     "FixedEffectDataConfiguration",
+    "IngestConfig",
     "RandomEffectDataConfiguration",
     "StagingConfig",
+    "parse_ingest_config",
     "parse_kv",
     "parse_optimizer_config",
     "parse_staging_config",
@@ -217,6 +220,32 @@ def parse_staging_config(spec: str) -> StagingConfig:
                          else defaults.retry_backoff_s),
         straggler_timeout_s=(float(kv["straggler"])
                              if "straggler" in kv else None),
+    )
+
+
+def parse_ingest_config(spec: str) -> IngestConfig:
+    """Parse ``key=value,...`` mini-DSL for the parallel Avro ingestion
+    pipeline (photon_ml_tpu/ingest, docs/INGEST.md).
+
+    Keys: workers (decode pool size; default = host cores), mode
+    (thread|process), depth (max decoded-but-unfolded chunks),
+    chunk_records (target records per decode task). The columnar ingest
+    cache directory is a separate flag (``game_train
+    --ingest-cache-dir``), mirroring ``--staging-cache-dir``.
+    """
+    kv = parse_kv(spec)
+    known = {"workers", "mode", "depth", "chunk_records"}
+    unknown = set(kv) - known
+    if unknown:
+        raise ValueError(f"unknown ingest keys {sorted(unknown)}; "
+                         f"expected {sorted(known)}")
+    defaults = IngestConfig()
+    return IngestConfig(
+        workers=int(kv["workers"]) if "workers" in kv else None,
+        mode=kv.get("mode", "thread").lower(),
+        pipeline_depth=int(kv["depth"]) if "depth" in kv else None,
+        chunk_records=(int(kv["chunk_records"]) if "chunk_records" in kv
+                       else defaults.chunk_records),
     )
 
 

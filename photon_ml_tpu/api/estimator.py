@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
+                                       IngestConfig,
                                        RandomEffectDataConfiguration,
                                        StagingConfig)
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
@@ -65,6 +66,7 @@ class GameEstimator:
         compute_variances_at_end: bool = True,
         staging_cache_dir: Optional[str] = None,
         staging: Optional[StagingConfig] = None,
+        ingest: Optional[IngestConfig] = None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -82,6 +84,12 @@ class GameEstimator:
         # Parallel staging pipeline knobs (game/staging.py), shared by
         # every projected random-effect coordinate this estimator builds.
         self.staging = staging
+        # Parallel Avro ingestion knobs (photon_ml_tpu/ingest): the
+        # estimator consumes already-materialized GameDatasets, so this is
+        # the configuration surface for the drivers that read Avro on its
+        # behalf (game_train wires --ingest / --ingest-cache-dir through
+        # here and into AvroDataReader.read).
+        self.ingest = ingest
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization

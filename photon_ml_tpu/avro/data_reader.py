@@ -12,6 +12,7 @@ int32 entity-id columns mapped through per-RE-type vocabularies.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional, Sequence, Union
 
 import numpy as np
@@ -21,6 +22,14 @@ from photon_ml_tpu.data.game_data import (GameDataset, SparseShard,
                                           vocab_token)
 from photon_ml_tpu.index.indexmap import (DefaultIndexMap, INTERCEPT_KEY,
                                           IndexMap, feature_key)
+from photon_ml_tpu.utils import events as ev_mod
+
+logger = logging.getLogger("photon_ml_tpu.avro")
+
+# The committed BENCH_r05 rates the fallback warning quotes: the native
+# block decoder measured ~123k records/s against ~6k records/s for the
+# pure-Python codec on the same file (bench.py, bench_avro_ingest).
+_FALLBACK_RATE_GAP = "~20x slower (BENCH_r05: ~123k vs ~6k records/s)"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,13 +100,20 @@ class AvroDataReader:
         use_native: bool = True,
         allow_unseen_entities: bool = False,
         chunk_rows: int = 65536,
+        ingest=None,
     ):
         """Returns (GameDataset, ReadMeta).
 
         ``use_native=True`` (default) decodes supported schemas through the
-        C++ block decoder (native/avro_decode.cc) with vectorized columnar
-        assembly — identical results to the pure-Python path, which remains
-        the fallback for exotic schemas or when no toolchain is available.
+        C++ block decoder (native/avro_decode.cc), block-parallel and
+        pipelined (photon_ml_tpu/ingest, knobs via ``ingest=
+        IngestConfig(...)`` including the columnar warm-restart cache) with
+        vectorized columnar assembly — identical results to the pure-Python
+        path, which remains the fallback for exotic schemas or when no
+        toolchain is available. The fallback is LOUD: it logs the measured
+        rate gap and emits an ``IngestFallback`` event, because silently
+        degrading ~20x on the cold-fit input layer cost a round of
+        benchmarking to notice (docs/INGEST.md).
 
         ``allow_unseen_entities=True`` makes a frozen ``entity_vocabs``
         EXTENSIBLE: ids absent from it get fresh rows appended after the
@@ -122,11 +138,19 @@ class AvroDataReader:
         if isinstance(paths, str):
             paths = [paths]
         if use_native:
-            out = self._read_native(paths, feature_shard_configs,
-                                    random_effect_types, index_maps,
-                                    entity_vocabs, allow_unseen_entities)
+            out, fallback = self._read_native(
+                paths, feature_shard_configs, random_effect_types,
+                index_maps, entity_vocabs, allow_unseen_entities,
+                ingest=ingest)
             if out is not None:
                 return out
+            if fallback:
+                logger.warning(
+                    "avro ingest is falling back to the pure-Python "
+                    "codec — %s — reason: %s (docs/INGEST.md)",
+                    _FALLBACK_RATE_GAP, fallback)
+                ev_mod.default_emitter.emit(
+                    ev_mod.IngestFallback(reason=fallback))
 
         def stream():
             for p in paths:
@@ -178,18 +202,29 @@ class AvroDataReader:
 
     def _read_native(self, paths, feature_shard_configs,
                      random_effect_types, index_maps, entity_vocabs,
-                     allow_unseen_entities=False):
-        """Vectorized read over native/avro_decode.cc columns; None →
-        caller falls back to the per-record Python loop. Semantics are
-        kept IDENTICAL to that loop: encounter-order index maps,
-        first-occurrence entity vocabularies, accumulate-then-set-intercept
-        feature assembly, and the same error conditions."""
+                     allow_unseen_entities=False, ingest=None):
+        """Vectorized read over native/avro_decode.cc columns, block-
+        parallel and pipelined (photon_ml_tpu/ingest): the inputs split
+        at sync-marker boundaries, decode workers fan over the chunks,
+        and this thread folds each chunk's columns in plan order as it
+        arrives — so decode and fold overlap, and warm restarts
+        memory-map the columnar ingest cache instead of decoding.
+
+        Returns ``(result, fallback_reason)``; ``result is None`` means
+        the caller falls back to the per-record Python loop (loudly when
+        ``fallback_reason`` is set; a None reason means the Python path
+        is about to raise its own error). Semantics are kept IDENTICAL
+        to that loop: encounter-order index maps, first-occurrence
+        entity vocabularies, accumulate-then-set-intercept feature
+        assembly, and the same error conditions."""
         import os
 
+        from photon_ml_tpu import ingest as ing
         from photon_ml_tpu.avro import native_decode as nd
 
         if not nd.native_available():
-            return None
+            return None, ("the native Avro decoder is unavailable (no "
+                          "C++ toolchain, or PHOTON_TPU_NO_NATIVE_AVRO=1)")
         files: list[str] = []
         for p in paths:
             if os.path.isdir(p):
@@ -199,7 +234,9 @@ class AvroDataReader:
             elif os.path.exists(p):
                 files.append(p)
             else:
-                return None  # let the Python path raise its own error
+                # Let the Python path raise its own error (not a silent
+                # degradation — the read fails either way).
+                return None, None
         if not files:
             raise ValueError(f"no records under {list(paths)}")
 
@@ -215,20 +252,52 @@ class AvroDataReader:
             fields.metadata: (nd.CAP_META, 0),
         }
         if len(captures) != 5:
-            return None  # colliding field-name preset: fall back
+            return None, "colliding field-name preset"
         for k, b in enumerate(bag_names):
             if b in captures:
-                return None
+                return None, (f"feature bag {b!r} collides with a "
+                              f"scalar field name")
             captures[b] = (nd.CAP_BAG, k)
         bag_pos = {b: k for k, b in enumerate(bag_names)}
 
-        # Decode. With ``index_maps`` given (the production frozen-feature-
-        # space flow), each file's decoded columns are folded into compact
-        # accumulators and FREED before the next file is touched — peak
-        # memory is the output arrays plus one partition. Without maps the
-        # feature space must be known before columns can be mapped, so all
-        # files stay decoded until the union key tables are built (the
-        # one-pass trade; pass index_maps to bound memory).
+        # Block scan + per-file decode plans. Any file whose writer
+        # schema the native plan compiler cannot express sends the WHOLE
+        # read down the Python path (one feature space, one code path).
+        forbidden = frozenset(random_effect_types)
+        fbs: list[ing.FileBlocks] = []
+        plans: list[np.ndarray] = []
+        for f in files:
+            fb = ing.scan_file(f)
+            schema = fb.schema
+            if isinstance(schema, dict) and any(
+                    fld.get("name") in forbidden
+                    for fld in schema.get("fields", ())):
+                return None, (f"{f}: an entity id is a top-level record "
+                              f"field (metadataMap layout required)")
+            plan = nd.compile_plan(schema, captures)
+            if plan is None:
+                return None, f"{f}: schema outside the native family"
+            fbs.append(fb)
+            plans.append(plan)
+        if not sum(fb.num_records for fb in fbs):
+            raise ValueError(f"no records under {list(paths)}")
+
+        config = ingest or ing.IngestConfig()
+        chunks = ing.plan_chunks(fbs, config.chunk_records)
+        cache_key = None
+        if config.cache_dir:
+            cache_key = ing.ingest_key(fbs, captures, len(bag_names),
+                                       config.chunk_records)
+        pipe = ing.IngestPipeline(chunks, plans, n_bags=len(bag_names),
+                                  config=config, cache_key=cache_key)
+
+        # Fold. With ``index_maps`` given (the production frozen-feature-
+        # space flow), each chunk's decoded columns are folded into compact
+        # accumulators and FREED before the next chunk is folded — peak
+        # memory is the output arrays plus the pipeline's depth bound.
+        # Without maps the feature space must be known before columns can
+        # be mapped, so all chunks stay decoded until the union key tables
+        # are built (the one-pass trade; pass index_maps to bound memory).
         incremental = index_maps is not None
         decoded: list = []
         scal_chunks: list[tuple] = []  # (response, offsets, weights, uids)
@@ -254,24 +323,19 @@ class AvroDataReader:
                         continue
                     lut = np.asarray([imap.get_index(s)
                                       for s in bag.key_strings], np.int64)
-                    cols = lut[bag.keys]
+                    cols = lut[np.asarray(bag.keys)]
                     keep = cols >= 0
                     coo_chunks[shard].append(
-                        (bag.rows[keep] + base, cols[keep],
-                         bag.values[keep]))
+                        (np.asarray(bag.rows)[keep] + base, cols[keep],
+                         np.asarray(bag.values)[keep]))
 
-        for f in files:
-            d = nd.decode_file(f, captures, n_bags=len(bag_names),
-                               forbidden_fields=frozenset(
-                                   random_effect_types))
-            if d is None:
-                return None  # exotic schema: Python codec takes over
+        for d in pipe.chunks():
             if incremental:
                 fold_scalars(d, n)
                 fold_features(d, n)
                 # Entity ids still need the string tables; keep only those
-                # and DROP the bag/scalar columns before the next decode
-                # (otherwise two partitions peak-coexist).
+                # and DROP the bag/scalar columns before the next fold
+                # (otherwise chunks peak-coexist beyond the depth bound).
                 decoded.append(_MetaOnly(d))
                 n += d.num_records
                 del d
@@ -414,9 +478,12 @@ class AvroDataReader:
                 for shard, cfg in feature_shard_configs.items()
             },
             vocab_tokens=_make_vocab_tokens(entity_vocabs, vocabs),
+            entity_counts={
+                t: np.bincount(col, minlength=len(vocabs[t]))
+                for t, col in id_cols.items()},
         )
-        return ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
-                            uids=uids)
+        return (ds, ReadMeta(index_maps=index_maps, entity_vocabs=vocabs,
+                             uids=uids)), None
 
 
 class _MetaOnly:
@@ -573,19 +640,23 @@ class _ChunkAccumulator:
                            labels=response, num_features=d)
             feature_shards[s] = SparseShard(
                 indices=ell.indices, values=ell.values, num_features=d)
+        id_cols = {t: np.concatenate(chunks)
+                   for t, chunks in self._ids.items()}
         ds = GameDataset(
             response=response,
             offsets=np.concatenate(self._offsets),
             weights=np.concatenate(self._weights),
             feature_shards=feature_shards,
-            entity_ids={t: np.concatenate(chunks)
-                        for t, chunks in self._ids.items()},
+            entity_ids=id_cols,
             num_entities={t: len(v) for t, v in self.vocabs.items()},
             intercept_index={
                 s: (self.index_maps[s].get_index(INTERCEPT_KEY)
                     if c.has_intercept else None)
                 for s, c in self.cfgs.items()
             },
+            entity_counts={
+                t: np.bincount(col, minlength=len(self.vocabs[t]))
+                for t, col in id_cols.items()},
         )
         return ds, np.concatenate(self._uids)
 

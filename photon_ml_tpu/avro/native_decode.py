@@ -47,6 +47,9 @@ def _load():
         lib = ctypes.CDLL(build_library("avro_decode", link=("-lz",)))
         lib.pavro_open.restype = ctypes.c_void_p
         lib.pavro_open.argtypes = [ctypes.c_char_p]
+        lib.pavro_open_range.restype = ctypes.c_void_p
+        lib.pavro_open_range.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                         ctypes.c_long, ctypes.c_long]
         lib.pavro_error.restype = ctypes.c_int
         lib.pavro_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int]
@@ -289,68 +292,99 @@ def decode_file(path: str, captures: dict[str, tuple[int, int]],
         plan = compile_plan(schema, captures)
         if plan is None:
             return None
-        n = lib.pavro_decode(h, plan, len(plan), n_bags)
-        if n < 0:
-            lib.pavro_error(h, err, 512)
-            raise ValueError(f"{path}: {err.value.decode()}")
-        n = int(n)
-        response = np.zeros(max(1, n), np.float64)
-        offsets = np.zeros(max(1, n), np.float64)
-        weights = np.zeros(max(1, n), np.float64)
-        uid_kind = np.zeros(max(1, n), np.uint8)
-        uid_long = np.zeros(max(1, n), np.int64)
-        if n:
-            lib.pavro_fill_scalars(h, response, offsets, weights, uid_kind,
-                                   uid_long)
-        # uids: local row index by default; vectorized fancy-index
-        # assignment for the records that carried one (no per-record
-        # interpreter loop on the hot ingestion path).
-        uids = np.arange(n).astype(object)
-        has_long = uid_kind[:n] == 2
-        if has_long.any():
-            uids[has_long] = uid_long[:n][has_long].tolist()
-        has_str = uid_kind[:n] == 1
-        if has_str.any():
-            uid_strs = _strings(
-                n, int(lib.pavro_uid_strs_len(h)),
-                lambda b, o: lib.pavro_fill_uid_strs(h, b, o))
-            uids[has_str] = np.asarray(uid_strs, object)[has_str]
-        bags = []
-        for b in range(n_bags):
-            nnz = int(lib.pavro_bag_nnz(h, b))
-            rows = np.zeros(max(1, nnz), np.int64)
-            keys = np.zeros(max(1, nnz), np.int32)
-            values = np.zeros(max(1, nnz), np.float64)
-            if nnz:
-                lib.pavro_fill_bag(h, b, rows, keys, values)
-            key_strings = _strings(
-                int(lib.pavro_bag_nkeys(h, b)),
-                int(lib.pavro_bag_keys_len(h, b)),
-                lambda bb, oo, _b=b: lib.pavro_fill_bag_keys(h, _b, bb, oo))
-            bags.append(BagColumns(rows[:nnz], keys[:nnz], values[:nnz],
-                                   key_strings))
-        mcount = int(lib.pavro_meta_count(h))
-        meta_rows = np.zeros(max(1, mcount), np.int64)
-        meta_keys = np.zeros(max(1, mcount), np.int32)
-        meta_vals = np.zeros(max(1, mcount), np.int32)
-        if mcount:
-            lib.pavro_fill_meta(h, meta_rows, meta_keys, meta_vals)
-        meta_key_strings = _strings(
-            int(lib.pavro_meta_table_nkeys(h, 0)),
-            int(lib.pavro_meta_table_len(h, 0)),
-            lambda b, o: lib.pavro_fill_meta_table(h, 0, b, o))
-        meta_val_strings = _strings(
-            int(lib.pavro_meta_table_nkeys(h, 1)),
-            int(lib.pavro_meta_table_len(h, 1)),
-            lambda b, o: lib.pavro_fill_meta_table(h, 1, b, o))
-        return DecodedFile(
-            num_records=n,
-            response=response[:n], offsets=offsets[:n], weights=weights[:n],
-            uids=uids, uid_kind=uid_kind[:n].copy(),
-            bags=bags,
-            meta_rows=meta_rows[:mcount], meta_keys=meta_keys[:mcount],
-            meta_vals=meta_vals[:mcount],
-            meta_key_strings=meta_key_strings,
-            meta_val_strings=meta_val_strings)
+        return _decode_open_handle(lib, h, path, plan, n_bags)
     finally:
         lib.pavro_free(h)
+
+
+def decode_span(path: str, header_len: int, start: int, end: int,
+                plan: np.ndarray, n_bags: int) -> DecodedFile:
+    """Decode one sync-aligned byte range of a container file with a
+    precompiled plan — the block-parallel ingestion path
+    (photon_ml_tpu/ingest): workers each decode a disjoint run of whole
+    blocks and the pipeline merges them in plan order, bit-identical to a
+    whole-file decode. No schema fallback here: the caller compiled the
+    plan from the scanned writer schema already. Raises ValueError on
+    corrupt data (the same failure mode as the whole-file decode) and
+    RuntimeError when the native toolchain is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native Avro decoder unavailable")
+    h = lib.pavro_open_range(path.encode(), header_len, start, end)
+    try:
+        err = ctypes.create_string_buffer(512)
+        if lib.pavro_error(h, err, 512):
+            raise ValueError(f"{path}: {err.value.decode()}")
+        return _decode_open_handle(lib, h, path, plan, n_bags)
+    finally:
+        lib.pavro_free(h)
+
+
+def _decode_open_handle(lib, h, path: str, plan: np.ndarray,
+                        n_bags: int) -> DecodedFile:
+    """Run the plan over an open handle and pull the columnar outputs
+    into numpy (shared by the whole-file and block-range entry points)."""
+    err = ctypes.create_string_buffer(512)
+    n = lib.pavro_decode(h, plan, len(plan), n_bags)
+    if n < 0:
+        lib.pavro_error(h, err, 512)
+        raise ValueError(f"{path}: {err.value.decode()}")
+    n = int(n)
+    response = np.zeros(max(1, n), np.float64)
+    offsets = np.zeros(max(1, n), np.float64)
+    weights = np.zeros(max(1, n), np.float64)
+    uid_kind = np.zeros(max(1, n), np.uint8)
+    uid_long = np.zeros(max(1, n), np.int64)
+    if n:
+        lib.pavro_fill_scalars(h, response, offsets, weights, uid_kind,
+                               uid_long)
+    # uids: local row index by default; vectorized fancy-index
+    # assignment for the records that carried one (no per-record
+    # interpreter loop on the hot ingestion path).
+    uids = np.arange(n).astype(object)
+    has_long = uid_kind[:n] == 2
+    if has_long.any():
+        uids[has_long] = uid_long[:n][has_long].tolist()
+    has_str = uid_kind[:n] == 1
+    if has_str.any():
+        uid_strs = _strings(
+            n, int(lib.pavro_uid_strs_len(h)),
+            lambda b, o: lib.pavro_fill_uid_strs(h, b, o))
+        uids[has_str] = np.asarray(uid_strs, object)[has_str]
+    bags = []
+    for b in range(n_bags):
+        nnz = int(lib.pavro_bag_nnz(h, b))
+        rows = np.zeros(max(1, nnz), np.int64)
+        keys = np.zeros(max(1, nnz), np.int32)
+        values = np.zeros(max(1, nnz), np.float64)
+        if nnz:
+            lib.pavro_fill_bag(h, b, rows, keys, values)
+        key_strings = _strings(
+            int(lib.pavro_bag_nkeys(h, b)),
+            int(lib.pavro_bag_keys_len(h, b)),
+            lambda bb, oo, _b=b: lib.pavro_fill_bag_keys(h, _b, bb, oo))
+        bags.append(BagColumns(rows[:nnz], keys[:nnz], values[:nnz],
+                               key_strings))
+    mcount = int(lib.pavro_meta_count(h))
+    meta_rows = np.zeros(max(1, mcount), np.int64)
+    meta_keys = np.zeros(max(1, mcount), np.int32)
+    meta_vals = np.zeros(max(1, mcount), np.int32)
+    if mcount:
+        lib.pavro_fill_meta(h, meta_rows, meta_keys, meta_vals)
+    meta_key_strings = _strings(
+        int(lib.pavro_meta_table_nkeys(h, 0)),
+        int(lib.pavro_meta_table_len(h, 0)),
+        lambda b, o: lib.pavro_fill_meta_table(h, 0, b, o))
+    meta_val_strings = _strings(
+        int(lib.pavro_meta_table_nkeys(h, 1)),
+        int(lib.pavro_meta_table_len(h, 1)),
+        lambda b, o: lib.pavro_fill_meta_table(h, 1, b, o))
+    return DecodedFile(
+        num_records=n,
+        response=response[:n], offsets=offsets[:n], weights=weights[:n],
+        uids=uids, uid_kind=uid_kind[:n].copy(),
+        bags=bags,
+        meta_rows=meta_rows[:mcount], meta_keys=meta_keys[:mcount],
+        meta_vals=meta_vals[:mcount],
+        meta_key_strings=meta_key_strings,
+        meta_val_strings=meta_val_strings)

@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "index-maps); entity vocabularies load from the "
                         "sibling entity-vocabs.json. Unseen entities "
                         "score with the fixed effect only")
+    p.add_argument("--ingest",
+                   help="parallel Avro ingestion knobs, "
+                        "'workers=8,mode=thread|process,depth=2,"
+                        "chunk_records=65536' (docs/INGEST.md); applies "
+                        "to Avro inputs (--avro-feature-shard)")
     p.add_argument("--model-format", default="NPZ",
                    choices=["NPZ", "AVRO"],
                    help="AVRO loads the BayesianLinearModelAvro layout "
@@ -100,15 +105,20 @@ def run(args) -> dict:
                 f"training entity vocabularies; expected {vocab_path} "
                 f"(written beside the index maps by game_train "
                 f"--model-output-format AVRO)")
+        from photon_ml_tpu.api.configs import parse_ingest_config
+
         data, read_meta = AvroDataReader().read(
             args.data, _parse_avro_shards(args.avro_feature_shard),
             random_effect_types=re_types,
             index_maps=imaps, entity_vocabs=vocabs,
-            allow_unseen_entities=True)
+            allow_unseen_entities=True,
+            ingest=(parse_ingest_config(args.ingest)
+                    if getattr(args, "ingest", None) else None))
     else:
         for flag, value in (("--avro-re-types", args.avro_re_types),
                             ("--feature-index-dir",
-                             args.feature_index_dir)):
+                             args.feature_index_dir),
+                            ("--ingest", getattr(args, "ingest", None))):
             if value:
                 raise ValueError(f"{flag} applies to Avro inputs "
                                  f"(--avro-feature-shard)")

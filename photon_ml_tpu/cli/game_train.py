@@ -28,7 +28,8 @@ from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FactoredRandomEffectDataConfiguration,
                                        FixedEffectDataConfiguration,
                                        RandomEffectDataConfiguration,
-                                       parse_kv, parse_optimizer_config,
+                                       parse_ingest_config, parse_kv,
+                                       parse_optimizer_config,
                                        parse_staging_config)
 from photon_ml_tpu.api.estimator import GameEstimator
 from photon_ml_tpu.data.io import load_game_dataset
@@ -154,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "straggler=30' (docs/STAGING.md, "
                         "docs/ROBUSTNESS.md); default: one worker per "
                         "host core, thread mode, depth=workers+2")
+    p.add_argument("--ingest",
+                   help="parallel Avro ingestion knobs, "
+                        "'workers=8,mode=thread|process,depth=2,"
+                        "chunk_records=65536' (docs/INGEST.md); applies "
+                        "to Avro inputs (--avro-feature-shard). Default: "
+                        "one decode worker per host core, thread mode")
+    p.add_argument("--ingest-cache-dir",
+                   help="persist decoded Avro columns here (columnar "
+                        "mmap ingest cache, keyed by file identity + "
+                        "decode plan) — a re-run on the same inputs "
+                        "memory-maps columns instead of re-decoding "
+                        "Avro, and a killed run resumes with per-chunk "
+                        "partial credit (docs/INGEST.md)")
     p.add_argument("--fault-plan",
                    help="TESTING ONLY: install a deterministic "
                         "fault-injection plan (photon_ml_tpu/faults "
@@ -193,6 +207,19 @@ def _parse_avro_shards(specs):
     return out
 
 
+def _ingest_config(args):
+    """--ingest / --ingest-cache-dir → IngestConfig (None when neither
+    flag is set: the reader then uses its defaults)."""
+    from photon_ml_tpu.ingest import IngestConfig
+
+    cfg = (parse_ingest_config(args.ingest)
+           if getattr(args, "ingest", None) else None)
+    if getattr(args, "ingest_cache_dir", None):
+        cfg = dataclasses.replace(cfg or IngestConfig(),
+                                  cache_dir=args.ingest_cache_dir)
+    return cfg
+
+
 def _load_avro_inputs(args):
     """The reference GameTrainingDriver flow: feature maps → AvroDataReader
     → (train, validation) GameDatasets sharing one feature space."""
@@ -201,6 +228,7 @@ def _load_avro_inputs(args):
     from photon_ml_tpu.utils.ranges import (DateRange,
                                             input_paths_within_date_range)
 
+    ingest_cfg = _ingest_config(args)
     shard_cfgs = _parse_avro_shards(args.avro_feature_shard)
     re_types = [t for t in args.avro_re_types.split(",") if t]
     index_maps = (load_index_maps(args.feature_index_dir)
@@ -218,7 +246,7 @@ def _load_avro_inputs(args):
     reader = AvroDataReader()
     train, meta = reader.read(train_paths, shard_cfgs,
                               random_effect_types=re_types,
-                              index_maps=index_maps)
+                              index_maps=index_maps, ingest=ingest_cfg)
     validation = None
     if args.validation:
         # Frozen feature space + entity vocabulary from training
@@ -229,7 +257,7 @@ def _load_avro_inputs(args):
         validation, val_meta = reader.read(
             args.validation, shard_cfgs, random_effect_types=re_types,
             index_maps=meta.index_maps, entity_vocabs=meta.entity_vocabs,
-            allow_unseen_entities=True)
+            allow_unseen_entities=True, ingest=ingest_cfg)
         for t in re_types:
             unseen = (len(val_meta.entity_vocabs[t])
                       - len(meta.entity_vocabs[t]))
@@ -267,7 +295,10 @@ def run(args) -> dict:
         for flag, value in (("--date-range", args.date_range),
                             ("--avro-re-types", args.avro_re_types),
                             ("--feature-index-dir",
-                             args.feature_index_dir)):
+                             args.feature_index_dir),
+                            ("--ingest", getattr(args, "ingest", None)),
+                            ("--ingest-cache-dir",
+                             getattr(args, "ingest_cache_dir", None))):
             if value:
                 raise ValueError(
                     f"{flag} applies to Avro inputs "
@@ -382,7 +413,8 @@ def run(args) -> dict:
         validation_evaluators=evaluators,
         staging_cache_dir=args.staging_cache_dir,
         staging=(parse_staging_config(args.staging)
-                 if getattr(args, "staging", None) else None))
+                 if getattr(args, "staging", None) else None),
+        ingest=_ingest_config(args) if args.avro_feature_shard else None)
 
     initial_models = None
     if args.model_input_dir:

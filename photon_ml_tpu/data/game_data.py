@@ -65,6 +65,14 @@ class GameDataset:
     # extension from an unrelated same-size vocabulary (reference: shared
     # PalDB index maps make this structural; here it must be carried).
     vocab_tokens: dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # Optional precomputed per-entity example counts (RE type ->
+    # (num_entities,) int64 bincount of entity_ids[t]). The ingestion
+    # layer folds these while decoding (photon_ml_tpu/ingest), letting
+    # build_bucketing skip its own bincount pass over the id column.
+    # Absent for datasets assembled elsewhere — consumers must treat it
+    # as a cache, not a source of truth (subset() drops it).
+    entity_counts: dict[str, np.ndarray] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def num_rows(self) -> int:

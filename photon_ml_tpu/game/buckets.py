@@ -78,12 +78,18 @@ def build_bucketing(
     entity_pad_multiple: int = 8,
     min_capacity: int = 8,
     rng: Optional[np.random.Generator] = None,
+    counts_all: Optional[np.ndarray] = None,
 ) -> EntityBucketing:
     """Group example rows by entity into padded power-of-two buckets.
 
     ``upper_bound`` caps examples per entity (reference
     numActiveDataPointsUpperBound: keeps a random subset); ``lower_bound``
     drops entities with too few examples from training entirely.
+    ``counts_all`` optionally supplies the per-entity bincount of
+    ``entity_ids`` precomputed elsewhere (the ingestion layer folds it
+    while decoding — GameDataset.entity_counts), skipping one pass over
+    the id column here; it MUST equal ``np.bincount(entity_ids)`` up to
+    trailing zeros, and the result is identical either way.
     """
     entity_ids = np.asarray(entity_ids)
     n = entity_ids.shape[0]
@@ -102,7 +108,14 @@ def build_bucketing(
     sort_keys = (entity_ids.astype(np.int32, copy=False)
                  if num_entities <= 2**31 else entity_ids)
     order = np.argsort(sort_keys, kind="stable")
-    counts_all = np.bincount(entity_ids)
+    if counts_all is None:
+        counts_all = np.bincount(entity_ids)
+    else:
+        counts_all = np.asarray(counts_all)
+        if int(counts_all.sum()) != n:
+            raise ValueError(
+                f"precomputed counts_all sums to {int(counts_all.sum())} "
+                f"but the id column has {n} rows")
     uniq = np.flatnonzero(counts_all)
     counts = counts_all[uniq]
     starts = (np.cumsum(counts) - counts).astype(np.int64)

@@ -139,7 +139,8 @@ class RandomEffectCoordinate:
             dataset.entity_ids[re_type], self.num_entities,
             lower_bound=lower_bound, upper_bound=upper_bound,
             entity_pad_multiple=max(8, int(np.prod(list(mesh.shape.values())))),
-            rng=np.random.default_rng(seed))
+            rng=np.random.default_rng(seed),
+            counts_all=dataset.entity_counts.get(re_type))
         if self.is_sparse:
             shard = dataset.feature_shards[shard_id]
             self._sp_indices = jnp.asarray(shard.indices)

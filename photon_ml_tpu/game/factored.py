@@ -194,7 +194,8 @@ class FactoredRandomEffectCoordinate:
             lower_bound=lower_bound, upper_bound=upper_bound,
             entity_pad_multiple=max(8,
                                     int(np.prod(list(mesh.shape.values())))),
-            rng=np.random.default_rng(seed))
+            rng=np.random.default_rng(seed),
+            counts_all=dataset.entity_counts.get(re_type))
 
         # Stage device-resident arrays once (rows sharded over the data axis
         # when divisible — the projection step is the data-parallel half).

@@ -78,6 +78,7 @@ from typing import Optional
 import numpy as np
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu.utils import workers as pools
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
@@ -272,19 +273,8 @@ def split_shard_triplets(
 #
 # Module-level pure functions so the process pool can pickle them. Big
 # read-only context (response/weights/norm arrays/dense X) travels once
-# per worker through the pool initializer instead of once per task.
-
-_WORKER_CTX: dict = {}
-
-
-def _init_worker(ctx: dict) -> None:
-    _WORKER_CTX.update(ctx)
-    # Process-pool workers are fresh interpreters: the driver's fault
-    # plan rides the ctx so injected worker crashes/kills happen in the
-    # worker process, exactly where a real one would.
-    plan = ctx.get("fault_plan")
-    if plan is not None:
-        flt.install(plan, worker=True)
+# per worker through the pool initializer (utils/workers.py — shared with
+# the ingestion pipeline) instead of once per task.
 
 
 def _retry_delay(base: float, attempt: int, seed: int, index: int) -> float:
@@ -317,7 +307,7 @@ def _phase_b(task: ShardTask, cols: np.ndarray, d_active: int,
     coordinate staging: (Xb, yb, wb, ex, rows[, cols][, f_p][, s_p])."""
     flt.fire("staging.phase_b", index=task.index)
     if ctx is None:
-        ctx = _WORKER_CTX
+        ctx = pools.worker_ctx()
     sub = bkt.EntityBucket(entity_rows=task.entity_rows,
                            example_idx=task.example_idx,
                            counts=task.counts)
@@ -346,17 +336,10 @@ def _phase_b(task: ShardTask, cols: np.ndarray, d_active: int,
 
 
 def _make_pool(mode: str, workers: int, ctx: dict):
-    if mode == "process":
-        import multiprocessing as mp
-
-        # spawn, not fork: the parent holds live XLA runtime threads, and
-        # forking them is undefined; spawn re-imports cleanly (the ctx
-        # arrays ship once per worker through the initializer).
-        return cf.ProcessPoolExecutor(
-            max_workers=workers, mp_context=mp.get_context("spawn"),
-            initializer=_init_worker, initargs=(ctx,))
-    return cf.ThreadPoolExecutor(max_workers=workers,
-                                 thread_name_prefix="pml-staging")
+    # Shared pool plumbing (utils/workers.py): spawn-context process pools
+    # with the ctx/fault-plan initializer, thread pools otherwise.
+    return pools.make_pool(mode, workers, ctx,
+                           thread_name_prefix="pml-staging")
 
 
 # ------------------------------------------------------------ the stager
@@ -752,7 +735,7 @@ class ProjectionStager:
             self.fault_stats["serial_restages"] += 1
             try:
                 # Inline runs in the DRIVER process, where the process
-                # pool's _WORKER_CTX initializer never ran — always pass
+                # pool's worker-ctx initializer never ran — always pass
                 # the ctx explicitly.
                 res = _phase_b(tasks[i], self._cols[i],
                                int(self._cols[i].shape[1]), ctx)

@@ -41,13 +41,15 @@ import hashlib
 import json
 import logging
 import os
-import tempfile
-import zlib
 from typing import Optional
 
 import numpy as np
 
 from photon_ml_tpu import faults as flt
+# Shared atomic-write + CRC discipline (utils/diskio.py); the historical
+# names stay importable from here (checkpoint.py and tests use them).
+from photon_ml_tpu.utils.diskio import atomic_write as _atomic_write
+from photon_ml_tpu.utils.diskio import file_crc32
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
@@ -55,16 +57,6 @@ logger = logging.getLogger("photon_ml_tpu.game")
 # bucket tuples became per-shard (lane-slice) tuples with commit markers.
 # v3: markers carry per-file CRC32s; loads verify before trusting.
 STAGING_VERSION = 3
-
-
-def file_crc32(path: str) -> int:
-    """CRC32 of a file's bytes (chunked; the integrity check of cache
-    shards and checkpoint artifacts)."""
-    crc = 0
-    with open(path, "rb") as f:
-        while chunk := f.read(1 << 20):
-            crc = zlib.crc32(chunk, crc)
-    return crc & 0xFFFFFFFF
 
 
 def staging_key(dataset, norm, **params) -> str:
@@ -80,22 +72,6 @@ def staging_key(dataset, norm, **params) -> str:
     for k in sorted(params):
         h.update(f"{k}={params[k]!r};".encode())
     return h.hexdigest()
-
-
-def _atomic_write(path: str, write_fn) -> None:
-    """Write via a temp file + os.replace (atomic on one filesystem)."""
-    d = os.path.dirname(path)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            write_fn(f)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 def save_shard(cache_dir: str, key: str, index: int,

@@ -1,0 +1,33 @@
+"""photon-ingest: block-parallel, pipelined Avro->tensor ingestion.
+
+The cold-fit input layer (docs/INGEST.md): Avro container files split
+at sync-marker block boundaries (``blocks``), native-decode workers fan
+over the resulting chunks with a deterministic in-order merge
+(``pipeline``), and a columnar memory-mapped cache lets warm restarts
+skip Avro decode entirely with per-chunk partial credit (``cache``).
+Consumed by ``avro/data_reader.AvroDataReader.read`` (the default
+native path) and configured through ``IngestConfig`` —
+``GameEstimator(ingest=...)`` / ``game_train --ingest workers=8``.
+"""
+
+from photon_ml_tpu.ingest.blocks import (ChunkSpec, FileBlocks,
+                                         file_token, plan_chunks,
+                                         scan_file)
+from photon_ml_tpu.ingest.cache import (INGEST_CACHE_VERSION, ingest_key,
+                                        load_chunk, save_chunk, save_meta)
+from photon_ml_tpu.ingest.pipeline import IngestConfig, IngestPipeline
+
+__all__ = [
+    "ChunkSpec",
+    "FileBlocks",
+    "INGEST_CACHE_VERSION",
+    "IngestConfig",
+    "IngestPipeline",
+    "file_token",
+    "ingest_key",
+    "load_chunk",
+    "plan_chunks",
+    "save_chunk",
+    "save_meta",
+    "scan_file",
+]

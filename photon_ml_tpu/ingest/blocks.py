@@ -1,0 +1,149 @@
+"""Sync-boundary block scan + chunk planning for parallel Avro ingest.
+
+An Avro object container file is a self-delimiting sequence of data
+blocks: ``(record count varint, byte size varint, payload, 16-byte sync
+marker)``. Walking just the block HEADERS (two varints + a seek per
+block) costs microseconds per block and yields exact byte boundaries at
+which the file can be split without decoding anything — the property
+the block-parallel decode of ``photon_ml_tpu/ingest`` is built on
+(Snap ML's hierarchical data loading makes the same cut: partition the
+input at container-format boundaries, decode partitions concurrently).
+
+``scan_file`` produces the boundary table (plus the writer schema and
+cheap identity facts for the ingest cache key); ``plan_chunks`` groups
+consecutive blocks of each file into decode tasks of roughly
+``chunk_records`` records. Chunks never span files and always cover
+whole blocks, so a worker decodes its byte range through the same
+native block loop as a whole file (``native_decode.decode_span``) and
+the in-order concatenation of chunk outputs is bit-identical to the
+serial read.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+from photon_ml_tpu.avro.codec import BinaryDecoder, _read_long
+from photon_ml_tpu.avro.container import MAGIC, _META_SCHEMA
+
+
+@dataclasses.dataclass(frozen=True)
+class FileBlocks:
+    """One container file's block-boundary table + identity facts."""
+
+    path: str
+    header_len: int  # byte offset where data blocks start
+    schema: dict  # parsed writer schema (JSON)
+    codec: str
+    sync: bytes
+    # Block i spans bytes [block_offsets[i], block_offsets[i + 1]) and
+    # holds block_counts[i] records.
+    block_offsets: tuple[int, ...]  # len B + 1
+    block_counts: tuple[int, ...]  # len B
+    size: int
+    mtime_ns: int
+
+    @property
+    def num_records(self) -> int:
+        return int(sum(self.block_counts))
+
+
+def scan_file(path: str) -> FileBlocks:
+    """Walk one container file's header + block headers (no payload
+    decode). Raises ValueError on a malformed/corrupt container — the
+    same failure class the serial readers report."""
+    st = os.stat(path)
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = BinaryDecoder(_META_SCHEMA).read(f)
+        schema = json.loads(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode("utf-8")
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"{path}: unsupported codec {codec}")
+        sync = f.read(16)
+        if len(sync) != 16:
+            raise ValueError(f"{path}: truncated header")
+        header_len = f.tell()
+        offsets = [header_len]
+        counts = []
+        while True:
+            head = f.read(1)
+            if not head:
+                break
+            f.seek(-1, os.SEEK_CUR)
+            try:
+                count = _read_long(f)
+                byte_size = _read_long(f)
+            except EOFError as e:
+                raise ValueError(f"{path}: truncated block header") from e
+            if count < 0 or byte_size < 0:
+                raise ValueError(f"{path}: corrupt block header")
+            f.seek(byte_size, os.SEEK_CUR)
+            if f.read(16) != sync:
+                raise ValueError(
+                    f"{path}: sync marker mismatch (corrupt block)")
+            pos = f.tell()
+            if pos > st.st_size:
+                raise ValueError(f"{path}: truncated block")
+            offsets.append(pos)
+            counts.append(int(count))
+    return FileBlocks(
+        path=path, header_len=header_len, schema=schema, codec=codec,
+        sync=sync, block_offsets=tuple(offsets),
+        block_counts=tuple(counts), size=st.st_size,
+        mtime_ns=st.st_mtime_ns)
+
+
+def file_token(fb: FileBlocks) -> str:
+    """Cheap identity digest of one scanned file for the ingest-cache
+    key: absolute path + size + mtime_ns + sync marker + block count.
+    Payload bytes are NOT hashed (that would cost a full read, what the
+    cache exists to avoid) — the mtime discipline is the same contract
+    build caches use."""
+    h = hashlib.sha1()
+    h.update(os.path.abspath(fb.path).encode())
+    h.update(f"|{fb.size}|{fb.mtime_ns}|{len(fb.block_counts)}|".encode())
+    h.update(fb.sync)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkSpec:
+    """One decode task: a run of whole blocks of one file. ``index`` is
+    the global plan position (the deterministic merge order); ``start``/
+    ``end`` are file byte offsets at sync boundaries."""
+
+    index: int
+    file_index: int
+    path: str
+    header_len: int
+    start: int
+    end: int
+    records: int
+
+
+def plan_chunks(files: list[FileBlocks],
+                chunk_records: int) -> list[ChunkSpec]:
+    """Group consecutive blocks into decode chunks of >= chunk_records
+    records (greedy; the last chunk of a file may be smaller). The plan
+    order is file order then byte order — exactly the serial readers'
+    record order."""
+    chunks: list[ChunkSpec] = []
+    for fi, fb in enumerate(files):
+        b = 0
+        nb = len(fb.block_counts)
+        while b < nb:
+            recs = 0
+            start = fb.block_offsets[b]
+            while b < nb and recs < max(1, chunk_records):
+                recs += fb.block_counts[b]
+                b += 1
+            chunks.append(ChunkSpec(
+                index=len(chunks), file_index=fi, path=fb.path,
+                header_len=fb.header_len, start=start,
+                end=fb.block_offsets[b], records=recs))
+    return chunks

@@ -1,0 +1,246 @@
+"""Columnar, memory-mapped ingest cache: warm restarts skip Avro decode.
+
+One cache entry holds the DECODED columns of one ingest plan — per
+chunk, the exact ``native_decode.DecodedFile`` payload (scalar columns,
+per-bag COO triples + key tables, metadataMap entries + string tables)
+as plain ``.npy`` files with string tables packed as (bytes, offsets)
+pairs. A warm read memory-maps the arrays straight off the page cache
+and re-runs only the cheap vectorized fold (index-map lookup + entity
+vocabularies), so it produces the SAME GameDataset as a cold decode —
+the fold is where read-time parameters (index maps, vocabularies,
+shard configs) apply, which is why the cache key covers only what
+determines the decoded columns: file identity + the capture plan.
+
+Commit discipline (same v3 contract as game/staging_cache.py):
+
+- ``c<i>.bin`` — ALL columns of chunk i as one 64-byte-aligned blob,
+  written atomically (one file per chunk, not one per column: a warm
+  load is one open + one mmap, and the page cache sees one sequential
+  extent instead of dozens of tiny inodes);
+- ``c<i>.ok`` — chunk i's commit marker (column directory: name/dtype/
+  shape/offset per column, plus the blob's CRC32 and record count),
+  written LAST via atomic rename — a reader never trusts a
+  half-written chunk, and silent corruption fails the CRC and degrades
+  to a re-decode of exactly that chunk;
+- ``meta.json`` — the entry's completion record.
+
+Chunks are written the moment they are decoded, so a killed run leaves
+a partial entry whose committed chunks are reused on restart — only
+the missing/corrupt ones re-decode (partial credit; the chaos suite
+drives a driver SIGKILL through the ``ingest.cache_write`` fault site
+and asserts bit-identical final coefficients on resume).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu import faults as flt
+from photon_ml_tpu.avro.native_decode import BagColumns, DecodedFile
+from photon_ml_tpu.ingest.blocks import FileBlocks, file_token
+from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
+
+logger = logging.getLogger("photon_ml_tpu.ingest")
+
+INGEST_CACHE_VERSION = 1
+
+_SCALARS = ("response", "offsets", "weights", "uid_kind", "uid_long")
+
+
+def ingest_key(files: list[FileBlocks],
+               captures: dict[str, tuple[int, int]], n_bags: int,
+               chunk_records: int) -> str:
+    """Cache key: every input file's identity token + the capture plan
+    (field names -> capture/arg) + bag count + the chunk grouping."""
+    h = hashlib.sha1()
+    h.update(f"v{INGEST_CACHE_VERSION};chunk={chunk_records};"
+             f"bags={n_bags};".encode())
+    for fb in files:
+        h.update(file_token(fb).encode())
+    for name in sorted(captures):
+        h.update(f"{name}={captures[name]!r};".encode())
+    return h.hexdigest()
+
+
+def _pack_strings(strs: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """list[str] -> (utf-8 byte pool, cumulative end offsets) — the same
+    layout the native decoder's string tables cross the C ABI in."""
+    encs = [s.encode("utf-8") for s in strs]
+    data = np.frombuffer(b"".join(encs), np.uint8).copy()
+    ends = np.cumsum([len(e) for e in encs], dtype=np.int64) \
+        if encs else np.zeros(0, np.int64)
+    return data, ends
+
+
+def _unpack_strings(data: np.ndarray, ends: np.ndarray) -> list[str]:
+    raw = bytes(np.asarray(data, np.uint8))
+    out = []
+    prev = 0
+    for end in np.asarray(ends, np.int64):
+        out.append(raw[prev:int(end)].decode("utf-8"))
+        prev = int(end)
+    return out
+
+
+def _chunk_arrays(d: DecodedFile) -> dict[str, np.ndarray]:
+    """Flatten one DecodedFile into named arrays (all npy-serializable;
+    object columns are re-derived from kind/long/string-pool parts)."""
+    n = d.num_records
+    kind = np.asarray(d.uid_kind, np.uint8)
+    uid_long = np.zeros(n, np.int64)
+    long_rows = np.flatnonzero(kind == 2)
+    if len(long_rows):
+        uid_long[long_rows] = np.asarray(
+            [int(d.uids[i]) for i in long_rows], np.int64)
+    str_rows = np.flatnonzero(kind == 1)
+    uid_bytes, uid_ends = _pack_strings([d.uids[i] for i in str_rows])
+    out = {
+        "response": np.asarray(d.response, np.float64),
+        "offsets": np.asarray(d.offsets, np.float64),
+        "weights": np.asarray(d.weights, np.float64),
+        "uid_kind": kind,
+        "uid_long": uid_long,
+        "uid_str_bytes": uid_bytes,
+        "uid_str_ends": uid_ends,
+        "meta_rows": np.asarray(d.meta_rows, np.int64),
+        "meta_keys": np.asarray(d.meta_keys, np.int32),
+        "meta_vals": np.asarray(d.meta_vals, np.int32),
+    }
+    for which, strs in (("metak", d.meta_key_strings),
+                        ("metav", d.meta_val_strings)):
+        data, ends = _pack_strings(strs)
+        out[f"{which}_bytes"], out[f"{which}_ends"] = data, ends
+    for b, bag in enumerate(d.bags):
+        out[f"bag{b}_rows"] = np.asarray(bag.rows, np.int64)
+        out[f"bag{b}_keys"] = np.asarray(bag.keys, np.int32)
+        out[f"bag{b}_vals"] = np.asarray(bag.values, np.float64)
+        data, ends = _pack_strings(bag.key_strings)
+        out[f"bag{b}_keybytes"], out[f"bag{b}_keyends"] = data, ends
+    return out
+
+
+def _chunk_from_arrays(arrs: dict[str, np.ndarray], records: int,
+                       n_bags: int) -> DecodedFile:
+    n = records
+    kind = np.asarray(arrs["uid_kind"], np.uint8)
+    uids = np.arange(n).astype(object)
+    long_rows = np.flatnonzero(kind == 2)
+    if len(long_rows):
+        uids[long_rows] = np.asarray(arrs["uid_long"])[long_rows].tolist()
+    str_rows = np.flatnonzero(kind == 1)
+    if len(str_rows):
+        strs = _unpack_strings(arrs["uid_str_bytes"],
+                               arrs["uid_str_ends"])
+        uids[str_rows] = np.asarray(strs, object)
+    bags = []
+    for b in range(n_bags):
+        bags.append(BagColumns(
+            rows=arrs[f"bag{b}_rows"], keys=arrs[f"bag{b}_keys"],
+            values=arrs[f"bag{b}_vals"],
+            key_strings=_unpack_strings(arrs[f"bag{b}_keybytes"],
+                                        arrs[f"bag{b}_keyends"])))
+    return DecodedFile(
+        num_records=n,
+        response=arrs["response"], offsets=arrs["offsets"],
+        weights=arrs["weights"], uids=uids, uid_kind=kind, bags=bags,
+        meta_rows=arrs["meta_rows"], meta_keys=arrs["meta_keys"],
+        meta_vals=arrs["meta_vals"],
+        meta_key_strings=_unpack_strings(arrs["metak_bytes"],
+                                         arrs["metak_ends"]),
+        meta_val_strings=_unpack_strings(arrs["metav_bytes"],
+                                         arrs["metav_ends"]))
+
+
+_ALIGN = 64  # column sections start on cache-line boundaries
+
+
+def save_chunk(cache_dir: str, key: str, index: int,
+               d: DecodedFile) -> None:
+    """Persist one decoded chunk as a single aligned blob; the ``.ok``
+    marker (column directory + blob CRC32) commits it last."""
+    flt.fire("ingest.cache_write", index=index)
+    path = os.path.join(cache_dir, key)
+    os.makedirs(path, exist_ok=True)
+    arrs = _chunk_arrays(d)
+    cols = []
+    pos = 0
+    pieces: list[bytes] = []
+    for name in sorted(arrs):
+        a = np.ascontiguousarray(arrs[name])
+        pad = (-pos) % _ALIGN
+        if pad:
+            pieces.append(b"\x00" * pad)
+            pos += pad
+        cols.append({"name": name, "dtype": a.dtype.str,
+                     "shape": list(a.shape), "offset": pos})
+        pieces.append(a.tobytes())
+        pos += a.nbytes
+    fpath = os.path.join(path, f"c{index}.bin")
+    atomic_write(fpath, lambda f: f.writelines(pieces))
+    crc = file_crc32(fpath)
+    # Injected bit rot lands AFTER the checksum was taken over the good
+    # bytes — the shape a CRC verification must catch.
+    flt.corrupt_file("ingest.cache_file", fpath, index=index)
+    marker = json.dumps({"version": INGEST_CACHE_VERSION,
+                         "cols": cols, "crc": crc, "nbytes": pos,
+                         "records": int(d.num_records),
+                         "n_bags": len(d.bags)}).encode()
+    atomic_write(os.path.join(path, f"c{index}.ok"),
+                 lambda f: f.write(marker))
+
+
+def load_chunk(cache_dir: str, key: str, index: int,
+               n_bags: int) -> Optional[DecodedFile]:
+    """One decoded chunk (columns as read-only views over one mmap), or
+    None on any miss: no marker, version/bag-count skew, an unreadable
+    blob, or a CRC mismatch against the commit marker (silent
+    corruption)."""
+    path = os.path.join(cache_dir, key)
+    try:
+        with open(os.path.join(path, f"c{index}.ok")) as f:
+            marker = json.load(f)
+        if (marker.get("version") != INGEST_CACHE_VERSION
+                or marker.get("n_bags") != n_bags):
+            return None
+        fpath = os.path.join(path, f"c{index}.bin")
+        got = file_crc32(fpath)
+        if got != marker["crc"]:
+            logger.warning(
+                "ingest cache chunk %s is corrupt (crc %08x != "
+                "committed %08x) — treating as a miss and re-decoding",
+                fpath, got, marker["crc"])
+            return None
+        blob = np.memmap(fpath, dtype=np.uint8, mode="r",
+                         shape=(int(marker["nbytes"]),))
+        arrs = {}
+        for col in marker["cols"]:
+            dt = np.dtype(col["dtype"])
+            count = int(np.prod(col["shape"], dtype=np.int64))
+            off = int(col["offset"])
+            arrs[col["name"]] = np.frombuffer(
+                blob, dtype=dt, count=count,
+                offset=off).reshape(col["shape"])
+        return _chunk_from_arrays(arrs, int(marker["records"]), n_bags)
+    except Exception:
+        logger.debug("ingest cache miss for %s chunk %d", key, index,
+                     exc_info=True)
+        return None
+
+
+def save_meta(cache_dir: str, key: str, num_chunks: int,
+              records: int) -> None:
+    """Finalize an entry (``meta.json`` written last — its presence
+    means COMPLETE; partial entries still give per-chunk credit)."""
+    path = os.path.join(cache_dir, key)
+    os.makedirs(path, exist_ok=True)
+    meta = json.dumps({"version": INGEST_CACHE_VERSION,
+                       "num_chunks": int(num_chunks),
+                       "records": int(records)}).encode()
+    atomic_write(os.path.join(path, "meta.json"),
+                 lambda f: f.write(meta))

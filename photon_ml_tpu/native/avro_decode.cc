@@ -536,6 +536,52 @@ void* pavro_open(const char* path) {
   return h;
 }
 
+// Range variant for block-parallel ingestion (photon_ml_tpu/ingest): the
+// caller has already walked the container's block headers in Python and
+// knows (a) where the header ends and (b) a sync-aligned [start, end) byte
+// range of whole blocks. Only header + range bytes are read — N workers
+// over one file cost one file's worth of I/O total, not N. The spliced
+// buffer (header immediately followed by the range) decodes through the
+// same block loop as a whole file; sync markers sit in the header, so
+// per-block validation is unchanged.
+void* pavro_open_range(const char* path, long header_len, long start,
+                       long end) {
+  Handle* h = new Handle();
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) {
+    h->error = std::string("cannot open ") + path;
+    return h;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  if (header_len < 4 || header_len > size || start < header_len ||
+      end < start || end > size) {
+    h->error = "invalid block range";
+    std::fclose(f);
+    return h;
+  }
+  h->file.resize(static_cast<size_t>(header_len + (end - start)));
+  std::fseek(f, 0, SEEK_SET);
+  bool ok = std::fread(h->file.data(), 1, static_cast<size_t>(header_len),
+                       f) == static_cast<size_t>(header_len);
+  if (ok && end > start) {
+    std::fseek(f, start, SEEK_SET);
+    ok = std::fread(h->file.data() + header_len, 1,
+                    static_cast<size_t>(end - start),
+                    f) == static_cast<size_t>(end - start);
+  }
+  std::fclose(f);
+  if (!ok) {
+    h->error = std::string("short read on ") + path;
+    return h;
+  }
+  if (parse_header(h) &&
+      h->blocks_start != static_cast<size_t>(header_len)) {
+    h->error = "header length does not match the parsed header";
+  }
+  return h;
+}
+
 int pavro_error(void* hv, char* buf, int cap) {
   Handle* h = static_cast<Handle*>(hv);
   if (h->error.empty()) return 0;

@@ -101,6 +101,57 @@ class StagingStraggler(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class IngestStart(Event):
+    """One Avro ingestion pipeline starting: ``num_chunks`` block-aligned
+    decode tasks over ``num_files`` container files, fanned over
+    ``workers`` pool workers (``mode`` "thread" or "process");
+    ``cached_chunks`` of them load from the columnar ingest cache
+    without touching Avro bytes (photon_ml_tpu/ingest)."""
+
+    num_files: int
+    num_chunks: int
+    workers: int
+    mode: str
+    cached_chunks: int
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestBlock(Event):
+    """One decoded chunk (a sync-aligned run of Avro blocks) became
+    available to the columnar fold. ``source`` is "decoded" (native
+    block decode ran now) or "cache" (memory-mapped from the ingest
+    cache); ``seconds`` is the decode time (0.0 for cache hits)."""
+
+    index: int
+    records: int
+    seconds: float
+    source: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestFinish(Event):
+    """Every chunk of one ingestion pipeline was consumed by the fold
+    (or the pipeline was abandoned after ``num_chunks`` consumed chunks
+    on error — the Start/Finish pair is finally-guarded)."""
+
+    num_files: int
+    num_chunks: int
+    records: int
+    cached_chunks: int
+    wall_seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestFallback(Event):
+    """Avro ingestion degraded to the pure-Python codec (~20x slower
+    than the native block decoder per BENCH_r05) instead of the
+    parallel native path; ``reason`` says why (no toolchain,
+    unsupported schema, ...)."""
+
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointRecovered(Event):
     """A corrupted checkpoint artifact failed its CRC and the manager
     fell back to the previous committed generation (game/checkpoint.py).

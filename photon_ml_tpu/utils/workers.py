@@ -1,0 +1,57 @@
+"""Shared worker-pool plumbing for the host-side data pipelines.
+
+Both producer/consumer pipelines — random-effect staging
+(game/staging.py) and block-parallel Avro ingestion (ingest/) — fan CPU
+work over the same two pool shapes: a thread pool (the default; the
+dominant kernels release the GIL — numpy sort/segment passes for
+staging, the ctypes native-decode calls for ingestion) and a
+spawn-context process pool for workloads where GIL-holding Python work
+dominates. This module is the one implementation of that choice.
+
+Spawn, not fork: the parent holds live XLA runtime threads, and forking
+them is undefined; spawn re-imports cleanly. Per-worker context (big
+read-only arrays, the active fault plan) ships once per worker through
+the pool initializer instead of once per task; process-pool workers are
+fresh interpreters, so the driver's fault plan rides the ctx and
+injected worker crashes/kills happen in the worker process, exactly
+where a real one would (photon_ml_tpu/faults).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+
+from photon_ml_tpu import faults as flt
+
+# Per-process context installed by the pool initializer (empty in the
+# driver process and in thread-mode workers, which share the driver's).
+_WORKER_CTX: dict = {}
+
+
+def worker_ctx() -> dict:
+    """The per-process worker context (see ``init_worker``)."""
+    return _WORKER_CTX
+
+
+def init_worker(ctx: dict) -> None:
+    """Process-pool initializer: install the shipped context and arm the
+    driver's fault plan inside the fresh worker interpreter."""
+    _WORKER_CTX.update(ctx)
+    plan = ctx.get("fault_plan")
+    if plan is not None:
+        flt.install(plan, worker=True)
+
+
+def make_pool(mode: str, workers: int, ctx: dict,
+              thread_name_prefix: str = "pml-worker"):
+    """A thread or spawn-process executor with ``ctx`` installed in every
+    process-mode worker (thread-mode workers see the driver's state
+    directly and need no initializer)."""
+    if mode == "process":
+        import multiprocessing as mp
+
+        return cf.ProcessPoolExecutor(
+            max_workers=workers, mp_context=mp.get_context("spawn"),
+            initializer=init_worker, initargs=(ctx,))
+    return cf.ThreadPoolExecutor(max_workers=workers,
+                                 thread_name_prefix=thread_name_prefix)
