@@ -47,6 +47,56 @@ def _progress(msg: str) -> None:
           flush=True)
 
 
+# --- host-line validity gating (BENCH r3–r5: a recurring ~4× builder-vs-
+# driver spread on host-staging lines was surfaced but never DETECTED —
+# the 1.5×-spread contention guard catches jitter, not sustained load).
+# Two gates, both recorded per line so a committed JSON self-describes:
+#   * load average at measurement start above LOAD_GATE (the r05 driver
+#     capture ran at 0.83 on this 1-core box and measured 4× slow);
+#   * the calibration micro-workload (run once per fresh-host suite)
+#     exceeding CALIBRATION_GATE × the committed clean-box reference —
+#     sustained background load that a momentary loadavg can miss.
+# An invalid line still reports its number, but carries ``<key>_valid:
+# false`` + the reason; check_bench_regression treats it as
+# reported-only and render_perf_docs drops it from doc ranges.
+
+# Min-of-5 of _calibration_workload on this 1-core CI box, measured
+# near-idle (load ~0.2). Machine-specific by construction — re-measure
+# when the fleet changes.
+HOST_CALIBRATION_REF_S = 0.34
+LOAD_GATE = 0.75
+CALIBRATION_GATE = 1.5
+
+_HOST_CAL = {"factor": None}
+
+
+def _calibration_workload():
+    """Fixed, allocation-light, sort-dominated — the same instruction
+    mix as the staging host sections it calibrates for."""
+    rng = np.random.default_rng(1234)
+    a = rng.integers(0, 1 << 30, size=2_000_000)
+    for _ in range(3):
+        a = np.sort(a, kind="stable")[::-1].copy()
+
+
+def host_calibration(out):
+    """Run the calibration micro-workload and record the host's current
+    speed factor vs the committed clean reference; later ``_host_line``
+    calls gate their validity on it."""
+    lo, samples, _ = _host_timed(_calibration_workload, n=3,
+                                 label="host_calibration")
+    factor = lo / HOST_CALIBRATION_REF_S
+    _HOST_CAL["factor"] = factor
+    out["host_calibration_seconds"] = round(lo, 3)
+    out["host_calibration_samples"] = samples
+    out["host_calibration_factor"] = round(factor, 2)
+    if factor > CALIBRATION_GATE:
+        _progress(f"WARNING host calibration {lo:.2f}s is {factor:.1f}x "
+                  f"the clean-box reference {HOST_CALIBRATION_REF_S}s — "
+                  "host lines in this capture will be marked invalid")
+    return factor
+
+
 def _host_timed(section, n=3, label=""):
     """Min-of-N timing for a HOST-side section with a contention guard.
 
@@ -79,12 +129,26 @@ def _host_timed(section, n=3, label=""):
 
 def _host_line(out, key, section, n=3):
     """Record one host-side bench line: ``key`` = min of n runs,
-    ``key_samples`` = every run, ``key_contended`` only when dirty."""
+    ``key_samples`` = every run, ``key_contended`` only when dirty, and
+    ``key_valid: false`` + reason when a load/calibration gate fired
+    (the line then documents the environment instead of polluting the
+    cross-round trajectory)."""
+    load = os.getloadavg()[0]
     lo, samples, contended = _host_timed(section, n=n, label=key)
     out[key] = round(lo, 2)
     out[f"{key}_samples"] = samples
     if contended:
         out[f"{key}_contended"] = True
+    reasons = []
+    if load > LOAD_GATE:
+        reasons.append(f"load_avg_1m {load:.2f} > {LOAD_GATE}")
+    factor = _HOST_CAL.get("factor")
+    if factor is not None and factor > CALIBRATION_GATE:
+        reasons.append(f"host calibration {factor:.1f}x the clean-box "
+                       f"reference")
+    if reasons:
+        out[f"{key}_valid"] = False
+        out[f"{key}_invalid_reason"] = "; ".join(reasons)
     return lo
 
 
@@ -472,6 +536,8 @@ def bench_host_staging(n=10_000_000, num_entities=1_000_000, d=1_000_000,
     shard = SparseShard(idx, vals, d)
 
     out: dict = {"staging_load_avg_1m": round(os.getloadavg()[0], 2)}
+    # Calibration FIRST: every _host_line below gates its validity on it.
+    host_calibration(out)
     bucketing = build_bucketing(ids, num_entities)  # warm result for below
 
     def _bucketing():
@@ -812,6 +878,76 @@ def bench_avro_ingest(n=20_000, nnz=20):
     return out
 
 
+def bench_stream_pinned(n=1 << 15, d=4096, nnz=16, chunk_rows=1 << 12):
+    """``pin_chunks`` pinned-fraction scaling curve (ROADMAP item 4): the
+    n=100M streamed sweep is ~95% host→device transfer, and pinning is
+    the first untried lever — each pinned chunk is stream traffic saved
+    on EVERY objective evaluation, so seconds-per-pass should fall
+    roughly linearly in the pinned fraction on a transfer-bound pass.
+    Sweeps 0/25/50/100% of chunks pinned (stream_pinned_fraction_curve)
+    plus the sharded composition at every local device
+    (stream_sharded_pass_seconds — D=1 on a single-chip box; the psum
+    merge is then an identity, so the line doubles as its overhead
+    check)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    batch, _ = sp.synthetic_sparse(n, d, nnz, seed=5)
+
+    def chunks():
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            yield sp.SparseBatch(
+                indices=np.asarray(batch.indices)[lo:hi],
+                values=np.asarray(batch.values)[lo:hi],
+                labels=np.asarray(batch.labels)[lo:hi],
+                weights=np.asarray(batch.weights)[lo:hi],
+                offsets=np.asarray(batch.offsets)[lo:hi],
+                num_features=d)
+
+    chunked = ss.build_chunked(chunks(), d, chunk_rows, num_hot=256)
+    out: dict = {
+        "stream_pass_config": f"n={n} d={d} chunks={chunked.num_chunks}",
+    }
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    def make_run(vg):
+        def run(iters):
+            w = w0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _, g = vg(w)
+                w = w - 1e-9 * g  # chain: next pass depends on this one
+            np.asarray(w[:8])
+            return time.perf_counter() - t0
+        return run
+
+    curve = {}
+    for frac in (0.0, 0.25, 0.5, 1.0):
+        count = int(round(frac * chunked.num_chunks))
+        pinned = ss.pin_chunks(chunked, count)
+        vg = ss.make_value_and_gradient(losses.LOGISTIC, chunked,
+                                        pinned=pinned)
+        curve[str(int(frac * 100))] = round(_slope(make_run(vg), 2, 8), 4)
+    out["stream_pinned_fraction_curve"] = curve
+    out["stream_pinned_fraction_speedup"] = round(
+        curve["0"] / max(curve["100"], 1e-9), 2)
+
+    mesh = make_mesh()
+    sharded = ss.ShardedChunkStream(chunked, mesh)
+    out["stream_sharded_devices"] = sharded.num_devices
+    out["stream_sharded_pass_seconds"] = round(
+        _slope(make_run(sharded.value_and_gradient(losses.LOGISTIC)),
+               2, 8), 4)
+    out["stream_single_pass_seconds"] = curve["0"]
+    return out
+
+
 def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
     """One GAME coordinate-descent sweep (fixed + per-user + per-item),
     steady-state, by the slope between 1- and 6-iteration runs."""
@@ -944,6 +1080,8 @@ def main():
     sparse = bench_sparse()
     _progress("sparse random effect")
     sparse_re = bench_sparse_random_effect()
+    _progress("streamed pass: pinned-fraction curve + sharded merge")
+    stream = bench_stream_pinned()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
     # Avro ingestion lines ride the fresh-host subprocess suite above
@@ -979,6 +1117,7 @@ def main():
             "sparse_hybrid_sharded_samples_per_sec":
                 sparse["sparse_hybrid_sharded_samples_per_sec"],
             **sparse_re,
+            **stream,
             **staging,
             **{key: round(v, 1) for key, v in scatter.items()},
             "game_cd_iteration_seconds": round(game_iter_s, 3),

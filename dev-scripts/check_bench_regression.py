@@ -114,6 +114,15 @@ def main() -> int:
 
     failures = []
     band = 1.0 + args.tolerance
+
+    def _invalid(lines, key):
+        """bench.py's load/calibration gate: a line marked ``_valid:
+        false`` documents a contended environment — reported only, never
+        a regression verdict in either direction."""
+        if lines.get(f"{key}_valid") is False:
+            return lines.get(f"{key}_invalid_reason", "gated invalid")
+        return None
+
     for key in GUARDED:
         if key not in base:
             continue  # line did not exist in that round
@@ -122,6 +131,11 @@ def main() -> int:
                             f"(baseline {base[key]})")
             continue
         b, v = float(base[key]), float(fresh[key])
+        reason = _invalid(fresh, key) or _invalid(base, key)
+        if reason is not None:
+            print(f"{key}: fresh {v:g} vs baseline {b:g} INVALID "
+                  f"(reported only: {reason})")
+            continue
         verdict = "OK" if v <= b * band else "REGRESSION"
         print(f"{key}: fresh {v:g} vs baseline {b:g} "
               f"(limit {b * band:.3g}) {verdict}")
@@ -186,6 +200,36 @@ def main() -> int:
             failures.append(
                 f"end_to_end_cold_fit_seconds: {e2e:g} > {limit:.3g} — "
                 f"ingestion is serializing in front of the fit again")
+
+    # --- streamed-pass invariants (docs/STREAMING.md), within the fresh
+    # tail: pinning trades spare HBM for stream traffic, so the fully-
+    # pinned pass may never be slower than the unpinned one beyond the
+    # band (a violation means pinning went from a lever to a liability).
+    curve = fresh.get("stream_pinned_fraction_curve")
+    if isinstance(curve, dict) and "0" in curve and "100" in curve:
+        t0, t100 = float(curve["0"]), float(curve["100"])
+        limit = t0 * band
+        verdict = "OK" if t100 <= limit else "REGRESSION"
+        print(f"stream_pinned_fraction_curve: fully-pinned {t100:g}s vs "
+              f"unpinned {t0:g}s (limit {limit:.3g}) {verdict}")
+        if t100 > limit:
+            failures.append(
+                f"stream_pinned_fraction_curve: fully-pinned pass "
+                f"{t100:g}s > {limit:.3g}s — pinning slows the stream")
+    sh = fresh.get("stream_sharded_pass_seconds")
+    single = fresh.get("stream_single_pass_seconds")
+    devs = int(fresh.get("stream_sharded_devices", 0))
+    if sh is not None and single is not None and devs == 1:
+        # At D=1 the sharded composition is the same work + an identity
+        # psum — it may not cost more than the band over the plain pass.
+        limit = float(single) * band
+        verdict = "OK" if float(sh) <= limit else "REGRESSION"
+        print(f"stream_sharded_pass_seconds (D=1): {sh:g}s vs single "
+              f"{single:g}s (limit {limit:.3g}) {verdict}")
+        if float(sh) > limit:
+            failures.append(
+                f"stream_sharded_pass_seconds: {sh:g}s > {limit:.3g}s — "
+                f"the sharded composition adds overhead at D=1")
 
     if failures:
         print(f"\n{len(failures)} staging regression(s) vs "

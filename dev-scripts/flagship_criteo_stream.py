@@ -48,7 +48,8 @@ def _rss_gb() -> float:
 def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
                       nnz_fe=8, nnz_re=4, chunk_rows=5_000_000,
                       hot_block_gb=1.25, pin_gb=2.0, iterations=2,
-                      fe_opt_iters=12, seed=11, log=lambda m: None):
+                      fe_opt_iters=12, seed=11, checkpoint_dir=None,
+                      log=lambda m: None):
     import jax
     import jax.numpy as jnp
 
@@ -162,9 +163,13 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
               int(pin_gb * 2 ** 30 / max(chunk_bytes, 1)))
     log(f"chunk ≈ {chunk_bytes / 2**30:.2f} GiB on device; pinning {pin} "
         f"of {chunked.num_chunks} chunks (budget {pin_gb} GiB)")
+    # Sharded over the data axis (docs/STREAMING.md): one chip streams
+    # everything on a 1-device host (bit-identical to the mesh-less
+    # path); a multi-chip host partitions the chunk ranges and psum-
+    # merges partials automatically. pin is PER DEVICE under a mesh.
     fe_coord = StreamingSparseFixedEffectCoordinate(
         ds, chunked, "global", losses.LOGISTIC, fe_cfg,
-        pin_device_chunks=pin,
+        pin_device_chunks=pin, mesh=make_mesh(),
         log=lambda m: log(f"  [fe-lbfgs] {m}"))
     # Opt-in staging cache (set PML_CRITEO_STAGING_CACHE=/path): a
     # crash-rerun then skips the ~20-minute host projection pass
@@ -181,11 +186,23 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
     log(f"RE staging {re_staging:.1f}s; host peak {_rss_gb():.1f} GB")
 
     coords = {"fixed": fe_coord, "per-user": re_coord}
+    # Crash-resume for the ~90-minute fit (the round-5 run lost its
+    # trained model to a TPU-worker crash): descent-level checkpoints
+    # plus mid-L-BFGS stream state (docs/STREAMING.md) — a rerun with
+    # the same --checkpoint-dir resumes instead of retraining.
+    manager = None
+    if checkpoint_dir:
+        from photon_ml_tpu.game.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(checkpoint_dir)
+        log(f"checkpointing descent + mid-L-BFGS state under "
+            f"{checkpoint_dir}")
     t0 = time.perf_counter()
     model, hist = descent.run(
         TaskType.LOGISTIC_REGRESSION, coords,
         descent.CoordinateDescentConfig(["fixed", "per-user"],
-                                        iterations=iterations))
+                                        iterations=iterations),
+        checkpoint_manager=manager)
     descent_s = time.perf_counter() - t0
     per_update = {r["coordinate"]: r["train_seconds"]
                   for r in hist.records[-2:]}  # last sweep's updates
@@ -228,6 +245,11 @@ def main():
     ap.add_argument("--fe-iters", type=int, default=12,
                     help="FE L-BFGS iterations (each is a full pass "
                          "over the stream)")
+    ap.add_argument("--checkpoint-dir",
+                    help="persist descent + mid-L-BFGS stream state "
+                         "here (docs/STREAMING.md); a rerun with the "
+                         "same dir resumes the ~90-min fit instead of "
+                         "retraining after a crash")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -244,7 +266,8 @@ def main():
         n_rows=args.rows, d=args.features, n_entities=args.entities,
         chunk_rows=args.chunk_rows, hot_block_gb=hot_gb,
         pin_gb=args.pin_gb, iterations=args.iterations,
-        fe_opt_iters=args.fe_iters, log=log)
+        fe_opt_iters=args.fe_iters, checkpoint_dir=args.checkpoint_dir,
+        log=log)
     if args.json:
         print(json.dumps(out))
     else:

@@ -29,11 +29,63 @@ __all__ = [
     "IngestConfig",
     "RandomEffectDataConfiguration",
     "StagingConfig",
+    "StreamingConfig",
     "parse_ingest_config",
     "parse_kv",
     "parse_optimizer_config",
     "parse_staging_config",
+    "parse_streaming_config",
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamingConfig:
+    """Row-streamed fixed-effect fit configuration (docs/STREAMING.md).
+
+    When passed to ``GameEstimator(streaming=...)`` (CLI: ``game_train
+    --streaming``), sparse fixed-effect coordinates route onto the
+    streamed path: the SparseShard stages into host-resident hot-dense/
+    cold-ELL chunks, chunk ranges partition over the mesh's ``data``
+    axis, and every L-BFGS value/gradient streams each device's range
+    with partials merged via ``psum`` — n bounded by host RAM, not HBM.
+
+    ``chunk_rows``: rows per chunk, the streamed transfer unit (every
+    chunk shares one compiled program; the flagship uses 5M). ``num_hot``:
+    hot-dense columns per chunk (the Zipf head). ``feature_dtype``:
+    chunk storage dtype — None inherits the coordinate's
+    ``FixedEffectDataConfiguration.feature_dtype``; "bfloat16" halves
+    the host→device stream, the steady-state cost of every objective
+    evaluation. ``prefetch_depth``: transfers in flight ahead of compute
+    per device. ``pin_chunks``: leading chunks pinned resident PER
+    DEVICE (spare HBM traded for stream traffic). ``workers``: staging
+    canonicalization threads (None = host cores).
+    """
+
+    chunk_rows: int = 262144
+    num_hot: int = 512
+    feature_dtype: Optional[str] = None
+    prefetch_depth: int = 2
+    pin_chunks: int = 0
+    workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.chunk_rows < 1:
+            raise ValueError(
+                f"chunk_rows must be >= 1, got {self.chunk_rows}")
+        if self.num_hot < 1:
+            raise ValueError(f"num_hot must be >= 1, got {self.num_hot}")
+        if self.feature_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"unsupported feature_dtype {self.feature_dtype!r}; "
+                "expected float32 or bfloat16")
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
+        if self.pin_chunks < 0:
+            raise ValueError(
+                f"pin_chunks must be >= 0, got {self.pin_chunks}")
+        if self.workers is not None and self.workers < 1:
+            raise ValueError(f"workers must be >= 1, got {self.workers}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,6 +298,36 @@ def parse_ingest_config(spec: str) -> IngestConfig:
         pipeline_depth=int(kv["depth"]) if "depth" in kv else None,
         chunk_records=(int(kv["chunk_records"]) if "chunk_records" in kv
                        else defaults.chunk_records),
+    )
+
+
+def parse_streaming_config(spec: str) -> StreamingConfig:
+    """Parse ``key=value,...`` mini-DSL for the row-streamed fixed-effect
+    path (docs/STREAMING.md). An empty spec (bare ``--streaming``) takes
+    every default.
+
+    Keys: chunk_rows (rows per streamed chunk), num_hot (hot-dense
+    columns per chunk), dtype (float32|bfloat16 chunk storage; default
+    inherits the coordinate's dtype), depth (prefetch transfers in
+    flight per device), pin (leading chunks pinned per device), workers
+    (staging canonicalization threads).
+    """
+    kv = parse_kv(spec)
+    known = {"chunk_rows", "num_hot", "dtype", "depth", "pin", "workers"}
+    unknown = set(kv) - known
+    if unknown:
+        raise ValueError(f"unknown streaming keys {sorted(unknown)}; "
+                         f"expected {sorted(known)}")
+    defaults = StreamingConfig()
+    return StreamingConfig(
+        chunk_rows=(int(kv["chunk_rows"]) if "chunk_rows" in kv
+                    else defaults.chunk_rows),
+        num_hot=int(kv["num_hot"]) if "num_hot" in kv else defaults.num_hot,
+        feature_dtype=kv["dtype"].lower() if "dtype" in kv else None,
+        prefetch_depth=(int(kv["depth"]) if "depth" in kv
+                        else defaults.prefetch_depth),
+        pin_chunks=int(kv["pin"]) if "pin" in kv else defaults.pin_chunks,
+        workers=int(kv["workers"]) if "workers" in kv else None,
     )
 
 

@@ -24,7 +24,7 @@ from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        FixedEffectDataConfiguration,
                                        IngestConfig,
                                        RandomEffectDataConfiguration,
-                                       StagingConfig)
+                                       StagingConfig, StreamingConfig)
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.evaluation import evaluators as ev
 from photon_ml_tpu.game import descent
@@ -67,6 +67,7 @@ class GameEstimator:
         staging_cache_dir: Optional[str] = None,
         staging: Optional[StagingConfig] = None,
         ingest: Optional[IngestConfig] = None,
+        streaming: Optional[StreamingConfig] = None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -90,6 +91,11 @@ class GameEstimator:
         # behalf (game_train wires --ingest / --ingest-cache-dir through
         # here and into AvroDataReader.read).
         self.ingest = ingest
+        # Row-streamed fixed effects (docs/STREAMING.md): when set, every
+        # sparse fixed-effect coordinate routes onto the streamed path —
+        # chunk ranges sharded over the mesh's data axis, psum-merged
+        # partials, n bounded by host RAM instead of HBM.
+        self.streaming = streaming
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
@@ -107,6 +113,7 @@ class GameEstimator:
         opt_configs: dict[str, GLMOptimizationConfiguration],
     ) -> dict[str, object]:
         coords: dict[str, object] = {}
+        streamed: list[str] = []
         for cid, cc in self.coordinate_configs.items():
             opt = opt_configs[cid]
             if isinstance(cc.data, FixedEffectDataConfiguration):
@@ -116,6 +123,23 @@ class GameEstimator:
                         raise ValueError(
                             f"normalization is not supported on sparse "
                             f"shard {cc.data.feature_shard_id!r}")
+                    if self.streaming is not None:
+                        if cc.data.feature_sharded:
+                            raise ValueError(
+                                f"coordinate {cid!r}: streaming and "
+                                f"feature_sharded are mutually exclusive "
+                                f"— the streamed path shards ROWS over "
+                                f"the data axis (docs/STREAMING.md)")
+                        from photon_ml_tpu.game.coordinates import \
+                            StreamingSparseFixedEffectCoordinate
+
+                        coords[cid] = \
+                            StreamingSparseFixedEffectCoordinate.stage(
+                                dataset, cc.data.feature_shard_id,
+                                self.loss, opt, self.mesh, self.streaming,
+                                default_dtype=cc.data.feature_dtype)
+                        streamed.append(cid)
+                        continue
                     coords[cid] = SparseFixedEffectCoordinate(
                         dataset, cc.data.feature_shard_id, self.loss, opt,
                         self.mesh,
@@ -177,6 +201,13 @@ class GameEstimator:
                     upper_bound=cc.data.active_data_upper_bound)
             else:  # pragma: no cover
                 raise TypeError(type(cc.data))
+        if self.streaming is not None and not streamed:
+            # A streaming config that routes nothing is a silent no-op
+            # pretending to be the biggest-config engine — fail loud.
+            raise ValueError(
+                "streaming=... was set but no coordinate routed onto the "
+                "streamed path: it applies to FIXED-effect coordinates "
+                "over SPARSE shards (docs/STREAMING.md)")
         return coords
 
     # -- evaluation --------------------------------------------------------
@@ -324,7 +355,11 @@ class GameEstimator:
                         (s, descent.normalization_digest(ctx))
                         for s, ctx in self.normalization.items())),
                     tuple((cid, self.coordinate_configs[cid].data)
-                          for cid in cids))
+                          for cid in cids),
+                    # Streaming reshapes coordinate construction (chunked
+                    # staging vs device-resident) without touching the
+                    # data configs above.
+                    self.streaming)
                 cached = self._coord_cache.get("last")
                 if cached is not None and cached[0] == cache_key:
                     base_coords = {

@@ -30,7 +30,8 @@ from photon_ml_tpu.api.configs import (CoordinateConfiguration,
                                        RandomEffectDataConfiguration,
                                        parse_ingest_config, parse_kv,
                                        parse_optimizer_config,
-                                       parse_staging_config)
+                                       parse_staging_config,
+                                       parse_streaming_config)
 from photon_ml_tpu.api.estimator import GameEstimator
 from photon_ml_tpu.data.io import load_game_dataset
 from photon_ml_tpu.data.validators import (DataValidationLevel,
@@ -161,6 +162,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "chunk_records=65536' (docs/INGEST.md); applies "
                         "to Avro inputs (--avro-feature-shard). Default: "
                         "one decode worker per host core, thread mode")
+    p.add_argument("--streaming", nargs="?", const="",
+                   help="route sparse fixed-effect coordinates onto the "
+                        "row-streamed path (docs/STREAMING.md): the shard "
+                        "stages into host-resident chunks, chunk ranges "
+                        "partition over the mesh's data axis, and every "
+                        "L-BFGS value/gradient streams each device's "
+                        "range with psum-merged partials — n bounded by "
+                        "host RAM, not HBM; the fit checkpoints mid-"
+                        "optimization. Optional mini-DSL "
+                        "'chunk_rows=262144,num_hot=512,"
+                        "dtype=float32|bfloat16,depth=2,pin=0,workers=8' "
+                        "(bare --streaming takes every default)")
     p.add_argument("--ingest-cache-dir",
                    help="persist decoded Avro columns here (columnar "
                         "mmap ingest cache, keyed by file identity + "
@@ -414,7 +427,10 @@ def run(args) -> dict:
         staging_cache_dir=args.staging_cache_dir,
         staging=(parse_staging_config(args.staging)
                  if getattr(args, "staging", None) else None),
-        ingest=_ingest_config(args) if args.avro_feature_shard else None)
+        ingest=_ingest_config(args) if args.avro_feature_shard else None,
+        streaming=(parse_streaming_config(args.streaming)
+                   if getattr(args, "streaming", None) is not None
+                   else None))
 
     initial_models = None
     if args.model_input_dir:

@@ -62,6 +62,7 @@ import json
 import logging
 import os
 import shutil
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -79,6 +80,26 @@ _STATE = "state.json"
 _MODEL = "model"
 _RESIDUALS = "residuals.npz"
 _PREV = ".prev"
+_STREAM_STATE = "stream_state.npz"
+_STREAM_META = "stream_meta.json"
+_STREAM_DIR = "stream-step-{step}"
+
+
+def _preserve_file(path: str) -> None:
+    """Keep the committed generation of ``path`` alive as ``path.prev``
+    before a rewrite. Hardlink (one inode, no copy); a filesystem
+    without hardlinks falls back to a copy."""
+    if not os.path.exists(path):
+        return
+    prev = path + _PREV
+    try:
+        os.unlink(prev)
+    except OSError:
+        pass  # absent or unremovable; os.link/copy below decides
+    try:
+        os.link(path, prev)
+    except OSError:
+        shutil.copy2(path, prev)
 
 
 @dataclasses.dataclass
@@ -116,21 +137,14 @@ class CheckpointManager:
         return os.path.join(self.directory, rel.replace("/", os.sep))
 
     def _preserve(self, rel: str) -> None:
-        """Keep the committed generation of ``rel`` alive as ``rel.prev``
-        before a rewrite. Hardlink (one inode, no copy); a filesystem
-        without hardlinks falls back to a copy."""
-        path = self._abs(rel)
-        if not os.path.exists(path):
-            return
-        prev = path + _PREV
-        try:
-            os.unlink(prev)
-        except OSError:
-            pass  # absent or unremovable; os.link/copy below decides
-        try:
-            os.link(path, prev)
-        except OSError:
-            shutil.copy2(path, prev)
+        _preserve_file(self._abs(rel))
+
+    def stream_dir(self, step: int) -> str:
+        """Directory for one descent step's MID-OPTIMIZATION streaming
+        state (StreamingStateStore) — the streamed fixed-effect update
+        is the multi-hour unit at flagship scale, so it checkpoints
+        inside the step, not just between steps."""
+        return os.path.join(self.directory, _STREAM_DIR.format(step=step))
 
     def _commit_file(self, rel: str) -> None:
         """Record one just-written artifact's CRC. Injected bit rot
@@ -390,3 +404,146 @@ class CheckpointManager:
             residual_total=residual_total,
             recovered=recovered,
         )
+
+
+class StreamingStateStore:
+    """Mid-L-BFGS state for the streamed fixed-effect coordinate, under
+    the repo's checkpoint discipline: atomic writes, a CRC32-carrying
+    commit marker written LAST, and two generations via ``.prev``
+    hardlinks (docs/STREAMING.md "Checkpoint format").
+
+    Layout under the store directory (one per descent step, from
+    ``CheckpointManager.stream_dir``)::
+
+        stream_state.npz       # optim/streaming.snapshot_state arrays
+        stream_meta.json       # CRC32 + fingerprint + iteration (COMMIT)
+        <both>.prev            # the previous committed generation
+
+    A kill between the npz and meta writes leaves a newer npz with an
+    older meta — ``load`` trusts the META (the commit point) and falls
+    back to the ``.prev`` npz its CRC vouches for; the torn iteration
+    simply re-runs on resume. Corruption of one generation degrades to
+    the previous one (CheckpointRecovered event); both gone → None, and
+    the coordinate re-optimizes the step from its warm start — recovery
+    degrades, it never resumes silently wrong state.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    # -- write -------------------------------------------------------------
+
+    def save(self, state: dict, fingerprint: Optional[dict] = None) -> None:
+        """Persist one iteration snapshot (rank 0 only — the store lives
+        on the shared checkpoint filesystem)."""
+        import jax
+
+        from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
+
+        if jax.process_index() != 0:
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        flt.fire("stream.checkpoint_write")
+        path = os.path.join(self.directory, _STREAM_STATE)
+        _preserve_file(path)
+        arrays = {k: np.asarray(v) for k, v in state.items()}
+        atomic_write(path, lambda f: np.savez(f, **arrays))
+        # CRC over the GOOD bytes first, injected bit rot after — the
+        # corruption shape load() must catch. Distinct corrupt-hook site
+        # (the convention of checkpoint.save / checkpoint.artifact):
+        # fire() and corrupt_file() each count occurrences, so sharing a
+        # name would interleave the two hooks' occurrence spaces.
+        crc = file_crc32(path)
+        flt.corrupt_file("stream.checkpoint_artifact", path)
+        meta_path = os.path.join(self.directory, _STREAM_META)
+        _preserve_file(meta_path)
+        atomic_write(meta_path, lambda f: f.write(json.dumps({
+            "crc": crc,
+            "iteration": int(state["it"]),
+            "fingerprint": fingerprint,
+        }).encode()))
+        logger.debug("stream state committed: iteration %d -> %s",
+                     int(state["it"]), self.directory)
+
+    # -- read --------------------------------------------------------------
+
+    def _read_meta(self, path: str) -> Optional[dict]:
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("stream meta %s unreadable (%s: %s)", path,
+                           type(e).__name__, e)
+            return None
+
+    def _load_generation(self, meta: Optional[dict]) -> Optional[dict]:
+        """The npz whose CRC the given meta vouches for: the current
+        file, or its ``.prev`` (a kill between npz and meta writes)."""
+        from photon_ml_tpu.utils.diskio import file_crc32
+
+        if meta is None:
+            return None
+        path = os.path.join(self.directory, _STREAM_STATE)
+        for cand in (path, path + _PREV):
+            try:
+                if os.path.exists(cand) and \
+                        file_crc32(cand) == int(meta["crc"]):
+                    with np.load(cand, allow_pickle=False) as z:
+                        return {k: z[k] for k in z.files}
+            except (OSError, ValueError, KeyError, zlib.error) as e:
+                logger.warning("stream state %s unusable (%s: %s)", cand,
+                               type(e).__name__, e)
+        return None
+
+    def load(self, expected_fingerprint: Optional[dict] = None
+             ) -> Optional[dict]:
+        """The newest committed snapshot, or None (absent, corrupt in
+        both generations, or written under a different fingerprint —
+        the step then re-optimizes from its warm start)."""
+        flt.fire("stream.checkpoint_load")
+        meta_path = os.path.join(self.directory, _STREAM_META)
+        meta = self._read_meta(meta_path)
+        state = self._load_generation(meta)
+        recovered = False
+        if state is None:
+            prev = self._read_meta(meta_path + _PREV)
+            state = self._load_generation(prev)
+            if state is None:
+                if meta is not None or prev is not None:
+                    logger.error(
+                        "stream checkpoint at %s is corrupt in both "
+                        "generations — the step re-optimizes from its "
+                        "warm start", self.directory)
+                return None
+            meta = prev
+            recovered = True
+        saved_fp = meta.get("fingerprint")
+        if (expected_fingerprint is not None and saved_fp is not None
+                and saved_fp != expected_fingerprint):
+            logger.warning(
+                "stream checkpoint at %s was written under a different "
+                "configuration — discarding (saved=%s expected=%s)",
+                self.directory, saved_fp, expected_fingerprint)
+            return None
+        if recovered:
+            logger.warning(
+                "stream checkpoint at %s was corrupt; recovered the "
+                "previous committed generation (iteration %d) — the torn "
+                "iteration re-runs", self.directory,
+                int(meta["iteration"]))
+            ev_mod.default_emitter.emit(ev_mod.CheckpointRecovered(
+                directory=self.directory,
+                done_steps=int(meta["iteration"]),
+                reason="stream state CRC mismatch"))
+        return state
+
+    def clear(self) -> None:
+        """Remove the store (the step committed; its mid-step state is
+        stale and must not leak into a later run's resume)."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        shutil.rmtree(self.directory, ignore_errors=True)

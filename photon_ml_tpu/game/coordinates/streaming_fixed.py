@@ -11,6 +11,20 @@ coordinate when the staged layout exceeds HBM (n in the hundreds of
 millions on one 16 GB chip); the device-resident
 ``SparseFixedEffectCoordinate`` is strictly faster whenever it fits.
 
+Multi-chip (docs/STREAMING.md): pass a ``mesh`` and the chunk ranges
+partition over its ``data`` axis — each device streams its own range and
+per-device partial (value, gradient) merge via ``psum``
+(``ops/streaming_sparse.ShardedChunkStream``), the reference's
+``treeAggregate`` over partitions. A 1-device mesh is bit-identical to
+the mesh-less path.
+
+Crash-resume: when coordinate descent binds a step checkpoint
+(``bind_step_checkpoint``, wired by game/descent.py from the
+CheckpointManager), every accepted L-BFGS iteration persists the full
+driver-loop state through game/checkpoint.py's StreamingStateStore
+(CRC + two generations), and a killed fit resumes mid-optimization with
+BIT-identical final coefficients.
+
 Streaming contract: the chunks must be staged with ZERO offsets — in
 coordinate descent the full residual (base offsets + other coordinates'
 scores) arrives as the ``offsets`` argument of ``train_model``, and
@@ -25,6 +39,10 @@ down-sampling, and SIMPLE/FULL variances.
 
 from __future__ import annotations
 
+import hashlib
+import logging
+import os
+import time
 from typing import Optional
 
 import jax
@@ -40,8 +58,30 @@ from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
 from photon_ml_tpu.optim.regularization import (intercept_mask, with_l2,
                                                 with_l2_value)
 from photon_ml_tpu.optim.streaming import minimize_streaming
+from photon_ml_tpu.utils import events as ev_mod
 
 Array = jax.Array
+
+logger = logging.getLogger("photon_ml_tpu.game")
+
+
+def _validate_streaming_config(config: GLMOptimizationConfiguration) -> None:
+    """The streamed path's feature envelope, enforced at construction AND
+    at every config swap (the estimator's grid/tuning path)."""
+    if config.regularization.l1_weight() != 0.0:
+        raise ValueError(
+            "L1/OWL-QN is not supported on the streaming path (the "
+            "orthant bookkeeping lives in the compiled optimizer); "
+            "use L2, or the device-resident SparseFixedEffectCoordinate")
+    if config.down_sampling_rate < 1.0:
+        raise ValueError("down-sampling is not supported on the "
+                         "streaming path")
+    if VarianceComputationType(config.variance_computation) != \
+            VarianceComputationType.NONE:
+        raise ValueError(
+            "variance computation is not supported on the streaming "
+            "path (a diagonal-Hessian stream pass is a straightforward "
+            "extension if needed)")
 
 
 class StreamingSparseFixedEffectCoordinate:
@@ -57,6 +97,7 @@ class StreamingSparseFixedEffectCoordinate:
         intercept_index: Optional[int] = None,
         prefetch_depth: int = 2,
         pin_device_chunks: int = 0,
+        mesh=None,
         log=lambda m: None,
     ):
         if chunked.num_rows != dataset.num_rows:
@@ -81,44 +122,157 @@ class StreamingSparseFixedEffectCoordinate:
                     "``train_model``, and ``score`` must return pure "
                     "wᵀx margins; staged offsets would be double-counted."
                 )
-        if config.regularization.l1_weight() != 0.0:
-            raise ValueError(
-                "L1/OWL-QN is not supported on the streaming path (the "
-                "orthant bookkeeping lives in the compiled optimizer); "
-                "use L2, or the device-resident SparseFixedEffectCoordinate")
-        if config.down_sampling_rate < 1.0:
-            raise ValueError("down-sampling is not supported on the "
-                             "streaming path")
-        if VarianceComputationType(config.variance_computation) != \
-                VarianceComputationType.NONE:
-            raise ValueError(
-                "variance computation is not supported on the streaming "
-                "path (a diagonal-Hessian stream pass is a straightforward "
-                "extension if needed)")
+        _validate_streaming_config(config)
         self.dataset = dataset
         self.chunked = chunked
         self.shard_id = shard_id
         self.loss = loss
         self.config = config
         self.intercept_index = intercept_index
+        self.mesh = mesh
         self._log = log
-        # Spare-HBM chunk pinning: the caller sizes this against whatever
-        # else the fit keeps resident (e.g. RE bucket blocks).
-        self._pinned = ss.pin_chunks(chunked, pin_device_chunks)
-        self._vg = ss.make_value_and_gradient(
-            loss, chunked, prefetch_depth=prefetch_depth,
-            pinned=self._pinned)
-        # Value-only streamed pass for Armijo probes: rejected steps skip
-        # the gradient half of the chunk kernel (optim/streaming.py).
-        self._v = ss.make_value_only(
-            loss, chunked, prefetch_depth=prefetch_depth,
-            pinned=self._pinned)
+        if mesh is not None:
+            # Sharded streaming: chunk ranges partition over the mesh's
+            # data axis, per-device partials psum-merge (treeAggregate).
+            # pin_device_chunks here is PER DEVICE (each chip's share of
+            # the spare-HBM budget).
+            self._stream = ss.ShardedChunkStream(
+                chunked, mesh, prefetch_depth=prefetch_depth,
+                pin_device_chunks=pin_device_chunks)
+            self._vg = self._stream.value_and_gradient(loss)
+            self._v = self._stream.value_only(loss)
+        else:
+            self._stream = None
+            # Spare-HBM chunk pinning: the caller sizes this against
+            # whatever else the fit keeps resident (e.g. RE buckets).
+            self._pinned = ss.pin_chunks(chunked, pin_device_chunks)
+            self._vg = ss.make_value_and_gradient(
+                loss, chunked, prefetch_depth=prefetch_depth,
+                pinned=self._pinned)
+            # Value-only streamed pass for Armijo probes: rejected steps
+            # skip the gradient half of the chunk kernel
+            # (optim/streaming.py).
+            self._v = ss.make_value_only(
+                loss, chunked, prefetch_depth=prefetch_depth,
+                pinned=self._pinned)
         self._prefetch_depth = prefetch_depth
         self._padded_n = chunked.num_chunks * chunked.chunk_rows
+        # Mid-optimization checkpoint binding (game/descent.py wires the
+        # CheckpointManager's per-step stream dir through here).
+        self._ckpt_store = None
+        self._ckpt_step = None
+
+    @classmethod
+    def stage(
+        cls,
+        dataset,
+        shard_id: str,
+        loss: PointwiseLoss,
+        config: GLMOptimizationConfiguration,
+        mesh,
+        streaming,
+        default_dtype: Optional[str] = None,
+        log=lambda m: None,
+    ) -> "StreamingSparseFixedEffectCoordinate":
+        """Build the coordinate from a GameDataset's SparseShard: slice
+        the shard into zero-offset row chunks and canonicalize them into
+        the hot-dense/cold-ELL layout (``workers``-parallel, bit-identical
+        to the serial pass) — the estimator's route onto the streamed
+        path (``GameEstimator(streaming=...)`` / ``game_train
+        --streaming``). ``streaming`` is an api/configs.StreamingConfig;
+        its ``feature_dtype=None`` inherits ``default_dtype`` (the
+        coordinate data config's dtype knob).
+        """
+        dtype = streaming.feature_dtype or default_dtype or "float32"
+        shard = dataset.feature_shards[shard_id]
+        n = int(shard.indices.shape[0])
+        workers = streaming.workers or os.cpu_count() or 1
+        num_chunks = (n + streaming.chunk_rows - 1) // streaming.chunk_rows
+        emitter = ev_mod.default_emitter
+        emitter.emit(ev_mod.StreamStageStart(
+            shard_id=shard_id, num_rows=n,
+            chunk_rows=streaming.chunk_rows, num_chunks=num_chunks,
+            workers=workers))
+        t0 = time.perf_counter()
+        chunked = None
+        try:
+            chunked = ss.build_chunked(
+                ss.iter_shard_chunks(shard, dataset.response,
+                                     dataset.weights,
+                                     streaming.chunk_rows),
+                int(shard.num_features), streaming.chunk_rows,
+                num_hot=streaming.num_hot,
+                feature_dtype=(jnp.bfloat16 if dtype == "bfloat16"
+                               else jnp.float32),
+                workers=workers, log=log)
+        finally:
+            # Balanced lifecycle (PML007): staging failures still close
+            # the scope for listeners tracking it.
+            emitter.emit(ev_mod.StreamStageFinish(
+                shard_id=shard_id,
+                num_chunks=chunked.num_chunks if chunked else 0,
+                seconds=time.perf_counter() - t0))
+        return cls(
+            dataset, chunked, shard_id, loss, config,
+            intercept_index=dataset.intercept_index.get(shard_id),
+            prefetch_depth=streaming.prefetch_depth,
+            pin_device_chunks=streaming.pin_chunks, mesh=mesh, log=log)
+
+    def with_optimization_config(
+        self, config: GLMOptimizationConfiguration
+    ) -> "StreamingSparseFixedEffectCoordinate":
+        """Same staged chunk stream, new optimization config (the
+        estimator's grid/tuning swap — staging is the expensive part)."""
+        import copy
+
+        _validate_streaming_config(config)
+        c = copy.copy(self)
+        c.config = config
+        c._ckpt_store = None
+        c._ckpt_step = None
+        return c
 
     @property
     def dim(self) -> int:
         return self.chunked.dim
+
+    # -- mid-optimization checkpointing -----------------------------------
+
+    def bind_step_checkpoint(self, directory: str, step: int) -> None:
+        """Arm mid-L-BFGS checkpointing for the NEXT train_model call
+        (game/descent.py binds one directory per descent step)."""
+        from photon_ml_tpu.game.checkpoint import StreamingStateStore
+
+        self._ckpt_store = StreamingStateStore(directory)
+        self._ckpt_step = step
+
+    def clear_step_checkpoint(self) -> None:
+        """Drop the committed step's mid-step state (descent calls this
+        after the step-level checkpoint commits — stale stream state
+        must not leak into a later step's resume)."""
+        if self._ckpt_store is not None:
+            self._ckpt_store.clear()
+        self._ckpt_store = None
+        self._ckpt_step = None
+
+    def _stream_fingerprint(self, offsets: Array, w0: Array) -> dict:
+        """What a mid-step snapshot must agree on to be resumable: the
+        step identity, the optimizer config, and digests of the residual
+        offsets and warm start (the objective the snapshot was taken
+        under — resuming against a different residual would silently
+        continue the wrong optimization)."""
+        from photon_ml_tpu.game.descent import _jsonable
+
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(np.asarray(offsets)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(w0)).tobytes())
+        return {
+            "step": self._ckpt_step,
+            "shard": self.shard_id,
+            "config": _jsonable(self.config),
+            "dim": self.dim,
+            "objective_digest": h.hexdigest(),
+        }
 
     def _pad_offsets(self, offsets: Array) -> Array:
         offsets = jnp.asarray(offsets, jnp.float32)
@@ -140,13 +294,30 @@ class StreamingSparseFixedEffectCoordinate:
         l2 = self.config.regularization.l2_weight()
         vg = with_l2(lambda w: self._vg(w, off), l2, mask)
         v = with_l2_value(lambda w: self._v(w, off), l2, mask)
+        checkpoint_save = None
+        resume_state = None
+        if self._ckpt_store is not None:
+            fp = self._stream_fingerprint(off, w0)
+            store = self._ckpt_store
+            resume_state = store.load(expected_fingerprint=fp)
+            if resume_state is not None:
+                self._log(f"resuming streamed fit from iteration "
+                          f"{int(resume_state['it'])} checkpoint")
+
+            def checkpoint_save(state, _store=store, _fp=fp):
+                _store.save(state, fingerprint=_fp)
+
         result = minimize_streaming(vg, w0, self.config.optimizer,
-                                    log=self._log, value_only=v)
+                                    log=self._log, value_only=v,
+                                    checkpoint_save=checkpoint_save,
+                                    resume_state=resume_state)
         return FixedEffectModel(shard_id=self.shard_id,
                                 coefficients=Coefficients(result.w))
 
     def score(self, model: FixedEffectModel) -> Array:
         """(n,) wᵀx margins, streamed (chunks staged with zero offsets)."""
+        if self._stream is not None:
+            return self._stream.margins(model.coefficients.means)
         return ss.margins_chunked(self.chunked, model.coefficients.means,
                                   prefetch_depth=self._prefetch_depth,
                                   pinned=self._pinned)

@@ -211,6 +211,14 @@ def run(
                     continue  # already covered by the checkpoint
                 coord = coordinates[cid]
                 t0 = time.monotonic()
+                if checkpoint_manager is not None:
+                    # Streamed coordinates checkpoint INSIDE the update
+                    # too (their fit is the multi-hour unit at flagship
+                    # scale): bind this step's stream-state directory so
+                    # a kill mid-L-BFGS resumes mid-optimization.
+                    bind = getattr(coord, "bind_step_checkpoint", None)
+                    if bind is not None:
+                        bind(checkpoint_manager.stream_dir(step), step)
                 # Residual offsets: everything except this coordinate.
                 offsets = base + total - scores[cid]
                 model = coord.train_model(offsets, initial=models[cid])
@@ -239,6 +247,11 @@ def run(
                         # host copy, once per coordinate update (seconds of
                         # device work), and _sync already drained the stream
                         updated=[cid], residual_total=np.asarray(total))
+                    # The step committed: its mid-step stream state is
+                    # stale (a later resume starts AFTER this step).
+                    clear = getattr(coord, "clear_step_checkpoint", None)
+                    if clear is not None:
+                        clear()
     finally:
         # Balanced lifecycle (PML007): a raise mid-descent must still
         # close the training scope for listeners tracking it.

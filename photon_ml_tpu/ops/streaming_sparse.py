@@ -37,16 +37,28 @@ from __future__ import annotations
 
 import dataclasses
 import gc
+import logging
+import time
 from typing import Callable, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import faults as flt
 from photon_ml_tpu.ops.hybrid_sparse import _hot_matvec, _hot_rmatvec
 from photon_ml_tpu.ops.losses import PointwiseLoss
 
 Array = jax.Array
+
+logger = logging.getLogger("photon_ml_tpu.ops")
+
+# Chunk host→device transfer degradation ladder (docs/ROBUSTNESS.md):
+# bounded retry with deterministic backoff, then a loud failure — a
+# transfer is idempotent (the chunk is host-resident), so retry is always
+# safe, and there is no serial fallback below it to degrade to.
+TRANSFER_MAX_RETRIES = 2
+TRANSFER_RETRY_BACKOFF_S = 0.05
 
 
 @jax.tree_util.register_dataclass
@@ -170,42 +182,82 @@ def build_chunked(
     num_hot: int = 512,
     feature_dtype=jnp.float32,
     log: Optional[Callable[[str], None]] = None,
+    workers: int = 1,
 ) -> ChunkedHybrid:
     """Stage a stream of ELL chunks into host-resident canonical layouts.
 
     ``chunk_iter`` yields objects with ``indices / values / labels /
     weights / offsets`` host arrays (``data/sparse.SparseBatch`` or any
     duck-typed source — the chunked Avro reader, a synthetic generator).
-    Peak host memory beyond the staged output is ONE chunk."""
+    Peak host memory beyond the staged output is ONE chunk serially;
+    ``workers > 1`` fans the per-chunk canonicalization (bincount +
+    argpartition + scatter — GIL-releasing numpy) over a thread pool
+    with a bounded in-flight window of ``workers + 2`` chunks, merged in
+    plan order BIT-identically to the serial pass (the per-chunk math is
+    independent; only the submission order is pipelined)."""
+    import concurrent.futures as cf
+
     num_hot = min(num_hot, num_features)
-    chunks = []
     total = 0
     short_at = None
-    for i, raw in enumerate(chunk_iter):
-        if short_at is not None:
-            # Row bookkeeping (margins_chunked's z[:num_rows] tail drop,
-            # _offsets_for's i*chunk_rows slices) assumes pad rows exist
-            # only at the STREAM tail; a mid-stream short chunk would
-            # silently misalign residuals.
-            raise ValueError(
-                f"chunk {short_at} was short but chunk {i} follows — "
-                f"only the final chunk may have fewer than chunk_rows="
-                f"{chunk_rows} rows")
-        n_i = int(np.asarray(raw.labels).shape[0])
-        if n_i > chunk_rows:
-            raise ValueError(f"chunk {i} has {n_i} rows > chunk_rows="
-                             f"{chunk_rows}")
-        total += n_i
-        if n_i < chunk_rows:
-            short_at = i
-            raw = _pad_chunk(raw, chunk_rows, num_features)
-        ch = _build_canonical(raw, num_features, num_hot, feature_dtype)
+    rows_of: list[int] = []
+
+    def _prepped():
+        """Serial validation + tail padding (cheap) ahead of the
+        canonicalization fan-out; mutates total/short_at bookkeeping."""
+        nonlocal total, short_at
+        for i, raw in enumerate(chunk_iter):
+            if short_at is not None:
+                # Row bookkeeping (margins_chunked's z[:num_rows] tail
+                # drop, _offsets_for's i*chunk_rows slices) assumes pad
+                # rows exist only at the STREAM tail; a mid-stream short
+                # chunk would silently misalign residuals.
+                raise ValueError(
+                    f"chunk {short_at} was short but chunk {i} follows — "
+                    f"only the final chunk may have fewer than chunk_rows="
+                    f"{chunk_rows} rows")
+            n_i = int(np.asarray(raw.labels).shape[0])
+            if n_i > chunk_rows:
+                raise ValueError(f"chunk {i} has {n_i} rows > chunk_rows="
+                                 f"{chunk_rows}")
+            total += n_i
+            rows_of.append(n_i)
+            if n_i < chunk_rows:
+                short_at = i
+                raw = _pad_chunk(raw, chunk_rows, num_features)
+            yield i, raw
+
+    chunks: list[CanonicalChunk] = []
+
+    def _emit(i: int, ch: CanonicalChunk) -> None:
         chunks.append(ch)
         if log is not None:
             cold_live = int((np.asarray(ch.cold_cols) <
                              num_features).sum())
-            log(f"staged chunk {i} ({n_i:,} rows, {num_hot} hot cols, "
-                f"{cold_live:,} cold nnz)")
+            log(f"staged chunk {i} ({rows_of[i]:,} rows, {num_hot} hot "
+                f"cols, {cold_live:,} cold nnz)")
+
+    if workers <= 1:
+        for i, raw in _prepped():
+            _emit(i, _build_canonical(raw, num_features, num_hot,
+                                      feature_dtype))
+    else:
+        import collections
+
+        window: collections.deque = collections.deque()
+        with cf.ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="pml-stream-stage") as pool:
+            for i, raw in _prepped():
+                window.append((i, pool.submit(
+                    _build_canonical, raw, num_features, num_hot,
+                    feature_dtype)))
+                if len(window) > workers + 2:
+                    j, fut = window.popleft()
+                    _emit(j, fut.result())
+            while window:
+                j, fut = window.popleft()
+                _emit(j, fut.result())
     if not chunks:
         raise ValueError("empty chunk stream")
     sigs = {ch.structure() for ch in chunks}
@@ -218,6 +270,27 @@ def build_chunked(
             "shares a single compiled program")
     return ChunkedHybrid(chunks=tuple(chunks), num_rows=total,
                          chunk_rows=chunk_rows)
+
+
+def iter_shard_chunks(shard, labels, weights, chunk_rows: int):
+    """SparseBatch chunks over an ELL SparseShard's row ranges, staged
+    with ZERO offsets (the streaming contract: in coordinate descent the
+    residual arrives via ``train_model``'s offsets argument, never via
+    the staged chunks). Feeds :func:`build_chunked` from a materialized
+    GameDataset shard — the estimator's route onto the streamed path.
+    Slices are views (no copy); _build_canonical owns the real work."""
+    from photon_ml_tpu.data.sparse import SparseBatch
+
+    labels = np.asarray(labels)
+    weights = np.asarray(weights)
+    n = int(shard.indices.shape[0])
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        yield SparseBatch(
+            indices=shard.indices[lo:hi], values=shard.values[lo:hi],
+            labels=labels[lo:hi], weights=weights[lo:hi],
+            offsets=np.zeros(hi - lo, np.float32),
+            num_features=int(shard.num_features))
 
 
 def _pad_chunk(raw, chunk_rows: int, d: int):
@@ -331,6 +404,28 @@ def _margins_kernel(w: Array, offsets: Array, ch: CanonicalChunk):
     return _chunk_margins_of(ch, w_pad, offsets)
 
 
+def _transfer(ch: CanonicalChunk, index: int,
+              device: Optional[jax.Device] = None):
+    """Host→device chunk copy behind the ``stream.chunk_transfer`` fault
+    site, with the bounded-retry ladder: a transfer is idempotent, so a
+    transient failure retries with deterministic backoff; exhausted
+    retries raise loudly (there is no degraded mode below a lost chunk —
+    dropping it would silently change the objective)."""
+    for attempt in range(TRANSFER_MAX_RETRIES + 1):
+        try:
+            flt.fire("stream.chunk_transfer", index=index)
+            return (jax.device_put(ch, device) if device is not None
+                    else jax.device_put(ch))
+        except Exception as e:
+            if attempt >= TRANSFER_MAX_RETRIES:
+                raise
+            logger.warning(
+                "chunk %d transfer failed (%s: %s); retry %d/%d",
+                index, type(e).__name__, e, attempt + 1,
+                TRANSFER_MAX_RETRIES)
+            time.sleep(TRANSFER_RETRY_BACKOFF_S * (attempt + 1))
+
+
 def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
     """Yield device-resident chunks with ``depth`` transfers in flight
     ahead of the consumer (same discipline as data/prefetch.py — the
@@ -347,16 +442,20 @@ def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
     for ch in pinned:
         yield ch
     q = collections.deque()
-    it = iter(chunked.chunks[len(pinned):])
+    it = enumerate(chunked.chunks)
+    for _ in range(len(pinned)):
+        next(it)
     try:
         for _ in range(depth):
-            q.append(jax.device_put(next(it)))
+            i, ch = next(it)
+            q.append(_transfer(ch, i))
     except StopIteration:
         pass
     while q:
         ready = q.popleft()
         try:
-            q.append(jax.device_put(next(it)))
+            i, ch = next(it)
+            q.append(_transfer(ch, i))
         except StopIteration:
             pass
         yield ready
@@ -477,3 +576,328 @@ def margins_chunked(
     gc.collect()
     z = jnp.concatenate(parts)
     return z[:chunked.num_rows]
+
+
+# ------------------------------------------------------- sharded streaming
+#
+# The multi-chip composition (ROADMAP item 1, the reference's
+# ``treeAggregate`` shape): chunk ranges partition over the mesh's
+# ``data`` axis, each device streams ITS range with the same
+# double-buffered prefetch + per-round barrier discipline as the
+# single-device path, and per-device partial (value, gradient) merge via
+# ``psum`` over ICI/DCN — the host-driven L-BFGS in optim/streaming.py
+# sees one global objective exactly as photon-api's Breeze driver loop
+# sees one treeAggregate result. Snap ML's local-compute/global-merge
+# hierarchy and Trofimov–Genkin's distributed GLM descent (PAPERS.md)
+# are the same decomposition.
+
+
+def shard_chunk_ranges(num_chunks: int, num_devices: int
+                       ) -> list[tuple[int, int]]:
+    """Contiguous, balanced [lo, hi) chunk ranges, one per device.
+
+    Contiguous (not round-robin) so each device's offsets slice is one
+    block of the global (padded_n,) residual array and the short padded
+    tail chunk stays on the LAST device — the pad-rows-at-stream-tail
+    invariant holds per device."""
+    if num_devices < 1:
+        raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+    base, rem = divmod(num_chunks, num_devices)
+    ranges = []
+    lo = 0
+    for k in range(num_devices):
+        hi = lo + base + (1 if k < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def data_axis_devices(mesh) -> list:
+    """The mesh's devices along ``data`` (streaming does not feature-
+    shard, so a model axis > 1 is a config error, not a silent drop)."""
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError(
+            f"streaming shards rows over the '{DATA_AXIS}' axis only; "
+            f"mesh has {MODEL_AXIS}={mesh.shape[MODEL_AXIS]} (feature-"
+            f"sharded streaming is not supported — use the device-"
+            f"resident feature-sharded path)")
+    return list(np.asarray(mesh.devices).reshape(-1))
+
+
+_MERGE_FNS: dict = {}
+
+
+def _merge_fn(mesh):
+    """shard_map psum merge of per-device partials: (D,) values and
+    (D, d) gradients sharded over ``data`` → replicated global sums.
+    This IS the treeAggregate reduction, riding ICI within a slice and
+    DCN across slices; cached per mesh (one compile per topology)."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    cached = _MERGE_FNS.get(mesh)
+    if cached is not None:
+        return cached
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS, None)),
+        out_specs=(P(), P()))
+    def _merge(v, g):
+        return (jax.lax.psum(jnp.sum(v), DATA_AXIS),
+                jax.lax.psum(jnp.sum(g, axis=0), DATA_AXIS))
+
+    # jit so the merge compiles once per (mesh, shape) instead of
+    # re-tracing on every objective evaluation.
+    merged = jax.jit(_merge)
+    _MERGE_FNS[mesh] = merged
+    return merged
+
+
+class ShardedChunkStream:
+    """Multi-device streamed aggregates over one ``ChunkedHybrid``.
+
+    Each data-axis device owns a contiguous chunk range and streams it
+    through its own prefetch queue; every objective evaluation runs the
+    per-chunk kernel round-robin across devices (so D transfers/computes
+    are in flight at once) with ONE dispatch barrier per round — the
+    multi-device analogue of the single-device per-chunk barrier, holding
+    at most D chunks of enqueue scratch. Per-device partials merge via
+    the psum program of :func:`_merge_fn`.
+
+    ``pin_device_chunks`` pins that many LEADING chunks of each device's
+    range on that device (the per-device share of the spare-HBM budget).
+
+    A 1-device mesh reproduces the single-device path bit-for-bit: same
+    kernel, same chunk order, same accumulation order; the psum over a
+    singleton axis is the identity.
+    """
+
+    def __init__(self, chunked: ChunkedHybrid, mesh,
+                 prefetch_depth: int = 2, pin_device_chunks: int = 0):
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}")
+        self.chunked = chunked
+        self.mesh = mesh
+        self.devices = data_axis_devices(mesh)
+        self.ranges = shard_chunk_ranges(chunked.num_chunks,
+                                         len(self.devices))
+        self.prefetch_depth = prefetch_depth
+        # Per-device pinned leading chunks (resident once, streamed never).
+        self._pinned = []
+        for dev, (lo, hi) in zip(self.devices, self.ranges):
+            n_pin = min(max(0, pin_device_chunks), hi - lo)
+            self._pinned.append(tuple(
+                jax.device_put(chunked.chunks[lo + j], dev)
+                for j in range(n_pin)))
+        # Offsets split cache: id(offsets) → per-device offset blocks.
+        # train_model calls the objective many times with the SAME
+        # residual array; splitting once per residual keeps the per-pass
+        # transfer at exactly the chunk payloads.
+        self._off_cache: tuple = (None, None)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    # -- per-device plumbing ----------------------------------------------
+
+    def _stream_range(self, k: int):
+        """Yield (global chunk index, device-resident chunk, streamed?)
+        for device k's range, prefetch_depth transfers ahead."""
+        import collections
+
+        lo, hi = self.ranges[k]
+        dev = self.devices[k]
+        pinned = self._pinned[k]
+        for j, ch in enumerate(pinned):
+            yield lo + j, ch, False
+        q: collections.deque = collections.deque()
+        it = iter(range(lo + len(pinned), hi))
+        try:
+            for _ in range(self.prefetch_depth):
+                i = next(it)
+                q.append((i, _transfer(self.chunked.chunks[i], i, dev)))
+        except StopIteration:
+            pass
+        while q:
+            i, ready = q.popleft()
+            try:
+                j = next(it)
+                q.append((j, _transfer(self.chunked.chunks[j], j, dev)))
+            except StopIteration:
+                pass
+            yield i, ready, True
+
+    def _offsets_by_device(self, offsets: Optional[Array]):
+        """Split the full (padded_n,) residual array into per-device
+        blocks, placed once (cached on the array's identity)."""
+        if offsets is None:
+            return None
+        key, cached = self._off_cache
+        if key is not None and key is offsets:
+            return cached
+        rows = self.chunked.chunk_rows
+        host = np.asarray(offsets, np.float32)
+        per_dev = []
+        for dev, (lo, hi) in zip(self.devices, self.ranges):
+            block = host[lo * rows: hi * rows]
+            per_dev.append(jax.device_put(jnp.asarray(block), dev)
+                           if block.size else None)
+        self._off_cache = (offsets, per_dev)
+        return per_dev
+
+    def _chunk_offsets(self, per_dev, k: int, i: int, ch: CanonicalChunk):
+        if per_dev is None:
+            return ch.offsets if isinstance(ch.offsets, jax.Array) \
+                else jnp.asarray(ch.offsets)
+        lo = self.ranges[k][0]
+        return jax.lax.dynamic_slice_in_dim(
+            per_dev[k], (i - lo) * self.chunked.chunk_rows,
+            self.chunked.chunk_rows, 0)
+
+    def _round_robin(self, w: Array, offsets: Optional[Array],
+                     dispatch, accs):
+        """Drive every device's stream one chunk per round; barrier per
+        round on each touched accumulator, then release streamed chunks
+        (the enqueue-scratch bound, held at ≤ D in-flight chunks)."""
+        per_dev = self._offsets_by_device(offsets)
+        w = jnp.asarray(w, jnp.float32)
+        w_dev = [jax.device_put(w, dev) for dev in self.devices]
+        streams = [self._stream_range(k) for k in range(self.num_devices)]
+        live = [True] * self.num_devices
+        while any(live):
+            touched = []
+            for k in range(self.num_devices):
+                if not live[k]:
+                    continue
+                item = next(streams[k], None)
+                if item is None:
+                    live[k] = False
+                    continue
+                i, ch, streamed = item
+                off = self._chunk_offsets(per_dev, k, i, ch)
+                dispatch(k, w_dev[k], off, ch)
+                touched.append((ch, streamed))
+            if touched:
+                # One barrier per round: the runtime holds every enqueued
+                # program's scratch from ENQUEUE time (the 100M lesson) —
+                # blocking on each touched device's accumulator caps the
+                # un-executed queue at one chunk per device.
+                for k in range(self.num_devices):
+                    if accs[k] is not None:
+                        jax.block_until_ready(accs[k])
+                for ch, streamed in touched:
+                    if streamed:
+                        for leaf in jax.tree.leaves(ch):
+                            if isinstance(leaf, jax.Array):
+                                leaf.delete()
+        gc.collect()  # the single-device transfer-buffer lesson, per pass
+
+    # -- streamed aggregates ----------------------------------------------
+
+    def value_and_gradient(self, loss: PointwiseLoss):
+        """(w, offsets) → replicated global (value, gradient): each
+        device streams its range, partials psum-merge (treeAggregate)."""
+        kernel = _chunk_value_grad(loss)
+        d = self.chunked.dim
+        merge = _merge_fn(self.mesh)
+
+        def vg(w: Array, offsets: Optional[Array] = None):
+            vals = [jax.device_put(jnp.zeros((1,), jnp.float32), dev)
+                    for dev in self.devices]
+            grads = [jax.device_put(jnp.zeros((1, d), jnp.float32), dev)
+                     for dev in self.devices]
+
+            def dispatch(k, w_k, off, ch):
+                v, g = kernel(w_k, off, ch)
+                vals[k] = vals[k] + v
+                grads[k] = grads[k] + g
+
+            self._round_robin(w, offsets, dispatch, grads)
+            value, grad = merge(self._global(vals, (1,)),
+                                self._global(grads, (1, d)))
+            # The replicated results re-commit to the lead device so the
+            # driver loop's jitted helpers (single-device history math)
+            # can mix them with their own state freely.
+            return (jax.device_put(value, self.devices[0]),
+                    jax.device_put(grad, self.devices[0]))
+
+        return vg
+
+    def value_only(self, loss: PointwiseLoss):
+        """(w, offsets) → global value — the Armijo-probe pass."""
+        kernel = _chunk_value(loss)
+        merge = _merge_fn(self.mesh)
+        d = self.chunked.dim
+
+        def v_fn(w: Array, offsets: Optional[Array] = None):
+            vals = [jax.device_put(jnp.zeros((1,), jnp.float32), dev)
+                    for dev in self.devices]
+            zeros = [jax.device_put(jnp.zeros((1, 1), jnp.float32), dev)
+                     for dev in self.devices]
+
+            def dispatch(k, w_k, off, ch):
+                vals[k] = vals[k] + kernel(w_k, off, ch)
+
+            self._round_robin(w, offsets, dispatch, vals)
+            value, _ = merge(self._global(vals, (1,)),
+                             self._global(zeros, (1, 1)))
+            return jax.device_put(value, self.devices[0])
+
+        return v_fn
+
+    def margins(self, w: Array, offsets: Optional[Array] = None) -> Array:
+        """(num_rows,) margins in global row order (pad tail dropped).
+        Parts come home per chunk (scoring runs once per coordinate
+        update; the pass is transfer-bound either way)."""
+        parts: dict[int, np.ndarray] = {}
+        per_dev = self._offsets_by_device(offsets)
+        w32 = jnp.asarray(w, jnp.float32)
+        w_dev = [jax.device_put(w32, dev) for dev in self.devices]
+        streams = [self._stream_range(k) for k in range(self.num_devices)]
+        live = [True] * self.num_devices
+        while any(live):
+            released = []
+            for k in range(self.num_devices):
+                if not live[k]:
+                    continue
+                item = next(streams[k], None)
+                if item is None:
+                    live[k] = False
+                    continue
+                i, ch, streamed = item
+                off = self._chunk_offsets(per_dev, k, i, ch)
+                z = _margins_kernel(w_dev[k], off, ch)
+                jax.block_until_ready(z)  # per-chunk barrier + host copy
+                # pml: allow[PML001] score-pass reassembly is BY-DESIGN a per-chunk host copy (global row order spans devices); scoring runs once per coordinate update on a transfer-bound pass
+                parts[i] = np.asarray(z)
+                if streamed:
+                    released.append(ch)
+            for ch in released:
+                for leaf in jax.tree.leaves(ch):
+                    if isinstance(leaf, jax.Array):
+                        leaf.delete()
+        gc.collect()
+        z = np.concatenate([parts[i] for i in range(len(parts))])
+        return jnp.asarray(z[:self.chunked.num_rows])
+
+    def _global(self, per_dev: list, local_shape: tuple):
+        """Assemble per-device partials into one data-sharded global
+        array (the psum merge's input layout)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+        D = self.num_devices
+        shape = (D * local_shape[0],) + local_shape[1:]
+        sharding = NamedSharding(
+            self.mesh, P(DATA_AXIS, *(None,) * (len(local_shape) - 1)))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, per_dev)

@@ -80,12 +80,33 @@ def _shift_in(stack: Array, v: Array, m: Array) -> Array:
     return shifted.at[idx].set(v)
 
 
+def snapshot_state(w, g, s_stack, y_stack, rho, m_host, it, fv, gn_prev,
+                   f0, gn0, vals, gns) -> dict:
+    """Host-side snapshot of the FULL driver-loop state at an iteration
+    boundary — everything the loop reads before its next streamed pass.
+    Plain numpy (f32 exact), so a save→load→resume round trip replays
+    the remaining iterations BIT-identically to an uninterrupted run
+    (the objective itself is deterministic: fixed chunk order per
+    device, fixed merge order)."""
+    return {
+        "w": np.asarray(w), "g": np.asarray(g),
+        "s_stack": np.asarray(s_stack), "y_stack": np.asarray(y_stack),
+        "rho": np.asarray(rho), "m": np.int32(m_host),
+        "it": np.int32(it), "fv": np.float32(fv),
+        "gn_prev": np.float32(gn_prev), "f0": np.float32(f0),
+        "gn0": np.float32(gn0), "vals": np.asarray(vals),
+        "gns": np.asarray(gns),
+    }
+
+
 def minimize_streaming(
     value_and_grad: Callable[[Array], tuple[Array, Array]],
     w0: Array,
     config: OptimizerConfig,
     log: Callable[[str], None] = lambda m: None,
     value_only: Optional[Callable[[Array], Array]] = None,
+    checkpoint_save: Optional[Callable[[dict], None]] = None,
+    resume_state: Optional[dict] = None,
 ) -> OptResult:
     """Driver-loop L-BFGS: minimize a host-driven (value, grad) callable.
 
@@ -103,26 +124,60 @@ def minimize_streaming(
     iteration drops from ``k·cost(vg)`` to ``k·cost(v) + cost(vg)``; on
     the hybrid-sparse chunk kernels the gradient half (hot rmatvec +
     per-slot cold scatter-adds) dominates compute, so cost(v) ≪
-    cost(vg) and the win grows with every backtrack."""
+    cost(vg) and the win grows with every backtrack.
+
+    ``checkpoint_save``, when given, is called at the end of every
+    accepted iteration with a :func:`snapshot_state` dict; passing a
+    saved snapshot back as ``resume_state`` restarts the loop at the
+    NEXT iteration with bit-identical state (the crash-resume seam of
+    the streamed fixed-effect coordinate — game/checkpoint.py's
+    StreamingStateStore persists the snapshots). A resumed call skips
+    the initial value/gradient pass entirely: the snapshot carries it.
+    """
     d = int(w0.shape[0])
     M = config.history_length
-    w = jnp.asarray(w0, jnp.float32)
-    f, g = value_and_grad(w)
-    f0, gn0 = float(f), float(jnp.linalg.norm(g))
-    s_stack = jnp.zeros((M, d), jnp.float32)
-    y_stack = jnp.zeros((M, d), jnp.float32)
-    rho = jnp.zeros((M,), jnp.float32)
-    m = jnp.zeros((), jnp.int32)
-    m_host = 0  # host mirror of m — the step-size branch must not sync
-
     max_it = config.max_iterations
-    vals = np.full((max_it + 1,), np.nan, np.float32)
-    gns = np.full((max_it + 1,), np.nan, np.float32)
-    vals[0], gns[0] = f0, gn0
+    if resume_state is not None:
+        st = resume_state
+        if st["s_stack"].shape != (M, d) or st["w"].shape != (d,):
+            raise ValueError(
+                f"resume state shape mismatch: saved history "
+                f"{st['s_stack'].shape} / w {st['w'].shape}, expected "
+                f"({M}, {d}) / ({d},) — the checkpoint was written under "
+                f"a different optimizer configuration")
+        w = jnp.asarray(st["w"], jnp.float32)
+        g = jnp.asarray(st["g"], jnp.float32)
+        s_stack = jnp.asarray(st["s_stack"], jnp.float32)
+        y_stack = jnp.asarray(st["y_stack"], jnp.float32)
+        rho = jnp.asarray(st["rho"], jnp.float32)
+        m_host = int(st["m"])
+        m = jnp.asarray(m_host, jnp.int32)
+        f0, gn0 = float(st["f0"]), float(st["gn0"])
+        fv, gn_prev = float(st["fv"]), float(st["gn_prev"])
+        start_it = int(st["it"]) + 1
+        vals = np.full((max_it + 1,), np.nan, np.float32)
+        gns = np.full((max_it + 1,), np.nan, np.float32)
+        k = min(st["vals"].shape[0], max_it + 1)
+        vals[:k], gns[:k] = st["vals"][:k], st["gns"][:k]
+        log(f"resuming streamed L-BFGS at iteration {start_it} "
+            f"(f={fv:.6g})")
+    else:
+        w = jnp.asarray(w0, jnp.float32)
+        f, g = value_and_grad(w)
+        f0, gn0 = float(f), float(jnp.linalg.norm(g))
+        s_stack = jnp.zeros((M, d), jnp.float32)
+        y_stack = jnp.zeros((M, d), jnp.float32)
+        rho = jnp.zeros((M,), jnp.float32)
+        m = jnp.zeros((), jnp.int32)
+        m_host = 0  # host mirror of m — step-size branch must not sync
+        vals = np.full((max_it + 1,), np.nan, np.float32)
+        gns = np.full((max_it + 1,), np.nan, np.float32)
+        vals[0], gns[0] = f0, gn0
+        fv, gn_prev = f0, gn0
+        start_it = 1
     converged = False
-    it = 0
-    fv, gn_prev = f0, gn0
-    for it in range(1, max_it + 1):
+    it = start_it - 1
+    for it in range(start_it, max_it + 1):
         direction = _two_loop(g, s_stack, y_stack, rho, m)
         # pml: allow[PML001] direction-validity guard is a host branch by design; one scalar read per iteration vs a full data pass
         dg = float(jnp.dot(direction, g))
@@ -172,6 +227,13 @@ def minimize_streaming(
         gn = float(jnp.linalg.norm(g))
         vals[it], gns[it] = fv, gn
         log(f"iter {it}: f={fv:.6g} |g|={gn:.3g} step={step:.3g}")
+        if checkpoint_save is not None:
+            # Iteration boundary = the resume point: everything the next
+            # iteration reads goes into the snapshot (gn_prev is the gn
+            # just computed — the value the next iteration would see).
+            checkpoint_save(snapshot_state(
+                w, g, s_stack, y_stack, rho, m_host, it, fv, gn, f0, gn0,
+                vals, gns))
         if gn <= config.tolerance * max(gn0, 1.0) or \
                 abs(fv - f_prev) <= config.tolerance * max(abs(f_prev),
                                                            1e-12):

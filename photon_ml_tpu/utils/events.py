@@ -101,6 +101,31 @@ class StagingStraggler(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class StreamStageStart(Event):
+    """One streamed fixed-effect chunk-staging pass starting: the
+    coordinate's SparseShard canonicalizes into ``num_chunks``
+    hot-dense/cold-ELL chunks over ``workers`` staging threads
+    (docs/STREAMING.md)."""
+
+    shard_id: str
+    num_rows: int
+    chunk_rows: int
+    num_chunks: int
+    workers: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamStageFinish(Event):
+    """The chunk-staging pass ended (finally-guarded pair with
+    StreamStageStart). ``num_chunks`` is 0 when staging raised before
+    the layout was built."""
+
+    shard_id: str
+    num_chunks: int
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
 class IngestStart(Event):
     """One Avro ingestion pipeline starting: ``num_chunks`` block-aligned
     decode tasks over ``num_files`` container files, fanned over

@@ -765,3 +765,204 @@ def test_driver_sigkill_resumes_from_ok_markers_bit_identical(tmp_path):
     assert sorted(a.files) == sorted(b.files)
     for k in a.files:
         np.testing.assert_array_equal(a[k], b[k])
+
+
+# ------------------------------- streamed fixed effect (docs/STREAMING.md)
+
+
+def _stream_fixture():
+    """Tiny streamed coordinate over a 2-device mesh (shared shapes with
+    tests/test_stream_dist.py)."""
+    import jax
+
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                    RegularizationType)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    batch, _ = sp.synthetic_sparse(700, 96, 5, seed=3)
+    ds = from_sparse_batch(batch)
+
+    def chunks():
+        for lo in range(0, 700, 64):
+            hi = min(lo + 64, 700)
+            yield sp.SparseBatch(
+                indices=np.asarray(batch.indices)[lo:hi],
+                values=np.asarray(batch.values)[lo:hi],
+                labels=np.asarray(batch.labels)[lo:hi],
+                weights=np.asarray(batch.weights)[lo:hi],
+                offsets=np.zeros(hi - lo, np.float32),
+                num_features=batch.num_features)
+
+    chunked = ss.build_chunked(chunks(), batch.num_features, 64, num_hot=16)
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=10, tolerance=1e-9),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+
+    def make_coord():
+        return StreamingSparseFixedEffectCoordinate(
+            ds, chunked, "global", losses.LOGISTIC, cfg, mesh=mesh)
+
+    return make_coord, chunked, ss, losses
+
+
+def test_stream_transfer_transient_fault_retries_bit_identical():
+    """One injected chunk-transfer failure mid-pass: the bounded-retry
+    ladder re-transfers and the pass result is bit-identical to the
+    unfaulted one (a transfer is idempotent)."""
+    make_coord, chunked, ss, losses = _stream_fixture()
+    import jax
+
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    vg = ss.ShardedChunkStream(chunked, mesh).value_and_gradient(
+        losses.LOGISTIC)
+    w = np.zeros(96, np.float32)
+    v0, g0 = vg(w)
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="stream.chunk_transfer", kind="raise", occurrences=(2,),
+        max_fires=1),))
+    with faults.installed(plan) as inj:
+        v1, g1 = vg(w)
+    assert inj.fires("stream.chunk_transfer") == 1
+    assert float(v0) == float(v1)
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+
+
+def test_stream_transfer_retries_exhausted_fail_defined():
+    """A persistently failing transfer exhausts the bounded retries and
+    raises the injected error — a lost chunk must never silently drop
+    out of the objective."""
+    make_coord, chunked, ss, losses = _stream_fixture()
+    import jax
+
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    vg = ss.ShardedChunkStream(chunked, mesh).value_and_gradient(
+        losses.LOGISTIC)
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="stream.chunk_transfer", kind="raise", indices=(1,)),))
+    with faults.installed(plan) as inj:
+        with pytest.raises(faults.InjectedFault):
+            vg(np.zeros(96, np.float32))
+    # Initial attempt + the full retry budget, then the loud failure.
+    assert inj.fires("stream.chunk_transfer") == \
+        ss.TRANSFER_MAX_RETRIES + 1
+
+
+def test_stream_checkpoint_corruption_recovers_prev_generation(tmp_path):
+    """Injected bit rot on the newest stream-state npz: load() detects
+    the CRC mismatch, falls back to the previous committed generation
+    (CheckpointRecovered event), and the resumed fit still lands on
+    bit-identical coefficients (it just re-runs the torn iteration)."""
+    make_coord, *_ = _stream_fixture()
+    clean = make_coord()
+    clean.bind_step_checkpoint(str(tmp_path / "clean"), 1)
+    off = np.zeros(700, np.float32)
+    w_clean = np.asarray(clean.train_model(off).coefficients.means)
+
+    victim = make_coord()
+    victim.bind_step_checkpoint(str(tmp_path / "victim"), 1)
+    # Corrupt the 5th snapshot's bytes AFTER its CRC was recorded, then
+    # kill the fit at the 6th write — resume sees a bad newest
+    # generation and must fall back one.
+    plan = faults.FaultPlan(specs=(
+        faults.FaultSpec(site="stream.checkpoint_artifact", kind="corrupt",
+                         occurrences=(4,)),
+        faults.FaultSpec(site="stream.checkpoint_write", kind="raise",
+                         occurrences=(5,)),
+    ))
+    with faults.installed(plan) as inj:
+        with pytest.raises(faults.InjectedFault):
+            victim.train_model(off)
+    assert inj.fires("stream.checkpoint_artifact") == 1
+    seen = []
+    ev.default_emitter.register(seen.append)
+    try:
+        w_resumed = np.asarray(victim.train_model(off).coefficients.means)
+    finally:
+        ev.default_emitter.unregister(seen.append)
+    recovered = [e for e in seen if isinstance(e, ev.CheckpointRecovered)]
+    assert recovered and recovered[0].directory == str(tmp_path / "victim")
+    np.testing.assert_array_equal(w_resumed, w_clean)
+
+
+def _stream_train_args(train_dir, out):
+    return [
+        "--train", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--update-sequence", "fixed",
+        "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--streaming", "chunk_rows=128,num_hot=8,workers=2",
+        "--output-dir", out,
+    ]
+
+
+def test_driver_sigkill_mid_lbfgs_resumes_bit_identical(tmp_path):
+    """The flagship drill (ISSUE 6 acceptance): the training driver is
+    SIGKILLed MID-L-BFGS on the streamed fixed effect (via
+    ``--fault-plan`` at the 5th stream-state write); ``--resume`` picks
+    up mid-optimization from the StreamingStateStore and the final
+    coefficients are bit-identical to a never-killed run."""
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.data.io import save_game_dataset
+
+    batch, _ = sp.synthetic_sparse(700, 64, 5, seed=11)
+    ds = from_sparse_batch(batch)
+    train_dir = str(tmp_path / "train")
+    save_game_dataset(ds, train_dir)
+
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="stream.checkpoint_write", kind="kill", occurrences=(4,)),))
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        f.write(plan.to_json())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS",)}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + (os.pathsep + env["PYTHONPATH"]
+                                      if env.get("PYTHONPATH") else "")})
+    out_killed = str(tmp_path / "out-killed")
+    log_path = str(tmp_path / "phase1.log")
+    with open(log_path, "w") as log:
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_ml_tpu.cli.game_train"]
+            + _stream_train_args(train_dir, out_killed)
+            + ["--fault-plan", plan_path],
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+            timeout=600)
+    assert proc.returncode == -9, (
+        f"driver survived the SIGKILL plan (rc={proc.returncode}):\n"
+        + open(log_path).read()[-3000:])
+    ckpt = os.path.join(out_killed, "checkpoints", "grid-0")
+    stream_dirs = [d for d in os.listdir(ckpt)
+                   if d.startswith("stream-step")]
+    assert stream_dirs, "no mid-step stream state survived the kill"
+
+    # Phase 2 (in-process): --resume continues MID-optimization...
+    game_train.run(game_train.build_parser().parse_args(
+        _stream_train_args(train_dir, out_killed) + ["--resume"]))
+
+    # ...and matches a never-killed run bit for bit.
+    out_clean = str(tmp_path / "out-clean")
+    game_train.run(game_train.build_parser().parse_args(
+        _stream_train_args(train_dir, out_clean)))
+    a = np.load(os.path.join(out_killed, "best", "fixed-effect", "fixed",
+                             "coefficients.npz"))
+    b = np.load(os.path.join(out_clean, "best", "fixed-effect", "fixed",
+                             "coefficients.npz"))
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])

@@ -1,0 +1,434 @@
+"""photon-stream-dist: the sharded, resumable, estimator-wired streamed
+fixed-effect path (docs/STREAMING.md).
+
+Parity discipline (the PR 2/5 way): sharding is an EXECUTION detail —
+a 1-device mesh must be bit-identical to the mesh-less single-device
+path, multi-device meshes must match within f32 accumulation-order
+tolerance, and the estimator/CLI route must reach the same coordinate
+the dev-script flow constructs by hand.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.api.configs import (StreamingConfig,
+                                       parse_streaming_config)
+from photon_ml_tpu.data import sparse as sp
+from photon_ml_tpu.data.game_data import from_sparse_batch
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops import streaming_sparse as ss
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.utils import events as ev
+
+
+@pytest.fixture(scope="module")
+def batch():
+    b, _ = sp.synthetic_sparse(700, 96, 5, seed=3)
+    return b
+
+
+def _chunks_of(batch, chunk_rows, zero_offsets=False):
+    n = batch.num_rows
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        off = (np.zeros(hi - lo, np.float32) if zero_offsets
+               else np.asarray(batch.offsets)[lo:hi])
+        yield sp.SparseBatch(
+            indices=np.asarray(batch.indices)[lo:hi],
+            values=np.asarray(batch.values)[lo:hi],
+            labels=np.asarray(batch.labels)[lo:hi],
+            weights=np.asarray(batch.weights)[lo:hi],
+            offsets=off,
+            num_features=batch.num_features)
+
+
+def _build(batch, chunk_rows=64, zero_offsets=False, workers=1):
+    # 700 rows / 64-row chunks = 11 chunks: enough to give every device
+    # of an 8-way mesh work, with a SHORT padded tail chunk in play.
+    return ss.build_chunked(
+        _chunks_of(batch, chunk_rows, zero_offsets=zero_offsets),
+        batch.num_features, chunk_rows, num_hot=16, workers=workers)
+
+
+def _cfg(max_iter=12, tol=1e-9):
+    # 12 iterations everywhere parity is asserted: both sides run the
+    # SAME trajectory (identical objective), so the comparison carries
+    # no more information at 25 — only tier-1 wall-clock.
+    return GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=max_iter, tolerance=tol),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+
+
+# ------------------------------------------------------ range partitioning
+
+
+def test_shard_chunk_ranges_balanced_contiguous():
+    assert ss.shard_chunk_ranges(11, 4) == [(0, 3), (3, 6), (6, 9),
+                                            (9, 11)]
+    assert ss.shard_chunk_ranges(3, 8) == [
+        (0, 1), (1, 2), (2, 3)] + [(3, 3)] * 5  # idle devices allowed
+    assert ss.shard_chunk_ranges(8, 1) == [(0, 8)]
+    with pytest.raises(ValueError):
+        ss.shard_chunk_ranges(4, 0)
+
+
+def test_model_axis_mesh_rejected(batch):
+    chunked = _build(batch)
+    mesh = make_mesh(num_data=4, num_model=2)
+    with pytest.raises(ValueError, match="model"):
+        ss.ShardedChunkStream(chunked, mesh)
+
+
+# ------------------------------------------------- sharded == single-device
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_sharded_value_gradient_matches_single_device(batch, devices):
+    """The psum-merged sharded pass computes the SAME objective as the
+    single-device stream: bit-identical at D=1 (same kernel, same chunk
+    order, identity psum), f32 accumulation-order tolerance beyond."""
+    chunked = _build(batch)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    pad = chunked.num_chunks * chunked.chunk_rows - chunked.num_rows
+    off = jnp.concatenate([jnp.asarray(np.asarray(batch.offsets)),
+                           jnp.zeros(pad)])
+    v0, g0 = ss.make_value_and_gradient(losses.LOGISTIC, chunked)(w, off)
+    mesh = make_mesh(num_data=devices, devices=jax.devices()[:devices])
+    strm = ss.ShardedChunkStream(chunked, mesh)
+    v1, g1 = strm.value_and_gradient(losses.LOGISTIC)(w, off)
+    vv = strm.value_only(losses.LOGISTIC)(w, off)
+    if devices == 1:
+        assert float(v0) == float(v1)
+        np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+    else:
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                                   rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(vv), float(v1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("devices", [2, 8])
+def test_sharded_margins_match(batch, devices):
+    chunked = _build(batch)
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    z0 = ss.margins_chunked(chunked, w)
+    mesh = make_mesh(num_data=devices, devices=jax.devices()[:devices])
+    z1 = ss.ShardedChunkStream(chunked, mesh).margins(w)
+    assert z1.shape == (700,)
+    np.testing.assert_allclose(np.asarray(z1), np.asarray(z0), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sharded_pinned_chunks_change_nothing(batch):
+    """Per-device pinned leading chunks are an execution detail."""
+    chunked = _build(batch)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    v0, g0 = ss.ShardedChunkStream(chunked, mesh).value_and_gradient(
+        losses.LOGISTIC)(w)
+    v1, g1 = ss.ShardedChunkStream(
+        chunked, mesh, pin_device_chunks=2).value_and_gradient(
+        losses.LOGISTIC)(w)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_sharded_descent_coefficients_match_single_device(batch, devices):
+    """Full streamed fits land on the same coefficients across mesh
+    sizes (the established streamed parity tolerance; exact at D=1)."""
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+    from photon_ml_tpu.types import TaskType
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch, zero_offsets=True)
+    results = {}
+    for name, mesh in (
+            ("single", None),
+            ("sharded", make_mesh(num_data=devices,
+                                  devices=jax.devices()[:devices]))):
+        coord = StreamingSparseFixedEffectCoordinate(
+            ds, chunked, "global", losses.LOGISTIC, _cfg(), mesh=mesh)
+        model, _ = descent.run(
+            TaskType.LOGISTIC_REGRESSION, {"fixed": coord},
+            descent.CoordinateDescentConfig(["fixed"], iterations=1))
+        results[name] = np.asarray(model.models["fixed"].coefficients.means)
+    if devices == 1:
+        np.testing.assert_array_equal(results["sharded"], results["single"])
+    else:
+        np.testing.assert_allclose(results["sharded"], results["single"],
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_parallel_chunk_staging_bit_identical(batch):
+    serial = _build(batch, workers=1)
+    parallel = _build(batch, workers=4)
+    assert serial.num_rows == parallel.num_rows
+    for a, b in zip(serial.chunks, parallel.chunks):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ------------------------------------------------------ config + estimator
+
+
+def test_parse_streaming_config():
+    cfg = parse_streaming_config(
+        "chunk_rows=1024,num_hot=64,dtype=bfloat16,depth=3,pin=2,workers=4")
+    assert cfg == StreamingConfig(chunk_rows=1024, num_hot=64,
+                                  feature_dtype="bfloat16",
+                                  prefetch_depth=3, pin_chunks=2, workers=4)
+    assert parse_streaming_config("") == StreamingConfig()
+    with pytest.raises(ValueError, match="unknown streaming keys"):
+        parse_streaming_config("chunks=5")
+    with pytest.raises(ValueError, match="feature_dtype"):
+        parse_streaming_config("dtype=float16")
+    with pytest.raises(ValueError, match="chunk_rows"):
+        StreamingConfig(chunk_rows=0)
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        StreamingConfig(prefetch_depth=0)
+
+
+def test_estimator_routes_sparse_fixed_onto_streaming(batch):
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.game.coordinates import (
+        SparseFixedEffectCoordinate, StreamingSparseFixedEffectCoordinate)
+    from photon_ml_tpu.types import TaskType
+
+    ds = from_sparse_batch(batch)
+    cc = {"fixed": CoordinateConfiguration(
+        data=FixedEffectDataConfiguration("global"), optimization=_cfg())}
+
+    def build(streaming):
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinates=cc,
+            update_sequence=["fixed"], mesh=make_mesh(),
+            streaming=streaming)
+        return est._build_coordinates(ds, {"fixed": _cfg()})
+
+    coords = build(StreamingConfig(chunk_rows=256, num_hot=16))
+    assert isinstance(coords["fixed"], StreamingSparseFixedEffectCoordinate)
+    # The streamed coordinate sharded over the full test mesh.
+    assert coords["fixed"]._stream is not None
+    assert coords["fixed"]._stream.num_devices == len(jax.devices())
+    # Without the knob the device-resident path is untouched.
+    assert isinstance(build(None)["fixed"], SparseFixedEffectCoordinate)
+
+
+def test_estimator_streaming_config_conflicts(batch, rng):
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.types import TaskType
+
+    ds = from_sparse_batch(batch)
+    # feature_sharded + streaming: contradictory sharding axes.
+    cc = {"fixed": CoordinateConfiguration(
+        data=FixedEffectDataConfiguration("global", feature_sharded=True),
+        optimization=_cfg())}
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinates=cc,
+        update_sequence=["fixed"], mesh=make_mesh(),
+        streaming=StreamingConfig(chunk_rows=256))
+    with pytest.raises(ValueError, match="feature_sharded"):
+        est._build_coordinates(ds, {"fixed": _cfg()})
+    # streaming set but nothing routes (dense shard): loud, not a no-op.
+    dense = from_synthetic(synthetic.game_data(rng, n=64, d_global=4,
+                                               re_specs={}))
+    cc2 = {"fixed": CoordinateConfiguration(
+        data=FixedEffectDataConfiguration("global"), optimization=_cfg())}
+    est2 = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinates=cc2,
+        update_sequence=["fixed"], mesh=make_mesh(),
+        streaming=StreamingConfig())
+    with pytest.raises(ValueError, match="no coordinate routed"):
+        est2._build_coordinates(dense, {"fixed": _cfg()})
+
+
+def test_streaming_grid_swap_keeps_staged_chunks(batch):
+    """with_optimization_config (the estimator's reg-grid path) swaps the
+    config without restaging, and still enforces the streamed envelope."""
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch, zero_offsets=True)
+    coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(), mesh=make_mesh())
+    swapped = coord.with_optimization_config(_cfg(max_iter=3))
+    assert swapped.chunked is coord.chunked
+    assert swapped.config.optimizer.max_iterations == 3
+    bad = GLMOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.L1, 0.5))
+    with pytest.raises(ValueError, match="L1"):
+        coord.with_optimization_config(bad)
+
+
+# ------------------------------------------------------------ CLI end-to-end
+
+
+def test_game_train_streaming_avro_end_to_end(tmp_path):
+    """Acceptance: ``game_train --streaming`` reaches the streamed
+    coordinate end-to-end from Avro input — no dev-script entry."""
+    from photon_ml_tpu.avro import schemas
+    from photon_ml_tpu.avro.container import write_records
+    from photon_ml_tpu.cli import game_train
+
+    r = np.random.default_rng(7)
+    recs = []
+    for i in range(900):
+        feats = [{"name": f"x{j}", "term": "", "value": float(r.normal())}
+                 for j in range(4)]
+        margin = feats[0]["value"] + feats[1]["value"] \
+            - feats[2]["value"] - feats[3]["value"]
+        recs.append({
+            "uid": i,
+            "label": float(r.uniform() < 1 / (1 + np.exp(-margin))),
+            "weight": 1.0, "offset": 0.0, "features": feats,
+            "metadataMap": {},
+        })
+    train_path = str(tmp_path / "train.avro")
+    write_records(train_path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+
+    out = str(tmp_path / "out")
+    seen = []
+    ev.default_emitter.register(seen.append)
+    try:
+        summary = game_train.run(game_train.build_parser().parse_args([
+            "--train", train_path, "--validation", train_path,
+            "--avro-feature-shard",
+            "name=global,bags=features,intercept=true,sparse=true",
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--update-sequence", "fixed",
+            "--evaluators", "AUC",
+            "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--streaming", "chunk_rows=128,num_hot=4,workers=2",
+            "--output-dir", out,
+        ]))
+    finally:
+        ev.default_emitter.unregister(seen.append)
+    starts = [e for e in seen if isinstance(e, ev.StreamStageStart)]
+    finishes = [e for e in seen if isinstance(e, ev.StreamStageFinish)]
+    assert starts and finishes, "streamed staging never ran"
+    assert starts[0].num_chunks == finishes[0].num_chunks > 1
+    assert summary["best_metrics"]["AUC"] > 0.8
+    assert os.path.exists(os.path.join(out, "best"))
+
+
+def test_cli_streaming_flag_parses_bare_and_dsl():
+    from photon_ml_tpu.cli import game_train
+
+    p = game_train.build_parser()
+    base = ["--train", "t", "--coordinate", "name=f,type=fixed,shard=g",
+            "--update-sequence", "f", "--output-dir", "o"]
+    assert p.parse_args(base).streaming is None
+    assert p.parse_args(base + ["--streaming"]).streaming == ""
+    args = p.parse_args(base + ["--streaming", "chunk_rows=512"])
+    assert parse_streaming_config(args.streaming).chunk_rows == 512
+
+
+# ------------------------------------------------------- checkpoint/resume
+
+
+def test_streamed_fit_resumes_bit_identical_after_interrupt(
+        batch, tmp_path):
+    """A streamed fit killed mid-optimization (injected failure at the
+    4th stream-state write) resumes from its StreamingStateStore and
+    lands on BIT-identical final coefficients."""
+    from photon_ml_tpu import faults
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch, zero_offsets=True)
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    off = np.zeros(700, np.float32)
+
+    clean = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(), mesh=mesh)
+    clean.bind_step_checkpoint(str(tmp_path / "clean"), 1)
+    w_clean = np.asarray(clean.train_model(off).coefficients.means)
+
+    interrupted = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(), mesh=mesh)
+    interrupted.bind_step_checkpoint(str(tmp_path / "int"), 1)
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="stream.checkpoint_write", kind="raise", occurrences=(3,)),))
+    with faults.installed(plan) as inj:
+        with pytest.raises(faults.InjectedFault):
+            interrupted.train_model(off)
+    assert inj.fires("stream.checkpoint_write") == 1
+    w_resumed = np.asarray(interrupted.train_model(off).coefficients.means)
+    np.testing.assert_array_equal(w_resumed, w_clean)
+
+
+def test_stream_resume_discards_mismatched_objective(batch, tmp_path):
+    """A snapshot taken under DIFFERENT residual offsets must not be
+    resumed (it would silently continue the wrong optimization)."""
+    from photon_ml_tpu import faults
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch, zero_offsets=True)
+    coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(max_iter=6),
+        mesh=make_mesh(num_data=1, devices=jax.devices()[:1]))
+    coord.bind_step_checkpoint(str(tmp_path / "s"), 1)
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="stream.checkpoint_write", kind="raise", occurrences=(2,)),))
+    off_a = np.zeros(700, np.float32)
+    with faults.installed(plan):
+        with pytest.raises(faults.InjectedFault):
+            coord.train_model(off_a)
+    # Different residuals: the stale snapshot must be ignored, and the
+    # fit from scratch must equal a never-checkpointed fit.
+    off_b = np.full(700, 0.25, np.float32)
+    w_resumed = np.asarray(coord.train_model(off_b).coefficients.means)
+    fresh = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(max_iter=6),
+        mesh=make_mesh(num_data=1, devices=jax.devices()[:1]))
+    w_fresh = np.asarray(fresh.train_model(off_b).coefficients.means)
+    np.testing.assert_array_equal(w_resumed, w_fresh)
+
+
+def test_descent_clears_stream_state_after_step_commit(batch, tmp_path):
+    """game/descent.py binds a per-step stream dir and clears it once the
+    step-level checkpoint commits — no stale mid-step state survives."""
+    from photon_ml_tpu.game import descent
+    from photon_ml_tpu.game.checkpoint import CheckpointManager
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+    from photon_ml_tpu.types import TaskType
+
+    ds = from_sparse_batch(batch)
+    chunked = _build(batch, zero_offsets=True)
+    coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(max_iter=4),
+        mesh=make_mesh(num_data=1, devices=jax.devices()[:1]))
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    descent.run(TaskType.LOGISTIC_REGRESSION, {"fixed": coord},
+                descent.CoordinateDescentConfig(["fixed"], iterations=1),
+                checkpoint_manager=manager)
+    left = [d for d in os.listdir(str(tmp_path / "ckpt"))
+            if d.startswith("stream-step")]
+    assert left == [], left
