@@ -29,8 +29,17 @@ plus cross-line invariants computed within the fresh tail itself:
     actually shrink the decode wall); reported-only on the 1-core CI
     box, the same caveat as the staging multi-worker scaling note.
 
+plus, with ``--metrics-dump METRICS.prom`` (a file written by
+``game_train --metrics-dump`` / ``flagship_criteo_stream.py``), a
+bench-vs-metrics consistency gate: bench lines that have a counter
+counterpart in the photon-obs registry (transfer seconds/bytes, peak
+in-flight chunks) must agree within 10% — a bench tail and a metrics
+dump from the same run can no longer silently disagree
+(docs/OBSERVABILITY.md).
+
 Usage:
   check_bench_regression.py --fresh TAIL.json [--baseline BENCH_r05.json]
+                            [--metrics-dump METRICS.prom]
   check_bench_regression.py --run-staging     [--baseline BENCH_r05.json]
 
 --fresh takes either a raw bench.py stdout object ({"metric": ...,
@@ -52,7 +61,20 @@ import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 TOLERANCE = 0.20
+# Bench line ↔ photon-obs metric counterparts (the --metrics-dump gate).
+# Fractions of a second of jitter between the perf_counter wall and the
+# counter's accumulated device_put time are expected; 10% is the band.
+METRIC_CROSSCHECKS = {
+    "criteo_stream_transfer_seconds": "photon_transfer_seconds_total",
+    "stream_transfer_seconds": "photon_transfer_seconds_total",
+    "criteo_stream_transfer_gb": ("photon_transfer_bytes_total",
+                                  1.0 / 2 ** 30),
+    "criteo_stream_peak_inflight_chunks":
+        "photon_stream_inflight_chunks_peak",
+}
+METRICS_TOLERANCE = 0.10
 GUARDED = [
     "staging_bucketing_seconds",
     "staging_projection_seconds",
@@ -94,6 +116,10 @@ def main() -> int:
                     default=os.path.join(REPO, "BENCH_r05.json"))
     ap.add_argument("--tolerance", type=float, default=TOLERANCE,
                     help="allowed fractional regression (default 0.20)")
+    ap.add_argument("--metrics-dump",
+                    help="photon-obs Prometheus dump from the SAME run "
+                         "as --fresh: bench lines with a metric "
+                         "counterpart must agree within 10%%")
     args = ap.parse_args()
 
     try:
@@ -230,6 +256,44 @@ def main() -> int:
             failures.append(
                 f"stream_sharded_pass_seconds: {sh:g}s > {limit:.3g}s — "
                 f"the sharded composition adds overhead at D=1")
+
+    # --- bench ↔ metrics consistency (docs/OBSERVABILITY.md) ------------
+    if args.metrics_dump:
+        from photon_ml_tpu.obs.metrics import (metric_value,
+                                               parse_prometheus_text)
+
+        try:
+            with open(args.metrics_dump) as f:
+                parsed = parse_prometheus_text(f.read())
+        except OSError as e:
+            print(f"cannot load metrics dump {args.metrics_dump}: {e}")
+            return 2
+        checked = 0
+        for bench_key, metric in METRIC_CROSSCHECKS.items():
+            scale = 1.0
+            if isinstance(metric, tuple):
+                metric, scale = metric
+            bench_v = fresh.get(bench_key)
+            metric_v = metric_value(parsed, metric)
+            if bench_v is None or metric_v is None:
+                continue
+            checked += 1
+            metric_v *= scale
+            denom = max(abs(float(bench_v)), abs(metric_v), 1e-9)
+            rel = abs(float(bench_v) - metric_v) / denom
+            ok = rel <= METRICS_TOLERANCE
+            print(f"{bench_key}: bench {bench_v:g} vs metric {metric} "
+                  f"{metric_v:g} (delta {rel:.1%}) "
+                  f"{'OK' if ok else 'DISAGREEMENT'}")
+            if not ok:
+                failures.append(
+                    f"{bench_key}: bench line {bench_v:g} disagrees "
+                    f"with metric {metric} = {metric_v:g} by {rel:.1%} "
+                    f"(> {METRICS_TOLERANCE:.0%}) — the bench tail and "
+                    f"the metrics dump cannot both be right")
+        if checked == 0:
+            print("metrics dump: no overlapping bench/metric keys to "
+                  "cross-check (nothing gated)")
 
     if failures:
         print(f"\n{len(failures)} staging regression(s) vs "

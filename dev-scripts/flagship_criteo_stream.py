@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
 enable_compilation_cache()
@@ -132,9 +133,10 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
                               jnp.bfloat16)
     log(f"{n_rows:,} rows in {n_chunks} chunks; num_hot={num_hot}")
     t0 = time.perf_counter()
-    chunked = ss.build_chunked(gen_chunks(), d, chunk_rows,
-                               num_hot=num_hot,
-                               feature_dtype=jnp.bfloat16, log=log)
+    with obs.span("flagship.fe_staging", cat="stage", chunks=n_chunks):
+        chunked = ss.build_chunked(gen_chunks(), d, chunk_rows,
+                                   num_hot=num_hot,
+                                   feature_dtype=jnp.bfloat16, log=log)
     fe_staging = time.perf_counter() - t0
     log(f"FE chunk staging {fe_staging:.1f}s; host peak {_rss_gb():.1f} GB")
 
@@ -178,10 +180,11 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
     # rows — and a tmpfs-backed default would eat host RAM silently.
     cache_dir = os.environ.get("PML_CRITEO_STAGING_CACHE") or None
     t0 = time.perf_counter()
-    re_coord = RandomEffectCoordinate(
-        ds, "userId", "re", losses.LOGISTIC, cfg, make_mesh(),
-        lower_bound=2, upper_bound=65536, feature_dtype="bfloat16",
-        staging_cache_dir=cache_dir)
+    with obs.span("flagship.re_staging", cat="stage"):
+        re_coord = RandomEffectCoordinate(
+            ds, "userId", "re", losses.LOGISTIC, cfg, make_mesh(),
+            lower_bound=2, upper_bound=65536, feature_dtype="bfloat16",
+            staging_cache_dir=cache_dir)
     re_staging = time.perf_counter() - t0
     log(f"RE staging {re_staging:.1f}s; host peak {_rss_gb():.1f} GB")
 
@@ -198,11 +201,13 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         log(f"checkpointing descent + mid-L-BFGS state under "
             f"{checkpoint_dir}")
     t0 = time.perf_counter()
-    model, hist = descent.run(
-        TaskType.LOGISTIC_REGRESSION, coords,
-        descent.CoordinateDescentConfig(["fixed", "per-user"],
-                                        iterations=iterations),
-        checkpoint_manager=manager)
+    with obs.span("flagship.descent", cat="train",
+                  iterations=iterations):
+        model, hist = descent.run(
+            TaskType.LOGISTIC_REGRESSION, coords,
+            descent.CoordinateDescentConfig(["fixed", "per-user"],
+                                            iterations=iterations),
+            checkpoint_manager=manager)
     descent_s = time.perf_counter() - t0
     per_update = {r["coordinate"]: r["train_seconds"]
                   for r in hist.records[-2:]}  # last sweep's updates
@@ -210,12 +215,13 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         f"(last sweep per-coordinate {per_update})")
 
     log("scoring (streamed FE + RE)")
-    scores = fe_coord.score(model.models["fixed"]) + \
-        re_coord.score(model.models["per-user"])
-    train_auc = float(auc(scores, jnp.asarray(y_all)))
+    with obs.span("flagship.scoring", cat="score"):
+        scores = fe_coord.score(model.models["fixed"]) + \
+            re_coord.score(model.models["per-user"])
+        train_auc = float(auc(scores, jnp.asarray(y_all)))
     log(f"train AUC vs planted effects: {train_auc:.4f}; "
         f"host peak {_rss_gb():.1f} GB")
-    return {
+    out = {
         "criteo_stream_rows": n_rows,
         "criteo_stream_chunks": n_chunks,
         "criteo_stream_fe_staging_seconds": round(fe_staging, 1),
@@ -226,6 +232,27 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         "criteo_stream_train_auc": round(train_auc, 4),
         "criteo_stream_host_peak_gb": round(_rss_gb(), 1),
     }
+    # Transfer attribution from the device_put accounting wrapper — the
+    # measured replacement for the "~95% host→device" hand subtraction
+    # (VERDICT Weak #3). Bench line and metric share PROVENANCE: this
+    # JSON line IS the counter, so check_bench_regression.py can assert
+    # a --metrics-dump never silently disagrees with the bench tail.
+    mx = obs.metrics()
+    if mx is not None:
+        parsed = obs.parse_prometheus_text(mx.render_text())
+        t_xfer = obs.metric_value(
+            parsed, "photon_transfer_seconds_total") or 0.0
+        b_xfer = obs.metric_value(
+            parsed, "photon_transfer_bytes_total") or 0.0
+        out["criteo_stream_transfer_seconds"] = round(t_xfer, 1)
+        out["criteo_stream_transfer_gb"] = round(b_xfer / 2 ** 30, 2)
+        if descent_s > 0:
+            out["criteo_stream_transfer_fraction"] = round(
+                t_xfer / descent_s, 4)
+        out["criteo_stream_peak_inflight_chunks"] = int(
+            obs.metric_value(parsed,
+                             "photon_stream_inflight_chunks_peak") or 0)
+    return out
 
 
 def main():
@@ -250,6 +277,15 @@ def main():
                          "here (docs/STREAMING.md); a rerun with the "
                          "same dir resumes the ~90-min fit instead of "
                          "retraining after a crash")
+    ap.add_argument("--trace-out", default="criteo-stream-trace.json",
+                    help="span-trace output (tracing is ON by default "
+                         "for the flagship — this run is exactly the "
+                         "one whose time accounting matters; pass '' "
+                         "to disable). Render with `photon-obs "
+                         "summarize` (docs/OBSERVABILITY.md)")
+    ap.add_argument("--metrics-dump", default=None,
+                    help="Prometheus-text metrics output (default: "
+                         "<trace-out>.prom when tracing is on)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -262,12 +298,29 @@ def main():
     # invariant unless the caller overrides explicitly.
     hot_gb = (args.hot_gb if args.hot_gb is not None
               else 1.25 * args.chunk_rows / 10_000_000)
-    out = run_criteo_stream(
-        n_rows=args.rows, d=args.features, n_entities=args.entities,
-        chunk_rows=args.chunk_rows, hot_block_gb=hot_gb,
-        pin_gb=args.pin_gb, iterations=args.iterations,
-        fe_opt_iters=args.fe_iters, checkpoint_dir=args.checkpoint_dir,
-        log=log)
+    trace_out = args.trace_out or None
+    metrics_dump = args.metrics_dump or (
+        trace_out + ".prom" if trace_out else None)
+    if trace_out or metrics_dump:
+        obs.enable(trace=bool(trace_out), metrics=True,
+                   spill=(trace_out + ".spill") if trace_out else None)
+    try:
+        out = run_criteo_stream(
+            n_rows=args.rows, d=args.features, n_entities=args.entities,
+            chunk_rows=args.chunk_rows, hot_block_gb=hot_gb,
+            pin_gb=args.pin_gb, iterations=args.iterations,
+            fe_opt_iters=args.fe_iters,
+            checkpoint_dir=args.checkpoint_dir, log=log)
+    finally:
+        # Dump in a finally: a crashed flagship leaves its timeline —
+        # the round-5 run lost exactly this evidence to a worker crash.
+        if trace_out:
+            obs.dump_trace(trace_out)
+            log(f"trace -> {trace_out} (photon-obs summarize "
+                f"{trace_out})")
+        if metrics_dump:
+            obs.dump_metrics(metrics_dump)
+            log(f"metrics -> {metrics_dump}")
     if args.json:
         print(json.dumps(out))
     else:

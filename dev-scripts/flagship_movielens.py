@@ -40,7 +40,7 @@ enable_compilation_cache()
 def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
                  d_global=32, feature_dtype="float32", cd_spans=(1, 3),
                  min_of=3, max_samples=65536, validate_each=False,
-                 quality_only=False, log=lambda msg: None):
+                 quality_only=False, seed=2026, log=lambda msg: None):
     """Build the MovieLens-shaped dataset and measure staged CD. Returns a
     dict of measurements (shared by this script and bench.py's gated line)."""
     import jax.numpy as jnp
@@ -59,7 +59,7 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
     from photon_ml_tpu.parallel.mesh import make_mesh
     from photon_ml_tpu.types import TaskType
 
-    rng = np.random.default_rng(2026)
+    rng = np.random.default_rng(seed)
     log(f"generating {n_rows:,} rows ({n_users:,} users x {n_items:,} items)")
     t0 = time.perf_counter()
     syn = synthetic.game_data(
@@ -138,10 +138,14 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
     log(f"validation AUC vs planted effects: {val_auc:.4f}")
     out = {
         "flagship_rows": n_rows,
+        "flagship_seed": seed,
         "flagship_staging_seconds": {k: round(v, 1)
                                      for k, v in staging.items()},
         "flagship_first_descent_seconds": round(t_first, 1),
-        "flagship_validation_auc": round(val_auc, 4),
+        # 6 decimals: the dtype-parity anchor quotes these to 6
+        # significant digits so "delta 0.0000" reads as a measurement,
+        # not 4-decimal rounding (round-6 verdict weak #5).
+        "flagship_validation_auc": round(val_auc, 6),
     }
     if per_sweep is not None:
         out["game_cd_iteration_seconds_20m"] = round(per_sweep, 3)
@@ -203,6 +207,9 @@ def main():
     ap.add_argument("--quality-only", action="store_true",
                     help="skip slope timing; train and report AUC only "
                          "(dtype-parity runs)")
+    ap.add_argument("--seed", type=int, default=2026,
+                    help="data-generation seed (dtype_parity.py sweeps "
+                         "this so the bf16 anchor is multi-seed)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line instead of prose")
     args = ap.parse_args()
@@ -212,7 +219,7 @@ def main():
         n_rows=args.rows, n_users=args.users, n_items=args.items,
         feature_dtype="bfloat16" if args.bf16 else "float32",
         max_samples=args.max_samples, validate_each=args.validate_each,
-        quality_only=args.quality_only, log=log)
+        quality_only=args.quality_only, seed=args.seed, log=log)
     if args.json:
         print(json.dumps(out))
     else:

@@ -1,0 +1,114 @@
+"""photon-obs overhead micro-harness: streamed fit, obs OFF vs ON.
+
+The observability acceptance budget (ISSUE 7): with tracing/metrics
+DISABLED the instrumentation must cost one None check per site
+(<2% on a streamed fit); ENABLED, the per-chunk cost is one span (two
+clock reads + a locked list append) and four counter increments, which
+must stay in the low single digits against a multi-megabyte
+``device_put`` per chunk.
+
+Each arm runs in a FRESH subprocess (no cross-arm compile-cache or
+allocator state), min of ``--min-of`` repeats inside the arm after one
+warm-up fit; the printed JSON carries both walls and the ratio.
+
+    python dev-scripts/obs_overhead.py [--rows 98304] [--chunk-rows 8192]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+_ARM = """
+import json, sys, time
+import numpy as np
+mode, rows, chunk_rows, min_of = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), int(sys.argv[4]))
+from photon_ml_tpu import obs
+from photon_ml_tpu.data.game_data import from_sparse_batch
+from photon_ml_tpu.data.sparse import synthetic_sparse
+from photon_ml_tpu.game.coordinates import \\
+    StreamingSparseFixedEffectCoordinate
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops import streaming_sparse as ss
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.regularization import (RegularizationContext,
+                                                RegularizationType)
+
+sbatch, _ = synthetic_sparse(rows, 4096, 6, seed=7)
+ds = from_sparse_batch(sbatch)
+chunked = ss.build_chunked(
+    ss.iter_shard_chunks(ds.feature_shards["global"], ds.response,
+                         ds.weights, chunk_rows),
+    4096, chunk_rows, num_hot=64)
+cfg = GLMOptimizationConfiguration(
+    optimizer=OptimizerConfig(max_iterations=6, tolerance=0.0),
+    regularization=RegularizationContext(RegularizationType.L2, 1.0))
+coord = StreamingSparseFixedEffectCoordinate(
+    ds, chunked, "global", losses.LOGISTIC, cfg)
+if mode == "on":
+    obs.enable()
+off = np.zeros(ds.num_rows, np.float32)
+coord.train_model(off)  # warm-up: compiles
+best = None
+for _ in range(min_of):
+    t0 = time.perf_counter()
+    coord.train_model(off)
+    dt = time.perf_counter() - t0
+    best = dt if best is None else min(best, dt)
+print(json.dumps({"mode": mode, "seconds": best,
+                  "chunks": chunked.num_chunks}))
+"""
+
+
+def run_arm(mode: str, rows: int, chunk_rows: int, min_of: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _ARM, mode, str(rows), str(chunk_rows),
+         str(min_of)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True, check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=98304)
+    ap.add_argument("--chunk-rows", type=int, default=8192)
+    ap.add_argument("--min-of", type=int, default=3)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    def log(m):
+        print(f"[obs-overhead {time.strftime('%H:%M:%S')}] {m}",
+              file=sys.stderr, flush=True)
+
+    arms = {}
+    for mode in ("off", "on"):
+        log(f"streamed fit with obs {mode} (fresh subprocess, "
+            f"min of {args.min_of})")
+        arms[mode] = run_arm(mode, args.rows, args.chunk_rows,
+                             args.min_of)
+        log(f"  {mode}: {arms[mode]['seconds']:.3f}s over "
+            f"{arms[mode]['chunks']} chunks")
+    ratio = arms["on"]["seconds"] / arms["off"]["seconds"]
+    summary = {
+        "obs_overhead_rows": args.rows,
+        "obs_overhead_chunks": arms["off"]["chunks"],
+        "streamed_fit_seconds_obs_off": round(arms["off"]["seconds"], 4),
+        "streamed_fit_seconds_obs_on": round(arms["on"]["seconds"], 4),
+        "obs_on_over_off_ratio": round(ratio, 4),
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()

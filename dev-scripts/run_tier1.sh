@@ -11,6 +11,14 @@ python -m photon_ml_tpu.cli.lint photon_ml_tpu/ || exit $?
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
+# Trace smoke (docs/OBSERVABILITY.md): a tiny traced game_train run must
+# yield a Chrome-loadable trace whose spans nest and whose bridged
+# Start/Finish pairs all closed. Seconds on CPU; catches a broken
+# observability layer before it reaches a 90-minute flagship run.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/trace_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

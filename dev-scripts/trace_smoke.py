@@ -1,0 +1,101 @@
+"""CI trace smoke: a tiny traced `game_train` run must produce a healthy
+trace (ISSUE 7 satellite: run_tier1.sh gains this step).
+
+Asserts, in order:
+
+1. the run completes and `--trace-out` / `--metrics-dump` files exist;
+2. the trace JSON loads and `photon-obs verify` passes — spans closed,
+   parents resolve, children contained in their parents;
+3. every bridged ``*Start`` event produced a CLOSED span (the bridge's
+   opened == closed counters, zero leaks);
+4. the expected lifecycle + driver spans are present (training,
+   descent.update, game_train) and the metrics dump parses with the
+   checkpoint counter the run must have bumped.
+
+Runs on CPU in seconds — wired into dev-scripts/run_tier1.sh after the
+test suite.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.cli.obs import (load_trace, summarize_trace,
+                                       verify_trace)
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.data.io import save_game_dataset
+    from photon_ml_tpu.obs.metrics import (metric_value,
+                                           parse_prometheus_text)
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory(prefix="pml_trace_smoke_") as td:
+        train_dir = os.path.join(td, "train")
+        save_game_dataset(from_synthetic(synthetic.game_data(
+            rng, n=256, d_global=6, re_specs={"userId": (8, 3)})),
+            train_dir)
+        trace_path = os.path.join(td, "trace.json")
+        metrics_path = os.path.join(td, "metrics.prom")
+        out_dir = os.path.join(td, "out")
+        summary = game_train.run(game_train.build_parser().parse_args([
+            "--train", train_dir,
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--coordinate",
+            "name=per-user,type=random,shard=re_userId,re=userId",
+            "--update-sequence", "fixed,per-user",
+            "--iterations", "1",
+            "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--opt-config",
+            "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--output-dir", out_dir,
+            "--trace-out", trace_path,
+            "--metrics-dump", metrics_path,
+        ]))
+        assert summary.get("model_digest"), "summary has no model digest"
+        assert os.path.exists(trace_path), "trace file missing"
+        assert os.path.exists(metrics_path), "metrics dump missing"
+
+        trace = load_trace(trace_path)  # (1) the JSON loads
+        problems = verify_trace(trace)  # (2) spans nest + closed
+        if problems:
+            print("trace verification FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        meta = trace.get("otherData", {})
+        # (3) every Start/Finish pair became one closed span.
+        assert meta.get("bridge_spans_opened", 0) >= 1, \
+            f"bridge opened no lifecycle spans: {meta}"
+        assert meta["bridge_spans_opened"] == meta["bridge_spans_closed"], \
+            f"bridge leaked spans: {meta}"
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        for expected in ("game_train", "training", "descent.update",
+                        "checkpoint.save"):
+            assert expected in names, \
+                f"span {expected!r} missing from trace (have {names})"
+        # (4) the metrics dump parses and carries the run's counters.
+        parsed = parse_prometheus_text(open(metrics_path).read())
+        ckpt = metric_value(parsed, "photon_checkpoint_writes_total")
+        assert ckpt and ckpt >= 1, \
+            f"checkpoint counter missing/zero in dump: {sorted(parsed)}"
+        s = summarize_trace(trace)
+        print(f"trace smoke ok: {len(names)} distinct span names, "
+              f"{meta['bridge_spans_closed']} bridged scopes closed, "
+              f"wall {s['wall_seconds']:.2f}s, top-level coverage "
+              f"{s['top_level_coverage']:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

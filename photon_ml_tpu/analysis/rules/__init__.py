@@ -5,7 +5,8 @@ the CLI selects/ignores a subset."""
 from __future__ import annotations
 
 from photon_ml_tpu.analysis.rules import (concurrency, device, lifecycle,
-                                          numeric, robustness, timeclock)
+                                          numeric, obs_discipline,
+                                          robustness, timeclock)
 
 # id → (check, one-line summary). Order is report order.
 ALL_RULES = {
@@ -25,4 +26,7 @@ ALL_RULES = {
                "*Start event without a guaranteed matching *Finish"),
     "PML008": (robustness.check_swallowed_exception,
                "broad except that swallows the error silently"),
+    "PML009": (obs_discipline.check_raw_span_discipline,
+               "raw tracer span begin/end without a with/finally "
+               "guarantee"),
 }

@@ -1,0 +1,117 @@
+"""PML009 — raw tracer span opened outside a ``with``/``finally``.
+
+The obs tracing API (photon_ml_tpu/obs) is finally-safe by construction
+through its context manager: ``with tracer.span("name"): ...``. The raw
+pair — ``tracer.start()`` returning a Span closed by ``.end()`` — exists
+for bridge-style code whose open and close live in different callbacks.
+Anywhere else it reintroduces exactly the leak PML007 mechanizes for
+events: the span opens, the body raises, ``end()`` never runs, and the
+exported trace carries a phantom "unfinished" span covering everything
+after the crash (or, worse, the contextvar parent is never restored and
+every LATER span nests under a dead scope).
+
+The rule (the PML007 pairing discipline extended to the span API):
+
+- a ``<tracer>.start(...)`` call used directly as a ``with`` item is
+  fine (the context manager owns the close);
+- otherwise, if a ``.end(...)`` call exists in the SAME function, it
+  must sit in a ``finally`` block covering the region after the start;
+- a start with no ``.end(...)`` anywhere in the module is flagged
+  outright (cross-method open/close — start held on self, end in a
+  different method — matches at module scope and is fine).
+
+"Tracer-ish" receivers are names whose last segment contains ``tracer``
+(``tracer``, ``self._tracer``, ``worker_tracer``) — the repo's naming
+convention for obs.Tracer handles, asserted by the obs module itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.taint import dotted_name, function_bodies
+
+
+def _tracer_start(node: ast.AST) -> bool:
+    """True for ``<tracer-ish>.start(...)``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "start"):
+        return False
+    recv = dotted_name(node.func.value)
+    if recv is None:
+        # Chained receivers (``obs.tracer().start(...)``): the callee
+        # name decides.
+        if isinstance(node.func.value, ast.Call):
+            callee = dotted_name(node.func.value.func) or ""
+            return "tracer" in callee.rsplit(".", 1)[-1].lower()
+        return False
+    return "tracer" in recv.rsplit(".", 1)[-1].lower()
+
+
+def _span_end(node: ast.AST) -> bool:
+    """True for any ``<x>.end(...)`` call (the loose half of the pair —
+    existence and finally-placement are what the rule checks, exactly
+    like PML007's module-scope Finish matching)."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "end")
+
+
+def _with_item_calls(root: ast.AST) -> set:
+    """ids of calls used directly as ``with`` context expressions."""
+    out = set()
+    for node in ast.walk(root):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.add(id(item.context_expr))
+    return out
+
+
+def _finally_covers(fn_body: list[ast.stmt], start: ast.Call,
+                    end: ast.Call) -> bool:
+    """True when ``end`` sits in the finalbody of a Try and ``start`` is
+    not lexically after that Try (the PML007 geometry)."""
+    for node in ast.walk(ast.Module(body=fn_body, type_ignores=[])):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        in_final = any(end is n for s in node.finalbody
+                       for n in ast.walk(s))
+        if in_final and start.lineno <= node.end_lineno:
+            return True
+    return False
+
+
+def check_raw_span_discipline(ctx: ModuleContext) -> list[Finding]:
+    module_has_end = any(_span_end(n) for n in ast.walk(ctx.tree))
+    out: list[Finding] = []
+    for owner, body in function_bodies(ctx.tree):
+        if isinstance(owner, ast.Module):
+            continue
+        with_items = _with_item_calls(owner)
+        starts = [n for n in ast.walk(owner)
+                  if _tracer_start(n) and id(n) not in with_items]
+        if not starts:
+            continue
+        ends = [n for n in ast.walk(owner) if _span_end(n)]
+        for snode in starts:
+            if ends:
+                if not any(_finally_covers(owner.body, snode, e)
+                           for e in ends):
+                    out.append(ctx.finding(
+                        "PML009", snode,
+                        f"raw tracer.start() in {owner.name}() whose "
+                        f".end() is not finally-guaranteed — a raise in "
+                        f"between leaks the span (and its contextvar "
+                        f"parent); use `with tracer.span(...)` or move "
+                        f"the end() into a finally block"))
+            elif not module_has_end:
+                out.append(ctx.finding(
+                    "PML009", snode,
+                    f"raw tracer.start() in {owner.name}() with no "
+                    f".end() anywhere in this module — every span needs "
+                    f"a guaranteed close; use `with tracer.span(...)`"))
+    return out

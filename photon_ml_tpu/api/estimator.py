@@ -68,6 +68,7 @@ class GameEstimator:
         staging: Optional[StagingConfig] = None,
         ingest: Optional[IngestConfig] = None,
         streaming: Optional[StreamingConfig] = None,
+        trace=None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -96,6 +97,11 @@ class GameEstimator:
         # chunk ranges sharded over the mesh's data axis, psum-merged
         # partials, n bounded by host RAM instead of HBM.
         self.streaming = streaming
+        # Span tracing (docs/OBSERVABILITY.md): an obs.Tracer instance
+        # activated for the duration of each fit() — library users get
+        # the same timeline `game_train --trace-out` produces, without
+        # going through the CLI. None (the default) costs nothing.
+        self.trace = trace
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
@@ -257,7 +263,31 @@ class GameEstimator:
         coordinate-descent progress under ``<checkpoint_dir>/grid-<i>`` and
         a rerun with the same arguments resumes mid-descent (SURVEY.md §5
         failure-recovery: the Spark-lineage replacement).
+
+        With ``GameEstimator(trace=...)`` set, the whole fit runs under
+        that tracer (an ``estimator.fit`` root span; staging, descent
+        updates, streamed passes and checkpoint writes nest below it) —
+        dump it afterwards with ``trace.dump(path)``.
         """
+        from photon_ml_tpu import obs
+
+        if self.trace is None:
+            return self._fit(data, validation_data, initial_models,
+                             locked_coordinates, checkpoint_dir)
+        with obs.activated(trace_obj=self.trace):
+            with obs.span("estimator.fit", cat="driver",
+                          coordinates=list(self.coordinate_configs)):
+                return self._fit(data, validation_data, initial_models,
+                                 locked_coordinates, checkpoint_dir)
+
+    def _fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        initial_models: Optional[dict] = None,
+        locked_coordinates: Optional[set[str]] = None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> list[GameResult]:
         from photon_ml_tpu.game.checkpoint import CheckpointManager
 
         if validation_data is not None:

@@ -1,0 +1,233 @@
+"""photon-obs: trace-file tooling (docs/OBSERVABILITY.md).
+
+``photon-obs summarize trace.json`` renders the phase waterfall, the
+top-span table, and the transfer-vs-compute attribution from a Chrome
+trace-event file produced by ``game_train --trace-out`` /
+``GameEstimator(trace=...)`` / ``flagship_criteo_stream.py`` — the
+machine-checkable replacement for the hand-computed subtraction that
+produced the "~95% host→device transfer" figure.
+
+``photon-obs verify trace.json`` is the CI smoke contract (run_tier1.sh):
+the JSON loads, spans nest (parents resolve and contain their children),
+and every bridged Start/Finish pair produced a CLOSED span.
+
+Pure stdlib — no JAX, no numpy — so it runs anywhere the lint CLI does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+# Child spans may start marginally before their parent's exported ts:
+# the parent's wall anchor and the child's are sampled by different
+# clock reads microseconds apart. Containment is asserted with slack.
+_NEST_SLACK_US = 500.0
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise ValueError(f"{path} is not a Chrome trace-event file "
+                         f"(no traceEvents key)")
+    return obj
+
+
+def _spans(trace: dict) -> list[dict]:
+    return [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+
+
+# -- verify -----------------------------------------------------------------
+
+
+def verify_trace(trace: dict) -> list[str]:
+    """Structural violations (empty list = healthy). The contract CI
+    smokes: spans closed, parents resolvable, children contained."""
+    problems = []
+    spans = _spans(trace)
+    if not spans:
+        problems.append("trace contains no spans")
+        return problems
+    by_id = {}
+    for e in spans:
+        sid = e.get("args", {}).get("span_id")
+        if sid is not None:
+            by_id[sid] = e
+    for e in spans:
+        args = e.get("args", {})
+        label = f"{e.get('name')}@{e.get('ts'):.0f}us"
+        if args.get("unfinished"):
+            problems.append(f"span {label} never closed")
+        if e.get("dur", 0) < 0:
+            problems.append(f"span {label} has negative duration")
+        pid_ = args.get("parent_id")
+        if pid_ is None:
+            continue
+        parent = by_id.get(pid_)
+        if parent is None:
+            problems.append(f"span {label} parent {pid_} not in trace")
+            continue
+        if e["ts"] + _NEST_SLACK_US < parent["ts"] or \
+                e["ts"] + e["dur"] > parent["ts"] + parent["dur"] \
+                + _NEST_SLACK_US:
+            problems.append(
+                f"span {label} is not contained in its parent "
+                f"{parent.get('name')} interval")
+    meta = trace.get("otherData", {})
+    if meta.get("open_spans"):
+        problems.append(f"{meta['open_spans']} span(s) still open at dump")
+    opened = meta.get("bridge_spans_opened")
+    closed = meta.get("bridge_spans_closed")
+    if opened is not None and opened != closed:
+        problems.append(
+            f"event bridge opened {opened} lifecycle span(s) but closed "
+            f"{closed} — a Start/Finish pair leaked")
+    if meta.get("bridge_spans_leaked"):
+        problems.append(
+            f"{meta['bridge_spans_leaked']} bridged scope(s) never saw "
+            f"their Finish event")
+    return problems
+
+
+# -- summarize --------------------------------------------------------------
+
+
+def summarize_trace(trace: dict, top: int = 12) -> dict:
+    """Waterfall + top spans + transfer-vs-compute attribution."""
+    spans = _spans(trace)
+    if not spans:
+        return {"wall_seconds": 0.0, "waterfall": [], "top_spans": [],
+                "attribution": {}}
+    t_min = min(e["ts"] for e in spans)
+    t_max = max(e["ts"] + e["dur"] for e in spans)
+    wall_us = max(t_max - t_min, 1e-9)
+
+    ids = {e["args"]["span_id"] for e in spans
+           if "span_id" in e.get("args", {})}
+    roots = [e for e in spans
+             if e.get("args", {}).get("parent_id") not in ids]
+    roots.sort(key=lambda e: e["ts"])
+    waterfall = [{
+        "name": e["name"], "cat": e.get("cat", ""),
+        "start_s": (e["ts"] - t_min) / 1e6, "dur_s": e["dur"] / 1e6,
+        "frac": e["dur"] / wall_us,
+    } for e in roots]
+
+    agg: dict[tuple, dict] = {}
+    for e in spans:
+        a = agg.setdefault((e["name"], e.get("cat", "")),
+                           {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        a["count"] += 1
+        a["total_us"] += e["dur"]
+        a["max_us"] = max(a["max_us"], e["dur"])
+    top_spans = [{
+        "name": k[0], "cat": k[1], "count": v["count"],
+        "total_s": v["total_us"] / 1e6, "max_s": v["max_us"] / 1e6,
+        "frac_of_wall": v["total_us"] / wall_us,
+    } for k, v in sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])]
+
+    # Transfer vs compute: transfer = the device_put accounting spans
+    # (cat "transfer"); the denominator is the streamed-pass time when
+    # passes exist (the bench-comparable fraction), else the wall.
+    transfer_us = sum(e["dur"] for e in spans
+                      if e.get("cat") == "transfer")
+    pass_us = sum(e["dur"] for e in spans
+                  if e["name"] == "stream.pass")
+    denom = pass_us if pass_us > 0 else wall_us
+    attribution = {
+        "transfer_seconds": transfer_us / 1e6,
+        "stream_pass_seconds": pass_us / 1e6,
+        "wall_seconds": wall_us / 1e6,
+        "transfer_fraction_of_stream": transfer_us / denom,
+        "transfer_fraction_of_wall": transfer_us / wall_us,
+    }
+    root_cover = sum(e["dur"] for e in roots)
+    return {
+        "wall_seconds": wall_us / 1e6,
+        "top_level_coverage": min(root_cover / wall_us, 1.0),
+        "waterfall": waterfall[:max(top, len(waterfall))],
+        "top_spans": top_spans[:top],
+        "attribution": attribution,
+    }
+
+
+def _bar(frac: float, width: int = 30) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return "#" * n + "." * (width - n)
+
+
+def render_summary(summary: dict) -> str:
+    out = [f"wall {summary['wall_seconds']:.3f}s; top-level spans cover "
+           f"{summary.get('top_level_coverage', 0.0):.0%} of it", "",
+           "phase waterfall (top-level spans):"]
+    for w in summary["waterfall"]:
+        out.append(f"  {w['start_s']:9.3f}s  {_bar(w['frac'])} "
+                   f"{w['dur_s']:9.3f}s  {w['name']} [{w['cat']}]")
+    out += ["", f"top spans by total time:"]
+    out.append(f"  {'name':<28} {'cat':<10} {'count':>6} {'total_s':>9} "
+               f"{'max_s':>8} {'% wall':>7}")
+    for t in summary["top_spans"]:
+        out.append(f"  {t['name']:<28} {t['cat']:<10} {t['count']:>6} "
+                   f"{t['total_s']:>9.3f} {t['max_s']:>8.3f} "
+                   f"{t['frac_of_wall']:>6.1%}")
+    a = summary["attribution"]
+    out += ["", "transfer vs compute:"]
+    out.append(f"  host→device transfer {a['transfer_seconds']:.3f}s of "
+               f"{a['stream_pass_seconds']:.3f}s streamed-pass time "
+               f"({a['transfer_fraction_of_stream']:.1%}); "
+               f"{a['transfer_fraction_of_wall']:.1%} of wall")
+    return "\n".join(out)
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-obs", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+    s = sub.add_parser("summarize",
+                       help="phase waterfall + top spans + transfer "
+                            "attribution from a trace file")
+    s.add_argument("trace", help="Chrome trace-event JSON "
+                                 "(game_train --trace-out)")
+    s.add_argument("--top", type=int, default=12,
+                   help="rows in the top-span table")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable summary instead of text")
+    v = sub.add_parser("verify",
+                       help="structural health check (CI smoke): spans "
+                            "closed, parents resolve, children nested")
+    v.add_argument("trace")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        trace = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"cannot load {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if args.command == "verify":
+        problems = verify_trace(trace)
+        if problems:
+            print(f"{len(problems)} trace violation(s):")
+            for pr in problems:
+                print(f"  - {pr}")
+            return 1
+        spans = len(_spans(trace))
+        print(f"trace ok: {spans} spans, all closed, nesting consistent")
+        return 0
+    summary = summarize_trace(trace, top=args.top)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,7 @@ from typing import Optional
 import numpy as np
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu import obs
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.game.staging_cache import file_crc32
 from photon_ml_tpu.models import io as model_io
@@ -180,6 +181,19 @@ class CheckpointManager:
 
         if jax.process_index() != 0:
             return
+        with obs.span("checkpoint.save", cat="checkpoint",
+                      done_steps=done_steps, complete=complete):
+            self._write(task, models, done_steps=done_steps,
+                        records=records, complete=complete,
+                        fingerprint=fingerprint, updated=updated,
+                        residual_total=residual_total)
+        mx = obs.metrics()
+        if mx is not None:
+            mx.counter("photon_checkpoint_writes_total",
+                       kind="descent").inc()
+
+    def _write(self, task, models, *, done_steps, records, complete,
+               fingerprint, updated, residual_total) -> None:
         flt.fire("checkpoint.save")
         model_dir = os.path.join(self.directory, _MODEL)
         os.makedirs(model_dir, exist_ok=True)
@@ -442,26 +456,33 @@ class StreamingStateStore:
 
         if jax.process_index() != 0:
             return
-        os.makedirs(self.directory, exist_ok=True)
-        flt.fire("stream.checkpoint_write")
-        path = os.path.join(self.directory, _STREAM_STATE)
-        _preserve_file(path)
-        arrays = {k: np.asarray(v) for k, v in state.items()}
-        atomic_write(path, lambda f: np.savez(f, **arrays))
-        # CRC over the GOOD bytes first, injected bit rot after — the
-        # corruption shape load() must catch. Distinct corrupt-hook site
-        # (the convention of checkpoint.save / checkpoint.artifact):
-        # fire() and corrupt_file() each count occurrences, so sharing a
-        # name would interleave the two hooks' occurrence spaces.
-        crc = file_crc32(path)
-        flt.corrupt_file("stream.checkpoint_artifact", path)
-        meta_path = os.path.join(self.directory, _STREAM_META)
-        _preserve_file(meta_path)
-        atomic_write(meta_path, lambda f: f.write(json.dumps({
-            "crc": crc,
-            "iteration": int(state["it"]),
-            "fingerprint": fingerprint,
-        }).encode()))
+        with obs.span("checkpoint.stream_state", cat="checkpoint",
+                      iteration=int(state["it"])):
+            os.makedirs(self.directory, exist_ok=True)
+            flt.fire("stream.checkpoint_write")
+            path = os.path.join(self.directory, _STREAM_STATE)
+            _preserve_file(path)
+            arrays = {k: np.asarray(v) for k, v in state.items()}
+            atomic_write(path, lambda f: np.savez(f, **arrays))
+            # CRC over the GOOD bytes first, injected bit rot after — the
+            # corruption shape load() must catch. Distinct corrupt-hook
+            # site (the convention of checkpoint.save /
+            # checkpoint.artifact): fire() and corrupt_file() each count
+            # occurrences, so sharing a name would interleave the two
+            # hooks' occurrence spaces.
+            crc = file_crc32(path)
+            flt.corrupt_file("stream.checkpoint_artifact", path)
+            meta_path = os.path.join(self.directory, _STREAM_META)
+            _preserve_file(meta_path)
+            atomic_write(meta_path, lambda f: f.write(json.dumps({
+                "crc": crc,
+                "iteration": int(state["it"]),
+                "fingerprint": fingerprint,
+            }).encode()))
+        mx = obs.metrics()
+        if mx is not None:
+            mx.counter("photon_checkpoint_writes_total",
+                       kind="stream").inc()
         logger.debug("stream state committed: iteration %d -> %s",
                      int(state["it"]), self.directory)
 

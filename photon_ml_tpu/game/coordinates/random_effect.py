@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game import buckets as bkt
@@ -674,8 +675,14 @@ class RandomEffectCoordinate:
             W = jnp.array(
                 self.norm.model_to_transformed_space(initial.means), copy=True)
         offsets = jnp.asarray(offsets)
-        for arrays in self._iter_bucket_data():
-            W = self._fit_bucket(W, offsets, *arrays)
+        for wave, arrays in enumerate(self._iter_bucket_data()):
+            # One span per vmapped entity-fit wave (the dispatch unit the
+            # lane bound exists for). Dispatch is async: the span times
+            # the submission + any blocking the runtime imposes, not the
+            # device execution — the device side belongs to jax.profiler.
+            with obs.span("re.fit_wave", cat="train", wave=wave,
+                          re_type=self.re_type):
+                W = self._fit_bucket(W, offsets, *arrays)
         if self.subspace:
             return SubspaceRandomEffectModel(
                 re_type=self.re_type, shard_id=self.shard_id,

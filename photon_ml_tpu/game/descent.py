@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils import events as ev_mod
@@ -211,22 +212,32 @@ def run(
                     continue  # already covered by the checkpoint
                 coord = coordinates[cid]
                 t0 = time.monotonic()
-                if checkpoint_manager is not None:
-                    # Streamed coordinates checkpoint INSIDE the update
-                    # too (their fit is the multi-hour unit at flagship
-                    # scale): bind this step's stream-state directory so
-                    # a kill mid-L-BFGS resumes mid-optimization.
-                    bind = getattr(coord, "bind_step_checkpoint", None)
-                    if bind is not None:
-                        bind(checkpoint_manager.stream_dir(step), step)
-                # Residual offsets: everything except this coordinate.
-                offsets = base + total - scores[cid]
-                model = coord.train_model(offsets, initial=models[cid])
-                new_scores = coord.score(model)
-                total = total + new_scores - scores[cid]
-                scores[cid] = new_scores
-                models[cid] = model
-                _sync(total)
+                # One span per coordinate update — the descent
+                # waterfall's unit; the coordinate's own spans (streamed
+                # passes, fit waves, checkpoint writes) nest under it.
+                with obs.span("descent.update", cat="train",
+                              iteration=it, coordinate=cid, step=step):
+                    if checkpoint_manager is not None:
+                        # Streamed coordinates checkpoint INSIDE the
+                        # update too (their fit is the multi-hour unit at
+                        # flagship scale): bind this step's stream-state
+                        # directory so a kill mid-L-BFGS resumes
+                        # mid-optimization.
+                        bind = getattr(coord, "bind_step_checkpoint",
+                                       None)
+                        if bind is not None:
+                            bind(checkpoint_manager.stream_dir(step),
+                                 step)
+                    # Residual offsets: everything except this
+                    # coordinate.
+                    offsets = base + total - scores[cid]
+                    model = coord.train_model(offsets,
+                                              initial=models[cid])
+                    new_scores = coord.score(model)
+                    total = total + new_scores - scores[cid]
+                    scores[cid] = new_scores
+                    models[cid] = model
+                    _sync(total)
                 elapsed = time.monotonic() - t0
                 rec = {"iteration": it, "coordinate": cid,
                        "train_seconds": elapsed}

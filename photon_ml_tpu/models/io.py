@@ -51,15 +51,11 @@ def coordinate_meta(m) -> dict:
     raise TypeError(type(m))  # pragma: no cover
 
 
-def save_coordinate(path: str, cid: str, m) -> dict:
-    """Atomically write one coordinate's coefficients under a GameModel
-    directory; returns its metadata entry. Atomic via tmp + ``os.replace``
-    so an interrupted write never corrupts an existing checkpoint file."""
+def coordinate_arrays(m) -> dict:
+    """One coordinate model's persisted arrays, as host numpy — the ONE
+    definition of "the model's bytes", shared by the npz writer and the
+    cross-rank digest."""
     meta = coordinate_meta(m)
-    sub = os.path.join(
-        path, "fixed-effect" if meta["type"] == "fixed" else "random-effect",
-        cid)
-    os.makedirs(sub, exist_ok=True)
     if isinstance(m, FixedEffectModel):
         payload = {"means": np.asarray(m.coefficients.means)}
         if m.coefficients.variances is not None:
@@ -80,10 +76,42 @@ def save_coordinate(path: str, cid: str, m) -> dict:
         payload = {"means": np.asarray(m.means)}
         if m.variances is not None:
             payload["variances"] = np.asarray(m.variances)
+    return payload
+
+
+def save_coordinate(path: str, cid: str, m) -> dict:
+    """Atomically write one coordinate's coefficients under a GameModel
+    directory; returns its metadata entry. Atomic via tmp + ``os.replace``
+    so an interrupted write never corrupts an existing checkpoint file."""
+    meta = coordinate_meta(m)
+    sub = os.path.join(
+        path, "fixed-effect" if meta["type"] == "fixed" else "random-effect",
+        cid)
+    os.makedirs(sub, exist_ok=True)
+    payload = coordinate_arrays(m)
     tmp = os.path.join(sub, "coefficients.tmp.npz")
     np.savez(tmp, **payload)
     os.replace(tmp, os.path.join(sub, "coefficients.npz"))
     return meta
+
+
+def game_model_digest(model: GameModel) -> str:
+    """SHA-256 over every coordinate's persisted arrays in canonical
+    order. Two models digest equal iff their trained bytes are IDENTICAL
+    — the cross-rank equality probe (`__graft_entry__._dryrun_dcn`
+    asserts ranks converge to byte-identical coefficients, not just an
+    AUC scalar agreeing to 1e-6; VERDICT Weak #6) and the ``game_train``
+    summary's model fingerprint."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for cid in sorted(model.models):
+        m = model.models[cid]
+        h.update(json.dumps(coordinate_meta(m), sort_keys=True).encode())
+        for key, arr in sorted(coordinate_arrays(m).items()):
+            h.update(key.encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 def write_metadata(path: str, task: TaskType,

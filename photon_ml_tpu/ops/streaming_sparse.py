@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu import obs
 from photon_ml_tpu.ops.hybrid_sparse import _hot_matvec, _hot_rmatvec
 from photon_ml_tpu.ops.losses import PointwiseLoss
 
@@ -356,12 +357,22 @@ _VG_KERNELS: dict = {}
 _V_KERNELS: dict = {}
 
 
+def _count_kernel_build(cache: str) -> None:
+    """One streamed-kernel program cache missed — a fresh trace/compile.
+    Steady state should show exactly one build per (loss, cache); a
+    climbing counter means the one-program-per-stream invariant broke."""
+    mx = obs.metrics()
+    if mx is not None:
+        mx.counter("photon_compile_cache_misses_total", cache=cache).inc()
+
+
 def _chunk_value_grad(loss: PointwiseLoss):
     """One jitted per-chunk pass: original-space w in, original-space
     (value, grad) out — shared by every chunk (identical structures)."""
     f = _VG_KERNELS.get(loss.name)
     if f is not None:
         return f
+    _count_kernel_build("stream_value_grad")
 
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
@@ -386,6 +397,7 @@ def _chunk_value(loss: PointwiseLoss):
     f = _V_KERNELS.get(loss.name)
     if f is not None:
         return f
+    _count_kernel_build("stream_value_only")
 
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
@@ -404,18 +416,63 @@ def _margins_kernel(w: Array, offsets: Array, ch: CanonicalChunk):
     return _chunk_margins_of(ch, w_pad, offsets)
 
 
+def _chunk_nbytes(ch) -> int:
+    """Host-side payload bytes of one chunk's leaves — the analytic unit
+    the transfer accounting sums (ISSUE 7 satellite 1 asserts the total
+    IS this sum, per streamed chunk, per pass)."""
+    return int(sum(int(getattr(leaf, "nbytes", 0))
+                   for leaf in jax.tree.leaves(ch)))
+
+
+# Per-pass gc floor: the full collection after a streamed pass exists to
+# bound lazily-freed transfer buffers (the n=100M lesson: ~60 GB of host
+# RSS over 11 L-BFGS iterations before the OOM killer fired). That only
+# matters when a pass actually moves serious bytes; eager per-chunk
+# ``leaf.delete()`` already frees the device side, and a FULL gc.collect
+# in a long-lived process (the test suite: measured 300s of a single
+# test's wall, ~8s standalone) costs seconds per call once the heap is
+# big. Collect only when the pass streamed enough for buffer pileup to
+# matter — flagship passes (GBs) always collect; test passes (KBs) never.
+GC_STREAM_BYTES_FLOOR = 1 << 28  # 256 MiB per pass
+
+
+def _stream_nbytes(chunked: "ChunkedHybrid") -> int:
+    """Total streamed payload per pass, memoized on the ChunkedHybrid."""
+    cached = getattr(chunked, "_payload_nbytes", None)
+    if cached is None:
+        cached = sum(_chunk_nbytes(ch) for ch in chunked.chunks)
+        object.__setattr__(chunked, "_payload_nbytes", cached)
+    return cached
+
+
+def _collect_after_pass(chunked: "ChunkedHybrid") -> None:
+    if _stream_nbytes(chunked) >= GC_STREAM_BYTES_FLOOR:
+        gc.collect()
+
+
 def _transfer(ch: CanonicalChunk, index: int,
               device: Optional[jax.Device] = None):
     """Host→device chunk copy behind the ``stream.chunk_transfer`` fault
     site, with the bounded-retry ladder: a transfer is idempotent, so a
     transient failure retries with deterministic backoff; exhausted
     retries raise loudly (there is no degraded mode below a lost chunk —
-    dropping it would silently change the objective)."""
+    dropping it would silently change the objective).
+
+    This is ALSO the ``device_put`` accounting seam (docs/OBSERVABILITY
+    .md): when obs is on, every successful transfer adds its payload to
+    ``photon_transfer_bytes_total``/``photon_transfer_seconds_total``
+    and bumps the in-flight chunk gauge; off, the cost is one None check.
+    The seconds counter measures the HOST-side ``device_put`` time (the
+    enqueue/copy commit) — on a transfer-bound stream that is the wall.
+    """
     for attempt in range(TRANSFER_MAX_RETRIES + 1):
         try:
             flt.fire("stream.chunk_transfer", index=index)
-            return (jax.device_put(ch, device) if device is not None
-                    else jax.device_put(ch))
+            mx, tr = obs.metrics(), obs.tracer()
+            if mx is None and tr is None:
+                return (jax.device_put(ch, device) if device is not None
+                        else jax.device_put(ch))
+            return _accounted_transfer(ch, index, device, mx, tr)
         except Exception as e:
             if attempt >= TRANSFER_MAX_RETRIES:
                 raise
@@ -423,7 +480,45 @@ def _transfer(ch: CanonicalChunk, index: int,
                 "chunk %d transfer failed (%s: %s); retry %d/%d",
                 index, type(e).__name__, e, attempt + 1,
                 TRANSFER_MAX_RETRIES)
+            mx = obs.metrics()
+            if mx is not None:
+                mx.counter("photon_stream_transfer_retries_total").inc()
             time.sleep(TRANSFER_RETRY_BACKOFF_S * (attempt + 1))
+
+
+def _accounted_transfer(ch, index: int, device, mx, tr):
+    """The traced/metered half of :func:`_transfer` (split out so the
+    off path stays one None check)."""
+    nbytes = _chunk_nbytes(ch)
+    t0 = time.perf_counter()
+    if tr is not None:
+        with tr.span("stream.chunk_transfer", cat="transfer",
+                     index=index, bytes=nbytes):
+            out = (jax.device_put(ch, device) if device is not None
+                   else jax.device_put(ch))
+    else:
+        out = (jax.device_put(ch, device) if device is not None
+               else jax.device_put(ch))
+    if mx is not None:
+        dt = time.perf_counter() - t0
+        mx.counter("photon_transfer_bytes_total", kind="stream").inc(
+            nbytes)
+        mx.counter("photon_transfer_seconds_total", kind="stream").inc(dt)
+        mx.counter("photon_transfer_chunks_total", kind="stream").inc()
+        mx.gauge("photon_stream_inflight_chunks").inc()
+    return out
+
+
+def _delete_chunk(ch) -> None:
+    """Eagerly drop one STREAMED chunk's device buffers and step the
+    in-flight gauge back down — the gauge's peak is the measured form of
+    the n=100M enqueue-scratch bound."""
+    for leaf in jax.tree.leaves(ch):
+        if isinstance(leaf, jax.Array):
+            leaf.delete()
+    mx = obs.metrics()
+    if mx is not None:
+        mx.gauge("photon_stream_inflight_chunks").dec()
 
 
 def _stream(chunked: ChunkedHybrid, depth: int, pinned=()):
@@ -500,6 +595,11 @@ def make_value_and_gradient(
     kernel = _chunk_value_grad(loss)
 
     def value_and_grad(w: Array, offsets: Optional[Array] = None):
+        with obs.span("stream.pass", cat="stream", kind="value_grad",
+                      chunks=chunked.num_chunks):
+            return _vg_pass(w, offsets)
+
+    def _vg_pass(w: Array, offsets: Optional[Array]):
         value = jnp.zeros((), jnp.float32)
         grad = jnp.zeros((chunked.dim,), jnp.float32)
         for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
@@ -518,8 +618,9 @@ def make_value_and_gradient(
         # Lazily-freed transfer buffers accumulate across evaluations
         # (measured: the 100M-row run's host RSS climbed ~60 GB over 11
         # L-BFGS iterations until the OOM killer fired); one collection
-        # per pass keeps the pool bounded.
-        gc.collect()
+        # per heavyweight pass keeps the pool bounded (gated on bytes —
+        # see GC_STREAM_BYTES_FLOOR).
+        _collect_after_pass(chunked)
         return value, grad
 
     return value_and_grad
@@ -537,13 +638,18 @@ def make_value_only(
     kernel = _chunk_value(loss)
 
     def value_only(w: Array, offsets: Optional[Array] = None):
+        with obs.span("stream.pass", cat="stream", kind="value_only",
+                      chunks=chunked.num_chunks):
+            return _v_pass(w, offsets)
+
+    def _v_pass(w: Array, offsets: Optional[Array]):
         value = jnp.zeros((), jnp.float32)
         for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
             v = kernel(w, _offsets_for(chunked, offsets, i, ch), ch)
             value = value + v
             jax.block_until_ready(value)  # same enqueue-scratch barrier
             _release(ch, i, pinned)
-        gc.collect()
+        _collect_after_pass(chunked)
         return value
 
     return value_only
@@ -554,9 +660,7 @@ def _release(ch, i: int, pinned) -> None:
     laziness is what let per-eval transfer buffers pile up on host."""
     if i < len(pinned):
         return
-    for leaf in jax.tree.leaves(ch):
-        if isinstance(leaf, jax.Array):
-            leaf.delete()
+    _delete_chunk(ch)
 
 
 def margins_chunked(
@@ -567,13 +671,19 @@ def margins_chunked(
     pinned=(),
 ) -> Array:
     """(num_rows,) margins (wᵀx + offset), streamed; pad rows dropped."""
+    with obs.span("stream.pass", cat="stream", kind="margins",
+                  chunks=chunked.num_chunks):
+        return _margins_pass(chunked, w, offsets, prefetch_depth, pinned)
+
+
+def _margins_pass(chunked, w, offsets, prefetch_depth, pinned) -> Array:
     parts = []
     for i, ch in enumerate(_stream(chunked, prefetch_depth, pinned)):
         parts.append(_margins_kernel(
             w, _offsets_for(chunked, offsets, i, ch), ch))
         jax.block_until_ready(parts[-1])  # same enqueue-scratch barrier
         _release(ch, i, pinned)
-    gc.collect()
+    _collect_after_pass(chunked)
     z = jnp.concatenate(parts)
     return z[:chunked.num_rows]
 
@@ -643,6 +753,7 @@ def _merge_fn(mesh):
     cached = _MERGE_FNS.get(mesh)
     if cached is not None:
         return cached
+    _count_kernel_build("stream_psum_merge")
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -795,10 +906,10 @@ class ShardedChunkStream:
                         jax.block_until_ready(accs[k])
                 for ch, streamed in touched:
                     if streamed:
-                        for leaf in jax.tree.leaves(ch):
-                            if isinstance(leaf, jax.Array):
-                                leaf.delete()
-        gc.collect()  # the single-device transfer-buffer lesson, per pass
+                        _delete_chunk(ch)
+        # The single-device transfer-buffer lesson, per pass (gated on
+        # bytes: heavyweight streams collect, test-scale ones skip).
+        _collect_after_pass(self.chunked)
 
     # -- streamed aggregates ----------------------------------------------
 
@@ -810,6 +921,12 @@ class ShardedChunkStream:
         merge = _merge_fn(self.mesh)
 
         def vg(w: Array, offsets: Optional[Array] = None):
+            with obs.span("stream.pass", cat="stream", kind="value_grad",
+                          chunks=self.chunked.num_chunks,
+                          devices=self.num_devices):
+                return _vg(w, offsets)
+
+        def _vg(w: Array, offsets: Optional[Array]):
             vals = [jax.device_put(jnp.zeros((1,), jnp.float32), dev)
                     for dev in self.devices]
             grads = [jax.device_put(jnp.zeros((1, d), jnp.float32), dev)
@@ -821,8 +938,10 @@ class ShardedChunkStream:
                 grads[k] = grads[k] + g
 
             self._round_robin(w, offsets, dispatch, grads)
-            value, grad = merge(self._global(vals, (1,)),
-                                self._global(grads, (1, d)))
+            with obs.span("stream.psum_merge", cat="compute",
+                          devices=self.num_devices):
+                value, grad = merge(self._global(vals, (1,)),
+                                    self._global(grads, (1, d)))
             # The replicated results re-commit to the lead device so the
             # driver loop's jitted helpers (single-device history math)
             # can mix them with their own state freely.
@@ -838,6 +957,12 @@ class ShardedChunkStream:
         d = self.chunked.dim
 
         def v_fn(w: Array, offsets: Optional[Array] = None):
+            with obs.span("stream.pass", cat="stream", kind="value_only",
+                          chunks=self.chunked.num_chunks,
+                          devices=self.num_devices):
+                return _v(w, offsets)
+
+        def _v(w: Array, offsets: Optional[Array]):
             vals = [jax.device_put(jnp.zeros((1,), jnp.float32), dev)
                     for dev in self.devices]
             zeros = [jax.device_put(jnp.zeros((1, 1), jnp.float32), dev)
@@ -847,8 +972,10 @@ class ShardedChunkStream:
                 vals[k] = vals[k] + kernel(w_k, off, ch)
 
             self._round_robin(w, offsets, dispatch, vals)
-            value, _ = merge(self._global(vals, (1,)),
-                             self._global(zeros, (1, 1)))
+            with obs.span("stream.psum_merge", cat="compute",
+                          devices=self.num_devices):
+                value, _ = merge(self._global(vals, (1,)),
+                                 self._global(zeros, (1, 1)))
             return jax.device_put(value, self.devices[0])
 
         return v_fn
@@ -857,6 +984,12 @@ class ShardedChunkStream:
         """(num_rows,) margins in global row order (pad tail dropped).
         Parts come home per chunk (scoring runs once per coordinate
         update; the pass is transfer-bound either way)."""
+        with obs.span("stream.pass", cat="stream", kind="margins",
+                      chunks=self.chunked.num_chunks,
+                      devices=self.num_devices):
+            return self._margins_pass(w, offsets)
+
+    def _margins_pass(self, w: Array, offsets: Optional[Array]) -> Array:
         parts: dict[int, np.ndarray] = {}
         per_dev = self._offsets_by_device(offsets)
         w32 = jnp.asarray(w, jnp.float32)
@@ -881,10 +1014,8 @@ class ShardedChunkStream:
                 if streamed:
                     released.append(ch)
             for ch in released:
-                for leaf in jax.tree.leaves(ch):
-                    if isinstance(leaf, jax.Array):
-                        leaf.delete()
-        gc.collect()
+                _delete_chunk(ch)
+        _collect_after_pass(self.chunked)
         z = np.concatenate([parts[i] for i in range(len(parts))])
         return jnp.asarray(z[:self.chunked.num_rows])
 

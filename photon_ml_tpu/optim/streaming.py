@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.optim.common import OptResult, OptimizerConfig
 
 Array = jax.Array
@@ -163,7 +164,8 @@ def minimize_streaming(
             f"(f={fv:.6g})")
     else:
         w = jnp.asarray(w0, jnp.float32)
-        f, g = value_and_grad(w)
+        with obs.span("lbfgs.initial_pass", cat="optim"):
+            f, g = value_and_grad(w)
         f0, gn0 = float(f), float(jnp.linalg.norm(g))
         s_stack = jnp.zeros((M, d), jnp.float32)
         y_stack = jnp.zeros((M, d), jnp.float32)
@@ -178,68 +180,79 @@ def minimize_streaming(
     converged = False
     it = start_it - 1
     for it in range(start_it, max_it + 1):
-        direction = _two_loop(g, s_stack, y_stack, rho, m)
-        # pml: allow[PML001] direction-validity guard is a host branch by design; one scalar read per iteration vs a full data pass
-        dg = float(jnp.dot(direction, g))
-        if not np.isfinite(dg) or dg >= 0.0:
-            # pml: allow[PML001] steepest-descent fallback needs the host scalar for the same Armijo branch; rare path
-            direction, dg = -g, -float(jnp.dot(g, g))
-        # First iteration: steepest descent scaled to unit step length
-        # (Breeze's determineStepSize init); later γ-scaling makes 1.0
-        # the natural trial step.
-        step = 1.0 if m_host > 0 else min(1.0, 1.0 / max(gn_prev, 1e-12))
-        accepted = False
-        for _ in range(config.max_line_search_steps):
-            w_try = w + step * direction
-            if value_only is None:
-                f_try, g_try = value_and_grad(w_try)
-                # pml: allow[PML001] Armijo probe is a BY-DESIGN barrier: the host decides accept/backtrack on this value (ISSUE 3)
-                f_try_h = float(f_try)
-            else:
-                # pml: allow[PML001] Armijo probe barrier, value-only pass (same by-design host decision as above)
-                f_try_h = float(value_only(w_try))
-            if np.isfinite(f_try_h) and \
-                    f_try_h <= fv + config.wolfe_c1 * step * dg:
-                accepted = True
+        # One span per driver-loop iteration (docs/OBSERVABILITY.md):
+        # streamed passes, probes, and the checkpoint write all nest
+        # under it, so the trace waterfall reads as the optimizer ran.
+        with obs.span("lbfgs.iteration", cat="optim", it=it):
+            direction = _two_loop(g, s_stack, y_stack, rho, m)
+            # pml: allow[PML001] direction-validity guard is a host branch by design; one scalar read per iteration vs a full data pass
+            dg = float(jnp.dot(direction, g))
+            if not np.isfinite(dg) or dg >= 0.0:
+                # pml: allow[PML001] steepest-descent fallback needs the host scalar for the same Armijo branch; rare path
+                direction, dg = -g, -float(jnp.dot(g, g))
+            # First iteration: steepest descent scaled to unit step
+            # length (Breeze's determineStepSize init); later γ-scaling
+            # makes 1.0 the natural trial step.
+            step = 1.0 if m_host > 0 else min(1.0,
+                                              1.0 / max(gn_prev, 1e-12))
+            accepted = False
+            for probe in range(config.max_line_search_steps):
+                w_try = w + step * direction
+                with obs.span("lbfgs.probe", cat="optim", it=it,
+                              probe=probe, step=step):
+                    if value_only is None:
+                        f_try, g_try = value_and_grad(w_try)
+                        # pml: allow[PML001] Armijo probe is a BY-DESIGN barrier: the host decides accept/backtrack on this value (ISSUE 3)
+                        f_try_h = float(f_try)
+                    else:
+                        # pml: allow[PML001] Armijo probe barrier, value-only pass (same by-design host decision as above)
+                        f_try_h = float(value_only(w_try))
+                if np.isfinite(f_try_h) and \
+                        f_try_h <= fv + config.wolfe_c1 * step * dg:
+                    accepted = True
+                    break
+                step *= 0.5
+            if not accepted:
+                log(f"iter {it}: line search failed (f={fv:.6g}); "
+                    f"stopping")
                 break
-            step *= 0.5
-        if not accepted:
-            log(f"iter {it}: line search failed (f={fv:.6g}); stopping")
-            break
-        if value_only is not None:
-            # Gradient pass only on acceptance (the curvature pair and
-            # the next direction need it; rejected probes never did).
-            _, g_try = value_and_grad(w_try)
-        s = w_try - w
-        y = g_try - g
-        # pml: allow[PML001] curvature-damping skip is a host branch; one scalar per accepted step
-        sy = float(jnp.dot(s, y))
-        if sy > 1e-10:
-            s_stack = _shift_in(s_stack, s, m)
-            y_stack = _shift_in(y_stack, y, m)
-            rho = _shift_in(rho[:, None], jnp.full((1,), 1.0 / sy,
-                                                   jnp.float32), m)[:, 0]
-            m = jnp.minimum(m + 1, M)
-            m_host = min(m_host + 1, M)
-        w, g = w_try, g_try
-        f_prev, fv = fv, f_try_h
-        # pml: allow[PML001] convergence test runs on host once per iteration; the streamed pass dominates by orders of magnitude
-        gn = float(jnp.linalg.norm(g))
-        vals[it], gns[it] = fv, gn
-        log(f"iter {it}: f={fv:.6g} |g|={gn:.3g} step={step:.3g}")
-        if checkpoint_save is not None:
-            # Iteration boundary = the resume point: everything the next
-            # iteration reads goes into the snapshot (gn_prev is the gn
-            # just computed — the value the next iteration would see).
-            checkpoint_save(snapshot_state(
-                w, g, s_stack, y_stack, rho, m_host, it, fv, gn, f0, gn0,
-                vals, gns))
-        if gn <= config.tolerance * max(gn0, 1.0) or \
-                abs(fv - f_prev) <= config.tolerance * max(abs(f_prev),
-                                                           1e-12):
-            converged = True
-            break
-        gn_prev = gn
+            if value_only is not None:
+                # Gradient pass only on acceptance (the curvature pair
+                # and the next direction need it; rejected probes never
+                # did).
+                _, g_try = value_and_grad(w_try)
+            s = w_try - w
+            y = g_try - g
+            # pml: allow[PML001] curvature-damping skip is a host branch; one scalar per accepted step
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                s_stack = _shift_in(s_stack, s, m)
+                y_stack = _shift_in(y_stack, y, m)
+                rho = _shift_in(rho[:, None], jnp.full((1,), 1.0 / sy,
+                                                       jnp.float32),
+                                m)[:, 0]
+                m = jnp.minimum(m + 1, M)
+                m_host = min(m_host + 1, M)
+            w, g = w_try, g_try
+            f_prev, fv = fv, f_try_h
+            # pml: allow[PML001] convergence test runs on host once per iteration; the streamed pass dominates by orders of magnitude
+            gn = float(jnp.linalg.norm(g))
+            vals[it], gns[it] = fv, gn
+            log(f"iter {it}: f={fv:.6g} |g|={gn:.3g} step={step:.3g}")
+            if checkpoint_save is not None:
+                # Iteration boundary = the resume point: everything the
+                # next iteration reads goes into the snapshot (gn_prev is
+                # the gn just computed — the value the next iteration
+                # would see).
+                checkpoint_save(snapshot_state(
+                    w, g, s_stack, y_stack, rho, m_host, it, fv, gn, f0,
+                    gn0, vals, gns))
+            if gn <= config.tolerance * max(gn0, 1.0) or \
+                    abs(fv - f_prev) <= config.tolerance * max(abs(f_prev),
+                                                               1e-12):
+                converged = True
+                break
+            gn_prev = gn
 
     return OptResult(
         w=w,

@@ -38,6 +38,8 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
+
+from photon_ml_tpu import obs
 from typing import Callable, Optional, Sequence
 
 logger = logging.getLogger("photon_ml_tpu.serving")
@@ -245,7 +247,11 @@ class MicroBatcher:
             if not batch:
                 continue
             try:
-                scores = self._flush_fn(batch)
+                # One span per device flush (docs/OBSERVABILITY.md) —
+                # off, this is one None check per batch.
+                with obs.span("serving.flush", cat="serving",
+                              rows=len(batch)):
+                    scores = self._flush_fn(batch)
                 if len(scores) != len(batch):
                     # A silent zip() over a short result left the tail
                     # pending FOREVER pre-hardening; fail loudly instead.

@@ -17,44 +17,12 @@ from __future__ import annotations
 import threading
 import time
 
-import numpy as np
+# The latency reservoir is the cross-stack histogram of obs/metrics.py
+# (photon-obs generalized this module's percentile ring into the
+# process-wide registry); the name survives for serving call sites.
+from photon_ml_tpu.obs.metrics import Histogram as LatencyHistogram
 
-# Ring size for the latency reservoirs: large enough that p99 over recent
-# traffic is stable, small enough that percentile() stays trivial.
-_RING = 8192
-
-
-class LatencyHistogram:
-    """Percentiles over the most recent ``size`` observations (seconds)."""
-
-    def __init__(self, size: int = _RING):
-        self._buf = np.zeros(size, np.float64)
-        self._n = 0  # total ever recorded
-        self._sum = 0.0
-
-    def record(self, seconds: float) -> None:
-        self._buf[self._n % self._buf.shape[0]] = seconds
-        self._n += 1
-        self._sum += seconds
-
-    @property
-    def count(self) -> int:
-        return self._n
-
-    def percentile(self, p: float) -> float:
-        k = min(self._n, self._buf.shape[0])
-        if k == 0:
-            return 0.0
-        return float(np.percentile(self._buf[:k], p))
-
-    def mean(self) -> float:
-        return self._sum / self._n if self._n else 0.0
-
-    def summary(self) -> dict:
-        return {"count": self._n, "mean_ms": self.mean() * 1e3,
-                "p50_ms": self.percentile(50) * 1e3,
-                "p95_ms": self.percentile(95) * 1e3,
-                "p99_ms": self.percentile(99) * 1e3}
+__all__ = ["CacheCounters", "LatencyHistogram", "ServingMetrics"]
 
 
 class CacheCounters:

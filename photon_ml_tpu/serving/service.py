@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu import obs
 from photon_ml_tpu.data.game_data import GameDataset, SparseShard
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops import losses as losses_mod
@@ -271,7 +272,15 @@ class ScoringService:
     # -- lifecycle ---------------------------------------------------------
 
     def metrics_text(self) -> str:
-        return self.metrics.render_text()
+        """The ``/metrics`` body: serving's own scoreboard plus — when
+        process-wide observability is on — the cross-stack registry
+        (transfer accounting, checkpoint/retry counters), so ONE endpoint
+        exposes the whole process (docs/OBSERVABILITY.md)."""
+        text = self.metrics.render_text()
+        registry = obs.metrics()
+        if registry is not None:
+            text += registry.render_text()
+        return text
 
     def close(self) -> None:
         if self._closed:

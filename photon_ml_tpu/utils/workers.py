@@ -20,8 +20,10 @@ where a real one would (photon_ml_tpu/faults).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu import obs
 
 # Per-process context installed by the pool initializer (empty in the
 # driver process and in thread-mode workers, which share the driver's).
@@ -35,23 +37,43 @@ def worker_ctx() -> dict:
 
 def init_worker(ctx: dict) -> None:
     """Process-pool initializer: install the shipped context and arm the
-    driver's fault plan inside the fresh worker interpreter."""
+    driver's fault plan (and, when the driver is tracing, a spilling
+    worker tracer) inside the fresh worker interpreter."""
     _WORKER_CTX.update(ctx)
     plan = ctx.get("fault_plan")
     if plan is not None:
         flt.install(plan, worker=True)
+    trace_ctx = ctx.get("obs_trace")
+    if trace_ctx is not None:
+        obs.adopt_worker_context(trace_ctx)
+
+
+class _PropagatingThreadPool(cf.ThreadPoolExecutor):
+    """Thread pool whose tasks run under a COPY of the submitter's
+    contextvars — worker-side spans (staging shards, ingest chunks,
+    stream staging) parent under the driver span that submitted them
+    instead of floating at the trace root."""
+
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = contextvars.copy_context()
+        return super().submit(ctx.run, fn, *args, **kwargs)
 
 
 def make_pool(mode: str, workers: int, ctx: dict,
               thread_name_prefix: str = "pml-worker"):
     """A thread or spawn-process executor with ``ctx`` installed in every
     process-mode worker (thread-mode workers see the driver's state
-    directly and need no initializer)."""
+    directly and need no initializer). Both shapes propagate the active
+    trace context: threads via contextvars, processes via the shipped
+    ctx + the tracer's spill file (docs/OBSERVABILITY.md)."""
     if mode == "process":
         import multiprocessing as mp
 
+        trace_ctx = obs.worker_context()
+        if trace_ctx is not None:
+            ctx = {**ctx, "obs_trace": trace_ctx}
         return cf.ProcessPoolExecutor(
             max_workers=workers, mp_context=mp.get_context("spawn"),
             initializer=init_worker, initargs=(ctx,))
-    return cf.ThreadPoolExecutor(max_workers=workers,
-                                 thread_name_prefix=thread_name_prefix)
+    return _PropagatingThreadPool(max_workers=workers,
+                                  thread_name_prefix=thread_name_prefix)

@@ -1,0 +1,460 @@
+"""photon-obs: span tracing, metrics registry, event bridge, transfer
+accounting — and the 100M-failure-mode regression test (ISSUE 7
+satellite 1: the enqueue-scratch and transfer-byte claims become
+assertions at test scale).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.utils import events as ev_mod
+from photon_ml_tpu.utils import workers as wk
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _obs_off_after():
+    """Observability is process-global state; never leak it into other
+    tests."""
+    yield
+    obs.disable()
+
+
+# ---------------------------------------------------------------- tracer
+
+
+def test_span_nesting_and_chrome_export():
+    t = obs.Tracer()
+    with t.span("root", cat="test", a=1) as root:
+        with t.span("child") as child:
+            time.sleep(0.002)
+        assert child.dur is not None and child.dur > 0
+    trace = t.chrome_trace()
+    spans = {e["name"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["child"]["args"]["parent_id"] == \
+        spans["root"]["args"]["span_id"]
+    assert spans["root"]["args"]["a"] == 1
+    assert trace["otherData"]["open_spans"] == 0
+    # Chrome geometry: child interval inside parent interval.
+    c, r = spans["child"], spans["root"]
+    assert c["ts"] >= r["ts"] - 500 and \
+        c["ts"] + c["dur"] <= r["ts"] + r["dur"] + 500
+
+
+def test_span_exception_path_closes_and_tags():
+    t = obs.Tracer()
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    [e] = [e for e in t.chrome_trace()["traceEvents"]
+           if e.get("ph") == "X"]
+    assert e["args"]["error"] == "RuntimeError"
+    assert t.open_spans() == 0
+
+
+def test_raw_start_end_pair_and_unfinished_export():
+    t = obs.Tracer()
+    sp = t.start("bridge-style")
+    assert t.open_spans() == 1
+    # An unfinished span exports flagged, not hidden.
+    ev = [e for e in t.chrome_trace()["traceEvents"]
+          if e.get("ph") == "X"][0]
+    assert ev["args"]["unfinished"] is True
+    sp.end(extra=1)
+    sp.end()  # idempotent
+    assert t.open_spans() == 0
+
+
+def test_thread_pool_propagates_span_context():
+    obs.enable(metrics=False)
+    t = obs.tracer()
+    got = {}
+
+    def task():
+        with obs.span("inner") as sp:
+            got["parent"] = sp.parent_id
+
+    with t.span("outer") as outer:
+        pool = wk.make_pool("thread", 2, {})
+        try:
+            pool.submit(task).result()
+        finally:
+            pool.shutdown()
+    assert got["parent"] == outer.span_id
+
+
+def test_spawn_worker_spans_spill_and_reparent(tmp_path):
+    spill = str(tmp_path / "spans.jsonl")
+    obs.enable(spill=spill)
+    t = obs.tracer()
+    with t.span("driver.submit") as outer:
+        ctx = obs.worker_context()
+        assert ctx == {"spill": spill, "parent": outer.span_id}
+        # The spawn-pool worker's side of make_pool/init_worker, run in
+        # a REAL fresh interpreter (the pickling-free equivalent of one
+        # pool worker executing one task).
+        code = (
+            "import sys\n"
+            "from photon_ml_tpu.utils import workers\n"
+            "from photon_ml_tpu import obs\n"
+            "workers.init_worker({'obs_trace': "
+            "{'spill': sys.argv[1], 'parent': sys.argv[2]}})\n"
+            "with obs.span('worker.task', cat='stage'):\n"
+            "    pass\n")
+        subprocess.run([sys.executable, "-c", code, spill,
+                        outer.span_id], cwd=REPO, check=True)
+    trace = t.chrome_trace()
+    worker = [e for e in trace["traceEvents"]
+              if e.get("name") == "worker.task"]
+    assert len(worker) == 1
+    assert worker[0]["args"]["parent_id"] == outer.span_id
+    assert worker[0]["pid"] != os.getpid()
+    # Rebased onto the driver's clock: lands inside the driver's run.
+    assert worker[0]["ts"] >= 0
+
+
+# --------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_render_parse_roundtrip():
+    m = obs.MetricsRegistry()
+    m.counter("photon_transfer_bytes_total", kind="stream").inc(4096)
+    m.counter("photon_transfer_bytes_total", kind="pin").inc(100)
+    g = m.gauge("photon_stream_inflight_chunks")
+    g.inc(); g.inc(); g.inc(); g.dec()
+    m.histogram("photon_coordinate_update_seconds").observe(0.25)
+    text = m.render_text()
+    parsed = obs.parse_prometheus_text(text)
+    assert parsed['photon_transfer_bytes_total{kind="stream"}'] == 4096
+    # metric_value sums a labeled family.
+    assert obs.metric_value(parsed, "photon_transfer_bytes_total") == 4196
+    assert parsed["photon_stream_inflight_chunks"] == 2
+    assert parsed["photon_stream_inflight_chunks_peak"] == 3
+    assert parsed["photon_coordinate_update_seconds_count"] == 1
+
+
+def test_counter_rejects_negative_and_type_conflicts():
+    m = obs.MetricsRegistry()
+    with pytest.raises(ValueError):
+        m.counter("c").inc(-1)
+    m.counter("x")
+    with pytest.raises(TypeError):
+        m.gauge("x")
+
+
+def test_histogram_is_servings_latency_reservoir():
+    from photon_ml_tpu.serving.metrics import LatencyHistogram
+
+    assert LatencyHistogram is obs.Histogram
+    h = LatencyHistogram(size=16)
+    for v in (0.01, 0.02, 0.03):
+        h.record(v)
+    s = h.summary()
+    assert s["count"] == 3 and s["p50_ms"] == pytest.approx(20.0)
+
+
+# ---------------------------------------------------------------- bridge
+
+
+def test_bridge_turns_event_pairs_into_spans_and_counters():
+    t, m = obs.enable()
+    em = ev_mod.default_emitter
+    em.emit(ev_mod.TrainingStart(task="LOGISTIC_REGRESSION",
+                                 update_sequence=("fixed",),
+                                 iterations=1))
+    em.emit(ev_mod.StagingStart(label="re:0", num_shards=2, workers=1,
+                                mode="thread", cached_shards=0))
+    em.emit(ev_mod.StagingRetry(label="re:0", index=0, attempt=1,
+                                error="boom"))
+    em.emit(ev_mod.StagingFinish(label="re:0", num_shards=2,
+                                 cached_shards=0, wall_seconds=0.1))
+    em.emit(ev_mod.TrainingFinish(task="LOGISTIC_REGRESSION",
+                                  total_updates=3))
+    spans = {e["name"]: e for e in t.chrome_trace()["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["training"]["args"]["total_updates"] == 3
+    # Nesting followed the event nesting: staging inside training.
+    assert spans["staging"]["args"]["parent_id"] == \
+        spans["training"]["args"]["span_id"]
+    parsed = obs.parse_prometheus_text(m.render_text())
+    assert parsed["photon_staging_retries_total"] == 1
+    b = obs.installed_bridge()
+    assert b.stats() == {"bridge_spans_opened": 2,
+                         "bridge_spans_closed": 2,
+                         "bridge_spans_leaked": 0}
+
+
+def test_bridge_survives_finish_without_start_and_reopen():
+    t, _ = obs.enable()
+    em = ev_mod.default_emitter
+    em.emit(ev_mod.IngestFinish(num_files=1, num_chunks=0, records=0,
+                                cached_chunks=0, wall_seconds=0.0))
+    em.emit(ev_mod.IngestStart(num_files=1, num_chunks=2, workers=1,
+                               mode="thread", cached_chunks=0))
+    em.emit(ev_mod.IngestStart(num_files=1, num_chunks=2, workers=1,
+                               mode="thread", cached_chunks=0))
+    em.emit(ev_mod.IngestFinish(num_files=1, num_chunks=2, records=10,
+                                cached_chunks=0, wall_seconds=0.1))
+    b = obs.installed_bridge()
+    assert b.stats()["bridge_spans_leaked"] == 0
+    stale = [e for e in t.chrome_trace()["traceEvents"]
+             if e.get("ph") == "X" and e["args"].get("stale")]
+    assert len(stale) == 1  # the reopened scope closed its predecessor
+
+
+def test_disable_closes_bridged_scopes():
+    t, _ = obs.enable()
+    ev_mod.default_emitter.emit(ev_mod.ScoringStart(source="serving"))
+    obs.disable()
+    closed = [e for e in t.chrome_trace()["traceEvents"]
+              if e.get("ph") == "X" and e.get("name") == "scoring"]
+    assert len(closed) == 1
+    assert closed[0]["args"]["closed_at_shutdown"] is True
+
+
+# ------------------------------------------- transfer accounting (sat. 1)
+
+
+def _tiny_chunked(n=96, d=64, chunk_rows=16, num_hot=8):
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.data.sparse import synthetic_sparse
+    from photon_ml_tpu.ops import streaming_sparse as ss
+
+    sbatch, _ = synthetic_sparse(n, d, 5, seed=3)
+    ds = from_sparse_batch(sbatch)
+    shard = ds.feature_shards["global"]
+    chunked = ss.build_chunked(
+        ss.iter_shard_chunks(shard, ds.response, ds.weights, chunk_rows),
+        d, chunk_rows, num_hot=num_hot)
+    return ds, chunked
+
+
+def test_transfer_bytes_match_analytic_sum_single_pass():
+    """VERDICT Weak #4 at test scale, part 1: one streamed pass moves
+    EXACTLY the analytic chunk-size sum — no hidden copies, no dropped
+    chunks."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+
+    _, chunked = _tiny_chunked()
+    depth = 2
+    vg = ss.make_value_and_gradient(losses.LOGISTIC, chunked,
+                                    prefetch_depth=depth)
+    _, m = obs.enable(trace=False)
+    v, g = vg(jnp.zeros((chunked.dim,), jnp.float32))
+    float(v)
+    parsed = obs.parse_prometheus_text(m.render_text())
+    analytic = sum(ss._chunk_nbytes(ch) for ch in chunked.chunks)
+    assert obs.metric_value(parsed, "photon_transfer_bytes_total") == \
+        analytic
+    assert obs.metric_value(parsed, "photon_transfer_chunks_total") == \
+        chunked.num_chunks
+    # Every streamed chunk was released: nothing in flight at rest...
+    assert parsed["photon_stream_inflight_chunks"] == 0
+    # ...and the prefetch window never exceeded its design bound: depth
+    # queued transfers + the chunk being consumed.
+    assert 1 <= parsed["photon_stream_inflight_chunks_peak"] <= depth + 1
+
+
+def test_streamed_fit_bounds_inflight_and_bytes():
+    """VERDICT Weak #4 at test scale, part 2: a full multi-chunk
+    streamed FIT (L-BFGS passes + probes + scoring) keeps the in-flight
+    gauge within the prefetch bound and moves a whole number of
+    analytic stream payloads."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.coordinates import \
+        StreamingSparseFixedEffectCoordinate
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.optim.regularization import (
+        RegularizationContext, RegularizationType)
+
+    ds, chunked = _tiny_chunked()
+    depth = 2
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=3, tolerance=1e-6),
+        regularization=RegularizationContext(RegularizationType.L2, 1.0))
+    coord = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, cfg,
+        prefetch_depth=depth)
+    _, m = obs.enable(trace=False)
+    model = coord.train_model(np.zeros(ds.num_rows, np.float32))
+    np.asarray(coord.score(model))
+    parsed = obs.parse_prometheus_text(m.render_text())
+    per_pass = sum(ss._chunk_nbytes(ch) for ch in chunked.chunks)
+    total = obs.metric_value(parsed, "photon_transfer_bytes_total")
+    assert total and total % per_pass == 0, \
+        f"transfer total {total} is not a whole number of " \
+        f"{per_pass}-byte stream passes"
+    assert total // per_pass >= 3  # initial pass + probes + score
+    assert parsed["photon_stream_inflight_chunks"] == 0
+    assert parsed["photon_stream_inflight_chunks_peak"] <= depth + 1
+    # The one-program-per-stream invariant, now measured: exactly one
+    # build per kernel cache across the whole fit. (>= because another
+    # test in this process may have built the kernels first — the cache
+    # is process-wide; the fit itself must not add more.)
+    builds = obs.metric_value(parsed, "photon_compile_cache_misses_total",
+                              default=0.0)
+    assert builds <= 2  # value_grad + value_only at most once each
+
+
+def test_sharded_stream_inflight_bound_scales_with_devices():
+    """The round-robin barrier's claim — at most one un-released chunk
+    per device beyond each device's prefetch queue — as a gauge
+    assertion over the real 8-virtual-device mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    _, chunked = _tiny_chunked(n=96, chunk_rows=12)  # 8 chunks
+    mesh = make_mesh()
+    depth = 1
+    stream = ss.ShardedChunkStream(chunked, mesh, prefetch_depth=depth)
+    D = stream.num_devices
+    _, m = obs.enable(trace=False)
+    vg = stream.value_and_gradient(losses.LOGISTIC)
+    v, g = vg(jnp.zeros((chunked.dim,), jnp.float32))
+    jax.block_until_ready(g)
+    parsed = obs.parse_prometheus_text(m.render_text())
+    analytic = sum(ss._chunk_nbytes(ch) for ch in chunked.chunks)
+    assert obs.metric_value(parsed, "photon_transfer_bytes_total") == \
+        analytic
+    assert parsed["photon_stream_inflight_chunks"] == 0
+    assert parsed["photon_stream_inflight_chunks_peak"] <= D * (depth + 1)
+
+
+def test_tracing_off_is_inert():
+    """Off = one None check: no tracer, no metrics, no span objects."""
+    assert obs.tracer() is None and obs.metrics() is None
+    cm = obs.span("anything")
+    import contextlib
+
+    assert isinstance(cm, contextlib.nullcontext().__class__)
+    obs.instant("nothing")  # no-op, no error
+
+
+# ------------------------------------------------------- product wiring
+
+
+def test_estimator_trace_param_produces_fit_timeline():
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.api.configs import (CoordinateConfiguration,
+                                           FixedEffectDataConfiguration)
+    from photon_ml_tpu.api.estimator import GameEstimator
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    ds = from_synthetic(synthetic.game_data(rng, n=128, d_global=5,
+                                            re_specs={}))
+    tracer = obs.Tracer()
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"fixed": CoordinateConfiguration(
+            data=FixedEffectDataConfiguration("global"),
+            optimization=GLMOptimizationConfiguration())},
+        update_sequence=["fixed"], mesh=make_mesh(), trace=tracer)
+    results = est.fit(ds)
+    assert len(results) == 1
+    assert obs.tracer() is None  # deactivated after fit
+    names = {e["name"] for e in tracer.chrome_trace()["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"estimator.fit", "training", "descent.update"} <= names
+    spans = {e["name"]: e for e in tracer.chrome_trace()["traceEvents"]
+             if e.get("ph") == "X"}
+    # The bridged training lifecycle nests under the estimator root.
+    assert spans["training"]["args"]["parent_id"] == \
+        spans["estimator.fit"]["args"]["span_id"]
+
+
+def test_summarize_and_verify_cli():
+    from photon_ml_tpu.cli import obs as obs_cli
+
+    t = obs.Tracer()
+    with t.span("flagship.descent", cat="train"):
+        with t.span("stream.pass", cat="stream", kind="value_grad"):
+            with t.span("stream.chunk_transfer", cat="transfer"):
+                time.sleep(0.004)
+            time.sleep(0.002)
+    trace = t.chrome_trace()
+    assert obs_cli.verify_trace(trace) == []
+    s = obs_cli.summarize_trace(trace)
+    assert s["wall_seconds"] > 0
+    assert s["waterfall"][0]["name"] == "flagship.descent"
+    a = s["attribution"]
+    assert 0.0 < a["transfer_fraction_of_stream"] <= 1.0
+    assert a["transfer_seconds"] == pytest.approx(0.004, rel=0.9)
+    text = obs_cli.render_summary(s)
+    assert "transfer" in text and "flagship.descent" in text
+
+
+def test_verify_flags_unfinished_and_orphan_spans():
+    from photon_ml_tpu.cli import obs as obs_cli
+
+    t = obs.Tracer()
+    t.start("leaky")  # never ended
+    problems = obs_cli.verify_trace(t.chrome_trace())
+    assert any("never closed" in p for p in problems)
+    assert any("still open" in p for p in problems)
+    # Orphan parent reference.
+    trace = {"traceEvents": [
+        {"name": "x", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "args": {"span_id": "a", "parent_id": "ghost"}}]}
+    assert any("not in trace" in p
+               for p in obs_cli.verify_trace(trace))
+
+
+def test_obs_cli_main_json(tmp_path, capsys):
+    from photon_ml_tpu.cli import obs as obs_cli
+
+    t = obs.Tracer()
+    with t.span("root"):
+        pass
+    path = str(tmp_path / "trace.json")
+    t.dump(path)
+    assert obs_cli.main(["verify", path]) == 0
+    assert obs_cli.main(["summarize", path, "--json"]) == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out.splitlines()[-1])
+    assert "attribution" in summary
+    assert obs_cli.main(["verify", str(tmp_path / "missing.json")]) == 2
+
+
+def test_serving_metrics_endpoint_appends_registry():
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+
+    # The endpoint body = serving text + registry text when obs is on
+    # (exercise the composition without standing up a full model).
+    from photon_ml_tpu.serving.service import ScoringService
+
+    _, m = obs.enable(trace=False)
+    m.counter("photon_checkpoint_writes_total", kind="descent").inc()
+    svc = object.__new__(ScoringService)
+    svc.metrics = ServingMetrics()
+    text = ScoringService.metrics_text(svc)
+    assert "photon_serving_rows_total" in text
+    assert 'photon_checkpoint_writes_total{kind="descent"} 1' in text
