@@ -1,17 +1,34 @@
 #!/usr/bin/env python
 """Serving bench: synthetic traffic against a resident ScoringService.
 
-Drives Zipf-skewed request traffic (realistic per-user activity — the same
-skew the training bucketing exploits) through the full serving path:
-micro-batcher → shape-bucketed jitted scorer → LRU random-effect cache.
-Emits one BENCH-style JSON line, like bench.py:
+Two modes, both driving Zipf-skewed request traffic (realistic per-user
+activity — the same skew the training bucketing exploits) through the
+full serving path: micro-batcher → shape-bucketed jitted scorer → LRU
+random-effect cache.
+
+**Open-loop target-QPS sweep (default).** Closed-loop clients can never
+see saturation: when the service slows down, so do they (coordinated
+omission). The sweep instead dispatches constant-arrival traffic at each
+target rate — arrival i is scheduled at ``t0 + i/qps`` regardless of how
+the service is doing, latency is measured from the SCHEDULED arrival,
+and admission-control sheds count against the level. Emits one BENCH
+line: ``serving_saturation_knee_qps`` with the full
+``serving_p99_vs_qps_curve``, per-stage attribution fractions
+(queue wait / assemble / device score / respond), and a bench-vs-metrics
+cross-check — the bench's request counts and latency totals must agree
+with the serving scoreboard within 10%, the same shared-provenance
+discipline check_bench_regression.py gates for the flagship
+(docs/OBSERVABILITY.md).
+
+**Closed-loop (--closed-loop).** The original bench: N client threads,
+submit→result round trips; still the right tool for steady-state
+latency floors.
 
     JAX_PLATFORMS=cpu python dev-scripts/bench_serving.py
+    JAX_PLATFORMS=cpu python dev-scripts/bench_serving.py --closed-loop
 
-Reported: request p50/p95/p99 latency (submit → result, closed-loop
-clients), steady-state throughput, batch-fill ratio, RE-cache hit rate,
-and — the compile-discipline check — steady-state recompiles, which must
-be ZERO (warmup owns every bucket shape).
+Both report steady-state recompiles, which must be ZERO (warmup owns
+every bucket shape).
 """
 
 from __future__ import annotations
@@ -29,6 +46,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# Dispatcher lateness beyond this marks an arrival "late" (the open-loop
+# validity signal: a dispatcher that cannot keep schedule is measuring
+# itself, not the service).
+_LATE_S = 0.005
+
 
 def build_parser():
     p = argparse.ArgumentParser(description=__doc__)
@@ -38,25 +60,36 @@ def build_parser():
     p.add_argument("--cache-entities", type=int, default=2048)
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--max-wait-ms", type=float, default=1.0)
-    p.add_argument("--clients", type=int, default=8,
-                   help="closed-loop client threads")
-    p.add_argument("--requests-per-client", type=int, default=400)
     p.add_argument("--entity-skew", type=float, default=1.2,
                    help="Zipf exponent of the entity draw")
     p.add_argument("--unseen-frac", type=float, default=0.02,
                    help="fraction of requests with unknown entities")
     p.add_argument("--seed", type=int, default=0)
+    # -- open-loop sweep (default mode) ------------------------------------
+    p.add_argument("--qps", default="50,100,200,400,800",
+                   help="comma-separated target-QPS levels of the "
+                        "open-loop sweep (ascending)")
+    p.add_argument("--seconds-per-level", type=float, default=2.0,
+                   help="constant-arrival dispatch duration per level")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="max wait for a level's in-flight requests")
+    # -- closed-loop mode ---------------------------------------------------
+    p.add_argument("--closed-loop", action="store_true",
+                   help="run the original closed-loop client bench "
+                        "instead of the open-loop sweep")
+    p.add_argument("--clients", type=int, default=8,
+                   help="closed-loop client threads")
+    p.add_argument("--requests-per-client", type=int, default=400)
     return p
 
 
-def main(argv=None):
-    args = build_parser().parse_args(argv)
+def build_service(args):
     import jax.numpy as jnp
 
     from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
                                            RandomEffectModel)
     from photon_ml_tpu.models.coefficients import Coefficients
-    from photon_ml_tpu.serving import ScoringRequest, ScoringService
+    from photon_ml_tpu.serving import ScoringService
     from photon_ml_tpu.types import TaskType
     from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
@@ -75,8 +108,13 @@ def main(argv=None):
     service = ScoringService(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         cache_entities=args.cache_entities)
-    load_seconds = time.perf_counter() - t0
+    return service, time.perf_counter() - t0
 
+
+def make_request_factory(args):
+    from photon_ml_tpu.serving import ScoringRequest
+
+    E, dg, dr = args.num_entities, args.d_global, args.d_re
     p = 1.0 / np.arange(1, E + 1) ** args.entity_skew
     p /= p.sum()
 
@@ -90,6 +128,213 @@ def main(argv=None):
                       "re_userId": r.normal(size=dr).astype(np.float32)},
             entity_ids={"userId": eid})
 
+    return make_request
+
+
+def warmup(service, make_request, args):
+    """Touch every bucket shape so steady state owns its programs: the
+    direct score() path compiles the same per-bucket programs the
+    batcher path runs, plus one batcher round trip for its seam."""
+    warm_rng = np.random.default_rng(args.seed + 99)
+    n = 1
+    while n <= args.max_batch:
+        service.score([make_request(warm_rng) for _ in range(n)])
+        n *= 2
+    service.submit(make_request(warm_rng)).result(timeout=60)
+
+
+# -- open-loop sweep ---------------------------------------------------------
+
+
+def run_open_loop_level(service, make_request, qps, seconds, seed,
+                        drain_timeout_s):
+    """One constant-arrival level; returns the level's scoreboard."""
+    from photon_ml_tpu.serving import BatcherQueueFull, DeadlineExceeded
+
+    rng = np.random.default_rng(seed)
+    n = max(1, int(round(qps * seconds)))
+    requests = [make_request(rng) for _ in range(n)]
+    period = 1.0 / qps
+    lock = threading.Lock()
+    done = threading.Event()
+    state = {"lat_open": [], "lat_submit": [], "deadline": 0, "error": 0,
+             "completed": 0, "dispatched": 0, "t_last_done": 0.0}
+    shed = late = 0
+
+    def _make_cb(t_sched, t_submit):
+        def _cb(fut):
+            t_end = time.perf_counter()
+            exc = fut.exception()
+            with lock:
+                state["completed"] += 1
+                state["t_last_done"] = max(state["t_last_done"], t_end)
+                if exc is None:
+                    state["lat_open"].append(t_end - t_sched)
+                    state["lat_submit"].append(t_end - t_submit)
+                elif isinstance(exc, DeadlineExceeded):
+                    state["deadline"] += 1
+                else:
+                    state["error"] += 1
+                if state["completed"] == state["dispatched"] \
+                        and done.is_set():
+                    drained.set()
+        return _cb
+
+    drained = threading.Event()
+    t0 = time.perf_counter()
+    for i, req in enumerate(requests):
+        t_sched = t0 + i * period
+        delay = t_sched - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t_submit = time.perf_counter()
+        if t_submit - t_sched > _LATE_S:
+            late += 1
+        try:
+            fut = service.submit(req)
+        except BatcherQueueFull:
+            shed += 1
+            continue
+        with lock:
+            state["dispatched"] += 1
+        fut.add_done_callback(_make_cb(t_sched, t_submit))
+    done.set()
+    with lock:  # either this recheck or a later callback sets drained
+        if state["completed"] == state["dispatched"]:
+            drained.set()
+    drained.wait(timeout=drain_timeout_s)
+    elapsed = max(state["t_last_done"], time.perf_counter()) - t0
+    lat = np.asarray(state["lat_open"]) * 1e3
+    ok = len(state["lat_open"])
+    return {
+        "target_qps": qps,
+        "offered": n,
+        "ok": ok,
+        "shed": shed,
+        "deadline_exceeded": state["deadline"],
+        "errors": state["error"],
+        "late_arrivals": late,
+        "achieved_qps": round(ok / elapsed, 1) if elapsed > 0 else 0.0,
+        "p50_ms": round(float(np.percentile(lat, 50)), 4) if ok else None,
+        "p95_ms": round(float(np.percentile(lat, 95)), 4) if ok else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 4) if ok else None,
+        "lat_submit_sum_s": float(np.sum(state["lat_submit"])),
+    }
+
+
+def find_knee(levels):
+    """The saturation knee: the highest target-QPS level the service
+    sustained — <1% of offered load shed/expired AND achieved ≥90% of
+    the target rate. Returns (knee_qps, saturated): ``saturated`` False
+    means every level was sustained (the knee is beyond the sweep)."""
+    knee = None
+    saturated = False
+    for lv in levels:
+        bad_frac = (lv["shed"] + lv["deadline_exceeded"]
+                    + lv["errors"]) / max(lv["offered"], 1)
+        sustained = (bad_frac <= 0.01
+                     and lv["achieved_qps"] >= 0.9 * lv["target_qps"])
+        if sustained:
+            knee = lv["target_qps"]
+        else:
+            saturated = True
+            break
+    if knee is None:  # even the lowest level fell over
+        knee = 0.0
+    return knee, saturated
+
+
+def run_sweep(args, service, make_request, load_seconds):
+    qps_levels = [float(q) for q in str(args.qps).split(",") if q]
+    warmup(service, make_request, args)
+    snap0 = service.metrics.snapshot()
+    levels = []
+    for i, qps in enumerate(qps_levels):
+        lv = run_open_loop_level(service, make_request, qps,
+                                 args.seconds_per_level,
+                                 args.seed + 7000 + i,
+                                 args.drain_timeout_s)
+        levels.append(lv)
+        print(f"[sweep] target {qps:g} qps: achieved "
+              f"{lv['achieved_qps']:g}, p99 "
+              f"{lv['p99_ms']}ms, shed {lv['shed']}", file=sys.stderr)
+    snap1 = service.metrics.snapshot()
+    knee, saturated = find_knee(levels)
+
+    # Bench ↔ scoreboard cross-check (shared provenance): the bench's
+    # completed-request count and summed submit→result latency must
+    # agree with the serving metrics' deltas over the same window.
+    bench_ok = sum(lv["ok"] for lv in levels)
+    obs_ok = (snap1["request_latency"]["count"]
+              - snap0["request_latency"]["count"])
+    bench_lat_s = sum(lv["lat_submit_sum_s"] for lv in levels)
+    obs_lat_s = (snap1["request_latency_sum_seconds"]
+                 - snap0["request_latency_sum_seconds"])
+    req_delta = (abs(bench_ok - obs_ok)
+                 / max(bench_ok, obs_ok, 1))
+    lat_delta = (abs(bench_lat_s - obs_lat_s)
+                 / max(abs(bench_lat_s), abs(obs_lat_s), 1e-9))
+
+    stage0, stage1 = (snap0["stage_seconds_total"],
+                      snap1["stage_seconds_total"])
+    stage_s = {k: stage1[k] - stage0[k] for k in stage1}
+    stage_total = sum(stage_s.values()) or 1.0
+
+    curve = {f"{lv['target_qps']:g}": lv["p99_ms"] for lv in levels}
+    secondary = {
+        "serving_p99_vs_qps_curve": curve,
+        "serving_p50_vs_qps_curve": {
+            f"{lv['target_qps']:g}": lv["p50_ms"] for lv in levels},
+        "serving_achieved_qps_curve": {
+            f"{lv['target_qps']:g}": lv["achieved_qps"]
+            for lv in levels},
+        "serving_shed_per_level": {
+            f"{lv['target_qps']:g}": lv["shed"] for lv in levels},
+        "serving_knee_saturated": saturated,
+        "serving_sweep_levels": levels,
+        "serving_sweep_recompiles":
+            snap1["compiles_total"] - snap0["compiles_total"],
+        "serving_bench_requests": bench_ok,
+        "serving_obs_requests": obs_ok,
+        "serving_bench_vs_metrics_request_delta": round(req_delta, 4),
+        "serving_bench_latency_total_s": round(bench_lat_s, 4),
+        "serving_obs_latency_total_s": round(obs_lat_s, 4),
+        "serving_bench_vs_metrics_latency_delta": round(lat_delta, 4),
+        "serving_queue_depth_peak": snap1["queue_depth_peak"],
+        "model_load_seconds": round(load_seconds, 3),
+        "seconds_per_level": args.seconds_per_level,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "cache_entities": args.cache_entities,
+        "num_entities": args.num_entities,
+        "config": f"E={args.num_entities} d_global={args.d_global} "
+                  f"d_re={args.d_re} skew={args.entity_skew} "
+                  f"open-loop",
+    }
+    for stage, s in stage_s.items():
+        secondary[f"serving_stage_fraction_{stage}"] = \
+            round(s / stage_total, 4)
+    out = {
+        "metric": "serving_saturation_knee_qps",
+        "value": knee,
+        "unit": "qps",
+        "secondary": secondary,
+    }
+    if secondary["serving_sweep_recompiles"] != 0:
+        print("WARNING: the sweep recompiled — bucketing is broken",
+              file=sys.stderr)
+    if max(req_delta, lat_delta) > 0.10:
+        print(f"WARNING: bench and serving metrics disagree "
+              f"(requests {req_delta:.1%}, latency {lat_delta:.1%}) — "
+              f"they share provenance and cannot both be right",
+              file=sys.stderr)
+    return out
+
+
+# -- closed-loop (the original bench) ----------------------------------------
+
+
+def run_closed_loop(args, service, make_request, load_seconds):
     def client(cid, count, record):
         r = np.random.default_rng(args.seed + 1000 + cid)
         reqs = [make_request(r) for _ in range(count)]
@@ -124,7 +369,6 @@ def main(argv=None):
     wall = time.perf_counter() - t0
 
     snap = service.metrics.snapshot()
-    service.close()
     lat = np.asarray(latencies) * 1e3
     total = len(latencies)
     out = {
@@ -152,14 +396,29 @@ def main(argv=None):
             "max_batch": args.max_batch,
             "max_wait_ms": args.max_wait_ms,
             "cache_entities": args.cache_entities,
-            "num_entities": E,
-            "config": f"E={E} d_global={dg} d_re={dr} "
-                      f"skew={args.entity_skew}",
+            "num_entities": args.num_entities,
+            "config": f"E={args.num_entities} d_global={args.d_global} "
+                      f"d_re={args.d_re} skew={args.entity_skew}",
         },
     }
     if out["secondary"]["steady_state_recompiles"] != 0:
         print("WARNING: steady state recompiled — bucketing is broken",
               file=sys.stderr)
+    return out
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    service, load_seconds = build_service(args)
+    try:
+        if args.closed_loop:
+            out = run_closed_loop(args, service, make_request_factory(args),
+                                  load_seconds)
+        else:
+            out = run_sweep(args, service, make_request_factory(args),
+                            load_seconds)
+    finally:
+        service.close()
     json.dump(out, sys.stdout)
     print()
     return 0

@@ -29,6 +29,15 @@ plus cross-line invariants computed within the fresh tail itself:
     actually shrink the decode wall); reported-only on the 1-core CI
     box, the same caveat as the staging multi-worker scaling note.
 
+plus the serving-sweep invariants when the fresh tail carries
+dev-scripts/bench_serving.py's open-loop lines (docs/SERVING.md):
+
+  - serving_sweep_recompiles must be 0 (steady state never recompiles);
+  - serving_bench_vs_metrics_{request,latency}_delta <= 10% (the sweep
+    and the serving scoreboard share provenance);
+  - serving_p99_vs_qps_curve banded against the committed baseline at
+    matching QPS levels, when the baseline has the curve.
+
 plus, with ``--metrics-dump METRICS.prom`` (a file written by
 ``game_train --metrics-dump`` / ``flagship_criteo_stream.py``), a
 bench-vs-metrics consistency gate: bench lines that have a counter
@@ -256,6 +265,50 @@ def main() -> int:
             failures.append(
                 f"stream_sharded_pass_seconds: {sh:g}s > {limit:.3g}s — "
                 f"the sharded composition adds overhead at D=1")
+
+    # --- serving invariants (docs/SERVING.md, ISSUE 8) ------------------
+    # The open-loop sweep's own lines, gated within the fresh tail: the
+    # sweep may never recompile in steady state, and the bench's request
+    # counts / latency totals must agree with the serving scoreboard
+    # (they share provenance). The p99 curve is banded against the
+    # committed baseline at matching QPS levels when one exists.
+    rec = fresh.get("serving_sweep_recompiles")
+    if rec is not None:
+        verdict = "OK" if int(rec) == 0 else "REGRESSION"
+        print(f"serving_sweep_recompiles: {rec} (must be 0) {verdict}")
+        if int(rec) != 0:
+            failures.append(
+                f"serving_sweep_recompiles: {rec} != 0 — the serving "
+                f"sweep recompiled in steady state (bucketing broke)")
+    for key in ("serving_bench_vs_metrics_request_delta",
+                "serving_bench_vs_metrics_latency_delta"):
+        delta = fresh.get(key)
+        if delta is None:
+            continue
+        ok = float(delta) <= METRICS_TOLERANCE
+        print(f"{key}: {float(delta):.1%} "
+              f"(limit {METRICS_TOLERANCE:.0%}) "
+              f"{'OK' if ok else 'DISAGREEMENT'}")
+        if not ok:
+            failures.append(
+                f"{key}: bench and serving metrics disagree by "
+                f"{float(delta):.1%} (> {METRICS_TOLERANCE:.0%}) — the "
+                f"sweep and the scoreboard cannot both be right")
+    fresh_curve = fresh.get("serving_p99_vs_qps_curve")
+    base_curve = base.get("serving_p99_vs_qps_curve")
+    if isinstance(fresh_curve, dict) and isinstance(base_curve, dict):
+        for q in sorted(set(fresh_curve) & set(base_curve), key=float):
+            if fresh_curve[q] is None or base_curve[q] is None:
+                continue
+            b, v = float(base_curve[q]), float(fresh_curve[q])
+            verdict = "OK" if v <= b * band else "REGRESSION"
+            print(f"serving_p99_vs_qps_curve[{q} qps]: fresh {v:g}ms vs "
+                  f"baseline {b:g}ms (limit {b * band:.3g}) {verdict}")
+            if v > b * band:
+                failures.append(
+                    f"serving_p99_vs_qps_curve[{q}]: {v:g}ms > "
+                    f"{b * band:.3g}ms — serving p99 regressed at "
+                    f"{q} qps")
 
     # --- bench ↔ metrics consistency (docs/OBSERVABILITY.md) ------------
     if args.metrics_dump:

@@ -7,11 +7,19 @@ clock reads + a locked list append) and four counter increments, which
 must stay in the low single digits against a multi-megabyte
 ``device_put`` per chunk.
 
+``--serving`` measures the request path instead (ISSUE 8 budget, same
+discipline): a fixed closed-loop run through the micro-batcher with obs
+off vs on — ON adds five ``record_complete`` appends per request plus
+the stage arithmetic; OFF, the request path pays one None check per
+flush plus the always-on stage clock reads (four per flush, amortized
+over the batch).
+
 Each arm runs in a FRESH subprocess (no cross-arm compile-cache or
 allocator state), min of ``--min-of`` repeats inside the arm after one
-warm-up fit; the printed JSON carries both walls and the ratio.
+warm-up pass; the printed JSON carries both walls and the ratio.
 
     python dev-scripts/obs_overhead.py [--rows 98304] [--chunk-rows 8192]
+    python dev-scripts/obs_overhead.py --serving [--requests 2000]
 """
 
 import argparse
@@ -67,9 +75,65 @@ print(json.dumps({"mode": mode, "seconds": best,
 """
 
 
+_SERVING_ARM = """
+import json, sys, time
+import numpy as np
+import jax.numpy as jnp
+mode, requests, min_of = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from photon_ml_tpu import obs
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.serving import ScoringRequest, ScoringService
+from photon_ml_tpu.types import TaskType
+
+rng = np.random.default_rng(7)
+dg, dr, E = 16, 8, 512
+model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+    "fixed": FixedEffectModel("global", Coefficients(
+        jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+    "per-user": RandomEffectModel(
+        "userId", "re_userId",
+        jnp.asarray(rng.normal(size=(E, dr)).astype(np.float32))),
+})
+if mode == "on":
+    obs.enable()
+# One submitter thread enqueues the whole run up front: admission
+# control must not shed it (the arm measures overhead, not shedding).
+svc = ScoringService(model, max_batch=16, max_wait_ms=0.5,
+                     max_queue=requests + 16)
+reqs = [ScoringRequest(
+    features={"global": rng.normal(size=dg).astype(np.float32),
+              "re_userId": rng.normal(size=dr).astype(np.float32)},
+    entity_ids={"userId": int(i) % E}) for i in range(requests)]
+n = 1
+while n <= 16:  # warm-up: every bucket shape
+    svc.score(reqs[:n])
+    n *= 2
+best = None
+for _ in range(min_of):
+    t0 = time.perf_counter()
+    futs = [svc.submit(r) for r in reqs]
+    for f in futs:
+        f.result(timeout=120)
+    dt = time.perf_counter() - t0
+    best = dt if best is None else min(best, dt)
+svc.close()
+print(json.dumps({"mode": mode, "seconds": best, "requests": requests}))
+"""
+
+
 def run_arm(mode: str, rows: int, chunk_rows: int, min_of: int) -> dict:
     out = subprocess.run(
         [sys.executable, "-c", _ARM, mode, str(rows), str(chunk_rows),
+         str(min_of)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True, check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_serving_arm(mode: str, requests: int, min_of: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _SERVING_ARM, mode, str(requests),
          str(min_of)],
         cwd=REPO, stdout=subprocess.PIPE, text=True, check=True)
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -80,6 +144,11 @@ def main():
     ap.add_argument("--rows", type=int, default=98304)
     ap.add_argument("--chunk-rows", type=int, default=8192)
     ap.add_argument("--min-of", type=int, default=3)
+    ap.add_argument("--serving", action="store_true",
+                    help="measure the serving request path instead of "
+                         "the streamed fit")
+    ap.add_argument("--requests", type=int, default=2000,
+                    help="closed-loop requests per serving arm")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -88,6 +157,27 @@ def main():
               file=sys.stderr, flush=True)
 
     arms = {}
+    if args.serving:
+        for mode in ("off", "on"):
+            log(f"serving path with obs {mode} (fresh subprocess, "
+                f"min of {args.min_of})")
+            arms[mode] = run_serving_arm(mode, args.requests,
+                                         args.min_of)
+            log(f"  {mode}: {arms[mode]['seconds']:.3f}s over "
+                f"{arms[mode]['requests']} requests")
+        ratio = arms["on"]["seconds"] / arms["off"]["seconds"]
+        summary = {
+            "serving_obs_overhead_requests": args.requests,
+            "serving_seconds_obs_off": round(arms["off"]["seconds"], 4),
+            "serving_seconds_obs_on": round(arms["on"]["seconds"], 4),
+            "serving_obs_on_over_off_ratio": round(ratio, 4),
+        }
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            for k, v in summary.items():
+                print(f"{k}: {v}")
+        return
     for mode in ("off", "on"):
         log(f"streamed fit with obs {mode} (fresh subprocess, "
             f"min of {args.min_of})")

@@ -19,6 +19,13 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/trace_smoke.py; rc=$?
 fi
 
+# Serving trace smoke (docs/SERVING.md): a tiny traced QPS run through
+# the real HTTP path — request spans parent into flush spans and close,
+# /slo parses, steady-state recompiles stay zero. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/serving_trace_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

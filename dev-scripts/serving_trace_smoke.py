@@ -1,0 +1,173 @@
+"""CI serving trace smoke: a tiny traced QPS run through the REAL HTTP
+path must leave a healthy request-level timeline (ISSUE 8 satellite:
+run_tier1.sh gains this step).
+
+Asserts, in order:
+
+1. a traced ScoringService behind the real HTTP front end answers
+   /score (including the opt-in ``"trace": true`` attribution payload,
+   whose stages must sum to within 10% of the reported total);
+2. steady-state recompiles are ZERO across the HTTP phase (warmup owns
+   every bucket shape);
+3. /slo parses and carries the window scoreboard; /metrics carries the
+   queue-depth gauge and stage-attribution counters;
+4. the dumped trace passes `photon-obs verify` — ``serving.request``
+   spans present, each parented into a ``serving.flush`` span, zero
+   open spans after close (nothing leaked across the worker-thread
+   boundary).
+
+Runs on CPU in seconds — wired into dev-scripts/run_tier1.sh after the
+training trace smoke.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.cli.obs import summarize_serving, verify_trace
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving import (ScoringRequest, ScoringService,
+                                       make_http_server)
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    dg, dr, E = 8, 4, 32
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, dr)).astype(np.float32))),
+    })
+
+    tracer, _ = obs.enable()
+    try:
+        svc = ScoringService(model, max_batch=8, max_wait_ms=1.0)
+        server = make_http_server(svc, port=0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            # Warmup: the direct path owns every bucket shape, plus one
+            # queued round trip for the batcher seam.
+            def req(i):
+                return ScoringRequest(
+                    features={
+                        "global": rng.normal(size=dg).astype(np.float32),
+                        "re_userId":
+                            rng.normal(size=dr).astype(np.float32)},
+                    entity_ids={"userId": int(i) % E})
+
+            n = 1
+            while n <= 8:
+                svc.score([req(i) for i in range(n)])
+                n *= 2
+            svc.submit(req(0)).result(timeout=30)
+            compiles_warm = svc.metrics.snapshot()["compiles_total"]
+
+            # (1) tiny QPS run through the real HTTP path, traced.
+            url = f"http://127.0.0.1:{port}"
+            for batch in range(4):
+                body = json.dumps({
+                    "requests": [{
+                        "features": {
+                            "global": np.asarray(
+                                rng.normal(size=dg),
+                                np.float32).tolist(),
+                            "re_userId": np.asarray(
+                                rng.normal(size=dr),
+                                np.float32).tolist()},
+                        "entity_ids": {"userId": (batch * 3 + j) % E},
+                        "uid": f"smoke-{batch}-{j}",
+                    } for j in range(3)],
+                    "trace": True,
+                }).encode()
+                resp = json.loads(urllib.request.urlopen(
+                    urllib.request.Request(f"{url}/score", data=body),
+                    timeout=30).read())
+                assert len(resp["scores"]) == 3, resp
+                attrs = resp.get("attribution")
+                assert attrs and all(a is not None for a in attrs), \
+                    f"trace=true returned no attribution: {resp}"
+                for a in attrs:
+                    stages = (a["queue_wait_ms"] + a["assemble_ms"]
+                              + a["device_score_ms"] + a["respond_ms"])
+                    assert abs(stages - a["total_ms"]) \
+                        <= 0.10 * a["total_ms"] + 0.05, \
+                        f"stages {stages} vs total {a['total_ms']}"
+
+            # (2) the HTTP phase never recompiled.
+            compiles_now = svc.metrics.snapshot()["compiles_total"]
+            assert compiles_now == compiles_warm, \
+                f"steady state recompiled: {compiles_warm} -> " \
+                f"{compiles_now}"
+
+            # (3) /slo parses; /metrics carries the new lines.
+            slo = json.loads(urllib.request.urlopen(
+                f"{url}/slo", timeout=30).read())
+            for key in ("window_seconds", "requests_in_window",
+                        "budget_burn_rate", "p99_ms", "lifetime"):
+                assert key in slo, f"/slo missing {key}: {slo}"
+            assert slo["requests_in_window"] >= 12, slo
+            text = urllib.request.urlopen(
+                f"{url}/metrics", timeout=30).read().decode()
+            for needle in ("photon_serving_queue_depth",
+                           "photon_serving_stage_seconds_total",
+                           "photon_serving_slo_budget_burn_rate"):
+                assert needle in text, f"/metrics missing {needle}"
+        finally:
+            server.shutdown()
+            server.server_close()
+            svc.close()
+
+        # (4) healthy trace: spans closed, request spans parented into
+        # flush spans, attribution summarizable.
+        assert tracer.open_spans() == 0, \
+            f"{tracer.open_spans()} span(s) leaked across close()"
+        trace = tracer.chrome_trace()
+        problems = verify_trace(trace)
+        if problems:
+            print("serving trace verification FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        flush_ids = {e["args"]["span_id"] for e in spans
+                     if e["name"] == "serving.flush"}
+        requests = [e for e in spans if e["name"] == "serving.request"]
+        assert len(requests) >= 13, \
+            f"expected >=13 request spans, got {len(requests)}"
+        assert all(e["args"].get("parent_id") in flush_ids
+                   for e in requests), \
+            "a request span is not parented into a flush span"
+        summary = summarize_serving(trace)
+        assert summary["requests"] == len(requests)
+        assert summary["attributed_fraction"] > 0.85, summary
+        print(f"serving trace smoke ok: {len(requests)} request spans "
+              f"over {summary['flushes']} flushes, p99 "
+              f"{summary['request_latency_ms']['p99']:.2f}ms, "
+              f"attribution covers "
+              f"{summary['attributed_fraction']:.0%} of request time")
+    finally:
+        obs.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

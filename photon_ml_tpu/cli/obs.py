@@ -70,8 +70,16 @@ def verify_trace(trace: dict) -> list[str]:
         if parent is None:
             problems.append(f"span {label} parent {pid_} not in trace")
             continue
-        if e["ts"] + _NEST_SLACK_US < parent["ts"] or \
-                e["ts"] + e["dur"] > parent["ts"] + parent["dur"] \
+        # Queue-crossing spans (args.crosses_queue — a serving request's
+        # enqueue→respond life parented into the flush that scored it)
+        # START before their parent by design: the queue wait precedes
+        # the flush. Containment is then asserted at the tail only.
+        if not args.get("crosses_queue") \
+                and e["ts"] + _NEST_SLACK_US < parent["ts"]:
+            problems.append(
+                f"span {label} is not contained in its parent "
+                f"{parent.get('name')} interval")
+        elif e["ts"] + e["dur"] > parent["ts"] + parent["dur"] \
                 + _NEST_SLACK_US:
             problems.append(
                 f"span {label} is not contained in its parent "
@@ -154,6 +162,100 @@ def summarize_trace(trace: dict, top: int = 12) -> dict:
     }
 
 
+_REQUEST_STAGES = ("serving.queue_wait", "serving.assemble",
+                   "serving.device_score", "serving.respond")
+
+
+def _pctl(sorted_vals: list, p: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list (stdlib-only —
+    this module must run without numpy)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   round(p / 100.0 * (len(sorted_vals) - 1))))
+    return float(sorted_vals[k])
+
+
+def summarize_serving(trace: dict) -> dict:
+    """Request-path view of a serving trace (``summarize --serving``):
+    request latency percentiles from the ``serving.request`` spans,
+    stage attribution (where request time went across queue wait /
+    assemble / device score / respond), flush stats, and the slowest
+    request's waterfall — the per-request counterpart of the batch-side
+    transfer attribution."""
+    spans = _spans(trace)
+    requests = [e for e in spans if e["name"] == "serving.request"]
+    flushes = [e for e in spans if e["name"] == "serving.flush"]
+    if not requests:
+        return {"requests": 0, "flushes": len(flushes)}
+    durs_ms = sorted(e["dur"] / 1e3 for e in requests)
+    total_ms = sum(durs_ms)
+    by_parent: dict = {}
+    for e in spans:
+        pid_ = e.get("args", {}).get("parent_id")
+        if pid_ is not None and e["name"] in _REQUEST_STAGES:
+            by_parent.setdefault(pid_, []).append(e)
+    stage_ms = {s: 0.0 for s in _REQUEST_STAGES}
+    for e in spans:
+        if e["name"] in stage_ms:
+            stage_ms[e["name"]] += e["dur"] / 1e3
+    attributed = sum(stage_ms.values())
+    slowest = max(requests, key=lambda e: e["dur"])
+    slow_id = slowest.get("args", {}).get("span_id")
+    waterfall = [{
+        "stage": c["name"], "start_ms": (c["ts"] - slowest["ts"]) / 1e3,
+        "dur_ms": c["dur"] / 1e3,
+        "frac": c["dur"] / max(slowest["dur"], 1e-9),
+    } for c in sorted(by_parent.get(slow_id, []), key=lambda c: c["ts"])]
+    return {
+        "requests": len(requests),
+        "flushes": len(flushes),
+        "request_latency_ms": {
+            "p50": _pctl(durs_ms, 50), "p95": _pctl(durs_ms, 95),
+            "p99": _pctl(durs_ms, 99), "max": durs_ms[-1],
+            "mean": total_ms / len(durs_ms),
+        },
+        "request_seconds_total": total_ms / 1e3,
+        "stage_attribution": {
+            s: {"seconds": stage_ms[s] / 1e3,
+                "frac_of_request_time": stage_ms[s] / max(total_ms, 1e-9)}
+            for s in _REQUEST_STAGES},
+        "attributed_fraction": attributed / max(total_ms, 1e-9),
+        "slowest_request": {
+            "request_id": slowest.get("args", {}).get("request_id"),
+            "total_ms": slowest["dur"] / 1e3,
+            "waterfall": waterfall,
+        },
+    }
+
+
+def render_serving_summary(summary: dict) -> str:
+    if not summary.get("requests"):
+        return (f"no serving.request spans in this trace "
+                f"({summary.get('flushes', 0)} flush span(s)) — was the "
+                f"service traced? (obs.enable() before requests arrive)")
+    lat = summary["request_latency_ms"]
+    out = [f"{summary['requests']} request(s) over "
+           f"{summary['flushes']} flush(es); request latency "
+           f"p50 {lat['p50']:.2f}ms  p95 {lat['p95']:.2f}ms  "
+           f"p99 {lat['p99']:.2f}ms  max {lat['max']:.2f}ms", "",
+           "stage attribution (of total request time, "
+           f"{summary['request_seconds_total']:.3f}s):"]
+    for stage, a in summary["stage_attribution"].items():
+        out.append(f"  {stage:<22} {_bar(a['frac_of_request_time'])} "
+                   f"{a['frac_of_request_time']:>6.1%}  "
+                   f"{a['seconds']:.3f}s")
+    out.append(f"  (stages cover {summary['attributed_fraction']:.1%} "
+               f"of request time; the gap is batcher wakeup jitter)")
+    slow = summary["slowest_request"]
+    out += ["", f"slowest request (id {slow['request_id']}, "
+                f"{slow['total_ms']:.2f}ms):"]
+    for w in slow["waterfall"]:
+        out.append(f"  {w['start_ms']:8.2f}ms  {_bar(w['frac'])} "
+                   f"{w['dur_ms']:8.2f}ms  {w['stage']}")
+    return "\n".join(out)
+
+
 def _bar(frac: float, width: int = 30) -> str:
     n = max(0, min(width, round(frac * width)))
     return "#" * n + "." * (width - n)
@@ -197,6 +299,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rows in the top-span table")
     s.add_argument("--json", action="store_true",
                    help="machine-readable summary instead of text")
+    s.add_argument("--serving", action="store_true",
+                   help="request-path view: request latency percentiles, "
+                        "stage attribution (queue wait / assemble / "
+                        "device score / respond), and the slowest "
+                        "request's waterfall (docs/SERVING.md)")
     v = sub.add_parser("verify",
                        help="structural health check (CI smoke): spans "
                             "closed, parents resolve, children nested")
@@ -220,6 +327,11 @@ def main(argv: Optional[list] = None) -> int:
             return 1
         spans = len(_spans(trace))
         print(f"trace ok: {spans} spans, all closed, nesting consistent")
+        return 0
+    if getattr(args, "serving", False):
+        summary = summarize_serving(trace)
+        print(json.dumps(summary) if args.json
+              else render_serving_summary(summary))
         return 0
     summary = summarize_trace(trace, top=args.top)
     if args.json:

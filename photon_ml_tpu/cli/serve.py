@@ -70,6 +70,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-request deadline: a request still queued "
                         "past this fails fast with 504 instead of "
                         "waiting forever (0 disables)")
+    p.add_argument("--slo-window-s", type=float, default=60.0,
+                   help="sliding window of the /slo tracker (latency "
+                        "percentiles + error-budget burn)")
+    p.add_argument("--slo-availability", type=float, default=0.999,
+                   help="availability objective: shed/deadline/5xx "
+                        "burn the 1-objective error budget")
+    p.add_argument("--slo-latency-ms", type=float, default=None,
+                   help="optional latency objective: answered requests "
+                        "slower than this also burn error budget")
+    p.add_argument("--trace-out",
+                   help="write a Chrome trace-event JSON of the serving "
+                        "session at shutdown (request spans with queue/"
+                        "assemble/device/respond attribution children "
+                        "parented into their flush spans) — written "
+                        "from a finally, so a crashed server keeps its "
+                        "timeline; render with `photon-obs summarize "
+                        "--serving` (docs/OBSERVABILITY.md)")
+    p.add_argument("--metrics-dump",
+                   help="write the full /metrics exposition (serving "
+                        "scoreboard + cross-stack registry) to this "
+                        "file at shutdown, also from a finally — the "
+                        "game_train --metrics-dump parity flag")
     return p
 
 
@@ -114,17 +136,46 @@ def create_server(args):
         max_wait_ms=args.max_wait_ms, cache_entities=args.cache_entities,
         store_shards=args.store_shards, entity_vocabs=vocabs,
         max_queue=args.max_queue,
-        request_deadline_s=(args.request_deadline_s or None))
+        request_deadline_s=(args.request_deadline_s or None),
+        slo_window_s=getattr(args, "slo_window_s", 60.0),
+        slo_availability=getattr(args, "slo_availability", 0.999),
+        slo_latency_ms=getattr(args, "slo_latency_ms", None))
     server = make_http_server(service, host=args.host, port=args.port)
     return server, service
 
 
+def _dump_observability(service, trace_out, metrics_dump) -> None:
+    """Shutdown/crash dump path (runs in a ``finally``): a served session
+    keeps its timeline and scoreboard even when the server dies — the
+    crash is exactly when you want them (game_train parity)."""
+    from photon_ml_tpu import obs
+
+    if trace_out:
+        obs.dump_trace(trace_out)
+        logger.info("wrote trace %s (render with `photon-obs summarize "
+                    "--serving`)", trace_out)
+    if metrics_dump:
+        tmp = metrics_dump + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(service.metrics_text())
+        os.replace(tmp, metrics_dump)
+        logger.info("wrote metrics %s", metrics_dump)
+
+
 def run(args) -> None:
     setup_logging()
+    trace_out = getattr(args, "trace_out", None)
+    metrics_dump = getattr(args, "metrics_dump", None)
+    if trace_out or metrics_dump:
+        from photon_ml_tpu import obs
+
+        # Metrics ride along with tracing (the request-span path needs
+        # the tracer; the /metrics registry append needs the registry).
+        obs.enable(trace=bool(trace_out), metrics=True)
     server, service = create_server(args)
     host, port = server.server_address[:2]
-    logger.info("serving %s on http://%s:%d (POST /score, GET /metrics)",
-                args.model_dir, host, port)
+    logger.info("serving %s on http://%s:%d (POST /score, GET /metrics, "
+                "GET /slo)", args.model_dir, host, port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -132,6 +183,13 @@ def run(args) -> None:
     finally:
         server.server_close()
         service.close()
+        if trace_out or metrics_dump:
+            from photon_ml_tpu import obs
+
+            try:
+                _dump_observability(service, trace_out, metrics_dump)
+            finally:
+                obs.disable()
 
 
 def main(argv=None):

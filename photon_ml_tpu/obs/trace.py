@@ -170,6 +170,40 @@ class Tracer:
         """The current contextvar span id (the worker-ctx parent seed)."""
         return _CURRENT.get() or self.default_parent
 
+    def record_complete(self, name: str, cat: str = "app", *,
+                        t0_epoch_ns: int, dur_s: float,
+                        parent: Optional[str] = None,
+                        tid: Optional[int] = None, **args) -> str:
+        """Record an already-measured interval as a CLOSED span.
+
+        For queue-crossing scopes whose open and close are observed after
+        the fact from measured timestamps — a serving request's life from
+        enqueue (HTTP handler thread) to respond (batcher worker thread)
+        is attributed in one place, AFTER the interval ended, so there is
+        no live ``Span`` to carry across threads. The span never touches
+        the contextvar (nothing can nest "inside" a finished interval)
+        and needs no ``end()``: it is born closed. Returns the span id so
+        callers can parent attribution children under it.
+        """
+        sp = object.__new__(Span)
+        sp.tracer = self
+        sp.name = name
+        sp.cat = cat
+        sp.span_id = self._new_id()
+        sp.parent_id = parent
+        sp.args = dict(args)
+        sp.tid = threading.get_ident() if tid is None else tid
+        sp.t0_perf = 0.0  # unused: dur is explicit
+        sp.t0_epoch_ns = int(t0_epoch_ns)
+        sp.dur = float(dur_s)
+        sp._token = None
+        sp._done = True
+        with self._lock:
+            self._finished.append(sp)
+        if self.spill_path is not None:  # skip the event build otherwise
+            self._spill(self._event(sp))
+        return sp.span_id
+
     def _record(self, span: Span) -> None:
         with self._lock:
             self._live.pop(span.span_id, None)

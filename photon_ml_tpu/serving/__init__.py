@@ -10,7 +10,8 @@ and streams micro-batched requests through a shape-bucketed jitted scorer.
 from photon_ml_tpu.serving.batcher import (BatcherDied, BatcherQueueFull,
                                            DeadlineExceeded, MicroBatcher,
                                            bucket_batch)
-from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.metrics import (STAGES, SLOTracker,
+                                           ServingMetrics)
 from photon_ml_tpu.serving.model_store import (HashShardedStore,
                                                ResidentModelStore)
 from photon_ml_tpu.serving.service import (ScoringRequest, ScoringService,
@@ -23,6 +24,8 @@ __all__ = [
     "DeadlineExceeded",
     "MicroBatcher",
     "bucket_batch",
+    "STAGES",
+    "SLOTracker",
     "ServingMetrics",
     "HashShardedStore",
     "ResidentModelStore",

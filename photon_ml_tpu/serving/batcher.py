@@ -33,6 +33,7 @@ ALWAYS resolves; nothing a flush does can strand a caller:
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -44,10 +45,23 @@ from typing import Callable, Optional, Sequence
 
 logger = logging.getLogger("photon_ml_tpu.serving")
 
+# Process-wide request ids: every queued request gets one at submit so a
+# request is addressable across the thread boundary — in its span, its
+# attribution payload, and the logs (docs/SERVING.md request lifecycle).
+_REQUEST_IDS = itertools.count(1)
+
 
 class BatcherQueueFull(RuntimeError):
     """Admission control: the request queue is at ``max_queue``; the
-    caller should shed load (HTTP: 503) rather than buffer unboundedly."""
+    caller should shed load (HTTP: 503) rather than buffer unboundedly.
+    Carries the observed ``depth`` (and ``max_queue``) so the shed
+    response can report how deep the queue actually was."""
+
+    def __init__(self, message: str, depth: Optional[int] = None,
+                 max_queue: Optional[int] = None):
+        super().__init__(message)
+        self.depth = depth
+        self.max_queue = max_queue
 
 
 class BatcherDied(RuntimeError):
@@ -69,6 +83,15 @@ class _Entry:
     # starved flushes or fired them instantly (PML004).
     enqueued_at: float = field(default_factory=time.monotonic)
     deadline: Optional[float] = None  # monotonic; None = no deadline
+    request_id: int = 0  # assigned at submit (_REQUEST_IDS)
+    # Wall anchor of the enqueue instant, captured only while tracing is
+    # on: it places the request span on the cross-thread trace axis
+    # (durations still come off ``enqueued_at``'s monotonic clock).
+    t0_epoch_ns: Optional[int] = None
+    # Stage attribution, filled by the flush function BEFORE the future
+    # resolves (serving/service.py) — the happens-before edge that lets
+    # whoever holds the future read it race-free after ``result()``.
+    attribution: Optional[dict] = None
 
 
 def bucket_batch(n: int, max_batch: int) -> int:
@@ -103,6 +126,7 @@ class MicroBatcher:
         default_deadline_s: Optional[float] = None,
         on_worker_death: Optional[Callable[[BaseException], None]] = None,
         on_deadline: Optional[Callable[[int], None]] = None,
+        depth_gauge=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -116,6 +140,10 @@ class MicroBatcher:
                                  else float(default_deadline_s))
         self._on_worker_death = on_worker_death
         self._on_deadline = on_deadline
+        # An obs-style gauge (set() + peak tracking) observed on every
+        # queue transition — the queue depth was previously invisible
+        # between "empty" and "BatcherQueueFull" (ISSUE 8 satellite).
+        self._depth_gauge = depth_gauge
         self._queue: list[_Entry] = []
         self._inflight: list[_Entry] = []  # batch being flushed right now
         self._cond = threading.Condition()
@@ -137,18 +165,25 @@ class MicroBatcher:
         resolves — with the score, the flush error, ``DeadlineExceeded``,
         or ``BatcherDied``."""
         entry = _Entry(request)
+        entry.request_id = next(_REQUEST_IDS)
+        tr = obs.tracer()
+        if tr is not None:  # wall anchor for the request span (off: one
+            entry.t0_epoch_ns = time.time_ns()  # None check)
         ttl = self.default_deadline if deadline_s is None else deadline_s
         if ttl is not None:
             entry.deadline = entry.enqueued_at + float(ttl)
         with self._cond:
             if not self._running:
                 raise RuntimeError("batcher is closed")
-            if (self.max_queue is not None
-                    and len(self._queue) >= self.max_queue):
+            depth = len(self._queue)
+            if self.max_queue is not None and depth >= self.max_queue:
                 raise BatcherQueueFull(
-                    f"scoring queue is full ({self.max_queue} pending); "
-                    f"shedding load")
+                    f"scoring queue is full ({depth} pending, "
+                    f"max {self.max_queue}); shedding load",
+                    depth=depth, max_queue=self.max_queue)
             self._queue.append(entry)
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(depth + 1)
             self._cond.notify()
         return entry.future
 
@@ -166,6 +201,8 @@ class MicroBatcher:
             # pml: allow[PML005] every caller holds self._cond (the
             # _locked suffix is the contract; asserted in tests)
             self._queue = [e for e in self._queue if id(e) not in dead]
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(len(self._queue))
         return expired
 
     def _fail_entries(self, entries: Sequence[_Entry],
@@ -194,6 +231,8 @@ class MicroBatcher:
             pending = self._inflight + self._queue
             self._inflight = []
             self._queue = []
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(0)
             restart = self._running
             if restart:
                 self.restarts += 1
@@ -235,6 +274,8 @@ class MicroBatcher:
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
                 self._inflight = batch
+                if self._depth_gauge is not None:
+                    self._depth_gauge.set(len(self._queue))
             if expired:
                 self.expired += len(expired)
                 self._fail_entries(expired, DeadlineExceeded(

@@ -1,12 +1,20 @@
-"""Serving metrics: latency percentiles, throughput, batch fill, RE cache.
+"""Serving metrics: latency percentiles, throughput, batch fill, RE cache,
+per-stage latency attribution, and sliding-window SLO accounting.
 
 Reference parity: none — the reference has no online story at all (its
 scoring driver is a batch job). The shape here follows standard model-server
 practice (latency histograms + counters behind a text endpoint) so the
 subsystem is observable from the first request: every micro-batch flush
 records device latency and fill, every queued request records end-to-end
-latency, and the random-effect device cache reports hit/miss/unseen/eviction
-counts per coordinate.
+latency AND its stage split (queue wait / assemble / device score /
+respond — docs/SERVING.md request lifecycle), and the random-effect device
+cache reports hit/miss/unseen/eviction counts per coordinate.
+
+The SLO layer (:class:`SLOTracker`) is the rolling-window view the
+lifetime histograms cannot give: lifetime p99 over a long uptime hides a
+bad last five minutes, and error-budget burn is only meaningful over a
+window. It feeds the ``/slo`` endpoint and the ``photon_serving_slo_*``
+exposition lines.
 
 All methods are thread-safe (one lock; the HTTP front end and the batcher
 worker record concurrently).
@@ -14,15 +22,127 @@ worker record concurrently).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
+from typing import Optional
+
+import numpy as np
 
 # The latency reservoir is the cross-stack histogram of obs/metrics.py
 # (photon-obs generalized this module's percentile ring into the
 # process-wide registry); the name survives for serving call sites.
+from photon_ml_tpu.obs.metrics import Gauge
 from photon_ml_tpu.obs.metrics import Histogram as LatencyHistogram
 
-__all__ = ["CacheCounters", "LatencyHistogram", "ServingMetrics"]
+__all__ = ["CacheCounters", "LatencyHistogram", "STAGES", "SLOTracker",
+           "ServingMetrics"]
+
+# The request lifecycle stages (docs/SERVING.md): a queued request's
+# end-to-end latency decomposes into exactly these four intervals.
+STAGES = ("queue_wait", "assemble", "device_score", "respond")
+
+
+class SLOTracker:
+    """Sliding-window latency percentiles + error-budget accounting.
+
+    ``record_ok(latency_s)`` is one successfully answered request;
+    ``record_bad(kind)`` is one request the service failed its users on —
+    the kinds are the serving degradation ladder: ``shed`` (admission
+    control, HTTP 503), ``deadline`` (expired in the queue, HTTP 504),
+    ``error`` (scoring failure, HTTP 5xx other than 503/504 — those two
+    are already counted at their sources). A request slower than
+    ``latency_objective_ms`` (when set) burns budget too, as ``slow``.
+
+    The error budget is the standard SRE formulation: with availability
+    objective ``a`` over the window, the budget is a ``1 - a`` fraction
+    of requests; ``budget_burn_rate`` is (bad fraction) / (1 - a) — 1.0
+    means burning exactly the sustainable rate, >1 means the window is
+    eating future budget.
+
+    All clocks are monotonic (PML004); the window prunes lazily on
+    record/snapshot. ``max_samples`` bounds memory under overload —
+    beyond it the OLDEST samples drop first (the window result is then
+    computed over the most recent ``max_samples`` observations, which is
+    also the regime where percentiles are most stable).
+    """
+
+    def __init__(self, window_s: float = 60.0,
+                 availability_objective: float = 0.999,
+                 latency_objective_ms: Optional[float] = None,
+                 max_samples: int = 65536):
+        if not 0.0 < availability_objective < 1.0:
+            raise ValueError(
+                f"availability objective must be in (0, 1), got "
+                f"{availability_objective}")
+        self._lock = threading.Lock()
+        self.window_s = float(window_s)
+        self.availability_objective = float(availability_objective)
+        self.latency_objective_ms = (
+            None if latency_objective_ms is None
+            else float(latency_objective_ms))
+        # (monotonic_t, latency_s) / (monotonic_t, kind)
+        self._ok: collections.deque = collections.deque(maxlen=max_samples)
+        self._bad: collections.deque = collections.deque(maxlen=max_samples)
+
+    def record_ok(self, latency_s: float,
+                  now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._ok.append((now, float(latency_s)))
+            if (self.latency_objective_ms is not None
+                    and latency_s * 1e3 > self.latency_objective_ms):
+                self._bad.append((now, "slow"))
+            self._prune_locked(now)
+
+    def record_bad(self, kind: str, n: int = 1,
+                   now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            for _ in range(int(n)):
+                self._bad.append((now, kind))
+            self._prune_locked(now)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        for q in (self._ok, self._bad):
+            while q and q[0][0] < horizon:
+                q.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(now)
+            lats = [v for _, v in self._ok]
+            bad = collections.Counter(k for _, k in self._bad)
+        ok_n, bad_n = len(lats), sum(bad.values())
+        total = ok_n + bad_n
+        # "slow" requests were ALSO recorded ok (they completed); they
+        # burn budget without changing the request count.
+        total -= bad.get("slow", 0)
+        bad_frac = bad_n / total if total else 0.0
+        budget = 1.0 - self.availability_objective
+        if lats:
+            arr = np.asarray(lats)
+            p50, p95, p99 = (float(np.percentile(arr, p))
+                             for p in (50, 95, 99))
+        else:
+            p50 = p95 = p99 = 0.0
+        return {
+            "window_seconds": self.window_s,
+            "availability_objective": self.availability_objective,
+            "latency_objective_ms": self.latency_objective_ms,
+            "requests_in_window": total,
+            "ok_in_window": ok_n,
+            "bad_in_window": bad_n,
+            "bad_by_kind": dict(bad),
+            "availability": 1.0 - bad_frac,
+            "error_budget_fraction": budget,
+            "budget_burn_rate": bad_frac / budget,
+            "p50_ms": p50 * 1e3,
+            "p95_ms": p95 * 1e3,
+            "p99_ms": p99 * 1e3,
+        }
 
 
 class CacheCounters:
@@ -47,7 +167,9 @@ class CacheCounters:
 class ServingMetrics:
     """One scoreboard per ScoringService."""
 
-    def __init__(self):
+    def __init__(self, slo_window_s: float = 60.0,
+                 slo_availability: float = 0.999,
+                 slo_latency_ms: Optional[float] = None):
         self._lock = threading.Lock()
         # Wall clock is for the TIMESTAMP only; uptime/throughput are
         # durations and come off the monotonic clock (an NTP step must
@@ -69,6 +191,20 @@ class ServingMetrics:
         self.retries_total = 0  # transient host-store fetch retries
         self.recoveries_total = 0  # batcher worker deaths recovered from
         self.http_errors_total: dict[int, int] = {}  # status code → count
+        # Request-stage attribution (docs/SERVING.md lifecycle): each
+        # COMPLETED queued request adds its own queue wait plus the full
+        # assemble/device/respond walls of the flush that carried it, so
+        # sum(stages) tracks sum(request_latency) — the cross-check
+        # bench_serving.py holds the bench lines to.
+        self.stage_seconds_total: dict[str, float] = {
+            s: 0.0 for s in STAGES}
+        self.stage_requests_total = 0  # requests attributed above
+        # Queue depth: observed on every batcher queue transition; the
+        # peak is the admission-control headroom number (ISSUE 8).
+        self.queue_depth = Gauge()
+        self.slo = SLOTracker(window_s=slo_window_s,
+                              availability_objective=slo_availability,
+                              latency_objective_ms=slo_latency_ms)
 
     def coordinate(self, cid: str) -> CacheCounters:
         with self._lock:
@@ -85,6 +221,18 @@ class ServingMetrics:
     def record_request_latency(self, seconds: float) -> None:
         with self._lock:
             self.request_latency.record(seconds)
+        self.slo.record_ok(seconds)
+
+    def record_stages(self, queue_wait_s: float, assemble_s: float,
+                      device_s: float, respond_s: float) -> None:
+        """One completed queued request's stage split (seconds)."""
+        with self._lock:
+            st = self.stage_seconds_total
+            st["queue_wait"] += queue_wait_s
+            st["assemble"] += assemble_s
+            st["device_score"] += device_s
+            st["respond"] += respond_s
+            self.stage_requests_total += 1
 
     def record_compile(self) -> None:
         with self._lock:
@@ -93,10 +241,12 @@ class ServingMetrics:
     def record_shed(self, n: int = 1) -> None:
         with self._lock:
             self.shed_total += n
+        self.slo.record_bad("shed", n)
 
     def record_deadline_exceeded(self, n: int = 1) -> None:
         with self._lock:
             self.deadline_exceeded_total += n
+        self.slo.record_bad("deadline", n)
 
     def record_flush_error(self) -> None:
         with self._lock:
@@ -114,6 +264,11 @@ class ServingMetrics:
         with self._lock:
             self.http_errors_total[code] = \
                 self.http_errors_total.get(code, 0) + 1
+        # 5xx burns error budget; 503/504 are excluded here because shed
+        # and deadline expiry already burned it at their sources (and the
+        # programmatic paths must count them without an HTTP front end).
+        if code >= 500 and code not in (503, 504):
+            self.slo.record_bad("error")
 
     def record_cache(self, cid: str, hits: int = 0, misses: int = 0,
                      unseen: int = 0, evictions: int = 0) -> None:
@@ -154,7 +309,13 @@ class ServingMetrics:
                 "recoveries_total": self.recoveries_total,
                 "http_errors_total": dict(self.http_errors_total),
                 "request_latency": self.request_latency.summary(),
+                "request_latency_sum_seconds": \
+                    self.request_latency.values()["sum"],
                 "batch_latency": self.batch_latency.summary(),
+                "stage_seconds_total": dict(self.stage_seconds_total),
+                "stage_requests_total": self.stage_requests_total,
+                "queue_depth": self.queue_depth.value,
+                "queue_depth_peak": self.queue_depth.peak,
                 "re_cache": {cid: c.summary()
                              for cid, c in self.cache.items()},
             }
@@ -177,9 +338,35 @@ class ServingMetrics:
             f"photon_serving_retries_total {s['retries_total']}",
             f"photon_serving_recoveries_total {s['recoveries_total']}",
         ]
+        lines.append(f"photon_serving_queue_depth {s['queue_depth']:g}")
+        lines.append(
+            f"photon_serving_queue_depth_peak {s['queue_depth_peak']:g}")
+        for stage in STAGES:
+            lines.append(
+                f"photon_serving_stage_seconds_total{{stage=\"{stage}\"}} "
+                f"{s['stage_seconds_total'][stage]:.6f}")
         for code, n in sorted(s["http_errors_total"].items()):
             lines.append(
                 f"photon_serving_http_errors_total{{code=\"{code}\"}} {n}")
+        slo = self.slo.snapshot()
+        lines.append(f"photon_serving_slo_window_seconds "
+                     f"{slo['window_seconds']:g}")
+        lines.append(f"photon_serving_slo_availability_objective "
+                     f"{slo['availability_objective']:g}")
+        lines.append(f"photon_serving_slo_requests_in_window "
+                     f"{slo['requests_in_window']}")
+        lines.append(f"photon_serving_slo_bad_in_window "
+                     f"{slo['bad_in_window']}")
+        for kind, n in sorted(slo["bad_by_kind"].items()):
+            lines.append(f"photon_serving_slo_bad_in_window_by_kind"
+                         f"{{kind=\"{kind}\"}} {n}")
+        lines.append(f"photon_serving_slo_availability "
+                     f"{slo['availability']:.6f}")
+        lines.append(f"photon_serving_slo_budget_burn_rate "
+                     f"{slo['budget_burn_rate']:.6f}")
+        for q in ("p50", "p95", "p99"):
+            lines.append(f"photon_serving_slo_latency_ms"
+                         f"{{quantile=\"{q}\"}} {slo[q + '_ms']:.4f}")
         for name, h in (("request", s["request_latency"]),
                         ("batch", s["batch_latency"])):
             lines.append(f"photon_serving_{name}_latency_count {h['count']}")

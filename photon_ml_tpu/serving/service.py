@@ -106,6 +106,9 @@ class ScoringService:
         entity_vocabs: Optional[dict[str, dict]] = None,
         max_queue: Optional[int] = None,
         request_deadline_s: Optional[float] = 30.0,
+        slo_window_s: float = 60.0,
+        slo_availability: float = 0.999,
+        slo_latency_ms: Optional[float] = None,
         emitter=default_emitter,
     ):
         # A flush's unique entities must fit the cache simultaneously
@@ -117,7 +120,9 @@ class ScoringService:
             metrics_retry=self._record_store_retry)
         self.as_mean = bool(as_mean)
         self.max_batch = int(max_batch)
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(slo_window_s=slo_window_s,
+                                      slo_availability=slo_availability,
+                                      slo_latency_ms=slo_latency_ms)
         self.emitter = emitter
         self._lock = threading.Lock()  # serializes resolve+score per flush
         self._compile_keys: set[int] = set()
@@ -133,7 +138,8 @@ class ScoringService:
             max_queue=self.max_queue,
             default_deadline_s=request_deadline_s,
             on_worker_death=self._on_worker_death,
-            on_deadline=self.metrics.record_deadline_exceeded)
+            on_deadline=self.metrics.record_deadline_exceeded,
+            depth_gauge=self.metrics.queue_depth)
         self._closed = False
         emitter.emit(ScoringStart(source="serving", num_rows=None))
 
@@ -208,9 +214,16 @@ class ScoringService:
 
     # -- scoring paths -----------------------------------------------------
 
-    def _score_chunk(self, requests: Sequence[ScoringRequest]) -> np.ndarray:
+    def _score_chunk(self, requests: Sequence[ScoringRequest]):
+        """Score one ≤max_batch chunk; returns ``(scores, stage_marks)``
+        where the marks are the monotonic stage boundaries
+        ``(assemble_start, device_start, device_end)`` — the raw material
+        of per-request latency attribution (docs/SERVING.md lifecycle).
+        All boundaries share ``_Entry.enqueued_at``'s clock so stage
+        durations and queue waits subtract cleanly."""
         n = len(requests)
         with self._lock:
+            t_a0 = time.monotonic()  # assemble: batch build + RE resolve
             padded = bucket_batch(n, self.max_batch)
             mats, offsets, ids = self._assemble(requests, padded)
             slots = self.store.resolve_slots(ids, metrics=self.metrics)
@@ -222,15 +235,16 @@ class ScoringService:
             if padded not in self._compile_keys:
                 self._compile_keys.add(padded)
                 self.metrics.record_compile()
-            t0 = time.perf_counter()
+            t_d0 = time.monotonic()  # device: dispatch + block on result
             out = self._score_fn(mats, offsets, slots_full,
                                  self.store.caches())
             out = np.asarray(jax.block_until_ready(out))
-            dt = time.perf_counter() - t0
+            t_d1 = time.monotonic()
+        dt = t_d1 - t_d0
         self.metrics.record_batch(n, padded, dt)
         self.emitter.emit(ScoringBatch(source="serving", rows=n,
                                        padded_rows=padded, seconds=dt))
-        return out[:n]
+        return out[:n], (t_a0, t_d0, t_d1)
 
     def score(self, requests: Sequence[ScoringRequest]) -> np.ndarray:
         """Programmatic batch API: score now, bypassing the queue (the
@@ -238,7 +252,7 @@ class ScoringService:
         scores = np.empty(len(requests), np.float32)
         for lo in range(0, len(requests), self.max_batch):
             chunk = requests[lo: lo + self.max_batch]
-            scores[lo: lo + len(chunk)] = self._score_chunk(chunk)
+            scores[lo: lo + len(chunk)] = self._score_chunk(chunk)[0]
         return scores
 
     def submit(self, request: ScoringRequest,
@@ -255,19 +269,78 @@ class ScoringService:
             raise
 
     def _flush(self, entries):
+        t_flush0 = time.monotonic()  # same clock as _Entry.enqueued_at
         try:
             # Injection site first: a fault here is indistinguishable
             # from the scorer failing (InjectedThreadDeath, being a
             # BaseException, still sails through to the supervisor).
             flt.fire("serving.flush")
-            scores = self._score_chunk([e.request for e in entries])
+            scores, marks = self._score_chunk(
+                [e.request for e in entries])
         except Exception:
             self.metrics.record_flush_error()
             raise
-        done = time.monotonic()  # same clock as _Entry.enqueued_at
-        for e in entries:
-            self.metrics.record_request_latency(done - e.enqueued_at)
+        self._attribute(entries, t_flush0, marks)
         return scores
+
+    def _attribute(self, entries, t_flush0: float, marks) -> None:
+        """Per-request latency attribution for one flush (runs on the
+        batcher worker, inside the ``serving.flush`` span, BEFORE the
+        futures resolve).
+
+        Every request in the flush experienced the flush's whole
+        assemble/device/respond walls plus its own queue wait, so those
+        are its stages verbatim: stages sum to the request total (the
+        10%-agreement contract tests and bench cross-checks rely on).
+        With tracing on, each request also becomes a ``serving.request``
+        span parented into this flush's span — the queue-crossing edge —
+        with one child span per stage.
+        """
+        t_a0, t_d0, t_d1 = marks
+        t_done = time.monotonic()
+        assemble_s = t_d0 - t_a0
+        device_s = t_d1 - t_d0
+        respond_s = t_done - t_d1
+        tr = obs.tracer()
+        parent = tr.current() if tr is not None else None
+        for e in entries:
+            queue_wait_s = max(t_flush0 - e.enqueued_at, 0.0)
+            total_s = t_done - e.enqueued_at
+            attr = {
+                "request_id": e.request_id,
+                "queue_wait_ms": round(queue_wait_s * 1e3, 4),
+                "assemble_ms": round(assemble_s * 1e3, 4),
+                "device_score_ms": round(device_s * 1e3, 4),
+                "respond_ms": round(respond_s * 1e3, 4),
+                "total_ms": round(total_s * 1e3, 4),
+            }
+            e.attribution = attr
+            # Visible to whoever holds the future, race-free: set_result
+            # happens after _flush returns (the happens-before edge).
+            e.future.attribution = attr
+            self.metrics.record_request_latency(total_s)
+            self.metrics.record_stages(queue_wait_s, assemble_s,
+                                       device_s, respond_s)
+            if tr is None or e.t0_epoch_ns is None:
+                continue
+
+            def _at(mono: float) -> int:
+                # The entry's own (epoch, monotonic) pair anchors its
+                # stage boundaries on the cross-thread trace axis.
+                return e.t0_epoch_ns + int((mono - e.enqueued_at) * 1e9)
+
+            sid = tr.record_complete(
+                "serving.request", cat="serving",
+                t0_epoch_ns=e.t0_epoch_ns, dur_s=total_s, parent=parent,
+                crosses_queue=True, request_id=e.request_id)
+            for name, mono, dur in (
+                    ("serving.queue_wait", e.enqueued_at, queue_wait_s),
+                    ("serving.assemble", t_a0, assemble_s),
+                    ("serving.device_score", t_d0, device_s),
+                    ("serving.respond", t_d1, respond_s)):
+                tr.record_complete(name, cat="serving",
+                                   t0_epoch_ns=_at(mono), dur_s=dur,
+                                   parent=sid)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -281,6 +354,22 @@ class ScoringService:
         if registry is not None:
             text += registry.render_text()
         return text
+
+    def slo_snapshot(self) -> dict:
+        """The ``/slo`` body: sliding-window percentiles + error-budget
+        burn, with the lifetime shed/deadline/error totals alongside so
+        one payload answers both "how is the window" and "how has the
+        lifetime been" (docs/SERVING.md)."""
+        out = self.metrics.slo.snapshot()
+        out["lifetime"] = {
+            "rows_total": self.metrics.rows_total,
+            "shed_total": self.metrics.shed_total,
+            "deadline_exceeded_total":
+                self.metrics.deadline_exceeded_total,
+            "flush_errors_total": self.metrics.flush_errors_total,
+            "queue_depth_peak": self.metrics.queue_depth.peak,
+        }
+        return out
 
     def close(self) -> None:
         if self._closed:
@@ -310,12 +399,15 @@ def _parse_request(obj: dict) -> ScoringRequest:
 
 
 class _ServingHandler(BaseHTTPRequestHandler):
-    """Minimal stdlib handler: POST /score, GET /metrics, GET /healthz.
+    """Minimal stdlib handler: POST /score, GET /metrics, GET /slo,
+    GET /healthz.
 
     Each POSTed request is submitted through the micro-batcher, so
     concurrent HTTP callers coalesce into shared device batches — the
     ThreadingHTTPServer thread-per-connection model is exactly what makes
-    the batcher useful here.
+    the batcher useful here. A ``"trace": true`` key in the /score body
+    opts that call into per-request latency attribution
+    (queue wait / assemble / device score / respond) in the response.
     """
 
     service: ScoringService = None  # set by make_http_server
@@ -336,18 +428,23 @@ class _ServingHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._respond(200, self.service.metrics_text().encode(),
                           "text/plain; version=0.0.4")
+        elif self.path == "/slo":
+            self._json(200, self.service.slo_snapshot())
         elif self.path == "/healthz":
             self._json(200, {"status": "ok"})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
-    def _error(self, code: int, message: str) -> None:
+    def _error(self, code: int, message: str, **extra) -> None:
         """One JSON error body + one metrics increment — every failure
         leaves through here, never as an unhandled exception on the
         handler thread (which would reset the connection with no body
-        and no count)."""
+        and no count). ``extra`` keys (non-None) ride along in the body
+        (the 503 shed body carries the observed queue depth)."""
         self.service.metrics.record_http_error(code)
-        self._json(code, {"error": message})
+        body = {"error": message}
+        body.update({k: v for k, v in extra.items() if v is not None})
+        self._json(code, body)
 
     def do_POST(self):
         if self.path != "/score":
@@ -359,6 +456,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             if not isinstance(payload, dict):
                 raise ValueError("request body must be a JSON object")
             reqs = [_parse_request(o) for o in payload.get("requests", [])]
+            want_trace = bool(payload.get("trace", False))
         except (ValueError, TypeError, AttributeError, KeyError) as exc:
             # Malformed JSON / wrong shapes: the CALLER's fault — 400.
             logger.warning("malformed scoring request: %s", exc)
@@ -371,8 +469,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
             futures = [self.service.submit(r) for r in reqs]
         except BatcherQueueFull as exc:
             # Admission control: shed with a Retry-After signal instead
-            # of buffering unboundedly (shed_total counts it).
-            self._error(503, str(exc))
+            # of buffering unboundedly (shed_total counts it); the body
+            # reports the observed depth so callers and dashboards see
+            # HOW saturated, not just that it was.
+            self._error(503, str(exc), queue_depth=exc.depth,
+                        max_queue=exc.max_queue)
             return
         try:
             scores = [float(f.result(timeout=self.result_timeout))
@@ -384,7 +485,13 @@ class _ServingHandler(BaseHTTPRequestHandler):
             logger.exception("scoring request failed")
             self._error(500, f"scoring failed: {exc}")
             return
-        self._json(200, {"scores": scores, "uids": [r.uid for r in reqs]})
+        body = {"scores": scores, "uids": [r.uid for r in reqs]}
+        if want_trace:
+            # Filled by the flush before each future resolved; reading
+            # after result() is the race-free side of that edge.
+            body["attribution"] = [getattr(f, "attribution", None)
+                                   for f in futures]
+        self._json(200, body)
 
     def log_message(self, fmt, *args):  # route access logs off stderr
         logger.debug("http: " + fmt, *args)

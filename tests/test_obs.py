@@ -458,3 +458,66 @@ def test_serving_metrics_endpoint_appends_registry():
     text = ScoringService.metrics_text(svc)
     assert "photon_serving_rows_total" in text
     assert 'photon_checkpoint_writes_total{kind="descent"} 1' in text
+
+
+# ------------------------------------------- record_complete (ISSUE 8)
+
+
+def test_record_complete_manual_span_exports_and_parents():
+    t = obs.Tracer()
+    with t.span("flush", cat="serving") as fl:
+        parent_id = fl.span_id
+    base = time.time_ns()
+    rid = t.record_complete("serving.request", cat="serving",
+                            t0_epoch_ns=base, dur_s=0.02,
+                            parent=parent_id, crosses_queue=True,
+                            request_id=7)
+    t.record_complete("serving.queue_wait", cat="serving",
+                      t0_epoch_ns=base, dur_s=0.01, parent=rid)
+    assert t.open_spans() == 0  # born closed, never live
+    trace = t.chrome_trace()
+    spans = {e["name"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    req = spans["serving.request"]
+    assert req["args"]["parent_id"] == parent_id
+    assert req["args"]["request_id"] == 7
+    assert req["dur"] == pytest.approx(20000.0)  # us
+    kid = spans["serving.queue_wait"]
+    assert kid["args"]["parent_id"] == req["args"]["span_id"]
+
+
+def test_record_complete_does_not_disturb_contextvar_nesting():
+    t = obs.Tracer()
+    with t.span("outer") as outer:
+        t.record_complete("manual", t0_epoch_ns=time.time_ns(),
+                          dur_s=0.001)
+        with t.span("inner") as inner:
+            assert inner.parent_id == outer.span_id  # not "manual"
+
+
+def test_verify_exempts_queue_crossing_spans_at_head_only():
+    from photon_ml_tpu.cli import obs as obs_cli
+
+    def ev(name, sid, ts, dur, parent=None, **args):
+        a = {"span_id": sid, **args}
+        if parent:
+            a["parent_id"] = parent
+        return {"name": name, "ph": "X", "ts": ts, "dur": dur,
+                "pid": 1, "tid": 1, "args": a}
+
+    # A request span starting 5ms BEFORE its flush parent: exempt when
+    # marked crosses_queue, flagged otherwise.
+    flush = ev("serving.flush", "f", 5000.0, 4000.0)
+    crossing = ev("serving.request", "r", 0.0, 8000.0, parent="f",
+                  crosses_queue=True)
+    assert obs_cli.verify_trace(
+        {"traceEvents": [flush, crossing]}) == []
+    plain = ev("serving.request", "r", 0.0, 8000.0, parent="f")
+    assert any("not contained" in p for p in obs_cli.verify_trace(
+        {"traceEvents": [flush, plain]}))
+    # The tail is still checked: a crossing span may not OUTLIVE its
+    # parent.
+    overhang = ev("serving.request", "r", 0.0, 20000.0, parent="f",
+                  crosses_queue=True)
+    assert any("not contained" in p for p in obs_cli.verify_trace(
+        {"traceEvents": [flush, overhang]}))

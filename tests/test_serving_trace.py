@@ -1,0 +1,404 @@
+"""Request-level tracing, latency attribution, and SLO accounting
+through the serving path (ISSUE 8).
+
+The contracts under test:
+
+- every queued request becomes a ``serving.request`` span that CROSSES
+  the batcher worker-thread boundary: parented into the
+  ``serving.flush`` span that scored it, with one child span per stage;
+- the four stages (queue wait / assemble / device score / respond) sum
+  to within 10% of the measured request total — attribution that does
+  not add up is worse than none;
+- ``close()`` leaks zero spans;
+- the SLO tracker's sliding window forgets, its error budget burns on
+  shed/deadline/5xx, and the ``/slo`` + ``/metrics`` endpoints expose
+  it;
+- the MicroBatcher queue depth is observed (gauge + peak) and the
+  observed depth rides in the ``BatcherQueueFull`` 503 body.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.cli.obs import (summarize_serving, summarize_trace,
+                                   verify_trace)
+from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.serving import (MicroBatcher, BatcherQueueFull,
+                                   SLOTracker, ScoringRequest,
+                                   ScoringService, make_http_server)
+from photon_ml_tpu.serving.metrics import STAGES
+from photon_ml_tpu.types import TaskType
+
+
+def _tiny_model(rng, d_global=6, d_re=4, num_entities=12):
+    return GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=d_global).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(num_entities, d_re)
+                                   ).astype(np.float32))),
+    })
+
+
+def _request(rng, model, eid=0):
+    return ScoringRequest(
+        features={"global": rng.normal(
+            size=model.models["fixed"].dim).astype(np.float32),
+            "re_userId": rng.normal(
+                size=model.models["per-user"].dim).astype(np.float32)},
+        entity_ids={"userId": int(eid)})
+
+
+def _spans(trace, name=None):
+    out = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    if name is not None:
+        out = [e for e in out if e["name"] == name]
+    return out
+
+
+# -- trace propagation across the batcher worker thread ---------------------
+
+
+def test_request_spans_parent_into_flush_spans(rng):
+    model = _tiny_model(rng)
+    tracer = obs.Tracer()
+    with obs.activated(trace_obj=tracer):
+        with ScoringService(model, max_batch=4, max_wait_ms=1.0) as svc:
+            futs = [svc.submit(_request(rng, model, i % 12))
+                    for i in range(11)]
+            scores = [f.result(timeout=30) for f in futs]
+    assert len(scores) == 11
+    assert tracer.open_spans() == 0  # close() leaked nothing
+    trace = tracer.chrome_trace()
+    assert verify_trace(trace) == []
+    flush_ids = {e["args"]["span_id"]
+                 for e in _spans(trace, "serving.flush")}
+    requests = _spans(trace, "serving.request")
+    assert len(requests) == 11
+    assert flush_ids, "no serving.flush spans in trace"
+    for e in requests:
+        assert e["args"]["parent_id"] in flush_ids, \
+            f"request span parented outside the flush spans: {e['args']}"
+        assert e["args"]["request_id"] > 0
+        assert e["args"]["crosses_queue"] is True
+
+
+def test_attribution_children_sum_to_request_total(rng):
+    model = _tiny_model(rng)
+    tracer = obs.Tracer()
+    with obs.activated(trace_obj=tracer):
+        with ScoringService(model, max_batch=4, max_wait_ms=1.0) as svc:
+            futs = [svc.submit(_request(rng, model, i % 12))
+                    for i in range(9)]
+            for f in futs:
+                f.result(timeout=30)
+    trace = tracer.chrome_trace()
+    requests = _spans(trace, "serving.request")
+    children_by_parent: dict = {}
+    for name in ("serving.queue_wait", "serving.assemble",
+                 "serving.device_score", "serving.respond"):
+        for e in _spans(trace, name):
+            children_by_parent.setdefault(
+                e["args"]["parent_id"], []).append(e)
+    for req in requests:
+        kids = children_by_parent[req["args"]["span_id"]]
+        assert sorted(k["name"] for k in kids) == [
+            "serving.assemble", "serving.device_score",
+            "serving.queue_wait", "serving.respond"]
+        total_us = req["dur"]
+        kid_us = sum(k["dur"] for k in kids)
+        assert abs(kid_us - total_us) <= 0.10 * total_us, \
+            f"stages {kid_us}us vs request {total_us}us"
+        # Children are contained in the request interval.
+        for k in kids:
+            assert k["ts"] >= req["ts"] - 1.0
+            assert k["ts"] + k["dur"] <= req["ts"] + req["dur"] + 1.0
+
+
+def test_untraced_path_has_attribution_but_no_spans(rng):
+    model = _tiny_model(rng)
+    with ScoringService(model, max_batch=2, max_wait_ms=1.0) as svc:
+        fut = svc.submit(_request(rng, model))
+        fut.result(timeout=30)
+        attr = fut.attribution
+        assert attr is not None  # always measured
+        stages = (attr["queue_wait_ms"] + attr["assemble_ms"]
+                  + attr["device_score_ms"] + attr["respond_ms"])
+        assert stages == pytest.approx(attr["total_ms"], rel=0.10)
+        snap = svc.metrics.snapshot()
+    assert snap["stage_requests_total"] == 1
+    total_stage_s = sum(snap["stage_seconds_total"].values())
+    assert total_stage_s == pytest.approx(
+        snap["request_latency_sum_seconds"], rel=0.10)
+    assert obs.tracer() is None  # nothing got enabled as a side effect
+
+
+def test_summarize_serving_renders_stage_attribution(rng):
+    model = _tiny_model(rng)
+    tracer = obs.Tracer()
+    with obs.activated(trace_obj=tracer):
+        with ScoringService(model, max_batch=4, max_wait_ms=1.0) as svc:
+            futs = [svc.submit(_request(rng, model, i % 12))
+                    for i in range(8)]
+            for f in futs:
+                f.result(timeout=30)
+    summary = summarize_serving(tracer.chrome_trace())
+    assert summary["requests"] == 8
+    assert summary["flushes"] >= 1
+    assert summary["request_latency_ms"]["p99"] > 0
+    assert 0.85 <= summary["attributed_fraction"] <= 1.01
+    fracs = [a["frac_of_request_time"]
+             for a in summary["stage_attribution"].values()]
+    assert sum(fracs) == pytest.approx(
+        summary["attributed_fraction"], abs=1e-6)
+    wf = summary["slowest_request"]["waterfall"]
+    assert [w["stage"] for w in wf] == [
+        "serving.queue_wait", "serving.assemble",
+        "serving.device_score", "serving.respond"]
+    # The plain summarize still loads a serving trace (request spans are
+    # exempt from strict head-containment, not from the summary).
+    assert summarize_trace(tracer.chrome_trace())["wall_seconds"] > 0
+
+
+# -- SLO tracker -------------------------------------------------------------
+
+
+def test_slo_tracker_window_and_burn_rate():
+    slo = SLOTracker(window_s=10.0, availability_objective=0.99)
+    t0 = 1000.0
+    for i in range(98):
+        slo.record_ok(0.001 * (i + 1), now=t0 + i * 0.01)
+    slo.record_bad("shed", now=t0 + 1.0)
+    slo.record_bad("deadline", now=t0 + 1.1)
+    s = slo.snapshot(now=t0 + 2.0)
+    assert s["requests_in_window"] == 100
+    assert s["bad_in_window"] == 2
+    assert s["bad_by_kind"] == {"shed": 1, "deadline": 1}
+    assert s["availability"] == pytest.approx(0.98)
+    # bad_frac 2% against a 1% budget: burning at 2x sustainable.
+    assert s["budget_burn_rate"] == pytest.approx(2.0)
+    assert s["p50_ms"] == pytest.approx(49.5, rel=0.05)
+    # The window forgets: 20s later everything has aged out.
+    s2 = slo.snapshot(now=t0 + 22.0)
+    assert s2["requests_in_window"] == 0
+    assert s2["budget_burn_rate"] == 0.0
+
+
+def test_slo_tracker_latency_objective_burns_budget():
+    slo = SLOTracker(window_s=60.0, availability_objective=0.9,
+                     latency_objective_ms=10.0)
+    t0 = 50.0
+    slo.record_ok(0.001, now=t0)  # fast: fine
+    slo.record_ok(0.5, now=t0)  # slow: burns budget
+    s = slo.snapshot(now=t0 + 1.0)
+    assert s["requests_in_window"] == 2
+    assert s["bad_by_kind"] == {"slow": 1}
+    assert s["availability"] == pytest.approx(0.5)
+
+
+def test_service_slo_counts_shed_and_errors(rng):
+    model = _tiny_model(rng)
+    with ScoringService(model, max_batch=2, max_queue=1,
+                        max_wait_ms=200.0) as svc:
+        svc.submit(_request(rng, model))  # occupies the queue
+        with pytest.raises(BatcherQueueFull):
+            svc.submit(_request(rng, model))
+        svc.metrics.record_http_error(500)
+        svc.metrics.record_http_error(503)  # NOT double-counted: shed
+        s = svc.slo_snapshot()
+    assert s["bad_by_kind"].get("shed") == 1
+    assert s["bad_by_kind"].get("error") == 1
+    assert s["lifetime"]["shed_total"] == 1
+
+
+# -- queue depth observability ----------------------------------------------
+
+
+def test_queue_depth_gauge_and_503_body():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_flush(entries):
+        started.set()
+        release.wait(timeout=30)
+        return [0.0] * len(entries)
+
+    from photon_ml_tpu.obs.metrics import Gauge
+
+    gauge = Gauge()
+    b = MicroBatcher(slow_flush, max_batch=1, max_wait_ms=1.0,
+                     max_queue=3, depth_gauge=gauge)
+    try:
+        futs = [b.submit(0)]  # taken in flight (flush blocks on release)
+        assert started.wait(timeout=10)
+        futs += [b.submit(i) for i in (1, 2, 3)]  # exactly fills the queue
+        with pytest.raises(BatcherQueueFull) as ei:
+            b.submit(99)
+        assert ei.value.depth == 3
+        assert ei.value.max_queue == 3
+        assert "3 pending, max 3" in str(ei.value)
+        assert gauge.peak >= 3
+        release.set()
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        release.set()
+        b.close()
+    assert gauge.value == 0  # drained
+
+
+def test_metrics_text_has_queue_depth_stages_and_slo(rng):
+    model = _tiny_model(rng)
+    with ScoringService(model, max_batch=2, max_wait_ms=1.0) as svc:
+        svc.submit(_request(rng, model)).result(timeout=30)
+        text = svc.metrics.render_text()
+    assert "photon_serving_queue_depth " in text
+    assert "photon_serving_queue_depth_peak 1" in text
+    for stage in STAGES:
+        assert f'photon_serving_stage_seconds_total{{stage="{stage}"}}' \
+            in text
+    assert "photon_serving_slo_requests_in_window 1" in text
+    assert "photon_serving_slo_budget_burn_rate 0" in text
+    assert 'photon_serving_slo_latency_ms{quantile="p99"}' in text
+
+
+# -- HTTP: /slo endpoint + opt-in attribution -------------------------------
+
+
+def test_http_slo_endpoint_and_trace_flag(rng):
+    model = _tiny_model(rng)
+    svc = ScoringService(model, max_batch=4, max_wait_ms=1.0)
+    server = make_http_server(svc, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def post(extra):
+            body = json.dumps({"requests": [{
+                "features": {
+                    "global": [0.1] * model.models["fixed"].dim,
+                    "re_userId":
+                        [0.2] * model.models["per-user"].dim},
+                "entity_ids": {"userId": 3}, "uid": "r1"}], **extra})
+            return json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/score",
+                    data=body.encode()), timeout=30).read())
+
+        plain = post({})
+        assert "attribution" not in plain  # strictly opt-in
+        traced = post({"trace": True})
+        attr = traced["attribution"][0]
+        assert attr["request_id"] > 0
+        stages = (attr["queue_wait_ms"] + attr["assemble_ms"]
+                  + attr["device_score_ms"] + attr["respond_ms"])
+        assert stages == pytest.approx(attr["total_ms"], rel=0.10)
+        slo = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo", timeout=30).read())
+        assert slo["requests_in_window"] == 2
+        assert slo["bad_in_window"] == 0
+        assert slo["p99_ms"] > 0
+        assert slo["lifetime"]["rows_total"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
+
+
+def test_http_503_body_reports_queue_depth(rng):
+    model = _tiny_model(rng)
+    # max_batch=2 with a long wait window: a lone queued request SITS in
+    # the queue waiting for batch-mates, deterministically occupying the
+    # max_queue=1 budget when the HTTP request arrives.
+    svc = ScoringService(model, max_batch=2, max_queue=1,
+                         max_wait_ms=2000.0)
+    server = make_http_server(svc, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    def body():
+        return json.dumps({"requests": [{
+            "features": {
+                "global": [0.0] * model.models["fixed"].dim,
+                "re_userId": [0.0] * model.models["per-user"].dim},
+            "entity_ids": {"userId": 1}}]}).encode()
+
+    try:
+        pending = svc.submit(ScoringRequest(
+            features={"global": np.zeros(6, np.float32),
+                      "re_userId": np.zeros(4, np.float32)},
+            entity_ids={"userId": 0}))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/score", data=body()),
+                timeout=30)
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        assert payload["queue_depth"] == 1
+        assert payload["max_queue"] == 1
+        assert "shedding load" in payload["error"]
+        assert svc.metrics.shed_total == 1
+        pending.result(timeout=30)  # flushes once the window closes
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
+
+
+# -- photon-game-serve observability dump parity ----------------------------
+
+
+def test_serve_cli_trace_and_metrics_dump(rng, tmp_path):
+    """--trace-out/--metrics-dump parity with game_train: the dump path
+    runs in run()'s finally; here the helper is driven directly against
+    a traced, served request so a crashed server exercises the same
+    code."""
+    from photon_ml_tpu.cli import serve
+    from photon_ml_tpu.cli.obs import load_trace, verify_trace
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.obs.metrics import parse_prometheus_text
+
+    model_dir = str(tmp_path / "model")
+    model_io.save_game_model(_tiny_model(rng), model_dir)
+    args = serve.build_parser().parse_args([
+        "--model-dir", model_dir, "--port", "0",
+        "--max-batch", "4", "--max-wait-ms", "1.0",
+        "--slo-window-s", "30", "--slo-availability", "0.99",
+        "--trace-out", str(tmp_path / "serve-trace.json"),
+        "--metrics-dump", str(tmp_path / "serve-metrics.prom"),
+    ])
+    obs.enable(trace=True, metrics=True)
+    try:
+        server, svc = serve.create_server(args)
+        try:
+            assert svc.metrics.slo.window_s == 30.0
+            assert svc.metrics.slo.availability_objective == 0.99
+            svc.submit(_request(rng, model_io.load_game_model(
+                model_dir, host=True))).result(timeout=30)
+        finally:
+            server.server_close()
+            svc.close()
+            serve._dump_observability(svc, args.trace_out,
+                                      args.metrics_dump)
+    finally:
+        obs.disable()
+    trace = load_trace(str(tmp_path / "serve-trace.json"))
+    assert verify_trace(trace) == []
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "serving.request" in names and "serving.flush" in names
+    parsed = parse_prometheus_text(
+        (tmp_path / "serve-metrics.prom").read_text())
+    assert parsed.get("photon_serving_rows_total") == 1.0
+    assert "photon_serving_slo_budget_burn_rate" in parsed
