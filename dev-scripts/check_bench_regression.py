@@ -38,6 +38,17 @@ dev-scripts/bench_serving.py's open-loop lines (docs/SERVING.md):
   - serving_p99_vs_qps_curve banded against the committed baseline at
     matching QPS levels, when the baseline has the curve.
 
+plus the CONVERGENCE gate (docs/OBSERVABILITY.md "The run ledger"):
+
+  - ``time_to_target_value_seconds`` (the flagships read it from their
+    run ledgers — time to achieve 99% of the run's objective drop) is
+    banded against the committed baseline when both carry it, so a
+    regression in HOW FAST the objective falls fails CI even when
+    wall-time totals still look fine;
+  - ``--ledger FRESH_DIR --baseline-ledger BASE_DIR`` compares two run
+    ledgers directly (photon-obs diff machinery): per-coordinate time
+    to the common target value must stay within the band.
+
 plus, with ``--metrics-dump METRICS.prom`` (a file written by
 ``game_train --metrics-dump`` / ``flagship_criteo_stream.py``), a
 bench-vs-metrics consistency gate: bench lines that have a counter
@@ -117,7 +128,7 @@ def _fresh_from_run() -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    src = ap.add_mutually_exclusive_group(required=True)
+    src = ap.add_mutually_exclusive_group(required=False)
     src.add_argument("--fresh", help="path to a fresh bench tail JSON")
     src.add_argument("--run-staging", action="store_true",
                      help="measure a fresh staging tail now (slow)")
@@ -129,7 +140,20 @@ def main() -> int:
                     help="photon-obs Prometheus dump from the SAME run "
                          "as --fresh: bench lines with a metric "
                          "counterpart must agree within 10%%")
+    ap.add_argument("--ledger",
+                    help="fresh run-ledger directory: per-coordinate "
+                         "time-to-target vs --baseline-ledger must stay "
+                         "within the band (docs/OBSERVABILITY.md)")
+    ap.add_argument("--baseline-ledger",
+                    help="baseline run-ledger directory for --ledger")
     args = ap.parse_args()
+
+    if bool(args.ledger) != bool(args.baseline_ledger):
+        print("--ledger and --baseline-ledger go together")
+        return 2
+    if not args.fresh and not args.run_staging and not args.ledger:
+        print("need --fresh, --run-staging, or a --ledger pair")
+        return 2
 
     try:
         with open(args.baseline) as f:
@@ -144,8 +168,10 @@ def main() -> int:
         except (OSError, ValueError) as e:
             print(f"cannot load fresh tail {args.fresh}: {e}")
             return 2
-    else:
+    elif args.run_staging:
         fresh = _lines(_fresh_from_run())
+    else:
+        fresh = {}  # ledger-only invocation: no bench tail to gate
 
     failures = []
     band = 1.0 + args.tolerance
@@ -158,7 +184,7 @@ def main() -> int:
             return lines.get(f"{key}_invalid_reason", "gated invalid")
         return None
 
-    for key in GUARDED:
+    for key in (GUARDED if fresh else ()):  # ledger-only: no bench tail
         if key not in base:
             continue  # line did not exist in that round
         if key not in fresh:
@@ -309,6 +335,51 @@ def main() -> int:
                     f"serving_p99_vs_qps_curve[{q}]: {v:g}ms > "
                     f"{b * band:.3g}ms — serving p99 regressed at "
                     f"{q} qps")
+
+    # --- convergence gate (docs/OBSERVABILITY.md "The run ledger") ------
+    # Time-to-target regressions fail CI even when wall totals look
+    # fine: a fit that takes the same 90 minutes but reaches the target
+    # objective half as fast has regressed in the way the papers'
+    # convergence-vs-wall-clock curves actually measure.
+    ttt_base = base.get("time_to_target_value_seconds")
+    ttt_fresh = fresh.get("time_to_target_value_seconds")
+    if ttt_base is not None and ttt_fresh is not None:
+        b, v = float(ttt_base), float(ttt_fresh)
+        verdict = "OK" if v <= b * band else "REGRESSION"
+        print(f"time_to_target_value_seconds: fresh {v:g} vs baseline "
+              f"{b:g} (limit {b * band:.3g}) {verdict}")
+        if v > b * band:
+            failures.append(
+                f"time_to_target_value_seconds: {v:g} > {b * band:.3g} "
+                f"— the objective falls slower than the committed round")
+    if args.ledger:
+        from photon_ml_tpu.obs.ledger import LedgerError, diff_ledgers
+
+        try:
+            # baseline-ledger is run A, fresh is run B: the gated ratio
+            # is B's time to the common target over A's.
+            d = diff_ledgers(args.baseline_ledger, args.ledger)
+        except LedgerError as e:
+            print(f"cannot diff ledgers: {e}")
+            return 2
+        gated = 0
+        for coord, entry in d["coordinates"].items():
+            ratio = entry.get("time_to_target_ratio")
+            if ratio is None:
+                continue
+            gated += 1
+            ok = ratio <= band
+            print(f"ledger time-to-target[{coord}]: fresh/base "
+                  f"{ratio:.2f}x (limit {band:.2f}x) "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"ledger time-to-target[{coord}]: {ratio:.2f}x > "
+                    f"{band:.2f}x — convergence regressed "
+                    f"(target {entry['target_value']:.6g})")
+        if gated == 0:
+            print("ledger diff: no coordinate with a comparable "
+                  "time-to-target (nothing gated)")
 
     # --- bench ↔ metrics consistency (docs/OBSERVABILITY.md) ------------
     if args.metrics_dump:

@@ -252,6 +252,26 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         out["criteo_stream_peak_inflight_chunks"] = int(
             obs.metric_value(parsed,
                              "photon_stream_inflight_chunks_peak") or 0)
+    led = obs.ledger()
+    if led is not None:
+        # Time-to-target READ FROM the run ledger (ISSUE 9 satellite):
+        # the bench line and the convergence curve share provenance —
+        # check_bench_regression's convergence gate can re-derive this
+        # number from the same rows.
+        from photon_ml_tpu.obs.ledger import (convergence_curves,
+                                              read_rows,
+                                              time_to_fraction)
+
+        led.flush()
+        rows, _ = read_rows(led.directory)
+        curve = convergence_curves(rows).get("fixed")
+        tt = time_to_fraction(curve) if curve else None
+        if tt is not None:
+            out["time_to_target_value_seconds"] = round(tt["seconds"], 3)
+            out["time_to_target_value"] = round(tt["target_value"], 6)
+            out["time_to_target_passes"] = tt["passes"]
+        out["criteo_stream_ledger_dir"] = led.directory
+        out["criteo_stream_run_id"] = led.manifest.get("run_id")
     return out
 
 
@@ -286,6 +306,14 @@ def main():
     ap.add_argument("--metrics-dump", default=None,
                     help="Prometheus-text metrics output (default: "
                          "<trace-out>.prom when tracing is on)")
+    ap.add_argument("--ledger-dir", default="criteo-stream-ledger",
+                    help="run-ledger directory (ON by default — the "
+                         "flagship's convergence curve is exactly the "
+                         "evidence the papers report; pass '' to "
+                         "disable). A crash-rerun with the same dir "
+                         "APPENDS after identity validation; inspect "
+                         "live with `photon-obs tail` "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -304,6 +332,23 @@ def main():
     if trace_out or metrics_dump:
         obs.enable(trace=bool(trace_out), metrics=True,
                    spill=(trace_out + ".spill") if trace_out else None)
+    led = None
+    if args.ledger_dir:
+        # Run ledger by default (resume-appending — the crash-rerun
+        # story matches --checkpoint-dir): the fit's convergence curve
+        # survives any exit, `photon-obs tail` watches it live.
+        from photon_ml_tpu.obs.ledger import build_manifest
+
+        led = obs.RunLedger.resume(args.ledger_dir, manifest=build_manifest(
+            config={"flagship": "criteo_stream", "rows": args.rows,
+                    "features": args.features, "entities": args.entities,
+                    "chunk_rows": args.chunk_rows, "pin_gb": args.pin_gb,
+                    "iterations": args.iterations,
+                    "fe_iters": args.fe_iters}))
+        obs.set_ledger(led)
+        log(f"run ledger -> {args.ledger_dir} (photon-obs tail "
+            f"{args.ledger_dir})")
+    status = "error"
     try:
         out = run_criteo_stream(
             n_rows=args.rows, d=args.features, n_entities=args.entities,
@@ -311,9 +356,13 @@ def main():
             pin_gb=args.pin_gb, iterations=args.iterations,
             fe_opt_iters=args.fe_iters,
             checkpoint_dir=args.checkpoint_dir, log=log)
+        status = "ok"
     finally:
         # Dump in a finally: a crashed flagship leaves its timeline —
         # the round-5 run lost exactly this evidence to a worker crash.
+        if led is not None:
+            led.close(status=status)
+            obs.set_ledger(None)
         if trace_out:
             obs.dump_trace(trace_out)
             log(f"trace -> {trace_out} (photon-obs summarize "

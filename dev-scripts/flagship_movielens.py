@@ -30,8 +30,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import contextlib
+
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
 enable_compilation_cache()
@@ -103,11 +106,23 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
         log(f"  {name} staged in {staging[name]:.1f}s")
     seq = ["fixed", "per-user", "per-item"]
 
+    # The script re-runs descent for slope timing; each run's ledger
+    # rows carry a distinct phase label so time-to-target is computed
+    # over the ONE descent that produced the final model.
+    phase_counter = [0]
+    last_phase = [None]
+
     def run_cd(iters, validation_fn=None):
+        led = obs.ledger()
+        phase_counter[0] += 1
+        last_phase[0] = f"descent-{phase_counter[0]}"
+        bound = (led.bound(phase=last_phase[0]) if led is not None
+                 else contextlib.nullcontext())
         cd = descent.CoordinateDescentConfig(seq, iterations=iters)
         t0 = time.perf_counter()
-        model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, coords, cd,
-                               validation_fn=validation_fn)
+        with bound:
+            model, _ = descent.run(TaskType.LOGISTIC_REGRESSION, coords,
+                                   cd, validation_fn=validation_fn)
         np.asarray(model.models["fixed"].coefficients.means)
         np.asarray(model.models["per-user"].means[:1])
         return time.perf_counter() - t0, model
@@ -149,6 +164,27 @@ def run_flagship(n_rows=20_000_000, n_users=138_000, n_items=27_000,
     }
     if per_sweep is not None:
         out["game_cd_iteration_seconds_20m"] = round(per_sweep, 3)
+
+    led = obs.ledger()
+    if led is not None:
+        # Time-to-target READ FROM the run ledger — wall resolution is
+        # the coordinate update (compiled fits spill their histories
+        # post-fit), which is the right granularity for a descent whose
+        # unit of progress IS the update.
+        from photon_ml_tpu.obs.ledger import (convergence_curves,
+                                              read_rows,
+                                              time_to_fraction)
+
+        led.flush()
+        rows, _ = read_rows(led.directory)
+        rows = [r for r in rows if r.get("phase") == last_phase[0]]
+        curve = convergence_curves(rows).get("fixed")
+        tt = time_to_fraction(curve) if curve else None
+        if tt is not None:
+            out["time_to_target_value_seconds"] = round(tt["seconds"], 3)
+            out["time_to_target_value"] = round(tt["target_value"], 6)
+        out["flagship_ledger_dir"] = led.directory
+        out["flagship_run_id"] = led.manifest.get("run_id")
 
     if validate_each:
         assert per_sweep is not None, \
@@ -210,16 +246,40 @@ def main():
     ap.add_argument("--seed", type=int, default=2026,
                     help="data-generation seed (dtype_parity.py sweeps "
                          "this so the bf16 anchor is multi-seed)")
+    ap.add_argument("--ledger-dir", default="movielens-ledger",
+                    help="run-ledger directory (ON by default; '' "
+                         "disables). A rerun with the same dir appends "
+                         "after identity validation; inspect with "
+                         "`photon-obs tail/diff` (docs/OBSERVABILITY.md)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line instead of prose")
     args = ap.parse_args()
     log = (lambda m: print(f"[flagship {time.strftime('%H:%M:%S')}] {m}",
                            file=sys.stderr, flush=True))
-    out = run_flagship(
-        n_rows=args.rows, n_users=args.users, n_items=args.items,
-        feature_dtype="bfloat16" if args.bf16 else "float32",
-        max_samples=args.max_samples, validate_each=args.validate_each,
-        quality_only=args.quality_only, seed=args.seed, log=log)
+    led = None
+    if args.ledger_dir:
+        from photon_ml_tpu.obs.ledger import build_manifest
+
+        led = obs.RunLedger.resume(args.ledger_dir, manifest=build_manifest(
+            config={"flagship": "movielens", "rows": args.rows,
+                    "users": args.users, "items": args.items,
+                    "bf16": args.bf16, "max_samples": args.max_samples,
+                    "seed": args.seed}))
+        obs.set_ledger(led)
+        log(f"run ledger -> {args.ledger_dir} (photon-obs tail "
+            f"{args.ledger_dir})")
+    status = "error"
+    try:
+        out = run_flagship(
+            n_rows=args.rows, n_users=args.users, n_items=args.items,
+            feature_dtype="bfloat16" if args.bf16 else "float32",
+            max_samples=args.max_samples, validate_each=args.validate_each,
+            quality_only=args.quality_only, seed=args.seed, log=log)
+        status = "ok"
+    finally:
+        if led is not None:
+            led.close(status=status)
+            obs.set_ledger(None)
     if args.json:
         print(json.dumps(out))
     else:

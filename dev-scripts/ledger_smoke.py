@@ -1,0 +1,120 @@
+"""CI ledger smoke: a tiny fit must leave a healthy run ledger
+(ISSUE 9 satellite: run_tier1.sh gains this step).
+
+Asserts, in order:
+
+1. a tiny ``game_train`` run writes a ledger by default
+   (``<output-dir>/ledger``) whose manifest is CRC-committed and whose
+   rows are contiguous, CRC-clean, and monotone (``verify_ledger``);
+2. the expected row kinds are present — live/spilled ``opt_iter``
+   convergence rows, ``coordinate_update`` rows, and the clean
+   ``run_end`` marker — and the manifest carries the run identity
+   stamped from the checkpoint-fingerprint machinery;
+3. ``photon-obs tail`` renders the finished run;
+4. ``photon-obs diff`` of the run AGAINST ITSELF reports zero
+   regression: no config delta and a time-to-target ratio of exactly
+   1.0 (the convergence gate's fixed point).
+
+Runs on CPU in seconds — wired into dev-scripts/run_tier1.sh after the
+trace smokes.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.cli.obs import render_diff, render_tail, tail_ledger
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.data.io import save_game_dataset
+    from photon_ml_tpu.obs.ledger import (diff_ledgers, read_manifest,
+                                          read_rows, verify_ledger)
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory(prefix="pml_ledger_smoke_") as td:
+        train_dir = os.path.join(td, "train")
+        save_game_dataset(from_synthetic(synthetic.game_data(
+            rng, n=256, d_global=6, re_specs={"userId": (8, 3)})),
+            train_dir)
+        out_dir = os.path.join(td, "out")
+        summary = game_train.run(game_train.build_parser().parse_args([
+            "--train", train_dir,
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--coordinate",
+            "name=per-user,type=random,shard=re_userId,re=userId",
+            "--update-sequence", "fixed,per-user",
+            "--iterations", "1",
+            "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--opt-config",
+            "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--output-dir", out_dir,
+        ]))
+        ledger_dir = os.path.join(out_dir, "ledger")
+        assert summary.get("ledger", {}).get("dir") == ledger_dir, \
+            f"summary has no ledger pointer: {summary.get('ledger')}"
+
+        # (1) structural health: the CI contract photon-obs verify gates.
+        problems = verify_ledger(ledger_dir)
+        if problems:
+            print("ledger verification FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+
+        # (2) the rows a fit must produce, and the stamped identity.
+        rows, _ = read_rows(ledger_dir)
+        kinds = {r["kind"] for r in rows}
+        for expected in ("opt_iter", "coordinate_update", "run_end"):
+            assert expected in kinds, \
+                f"row kind {expected!r} missing (have {sorted(kinds)})"
+        seqs = [r["seq"] for r in rows]
+        assert seqs == list(range(len(rows))), "seq not contiguous"
+        manifest = read_manifest(ledger_dir)
+        assert manifest.get("identity"), \
+            "run identity was never stamped from the fingerprint"
+        assert rows[-1]["kind"] == "run_end" and \
+            rows[-1].get("status") == "ok", "no clean run_end marker"
+
+        # (3) tail renders the finished run.
+        tail = tail_ledger(ledger_dir)
+        assert tail["status"].startswith("finished"), tail["status"]
+        render_tail(tail)
+
+        # (4) diff run-vs-itself = zero regression, by construction.
+        twin = os.path.join(td, "ledger-twin")
+        shutil.copytree(ledger_dir, twin)
+        diff = diff_ledgers(ledger_dir, twin)
+        assert diff["config_delta"] == [], \
+            f"self-diff found config delta: {diff['config_delta']}"
+        gated = 0
+        for coord, entry in diff["coordinates"].items():
+            ratio = entry.get("time_to_target_ratio")
+            if ratio is None:
+                continue
+            gated += 1
+            assert abs(ratio - 1.0) < 1e-9, \
+                f"self-diff time-to-target ratio {ratio} != 1.0 ({coord})"
+            assert entry["final_value_delta"] == 0.0, \
+                f"self-diff final-value delta nonzero ({coord})"
+        assert gated >= 1, "self-diff gated no coordinate"
+        render_diff(diff)
+        print(f"ledger smoke ok: {len(rows)} rows, kinds "
+              f"{sorted(kinds)}, identity "
+              f"{manifest['identity'][:12]}, self-diff ratio 1.0 over "
+              f"{gated} coordinate(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

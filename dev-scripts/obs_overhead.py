@@ -62,6 +62,15 @@ coord = StreamingSparseFixedEffectCoordinate(
     ds, chunked, "global", losses.LOGISTIC, cfg)
 if mode == "on":
     obs.enable()
+elif mode == "ledger":
+    # Ledger-only arm: no tracer/metrics — the measured delta is the
+    # run ledger's per-iteration record+append alone.
+    import tempfile
+    from photon_ml_tpu.obs.ledger import build_manifest
+    led = obs.RunLedger.resume(
+        tempfile.mkdtemp(prefix="pml_obs_overhead_ledger_"),
+        manifest=build_manifest(config={"arm": "ledger"}))
+    obs.set_ledger(led)
 off = np.zeros(ds.num_rows, np.float32)
 coord.train_model(off)  # warm-up: compiles
 best = None
@@ -147,6 +156,12 @@ def main():
     ap.add_argument("--serving", action="store_true",
                     help="measure the serving request path instead of "
                          "the streamed fit")
+    ap.add_argument("--ledger", action="store_true",
+                    help="third arm: streamed fit with ONLY the run "
+                         "ledger active (no tracer/metrics) — proves "
+                         "the per-iteration record+append stays inside "
+                         "the established 0.95-1.05 jitter band "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--requests", type=int, default=2000,
                     help="closed-loop requests per serving arm")
     ap.add_argument("--json", action="store_true")
@@ -178,7 +193,8 @@ def main():
             for k, v in summary.items():
                 print(f"{k}: {v}")
         return
-    for mode in ("off", "on"):
+    modes = ("off", "on", "ledger") if args.ledger else ("off", "on")
+    for mode in modes:
         log(f"streamed fit with obs {mode} (fresh subprocess, "
             f"min of {args.min_of})")
         arms[mode] = run_arm(mode, args.rows, args.chunk_rows,
@@ -193,6 +209,11 @@ def main():
         "streamed_fit_seconds_obs_on": round(arms["on"]["seconds"], 4),
         "obs_on_over_off_ratio": round(ratio, 4),
     }
+    if "ledger" in arms:
+        summary["streamed_fit_seconds_ledger_on"] = round(
+            arms["ledger"]["seconds"], 4)
+        summary["ledger_on_over_off_ratio"] = round(
+            arms["ledger"]["seconds"] / arms["off"]["seconds"], 4)
     if args.json:
         print(json.dumps(summary))
     else:

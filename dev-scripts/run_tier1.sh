@@ -26,6 +26,13 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/serving_trace_smoke.py; rc=$?
 fi
 
+# Ledger smoke (docs/OBSERVABILITY.md "The run ledger"): a tiny fit
+# must leave a CRC-committed, seq-contiguous run ledger whose
+# run-vs-itself diff reports zero convergence regression. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/ledger_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

@@ -29,4 +29,7 @@ ALL_RULES = {
     "PML009": (obs_discipline.check_raw_span_discipline,
                "raw tracer span begin/end without a with/finally "
                "guarantee"),
+    "PML010": (obs_discipline.check_ledger_io_discipline,
+               "raw telemetry/artifact write inside a loop (use the "
+               "buffered run-ledger API)"),
 }

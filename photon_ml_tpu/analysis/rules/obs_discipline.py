@@ -1,4 +1,6 @@
-"""PML009 — raw tracer span opened outside a ``with``/``finally``.
+"""PML009/PML010 — observability-discipline rules.
+
+PML009 — raw tracer span opened outside a ``with``/``finally``.
 
 The obs tracing API (photon_ml_tpu/obs) is finally-safe by construction
 through its context manager: ``with tracer.span("name"): ...``. The raw
@@ -23,6 +25,24 @@ The rule (the PML007 pairing discipline extended to the span API):
 "Tracer-ish" receivers are names whose last segment contains ``tracer``
 (``tracer``, ``self._tracer``, ``worker_tracer``) — the repo's naming
 convention for obs.Tracer handles, asserted by the obs module itself.
+
+PML010 — raw telemetry/artifact I/O inside a loop.
+
+The run ledger (obs/ledger.py) exists so per-iteration telemetry costs
+one buffered ``led.record(...)`` per row — the PML001 host-sync
+discipline applied to I/O: a raw ``open(..., "w")``/``json.dump``/
+``np.save`` inside an optimizer or descent loop re-opens a file (or
+rewrites a whole JSON document) once per iteration, serializes the loop
+on the filesystem, and — unlike the ledger — leaves no CRC'd
+crash-consistent prefix. The rule flags, at loop depth >= 1:
+
+- ``open(...)`` whose mode contains ``w``/``a``/``x``/``+``;
+- ``json.dump(...)`` (the file-writing form; ``dumps`` is fine);
+- ``np.save``/``np.savez``/``np.savez_compressed``.
+
+Reads in loops are untouched; writes at loop depth 0 (per-call
+artifacts like checkpoint commits) are untouched; the ledger API and
+``atomic_write`` helpers don't match the patterns by construction.
 """
 
 from __future__ import annotations
@@ -32,7 +52,10 @@ from typing import Optional
 
 from photon_ml_tpu.analysis.context import ModuleContext
 from photon_ml_tpu.analysis.findings import Finding
-from photon_ml_tpu.analysis.taint import dotted_name, function_bodies
+from photon_ml_tpu.analysis.rules._walk import (scope_statements,
+                                                statement_exprs)
+from photon_ml_tpu.analysis.taint import (call_func_name, dotted_name,
+                                          function_bodies)
 
 
 def _tracer_start(node: ast.AST) -> bool:
@@ -114,4 +137,73 @@ def check_raw_span_discipline(ctx: ModuleContext) -> list[Finding]:
                     f"raw tracer.start() in {owner.name}() with no "
                     f".end() anywhere in this module — every span needs "
                     f"a guaranteed close; use `with tracer.span(...)`"))
+    return out
+
+
+# ---------------------------------------------------------------- PML010
+
+
+_NP_SAVERS = {"save", "savez", "savez_compressed"}
+_WRITE_MODE_CHARS = set("wax+")
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The write-ish mode string of an ``open(...)`` call, or None when
+    it is a read (default mode, explicit 'r'/'rb', or a dynamic mode —
+    dynamic modes are given the benefit of the doubt)."""
+    if call_func_name(call) not in ("open", "io.open", "os.fdopen",
+                                    "gzip.open"):
+        return None
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if not (isinstance(mode_node, ast.Constant)
+            and isinstance(mode_node.value, str)):
+        return None
+    mode = mode_node.value
+    return mode if set(mode) & _WRITE_MODE_CHARS else None
+
+
+def _telemetry_write_message(call: ast.Call) -> Optional[str]:
+    mode = _open_write_mode(call)
+    if mode is not None:
+        return (f"open(..., {mode!r}) inside a loop re-opens a file "
+                f"every iteration; per-iteration telemetry goes through "
+                f"the buffered run-ledger API "
+                f"(obs/ledger.RunLedger.record) — or hoist the open out "
+                f"of the loop")
+    name = call_func_name(call)
+    if name in ("json.dump",):
+        return ("json.dump inside a loop rewrites a document every "
+                "iteration; per-iteration telemetry goes through the "
+                "buffered run-ledger API (obs/ledger.RunLedger.record, "
+                "one CRC'd JSONL row per record)")
+    if name is not None:
+        head, _, tail = name.rpartition(".")
+        if head in ("np", "numpy") and tail in _NP_SAVERS:
+            return (f"{name} inside a loop writes an artifact every "
+                    f"iteration; batch the save outside the loop or "
+                    f"route telemetry through the run ledger "
+                    f"(obs/ledger.py)")
+    return None
+
+
+def check_ledger_io_discipline(ctx: ModuleContext) -> list[Finding]:
+    """PML010: raw telemetry/artifact writes inside loops must go
+    through the buffered ledger API (the PML001 host-sync discipline
+    applied to telemetry I/O)."""
+    out: list[Finding] = []
+    for _owner, body in function_bodies(ctx.tree):
+        for stmt, depth in scope_statements(body):
+            if depth == 0:
+                continue
+            for node in statement_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _telemetry_write_message(node)
+                if msg:
+                    out.append(ctx.finding("PML010", node, msg))
     return out

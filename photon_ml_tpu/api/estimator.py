@@ -11,6 +11,7 @@ config)]``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -69,6 +70,8 @@ class GameEstimator:
         ingest: Optional[IngestConfig] = None,
         streaming: Optional[StreamingConfig] = None,
         trace=None,
+        ledger_dir: Optional[str] = None,
+        watchdog=None,
     ):
         self.task = TaskType(task)
         self.coordinate_configs = coordinates
@@ -102,6 +105,16 @@ class GameEstimator:
         # the same timeline `game_train --trace-out` produces, without
         # going through the CLI. None (the default) costs nothing.
         self.trace = trace
+        # Run ledger (docs/OBSERVABILITY.md "The run ledger"): when set,
+        # each fit() writes convergence telemetry under this directory —
+        # manifest + append-as-produced per-iteration rows. Reuses an
+        # already-active ledger (the game_train driver's, a tuning
+        # trial's parent) instead of opening a second one.
+        self.ledger_dir = ledger_dir
+        # Convergence watchdogs (obs/watchdog.py): a WatchdogConfig
+        # armed for the duration of fit(). None (default) = every
+        # optimizer site pays one None check.
+        self.watchdog = watchdog
         self.loss = losses_mod.loss_for_task(self.task)
         # (cache key, coords) of the last fit — lets repeated fits on the
         # SAME dataset (hyperparameter tuning trials) swap optimization
@@ -268,17 +281,75 @@ class GameEstimator:
         that tracer (an ``estimator.fit`` root span; staging, descent
         updates, streamed passes and checkpoint writes nest below it) —
         dump it afterwards with ``trace.dump(path)``.
+
+        With ``GameEstimator(ledger_dir=...)`` set, the fit records a
+        run ledger there (resume-appending when one with the same run
+        identity already exists); ``GameEstimator(watchdog=...)`` arms
+        the convergence watchdogs for the duration
+        (docs/OBSERVABILITY.md "The run ledger").
         """
         from photon_ml_tpu import obs
 
-        if self.trace is None:
-            return self._fit(data, validation_data, initial_models,
-                             locked_coordinates, checkpoint_dir)
-        with obs.activated(trace_obj=self.trace):
-            with obs.span("estimator.fit", cat="driver",
-                          coordinates=list(self.coordinate_configs)):
+        with contextlib.ExitStack() as stack:
+            if self.watchdog is not None:
+                prev_wd = obs.set_watchdog(self.watchdog)
+                stack.callback(obs.set_watchdog, prev_wd)
+            if self.ledger_dir and obs.ledger() is None:
+                import jax
+
+                if jax.process_index() == 0:
+                    # Open (or resume-append) this fit's run ledger —
+                    # unless the driver already installed one, which
+                    # every row then lands in (the tuning-trial case).
+                    # One writer per shared filesystem: rank 0 only.
+                    led = obs.RunLedger.resume(
+                        self.ledger_dir, manifest=self.ledger_manifest())
+                    prev_led = obs.set_ledger(led)
+                    stack.callback(obs.set_ledger, prev_led)
+
+                    # Closed via the stack even when the fit raises — a
+                    # crashed fit keeps its curve prefix, stamped with
+                    # how it ended.
+                    def _close(exc_type, exc, tb, _led=led):
+                        _led.close(status="ok" if exc_type is None
+                                   else "error")
+                        return False
+
+                    stack.push(_close)
+            if self.trace is None:
                 return self._fit(data, validation_data, initial_models,
                                  locked_coordinates, checkpoint_dir)
+            stack.enter_context(obs.activated(trace_obj=self.trace))
+            stack.enter_context(
+                obs.span("estimator.fit", cat="driver",
+                         coordinates=list(self.coordinate_configs)))
+            return self._fit(data, validation_data, initial_models,
+                             locked_coordinates, checkpoint_dir)
+
+    def ledger_manifest(self) -> dict:
+        """Creator-side run-ledger manifest: the configuration this
+        estimator can describe up front (game_train reuses it when the
+        DRIVER owns the ledger). Run IDENTITY (dataset digest etc.) is
+        stamped by descent.run's fingerprint machinery at the first
+        update."""
+        from photon_ml_tpu.obs.ledger import build_manifest
+
+        config = {
+            "task": self.task.value,
+            "update_sequence": list(self.update_sequence),
+            "iterations": self.descent_iterations,
+            "coordinates": {
+                cid: {"data": descent._jsonable(cc.data),
+                      "optimization": descent._jsonable(cc.optimization),
+                      "reg_weight_grid": list(cc.reg_weight_grid)}
+                for cid, cc in self.coordinate_configs.items()},
+            "streaming": descent._jsonable(self.streaming),
+            "normalization": {
+                s: descent.normalization_digest(ctx)
+                for s, ctx in self.normalization.items()},
+        }
+        return build_manifest(
+            config=config, mesh_shape=dict(self.mesh.shape))
 
     def _fit(
         self,
@@ -410,14 +481,19 @@ class GameEstimator:
             manager = (CheckpointManager(
                 os.path.join(checkpoint_dir, f"grid-{grid_index}"))
                 if checkpoint_dir else None)
-            model, history = descent.run(
-                self.task, coords,
-                descent.CoordinateDescentConfig(
-                    self.update_sequence, self.descent_iterations),
-                initial_models=initial_models,
-                locked_coordinates=locked_coordinates,
-                validation_fn=val_fn,
-                checkpoint_manager=manager)
+            from photon_ml_tpu import obs
+            led = obs.ledger()
+            bound = (led.bound(grid=grid_index) if led is not None
+                     else contextlib.nullcontext())
+            with bound:
+                model, history = descent.run(
+                    self.task, coords,
+                    descent.CoordinateDescentConfig(
+                        self.update_sequence, self.descent_iterations),
+                    initial_models=initial_models,
+                    locked_coordinates=locked_coordinates,
+                    validation_fn=val_fn,
+                    checkpoint_manager=manager)
             model = self._finalize_variances(model, coords, data)
             evaluation = (self._evaluate(model, validation_data)
                           if validation_data is not None else None)

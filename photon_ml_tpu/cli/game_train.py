@@ -18,6 +18,7 @@ strings, e.g.:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import logging
@@ -204,7 +205,65 @@ def build_parser() -> argparse.ArgumentParser:
                         "as Prometheus text at exit — the batch-run "
                         "form of the serving /metrics endpoint "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--ledger-dir", default=None,
+                   help="run-ledger directory (docs/OBSERVABILITY.md "
+                        "\"The run ledger\"): manifest + append-as-"
+                        "produced per-iteration convergence telemetry, "
+                        "committed under the atomic-marker/CRC "
+                        "discipline so a crashed run keeps its curve. "
+                        "Default: <output-dir>/ledger; pass '' to "
+                        "disable. A fresh run replaces a stale ledger; "
+                        "--resume validates run identity and APPENDS. "
+                        "Inspect with `photon-obs tail/diff/verify`")
+    p.add_argument("--watchdog", nargs="?", const="",
+                   help="arm the convergence watchdogs "
+                        "(obs/watchdog.py): 'nan=raise|warn|stop|off,"
+                        "stall=K[:action],divergence=F[:action],"
+                        "slow_iter=F[:action]'. Bare --watchdog arms "
+                        "the NaN detector (raise). Off by default at "
+                        "one None check per optimizer iteration")
     return p
+
+
+def _arm_observability(args, stack, is_primary, est) -> None:
+    """Install the run ledger + convergence watchdogs for the span of
+    the fit/tuning phase (docs/OBSERVABILITY.md "The run ledger").
+
+    The ledger defaults ON (``<output-dir>/ledger``; ``--ledger-dir ''``
+    disables): every ``game_train`` run leaves its convergence curve on
+    disk. A fresh run replaces a stale ledger (exactly the checkpoint
+    cleanup discipline); ``--resume`` appends after descent validates
+    run identity against the checkpoint fingerprint. Rank 0 only — one
+    writer per shared filesystem; close() runs via the stack so a
+    crashed fit keeps its curve prefix.
+    """
+    from photon_ml_tpu import obs
+
+    spec = getattr(args, "watchdog", None)
+    if spec is not None:
+        prev_wd = obs.set_watchdog(obs.parse_watchdog_config(spec))
+        stack.callback(obs.set_watchdog, prev_wd)
+    ledger_dir = getattr(args, "ledger_dir", None)
+    if ledger_dir is None:
+        ledger_dir = os.path.join(args.output_dir, "ledger")
+    if not ledger_dir or not is_primary:
+        return
+    if not getattr(args, "resume", False) and os.path.exists(ledger_dir):
+        import shutil
+
+        logger.info("fresh run: removing stale run ledger at %s",
+                    ledger_dir)
+        shutil.rmtree(ledger_dir)
+    led = obs.RunLedger.resume(ledger_dir,
+                               manifest=est.ledger_manifest())
+    prev_led = obs.set_ledger(led)
+
+    def _close(exc_type, exc, tb):
+        led.close(status="ok" if exc_type is None else "error")
+        obs.set_ledger(prev_led)
+        return False
+
+    stack.push(_close)
 
 
 def _load_dataset(path: str, num_features=None):
@@ -515,54 +574,67 @@ def _run(args) -> dict:
 
     from photon_ml_tpu.utils.logging import profile_trace
 
-    with profile_trace(getattr(args, "profile_dir", None)):
-        results = est.fit(train, validation, initial_models=initial_models,
-                          locked_coordinates=locked or None,
-                          checkpoint_dir=checkpoint_dir)
+    with contextlib.ExitStack() as obs_stack:
+        _arm_observability(args, obs_stack, is_primary, est)
+        from photon_ml_tpu import obs
 
-    tuning_summary = None
-    if args.tuning != "NONE":
-        # Reference: GameTrainingDriver's hyperparameter-tuning mode — the
-        # grid results seed the search as prior observations, then RANDOM /
-        # BAYESIAN (GP + expected improvement) trials refine the
-        # per-coordinate regularization weights on the validation metric.
-        from photon_ml_tpu.hyperparameter.evaluation import \
-            GameEvaluationFunction
-        from photon_ml_tpu.hyperparameter.search import (
-            GaussianProcessSearch, RandomSearch)
-        from photon_ml_tpu.utils.ranges import DoubleRange
+        led = obs.ledger()
+        ledger_info = (None if led is None else
+                       {"dir": led.directory,
+                        "run_id": led.manifest.get("run_id")})
 
-        lo, _, hi = args.tuning_range.partition(":")
-        evalfn = GameEvaluationFunction(
-            est, train, validation,
-            coordinate_ids=[c for c in est.update_sequence
-                            if c not in locked],
-            reg_weight_range=DoubleRange(float(lo), float(hi)),
-            initial_models=initial_models,
-            locked_coordinates=locked or None)
-        dims = evalfn.dimensions()
-        searcher_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"
-                        else RandomSearch)
-        searcher = searcher_cls(dims, evalfn)
-        priors = evalfn.observations_from_results(results)
-        search = searcher.find_with_priors(args.tuning_iters, priors)
-        best_trial = evalfn.best_trial()
-        if (best_trial is not None
-                and best_trial[0] <= search.best_value + 1e-12):
-            # The winning trial's model was already trained during the
-            # search — reuse it instead of refitting an (n+1)-th time.
-            results = results + best_trial[2]
-        # else: the winner is a grid prior, already present in `results`.
-        tuning_summary = {
-            "mode": args.tuning,
-            "iterations": args.tuning_iters,
-            "best_config": search.best_config(dims),
-            "trials": [
-                {"point": {d.name: float(p)
-                           for d, p in zip(dims, o.point)},
-                 "objective": float(o.value)}
-                for o in search.observations],
-        }
+        with profile_trace(getattr(args, "profile_dir", None)):
+            results = est.fit(train, validation,
+                              initial_models=initial_models,
+                              locked_coordinates=locked or None,
+                              checkpoint_dir=checkpoint_dir)
+
+        tuning_summary = None
+        if args.tuning != "NONE":
+            # Reference: GameTrainingDriver's hyperparameter-tuning mode
+            # — the grid results seed the search as prior observations,
+            # then RANDOM / BAYESIAN (GP + expected improvement) trials
+            # refine the per-coordinate regularization weights on the
+            # validation metric. The search runs INSIDE the ledger
+            # scope: per-trial rows land in the same run ledger.
+            from photon_ml_tpu.hyperparameter.evaluation import \
+                GameEvaluationFunction
+            from photon_ml_tpu.hyperparameter.search import (
+                GaussianProcessSearch, RandomSearch)
+            from photon_ml_tpu.utils.ranges import DoubleRange
+
+            lo, _, hi = args.tuning_range.partition(":")
+            evalfn = GameEvaluationFunction(
+                est, train, validation,
+                coordinate_ids=[c for c in est.update_sequence
+                                if c not in locked],
+                reg_weight_range=DoubleRange(float(lo), float(hi)),
+                initial_models=initial_models,
+                locked_coordinates=locked or None)
+            dims = evalfn.dimensions()
+            searcher_cls = (GaussianProcessSearch
+                            if args.tuning == "BAYESIAN" else RandomSearch)
+            searcher = searcher_cls(dims, evalfn)
+            priors = evalfn.observations_from_results(results)
+            search = searcher.find_with_priors(args.tuning_iters, priors)
+            best_trial = evalfn.best_trial()
+            if (best_trial is not None
+                    and best_trial[0] <= search.best_value + 1e-12):
+                # The winning trial's model was already trained during
+                # the search — reuse it instead of refitting an
+                # (n+1)-th time.
+                results = results + best_trial[2]
+            # else: the winner is a grid prior, already in `results`.
+            tuning_summary = {
+                "mode": args.tuning,
+                "iterations": args.tuning_iters,
+                "best_config": search.best_config(dims),
+                "trials": [
+                    {"point": {d.name: float(p)
+                               for d, p in zip(dims, o.point)},
+                     "objective": float(o.value)}
+                    for o in search.observations],
+            }
 
     best = est.select_best_model(results)
 
@@ -606,6 +678,9 @@ def _run(args) -> dict:
             for r in results],
         "best_metrics": (best.evaluation.metrics if best.evaluation else None),
         "tuning": tuning_summary,
+        # Provenance pointer: summary and convergence curve are the
+        # same run (photon-obs tail/diff on this directory).
+        "ledger": ledger_info,
         "wall_seconds": time.perf_counter() - t0,
     }
     if is_primary:

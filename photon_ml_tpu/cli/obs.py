@@ -1,4 +1,4 @@
-"""photon-obs: trace-file tooling (docs/OBSERVABILITY.md).
+"""photon-obs: trace-file + run-ledger tooling (docs/OBSERVABILITY.md).
 
 ``photon-obs summarize trace.json`` renders the phase waterfall, the
 top-span table, and the transfer-vs-compute attribution from a Chrome
@@ -7,19 +7,36 @@ trace-event file produced by ``game_train --trace-out`` /
 machine-checkable replacement for the hand-computed subtraction that
 produced the "~95% host→device transfer" figure.
 
-``photon-obs verify trace.json`` is the CI smoke contract (run_tier1.sh):
-the JSON loads, spans nest (parents resolve and contain their children),
-and every bridged Start/Finish pair produced a CLOSED span.
+``photon-obs tail <ledger-dir>`` renders a LIVE run from its run ledger
+(obs/ledger.py): current coordinate/iteration, objective value, an ETA
+from the iteration-time EMA, and the transfer fraction — the flagship is
+no longer a black box until it exits.
 
-Pure stdlib — no JAX, no numpy — so it runs anywhere the lint CLI does.
+``photon-obs diff <runA> <runB>`` compares two ledgers: config delta,
+value-vs-wall-clock and value-vs-passes convergence overlay,
+time-to-target-value, final metric deltas — the instrument ROADMAP items
+2/5 need before "warm-start day N+1" claims are checkable.
+
+``photon-obs verify <trace.json | ledger-dir>`` is the CI smoke contract
+(run_tier1.sh): traces must load with closed, properly nested spans;
+ledgers must have a CRC-committed manifest and contiguous, CRC-clean,
+monotone telemetry rows.
+
+No JAX anywhere on these paths — the CLI runs on a box that has never
+seen an accelerator.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
+
+from photon_ml_tpu.obs.ledger import (LedgerError, diff_ledgers,
+                                      read_manifest, read_rows,
+                                      verify_ledger)
 
 # Child spans may start marginally before their parent's exported ts:
 # the parent's wall anchor and the child's are sampled by different
@@ -284,6 +301,221 @@ def render_summary(summary: dict) -> str:
     return "\n".join(out)
 
 
+# -- run-ledger views (docs/OBSERVABILITY.md "The run ledger") --------------
+
+
+def _find_max_iterations(node, coordinate: Optional[str]) -> Optional[int]:
+    """Best-effort ``max_iterations`` for the coordinate from the
+    manifest config tree (for the tail ETA; None when undiscoverable)."""
+    if isinstance(node, dict):
+        coords = node.get("coordinates")
+        if coordinate and isinstance(coords, dict) \
+                and coordinate in coords:
+            node = coords[coordinate]
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, dict):
+                if isinstance(cur.get("max_iterations"), int):
+                    return cur["max_iterations"]
+                stack.extend(cur.values())
+            elif isinstance(cur, list):
+                stack.extend(cur)
+    return None
+
+
+def tail_ledger(directory: str) -> dict:
+    """Snapshot of a (possibly live) run from its ledger: run identity,
+    last position, iteration-time EMA + ETA, transfer fraction."""
+    manifest = read_manifest(directory)
+    if manifest is None:
+        raise LedgerError(f"no run ledger at {directory}")
+    rows, problems = read_rows(directory)
+    out: dict = {
+        "run_id": manifest.get("run_id"),
+        "identity": manifest.get("identity"),
+        "rows": len(rows),
+        "problems": problems,
+        "status": "in progress (or killed)",
+    }
+    ends = [r for r in rows if r.get("kind") == "run_end"]
+    if ends:
+        out["status"] = f"finished ({ends[-1].get('status', 'ok')})"
+    if rows:
+        out["wall_seconds"] = float(rows[-1]["t"])
+    alerts = [r for r in rows if r.get("kind") == "watchdog"]
+    if alerts:
+        out["watchdog_alerts"] = [
+            {"kind": a.get("watchdog_kind"), "action": a.get("action"),
+             "detail": a.get("detail")} for a in alerts]
+    iters = [r for r in rows if r.get("kind") == "opt_iter"]
+    updates = [r for r in rows if r.get("kind") == "coordinate_update"]
+    if updates:
+        out["completed_updates"] = len(updates)
+    trials = [r for r in rows if r.get("kind") == "tuning_trial"]
+    if trials:
+        out["tuning_trials"] = len(trials)
+    if not iters:
+        return out
+    last = iters[-1]
+    cur: dict = {
+        "coordinate": last.get("coordinate"),
+        "outer_iteration": last.get("outer_iteration"),
+        "iteration": last.get("iteration"),
+        "value": last.get("value"),
+        "grad_norm": last.get("grad_norm"),
+    }
+    # Iteration-time EMA over the live rows of the current coordinate
+    # (post_fit spills carry no per-iteration wall).
+    live = [r for r in iters
+            if r.get("coordinate") == last.get("coordinate")
+            and r.get("seconds") is not None]
+    if live:
+        ema = None
+        for r in live:
+            s = float(r["seconds"])
+            ema = s if ema is None else 0.7 * ema + 0.3 * s
+        cur["iteration_seconds_ema"] = round(ema, 4)
+        max_it = _find_max_iterations(manifest.get("config"),
+                                      last.get("coordinate"))
+        if max_it and last.get("iteration") is not None:
+            remaining = max(0, max_it - int(last["iteration"]))
+            cur["max_iterations"] = max_it
+            cur["eta_seconds"] = round(remaining * ema, 1)
+    if last.get("transfer_seconds") is not None and \
+            float(last["t"]) > 0:
+        cur["transfer_fraction_of_wall"] = round(
+            float(last["transfer_seconds"]) / float(last["t"]), 4)
+    out["current"] = cur
+    return out
+
+
+def render_tail(tail: dict) -> str:
+    out = [f"run {tail.get('run_id', '?')}  [{tail['status']}]  "
+           f"{tail['rows']} rows"
+           + (f", wall {tail['wall_seconds']:.1f}s"
+              if "wall_seconds" in tail else "")]
+    if tail.get("completed_updates"):
+        out.append(f"  completed coordinate updates: "
+                   f"{tail['completed_updates']}")
+    if tail.get("tuning_trials"):
+        out.append(f"  tuning trials: {tail['tuning_trials']}")
+    cur = tail.get("current")
+    if cur:
+        pos = (f"  at: coordinate {cur.get('coordinate') or '(run)'}"
+               f" outer {cur.get('outer_iteration', '-')}"
+               f" iteration {cur.get('iteration', '-')}")
+        if cur.get("max_iterations"):
+            pos += f"/{cur['max_iterations']}"
+        out.append(pos)
+        val = cur.get("value")
+        gn = cur.get("grad_norm")
+        out.append(f"  objective {val:.6g}" if val is not None else
+                   "  objective -")
+        if gn is not None:
+            out[-1] += f"  |g| {gn:.3g}"
+        if cur.get("iteration_seconds_ema") is not None:
+            line = f"  {cur['iteration_seconds_ema']:.3g}s/iteration (EMA)"
+            if cur.get("eta_seconds") is not None:
+                line += f", ETA ~{cur['eta_seconds']:.0f}s"
+            out.append(line)
+        if cur.get("transfer_fraction_of_wall") is not None:
+            out.append(f"  transfer "
+                       f"{cur['transfer_fraction_of_wall']:.1%} of wall")
+    for a in tail.get("watchdog_alerts", []):
+        out.append(f"  WATCHDOG[{a['kind']}/{a['action']}]: {a['detail']}")
+    for p in tail.get("problems", []):
+        out.append(f"  (tail problem: {p})")
+    return "\n".join(out)
+
+
+def _overlay(curve_a: list, curve_b: list, x_key: str,
+             width: int = 56, height: int = 12) -> list[str]:
+    """Two convergence curves on one downsampled text grid
+    (A = ``a``/``*`` where they overlap, B = ``b``)."""
+    pts = [(float(p[x_key]), float(p["value"]), 0) for p in curve_a] + \
+          [(float(p[x_key]), float(p["value"]), 1) for p in curve_b]
+    if not pts:
+        return []
+    xs = [p[0] for p in pts]
+    vs = [p[1] for p in pts]
+    x_lo, x_hi = min(xs), max(xs)
+    v_lo, v_hi = min(vs), max(vs)
+    x_span = max(x_hi - x_lo, 1e-12)
+    v_span = max(v_hi - v_lo, 1e-12)
+    grid = [[" "] * width for _ in range(height)]
+    marks = ("a", "b")
+    for x, v, who in pts:
+        col = min(width - 1, int((x - x_lo) / x_span * (width - 1)))
+        row = min(height - 1, int((v_hi - v) / v_span * (height - 1)))
+        cell = grid[row][col]
+        grid[row][col] = ("*" if cell not in (" ", marks[who])
+                          else marks[who])
+    unit = "s" if x_key == "t" else " passes"
+    lines = [f"  {v_hi:>12.6g} |" + "".join(grid[0])]
+    lines += ["  " + " " * 12 + " |" + "".join(r) for r in grid[1:-1]]
+    lines.append(f"  {v_lo:>12.6g} |" + "".join(grid[-1]))
+    lines.append("  " + " " * 12 + " +" + "-" * width)
+    lines.append(f"  {'':12}  {x_lo:.3g}{unit}"
+                 f"{'':>{max(1, width - 24)}}{x_hi:.3g}{unit}")
+    return lines
+
+
+def render_diff(diff: dict) -> str:
+    out = [f"run A: {diff['a']}  (run_id {diff['run_ids']['a']})",
+           f"run B: {diff['b']}  (run_id {diff['run_ids']['b']})"]
+    for side in ("a", "b"):
+        for p in diff["problems"][side]:
+            out.append(f"  ({side} tail problem: {p})")
+    delta = diff["config_delta"]
+    if delta:
+        out += ["", f"config delta ({len(delta)} key(s)):"]
+        for d in delta[:20]:
+            out.append(f"  {d['key']}: {d['a']!r} -> {d['b']!r}")
+        if len(delta) > 20:
+            out.append(f"  ... {len(delta) - 20} more")
+    else:
+        out += ["", "config delta: none (identical configuration)"]
+    for coord, entry in diff["coordinates"].items():
+        if "curve_a" not in entry:
+            out += ["", f"coordinate {coord}: present in only one run"]
+            continue
+        out += ["", f"coordinate {coord}:"]
+        out.append(f"  final value  A {entry['final_value_a']:.6g}   "
+                   f"B {entry['final_value_b']:.6g}   "
+                   f"(delta {entry['final_value_delta']:+.3g})")
+        tta, ttb = entry["time_to_target_a"], entry["time_to_target_b"]
+        if tta and ttb:
+            out.append(
+                f"  time to target {entry['target_value']:.6g}:  "
+                f"A {tta['seconds']:.3f}s / {tta['passes']:.0f} passes   "
+                f"B {ttb['seconds']:.3f}s / {ttb['passes']:.0f} passes"
+                + (f"   (B/A {entry['time_to_target_ratio']:.2f}x)"
+                   if entry.get("time_to_target_ratio") is not None
+                   else ""))
+        out.append("  value vs wall clock (a=A, b=B, *=both):")
+        out += _overlay(entry["curve_a"], entry["curve_b"], "t")
+        out.append("  value vs streamed passes:")
+        out += _overlay(entry["curve_a"], entry["curve_b"], "passes")
+    fm = diff["final_metrics"]
+    coords = sorted(set(fm["a"]) | set(fm["b"]))
+    if coords:
+        out += ["", "final validation metrics:"]
+        for c in coords:
+            ma, mb = fm["a"].get(c, {}), fm["b"].get(c, {})
+            for metric in sorted(set(ma) | set(mb)):
+                va, vb = ma.get(metric), mb.get(metric)
+                d = ("" if va is None or vb is None
+                     else f"   (delta {vb - va:+.6g})")
+                out.append(f"  {c}/{metric}: A {va}   B {vb}{d}")
+    return "\n".join(out)
+
+
+def _is_ledger(path: str) -> bool:
+    return os.path.isdir(path) and \
+        os.path.exists(os.path.join(path, "manifest.json"))
+
+
 # -- CLI --------------------------------------------------------------------
 
 
@@ -305,14 +537,60 @@ def build_parser() -> argparse.ArgumentParser:
                         "device score / respond), and the slowest "
                         "request's waterfall (docs/SERVING.md)")
     v = sub.add_parser("verify",
-                       help="structural health check (CI smoke): spans "
-                            "closed, parents resolve, children nested")
-    v.add_argument("trace")
+                       help="structural health check (CI smoke): trace "
+                            "spans closed/nested, or — for a ledger "
+                            "directory — manifest CRC committed + "
+                            "telemetry rows contiguous and CRC-clean")
+    v.add_argument("trace", help="trace JSON or run-ledger directory")
+    t = sub.add_parser("tail",
+                       help="live view of a run from its ledger: "
+                            "current coordinate/iteration, ETA from the "
+                            "iteration-time EMA, transfer fraction")
+    t.add_argument("ledger", help="run-ledger directory "
+                                  "(game_train --ledger-dir)")
+    t.add_argument("--json", action="store_true")
+    d = sub.add_parser("diff",
+                       help="compare two run ledgers: config delta, "
+                            "convergence overlay, time-to-target, "
+                            "final metric deltas")
+    d.add_argument("run_a", help="run-ledger directory A (baseline)")
+    d.add_argument("run_b", help="run-ledger directory B")
+    d.add_argument("--json", action="store_true")
     return p
+
+
+def _main_ledger(args) -> int:
+    try:
+        if args.command == "tail":
+            tail = tail_ledger(args.ledger)
+            print(json.dumps(tail) if args.json else render_tail(tail))
+            return 0
+        diff = diff_ledgers(args.run_a, args.run_b)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            print(render_diff(diff))
+        return 0
+    except LedgerError as e:
+        print(f"ledger error: {e}", file=sys.stderr)
+        return 2
 
 
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command in ("tail", "diff"):
+        return _main_ledger(args)
+    if args.command == "verify" and _is_ledger(args.trace):
+        problems = verify_ledger(args.trace)
+        if problems:
+            print(f"{len(problems)} ledger violation(s):")
+            for pr in problems:
+                print(f"  - {pr}")
+            return 1
+        rows, _ = read_rows(args.trace)
+        print(f"ledger ok: {len(rows)} rows, seq contiguous, CRCs clean, "
+              f"manifest committed")
+        return 0
     try:
         trace = load_trace(args.trace)
     except (OSError, ValueError) as e:

@@ -10,7 +10,8 @@ from photon_ml_tpu.faults.injector import (FaultInjector, FaultPlan,
                                            InjectedIOError,
                                            InjectedThreadDeath, active,
                                            corrupt_file, current_plan,
-                                           fire, install, installed)
+                                           fire, install, installed,
+                                           poison_scalar)
 
 __all__ = [
     "FaultInjector",
@@ -25,4 +26,5 @@ __all__ = [
     "fire",
     "install",
     "installed",
+    "poison_scalar",
 ]

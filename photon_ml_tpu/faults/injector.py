@@ -23,7 +23,10 @@ and says what happens there:
                      (corrupted cache shard / checkpoint artifact);
 - ``thread_death`` — raise ``InjectedThreadDeath`` (a BaseException, so
                      it sails past ``except Exception`` and kills the
-                     thread — the scoring-worker-death fault class).
+                     thread — the scoring-worker-death fault class);
+- ``nan``          — poison a scalar flowing through a ``poison_scalar``
+                     site with NaN (a numerically sick objective — the
+                     convergence-watchdog fault class, obs/watchdog.py).
 
 Everything is deterministic: specs address exact occurrences, corruption
 bytes come from ``random.Random(plan.seed)``, and the injector records
@@ -104,7 +107,7 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.kind not in ("raise", "sleep", "kill", "corrupt",
-                             "thread_death"):
+                             "thread_death", "nan"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.scope not in ("any", "worker", "driver"):
             raise ValueError(f"unknown fault scope {self.scope!r}")
@@ -210,6 +213,14 @@ class FaultInjector:
         else:
             raise _EXC_TYPES[spec.exc](f"{spec.message} [site={site}]")
 
+    def poison_scalar(self, site: str, value: float,
+                      index: Optional[int] = None) -> float:
+        """Value-poisoning hook for numeric sites: returns NaN when a
+        ``nan`` spec matches, else ``value`` unchanged — the injected
+        form of a numerically sick objective (watchdog chaos drills)."""
+        spec = self._match(site, index, ("nan",))
+        return float("nan") if spec is not None else value
+
     def corrupt_file(self, site: str, path: str,
                      index: Optional[int] = None) -> bool:
         """Corruption hook for save-sites: garble ``path`` in place when
@@ -285,3 +296,11 @@ def corrupt_file(site: str, path: str, index: Optional[int] = None) -> bool:
     if _ACTIVE is not None:
         return _ACTIVE.corrupt_file(site, path, index)
     return False
+
+
+def poison_scalar(site: str, value: float,
+                  index: Optional[int] = None) -> float:
+    """Module-level poisoning hook: identity unless a plan is installed."""
+    if _ACTIVE is not None:
+        return _ACTIVE.poison_scalar(site, value, index)
+    return value

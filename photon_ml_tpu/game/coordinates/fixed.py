@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.data.batch import LabeledBatch
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game.coordinates._down_sampling import (
@@ -20,6 +21,7 @@ from photon_ml_tpu.game.coordinates._down_sampling import (
 from photon_ml_tpu.game.models import FixedEffectModel
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.obs.ledger import spill_history
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType,
@@ -96,16 +98,19 @@ class FixedEffectCoordinate:
         loss, mesh, norm = self.loss, self.mesh, self.norm
         ii = self.intercept_index
 
-        def fit(staged: LabeledBatch, offsets: Array, w0: Array) -> Array:
+        def fit(staged: LabeledBatch, offsets: Array, w0: Array):
             batch = dataclasses.replace(staged,
                                         offsets=self._padded_offsets(offsets))
-            coef, _ = dist_problem.run(
+            coef, res = dist_problem.run(
                 loss, batch, mesh, cfg, initial=Coefficients(w0), norm=norm,
                 intercept_index=ii, already_sharded=True)
-            return coef.means
+            # Histories ride along for the run ledger's post-fit spill
+            # (tiny (max_it+1,) vectors; they stay on device — and cost
+            # nothing — unless a ledger is active).
+            return coef.means, res.value_history, res.grad_norm_history
 
         def fit_sampled(staged: LabeledBatch, idx: Array, mult: Array,
-                        offsets: Array, w0: Array) -> Array:
+                        offsets: Array, w0: Array):
             # Down-sampled pass: gather the subsample on device, rescale
             # weights, pad back to a data-axis multiple (static shapes: the
             # samplers return deterministic sizes).
@@ -115,10 +120,10 @@ class FixedEffectCoordinate:
                 weights=staged.weights[idx] * mult,
                 offsets=offsets[idx],
             ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
-            coef, _ = dist_problem.run(
+            coef, res = dist_problem.run(
                 loss, sub, mesh, cfg, initial=Coefficients(w0), norm=norm,
                 intercept_index=ii, already_sharded=True)
-            return coef.means
+            return coef.means, res.value_history, res.grad_norm_history
 
         self._fit = jax.jit(fit)
         self._fit_sampled = jax.jit(fit_sampled)
@@ -163,10 +168,20 @@ class FixedEffectCoordinate:
             # draw is host-side (cheap, label metadata only); the data
             # gather happens on device.
             idx, mult = draw_down_sample(self, rate)
-            w_t = self._fit_sampled(self._staged, jnp.asarray(idx),
-                                    jnp.asarray(mult), offsets, w0)
+            w_t, vals, gns = self._fit_sampled(self._staged,
+                                               jnp.asarray(idx),
+                                               jnp.asarray(mult),
+                                               offsets, w0)
         else:
-            w_t = self._fit(self._staged, offsets, w0)
+            w_t, vals, gns = self._fit(self._staged, offsets, w0)
+        led = obs.ledger()
+        if led is not None:
+            # Post-fit spill of the compiled optimizer's NaN-padded
+            # histories — the run ledger's view of a solve that lives
+            # inside one XLA program (one host read, once per update).
+            spill_history(
+                led, np.asarray(vals), np.asarray(gns),
+                opt=self.config.optimizer.optimizer_type.value.lower())
         raw = Coefficients(self.norm.model_to_original_space(w_t))
         return FixedEffectModel(shard_id=self.shard_id, coefficients=raw)
 

@@ -8,6 +8,7 @@ the residency discipline shared by all coordinate types.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
@@ -675,7 +676,9 @@ class RandomEffectCoordinate:
             W = jnp.array(
                 self.norm.model_to_transformed_space(initial.means), copy=True)
         offsets = jnp.asarray(offsets)
+        led = obs.ledger()
         for wave, arrays in enumerate(self._iter_bucket_data()):
+            t_wave = time.perf_counter()
             # One span per vmapped entity-fit wave (the dispatch unit the
             # lane bound exists for). Dispatch is async: the span times
             # the submission + any blocking the runtime imposes, not the
@@ -683,6 +686,12 @@ class RandomEffectCoordinate:
             with obs.span("re.fit_wave", cat="train", wave=wave,
                           re_type=self.re_type):
                 W = self._fit_bucket(W, offsets, *arrays)
+            if led is not None:
+                # Wave-level aggregate (per-entity rows would be 1M-deep
+                # noise); seconds are dispatch-side, same caveat as the
+                # span above.
+                led.record("re_fit_wave", re_type=self.re_type, wave=wave,
+                           seconds=round(time.perf_counter() - t_wave, 6))
         if self.subspace:
             return SubspaceRandomEffectModel(
                 re_type=self.re_type, shard_id=self.shard_id,

@@ -13,11 +13,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.game.coordinates._down_sampling import (
     _advance_down_sampling, draw_down_sample)
 from photon_ml_tpu.game.models import FixedEffectModel
 from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.obs.ledger import spill_history
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType,
@@ -174,11 +176,14 @@ class SparseFixedEffectCoordinate:
         def fit(staged, offsets, w0):
             batch = dataclasses.replace(
                 staged, offsets=self._padded_offsets(offsets))
-            coef, _ = sp.run(loss, batch, mesh, cfg,
-                             initial=Coefficients(lift(w0)),
-                             intercept_index=ii,
-                             feature_sharded=fs, already_sharded=True)
-            return coef.means[:d_true]
+            coef, res = sp.run(loss, batch, mesh, cfg,
+                               initial=Coefficients(lift(w0)),
+                               intercept_index=ii,
+                               feature_sharded=fs, already_sharded=True)
+            # Histories ride along for the run ledger's post-fit spill
+            # (tiny, device-resident, free when no ledger is active).
+            return (coef.means[:d_true], res.value_history,
+                    res.grad_norm_history)
 
         def fit_sampled(staged, idx, mult, offsets, w0):
             sub = dataclasses.replace(
@@ -189,11 +194,12 @@ class SparseFixedEffectCoordinate:
                 weights=staged.weights[idx] * mult,
                 offsets=offsets[idx],
             ).pad_to(pad_to_multiple(idx.shape[0], mesh.shape[DATA_AXIS]))
-            coef, _ = sp.run(loss, sub, mesh, cfg,
-                             initial=Coefficients(lift(w0)),
-                             intercept_index=ii,
-                             feature_sharded=fs, already_sharded=True)
-            return coef.means[:d_true]
+            coef, res = sp.run(loss, sub, mesh, cfg,
+                               initial=Coefficients(lift(w0)),
+                               intercept_index=ii,
+                               feature_sharded=fs, already_sharded=True)
+            return (coef.means[:d_true], res.value_history,
+                    res.grad_norm_history)
 
         def score_fn(staged, means):
             # Staged offsets are zeros, so margins == X @ w exactly.
@@ -225,20 +231,20 @@ class SparseFixedEffectCoordinate:
 
         def fit(hb, offsets, w0):
             hbo = dataclasses.replace(hb, offsets=jnp.asarray(offsets))
-            coef, _ = sp.run_hybrid(loss, hbo, cfg,
-                                    initial=Coefficients(w0),
-                                    intercept_index_permuted=ii_perm)
-            return coef.means
+            coef, res = sp.run_hybrid(loss, hbo, cfg,
+                                      initial=Coefficients(w0),
+                                      intercept_index_permuted=ii_perm)
+            return coef.means, res.value_history, res.grad_norm_history
 
         def fit_sampled(hb, idx, mult, offsets, w0):
             w_masked = jnp.zeros_like(hb.weights).at[idx].set(
                 hb.weights[idx] * mult)
             hbo = dataclasses.replace(hb, weights=w_masked,
                                       offsets=jnp.asarray(offsets))
-            coef, _ = sp.run_hybrid(loss, hbo, cfg,
-                                    initial=Coefficients(w0),
-                                    intercept_index_permuted=ii_perm)
-            return coef.means
+            coef, res = sp.run_hybrid(loss, hbo, cfg,
+                                      initial=Coefficients(w0),
+                                      intercept_index_permuted=ii_perm)
+            return coef.means, res.value_history, res.grad_norm_history
 
         def score_fn(hb, means):
             # Staged offsets are zeros, so margins == X @ w exactly.
@@ -282,10 +288,10 @@ class SparseFixedEffectCoordinate:
 
         def fit(shb, offsets, w0):
             shbo = dataclasses.replace(shb, offsets=grid(offsets))
-            coef, _ = sp.run_hybrid_sharded(
+            coef, res = sp.run_hybrid_sharded(
                 loss, shbo, mesh, cfg, initial=Coefficients(w0),
                 intercept_index_permuted=ii_perm)
-            return coef.means
+            return coef.means, res.value_history, res.grad_norm_history
 
         def fit_sampled(shb, idx, mult, offsets, w0):
             wf = shb.weights.reshape(-1)
@@ -293,10 +299,10 @@ class SparseFixedEffectCoordinate:
                 wf[idx] * mult).reshape(shb.weights.shape)
             shbo = dataclasses.replace(shb, weights=w_masked,
                                        offsets=grid(offsets))
-            coef, _ = sp.run_hybrid_sharded(
+            coef, res = sp.run_hybrid_sharded(
                 loss, shbo, mesh, cfg, initial=Coefficients(w0),
                 intercept_index_permuted=ii_perm)
-            return coef.means
+            return coef.means, res.value_history, res.grad_norm_history
 
         def score_fn(shb, means):
             # Staged offsets are zeros, so margins == X @ w exactly; rows
@@ -344,11 +350,20 @@ class SparseFixedEffectCoordinate:
         rate = self.config.down_sampling_rate
         if rate < 1.0:
             idx, mult = draw_down_sample(self, rate)
-            w = self._fit_sampled(self._staged, jnp.asarray(idx),
-                                  jnp.asarray(mult),
-                                  self._padded_offsets(offsets), w0)
+            w, vals, gns = self._fit_sampled(self._staged,
+                                             jnp.asarray(idx),
+                                             jnp.asarray(mult),
+                                             self._padded_offsets(offsets),
+                                             w0)
         else:
-            w = self._fit(self._staged, offsets, w0)
+            w, vals, gns = self._fit(self._staged, offsets, w0)
+        led = obs.ledger()
+        if led is not None:
+            # Post-fit spill of the compiled histories (one host read,
+            # once per coordinate update) — docs/OBSERVABILITY.md.
+            spill_history(
+                led, np.asarray(vals), np.asarray(gns),
+                opt=self.config.optimizer.optimizer_type.value.lower())
         return FixedEffectModel(shard_id=self.shard_id,
                                 coefficients=Coefficients(w))
 

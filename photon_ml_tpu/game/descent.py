@@ -16,6 +16,7 @@ of the reference's outer-join RDD arithmetic (CoordinateDataScores +/-).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import hashlib
@@ -99,10 +100,18 @@ def run(
     some = coordinates[seq[0]]
     n = some.dataset.num_rows
 
+    led = obs.ledger()
     fingerprint = None
     resume = None
-    if checkpoint_manager is not None:
+    if checkpoint_manager is not None or led is not None:
         fingerprint = _fingerprint(task, coordinates, seq, config, locked, n)
+    if led is not None:
+        # Stamp (or validate, on a --resume append) the run ledger's
+        # identity from the SAME fingerprint machinery the checkpoint
+        # trusts — a ledger never silently continues a different run's
+        # curve (obs/ledger.py).
+        led.bind_fingerprint(fingerprint)
+    if checkpoint_manager is not None:
         resume = checkpoint_manager.load(expected_fingerprint=fingerprint)
     history = CoordinateDescentHistory()
     done_steps = 0
@@ -212,11 +221,20 @@ def run(
                     continue  # already covered by the checkpoint
                 coord = coordinates[cid]
                 t0 = time.monotonic()
+                # Ledger context: every telemetry row the update's
+                # optimizer produces (live opt_iter rows, compiled
+                # spills, RE waves) carries which coordinate/step it
+                # belongs to.
+                bound = (led.bound(coordinate=cid, outer_iteration=it,
+                                   step=step)
+                         if led is not None
+                         else contextlib.nullcontext())
                 # One span per coordinate update — the descent
                 # waterfall's unit; the coordinate's own spans (streamed
                 # passes, fit waves, checkpoint writes) nest under it.
-                with obs.span("descent.update", cat="train",
-                              iteration=it, coordinate=cid, step=step):
+                with bound, obs.span("descent.update", cat="train",
+                                     iteration=it, coordinate=cid,
+                                     step=step):
                     if checkpoint_manager is not None:
                         # Streamed coordinates checkpoint INSIDE the
                         # update too (their fit is the multi-hour unit at
@@ -250,6 +268,11 @@ def run(
                 emitter.emit(ev_mod.CoordinateUpdate(
                     iteration=it, coordinate=cid, train_seconds=elapsed,
                     validation=rec.get("validation")))
+                if led is not None:
+                    led.record("coordinate_update", coordinate=cid,
+                               outer_iteration=it, step=step,
+                               seconds=round(elapsed, 6),
+                               validation=rec.get("validation"))
                 if checkpoint_manager is not None:
                     checkpoint_manager.save(
                         task, models, done_steps=step,

@@ -14,8 +14,10 @@ this automatically from the evaluator's metric direction.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -84,6 +86,10 @@ class RandomSearch:
         self.evaluate = evaluation_function
         self._rng = np.random.default_rng(seed)
         self.observations: list[Observation] = []
+        # Expected improvement of the LAST proposal (GP search sets it;
+        # random/seed proposals have none) — rides into the run ledger's
+        # per-trial rows so tuning runs are diffable (ISSUE 9).
+        self._last_ei: Optional[float] = None
 
     def _draw(self) -> np.ndarray:
         u = self._rng.uniform(size=len(self.dimensions))
@@ -94,10 +100,30 @@ class RandomSearch:
         return self._draw()
 
     def find(self, n: int) -> SearchResult:
+        from photon_ml_tpu import obs
+
+        led = obs.ledger()
         for i in range(n):
+            self._last_ei = None
             point = self._propose()
-            value = float(self.evaluate(point))
+            bound = (led.bound(trial=i + 1) if led is not None
+                     else contextlib.nullcontext())
+            t0 = time.perf_counter()
+            with bound:
+                value = float(self.evaluate(point))
             self.observations.append(Observation(point, value))
+            if led is not None:
+                # One row per trial: the sampled config, the proposal's
+                # expected improvement, the validation objective, and
+                # the trial's wall seconds — `photon-obs diff` then
+                # compares tuning runs like any other run.
+                led.record(
+                    "tuning_trial", trial=i + 1,
+                    point={d.name: float(p)
+                           for d, p in zip(self.dimensions, point)},
+                    expected_improvement=self._last_ei,
+                    objective=value,
+                    seconds=round(time.perf_counter() - t0, 6))
             logger.info("hyperparameter trial %d/%d: %s -> %.6g",
                         i + 1, n,
                         {d.name: float(p) for d, p in
@@ -150,5 +176,6 @@ class GaussianProcessSearch(RandomSearch):
         mean, std = model.predict(cand_u)
         ei = criteria.expected_improvement(mean, std, float(vals.min()))
         u = cand_u[int(np.argmax(ei))]
+        self._last_ei = float(np.max(ei))
         return np.array([d.from_unit(ui)
                          for d, ui in zip(self.dimensions, u)])

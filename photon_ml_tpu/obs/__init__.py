@@ -30,23 +30,31 @@ from typing import Optional
 
 from photon_ml_tpu.obs.bridge import (EventSpanBridge, install_bridge,
                                       installed_bridge, uninstall_bridge)
+from photon_ml_tpu.obs.ledger import RunLedger
 from photon_ml_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry, metric_value,
                                        parse_prometheus_text)
 from photon_ml_tpu.obs.trace import Span, Tracer, WorkerTracer
+from photon_ml_tpu.obs.watchdog import (ConvergenceWatchdog, WatchdogConfig,
+                                        WatchdogError,
+                                        parse_watchdog_config)
 
 __all__ = [
-    "Counter", "EventSpanBridge", "Gauge", "Histogram", "MetricsRegistry",
-    "Span", "Tracer", "WorkerTracer", "activated", "adopt_worker_context",
-    "disable", "dump_trace", "enable", "install_bridge",
-    "installed_bridge", "instant", "metric_value", "metrics",
-    "parse_prometheus_text", "span", "tracer", "uninstall_bridge",
-    "worker_context",
+    "ConvergenceWatchdog", "Counter", "EventSpanBridge", "Gauge",
+    "Histogram", "MetricsRegistry", "RunLedger", "Span", "Tracer",
+    "WatchdogConfig", "WatchdogError", "WorkerTracer", "activated",
+    "adopt_worker_context", "disable", "dump_trace", "enable",
+    "install_bridge", "installed_bridge", "instant", "ledger",
+    "metric_value", "metrics", "parse_prometheus_text",
+    "parse_watchdog_config", "set_ledger", "set_watchdog", "span",
+    "tracer", "uninstall_bridge", "watchdog_config", "worker_context",
 ]
 
 _LOCK = threading.Lock()
 _TRACER: Optional[Tracer] = None
 _METRICS: Optional[MetricsRegistry] = None
+_LEDGER: Optional[RunLedger] = None
+_WATCHDOG: Optional[WatchdogConfig] = None
 
 
 def tracer() -> Optional[Tracer]:
@@ -58,6 +66,39 @@ def tracer() -> Optional[Tracer]:
 def metrics() -> Optional[MetricsRegistry]:
     """The active metrics registry, or None when metrics are off."""
     return _METRICS
+
+
+def ledger() -> Optional[RunLedger]:
+    """The active run ledger, or None when no run is being recorded —
+    the ledger sites' one None check (``led = obs.ledger(); if led is
+    not None: led.record(...)``)."""
+    return _LEDGER
+
+
+def set_ledger(led: Optional[RunLedger]) -> Optional[RunLedger]:
+    """Install ``led`` process-wide (None uninstalls); returns the
+    PREVIOUS ledger so callers can restore it. The installer owns the
+    lifecycle — close() in a finally (a crashed run keeps its prefix)."""
+    global _LEDGER
+    with _LOCK:
+        prev, _LEDGER = _LEDGER, led
+    return prev
+
+
+def watchdog_config() -> Optional[WatchdogConfig]:
+    """The installed convergence-watchdog config, or None (watchdogs
+    off — the default; each optimizer site pays one None check)."""
+    return _WATCHDOG
+
+
+def set_watchdog(cfg: Optional[WatchdogConfig]
+                 ) -> Optional[WatchdogConfig]:
+    """Install ``cfg`` process-wide (None disarms); returns the
+    previous config for restore."""
+    global _WATCHDOG
+    with _LOCK:
+        prev, _WATCHDOG = _WATCHDOG, cfg
+    return prev
 
 
 def enable(trace: bool = True, metrics: bool = True,

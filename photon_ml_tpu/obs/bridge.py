@@ -137,6 +137,9 @@ class EventSpanBridge:
                                 source=str(args.get("source"))).inc()
                 metrics.counter("photon_ingest_records_total").inc(
                     float(args.get("records") or 0))
+            elif name == "WatchdogAlert":
+                metrics.counter("photon_watchdog_alerts_total",
+                                kind=str(args.get("kind"))).inc()
             elif name == "CoordinateUpdate":
                 metrics.histogram(
                     "photon_coordinate_update_seconds").observe(

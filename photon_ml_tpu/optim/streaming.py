@@ -24,13 +24,17 @@ problems (tests/test_streaming.py).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import faults as flt
 from photon_ml_tpu import obs
+from photon_ml_tpu.obs.ledger import transfer_totals
+from photon_ml_tpu.obs.watchdog import ConvergenceWatchdog
 from photon_ml_tpu.optim.common import OptResult, OptimizerConfig
 
 Array = jax.Array
@@ -134,10 +138,24 @@ def minimize_streaming(
     the streamed fixed-effect coordinate — game/checkpoint.py's
     StreamingStateStore persists the snapshots). A resumed call skips
     the initial value/gradient pass entirely: the snapshot carries it.
+
+    Telemetry (docs/OBSERVABILITY.md "The run ledger"): when a run
+    ledger is active (``obs.ledger()``), every accepted iteration
+    records an ``opt_iter`` row LIVE — value, gradient norm, step,
+    probe/pass counts, per-iteration wall seconds, cumulative transfer
+    counters. When a watchdog config is installed
+    (``obs.watchdog_config()``), the same per-iteration stream feeds a
+    :class:`ConvergenceWatchdog` — NaN/stall/divergence/slow-iteration
+    become a loud event plus a defined error or early stop. Both are
+    off by default at one None check here.
     """
     d = int(w0.shape[0])
     M = config.history_length
     max_it = config.max_iterations
+    led = obs.ledger()
+    wd_cfg = obs.watchdog_config()
+    wd = (ConvergenceWatchdog(wd_cfg) if wd_cfg is not None else None)
+    v_passes = g_passes = 0  # streamed passes, cumulative this call
     if resume_state is not None:
         st = resume_state
         if st["s_stack"].shape != (M, d) or st["w"].shape != (d,):
@@ -166,6 +184,7 @@ def minimize_streaming(
         w = jnp.asarray(w0, jnp.float32)
         with obs.span("lbfgs.initial_pass", cat="optim"):
             f, g = value_and_grad(w)
+        g_passes += 1
         f0, gn0 = float(f), float(jnp.linalg.norm(g))
         s_stack = jnp.zeros((M, d), jnp.float32)
         y_stack = jnp.zeros((M, d), jnp.float32)
@@ -180,6 +199,8 @@ def minimize_streaming(
     converged = False
     it = start_it - 1
     for it in range(start_it, max_it + 1):
+        t_iter = time.perf_counter()
+        v0_passes, g0_passes = v_passes, g_passes
         # One span per driver-loop iteration (docs/OBSERVABILITY.md):
         # streamed passes, probes, and the checkpoint write all nest
         # under it, so the trace waterfall reads as the optimizer ran.
@@ -202,17 +223,28 @@ def minimize_streaming(
                               probe=probe, step=step):
                     if value_only is None:
                         f_try, g_try = value_and_grad(w_try)
+                        g_passes += 1
                         # pml: allow[PML001] Armijo probe is a BY-DESIGN barrier: the host decides accept/backtrack on this value (ISSUE 3)
                         f_try_h = float(f_try)
                     else:
+                        v_passes += 1
                         # pml: allow[PML001] Armijo probe barrier, value-only pass (same by-design host decision as above)
                         f_try_h = float(value_only(w_try))
+                # Watchdog chaos seam (docs/ROBUSTNESS.md): a "nan"
+                # fault spec here is the injected form of a numerically
+                # sick objective.
+                f_try_h = flt.poison_scalar("stream.objective", f_try_h)
                 if np.isfinite(f_try_h) and \
                         f_try_h <= fv + config.wolfe_c1 * step * dg:
                     accepted = True
                     break
                 step *= 0.5
             if not accepted:
+                if wd is not None:
+                    # A line search that died on NON-FINITE probes is
+                    # the NaN failure shape — loud, defined (a finite
+                    # failed search stays the optimizer's own stop).
+                    wd.on_line_search_failure(f_try_h, it)
                 log(f"iter {it}: line search failed (f={fv:.6g}); "
                     f"stopping")
                 break
@@ -221,6 +253,7 @@ def minimize_streaming(
                 # and the next direction need it; rejected probes never
                 # did).
                 _, g_try = value_and_grad(w_try)
+                g_passes += 1
             s = w_try - w
             y = g_try - g
             # pml: allow[PML001] curvature-damping skip is a host branch; one scalar per accepted step
@@ -239,6 +272,17 @@ def minimize_streaming(
             gn = float(jnp.linalg.norm(g))
             vals[it], gns[it] = fv, gn
             log(f"iter {it}: f={fv:.6g} |g|={gn:.3g} step={step:.3g}")
+            if led is not None:
+                # Append-as-produced: a SIGKILL one iteration later
+                # still leaves this point on the curve (the ledger's
+                # whole reason to exist).
+                led.record("opt_iter", opt="lbfgs-stream", iteration=it,
+                           value=fv, grad_norm=gn, step=step,
+                           probes=probe + 1,
+                           value_passes=v_passes - v0_passes,
+                           grad_passes=g_passes - g0_passes,
+                           seconds=round(time.perf_counter() - t_iter, 6),
+                           **transfer_totals())
             if checkpoint_save is not None:
                 # Iteration boundary = the resume point: everything the
                 # next iteration reads goes into the snapshot (gn_prev is
@@ -247,6 +291,13 @@ def minimize_streaming(
                 checkpoint_save(snapshot_state(
                     w, g, s_stack, y_stack, rho, m_host, it, fv, gn, f0,
                     gn0, vals, gns))
+            if wd is not None:
+                # After the checkpoint write: a "raise" verdict still
+                # leaves a resumable snapshot + a flushed ledger row.
+                if wd.observe(it, fv, gn,
+                              time.perf_counter() - t_iter) == "stop":
+                    log(f"iter {it}: watchdog early stop")
+                    break
             if gn <= config.tolerance * max(gn0, 1.0) or \
                     abs(fv - f_prev) <= config.tolerance * max(abs(f_prev),
                                                                1e-12):

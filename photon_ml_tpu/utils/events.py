@@ -188,6 +188,19 @@ class CheckpointRecovered(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class WatchdogAlert(Event):
+    """A convergence watchdog fired (obs/watchdog.py): ``kind`` names
+    the detector (nan/stall/divergence/slow_iter), ``action`` what
+    happened (warn/stop/raise). The obs bridge turns these into timeline
+    instants + ``photon_watchdog_alerts_total{kind=...}``."""
+
+    kind: str
+    action: str
+    detail: str
+    coordinate: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class StagingFinish(Event):
     """Every shard of one staging pipeline is produced (NOT necessarily
     consumed — consumption is the fit stream's side of the handoff)."""

@@ -732,7 +732,8 @@ def test_cli_rejects_unknown_rule_and_reasonless_baseline_write(tmp_path):
 
 
 def test_rule_catalog_is_complete():
-    assert sorted(ALL_RULES) == [f"PML00{i}" for i in range(1, 10)]
+    assert sorted(ALL_RULES) == \
+        [f"PML00{i}" for i in range(1, 10)] + ["PML010"]
     for rid, (check, doc) in ALL_RULES.items():
         assert callable(check) and doc
 
@@ -806,3 +807,87 @@ def test_pml009_clean_on_real_obs_modules():
         with open(os.path.join(REPO, rel)) as f:
             ctx = ModuleContext.parse(rel, f.read())
         assert ALL_RULES["PML009"][0](ctx) == [], rel
+
+
+# ---------------------------------------------------------------- PML010
+
+
+def test_pml010_flags_open_write_in_loop():
+    # The telemetry anti-pattern the run ledger exists to replace: one
+    # file open per optimizer iteration (PML001's host-sync discipline
+    # applied to I/O).
+    src = """
+        def fit(path, steps):
+            for it in steps:
+                with open(path, "a") as f:
+                    f.write(f"{it}\\n")
+    """
+    out = findings_for("PML010", src)
+    assert len(out) == 1 and out[0].rule == "PML010"
+    assert "run-ledger" in out[0].message
+
+
+def test_pml010_flags_json_dump_and_np_save_in_loop():
+    src = """
+        import json
+        import numpy as np
+
+        def fit(f, steps):
+            while steps:
+                json.dump({"it": steps.pop()}, f)
+
+        def snapshot(paths, arrays):
+            for p, a in zip(paths, arrays):
+                np.save(p, a)
+    """
+    out = findings_for("PML010", src)
+    assert len(out) == 2
+    assert any("json.dump" in f.message for f in out)
+    assert any("np.save" in f.message for f in out)
+
+
+def test_pml010_accepts_reads_depth_zero_writes_and_ledger_api():
+    src = """
+        import json
+
+        def read_all(paths):
+            rows = []
+            for p in paths:
+                with open(p) as f:          # read mode: fine
+                    rows.append(f.read())
+            with open(p, "rb") as f:        # explicit read: fine
+                rows.append(f.read())
+            return rows
+
+        def commit(path, state):
+            with open(path, "w") as f:      # depth 0: per-call artifact
+                json.dump(state, f)
+
+        def fit(led, steps):
+            for it in steps:
+                led.record("opt_iter", iteration=it)   # THE sanctioned API
+    """
+    assert findings_for("PML010", src) == []
+
+
+def test_pml010_dynamic_mode_gets_benefit_of_the_doubt():
+    src = """
+        def copy_all(paths, mode):
+            for p in paths:
+                with open(p, mode) as f:
+                    f.read()
+    """
+    assert findings_for("PML010", src) == []
+
+
+def test_pml010_clean_on_real_telemetry_writers():
+    # The ledger itself, the checkpoint managers, and the optimizer
+    # loops must be PML010-clean without suppressions — the rule guards
+    # the discipline they already follow.
+    for rel in ("photon_ml_tpu/obs/ledger.py",
+                "photon_ml_tpu/game/checkpoint.py",
+                "photon_ml_tpu/optim/streaming.py",
+                "photon_ml_tpu/game/descent.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            ctx = ModuleContext.parse(rel, f.read())
+        assert ALL_RULES["PML010"][0](ctx) == [], rel
