@@ -95,6 +95,10 @@ METRIC_CROSSCHECKS = {
         "photon_stream_inflight_chunks_peak",
 }
 METRICS_TOLERANCE = 0.10
+# Failure-window p99 may cost up to this over the sweep's own steady
+# p99 when no committed baseline carries the line yet (detection +
+# failover + cold re-homed cache, all inside the window).
+FLEET_FAILURE_P99_FACTOR = 10.0
 GUARDED = [
     "staging_bucketing_seconds",
     "staging_projection_seconds",
@@ -335,6 +339,72 @@ def main() -> int:
                     f"serving_p99_vs_qps_curve[{q}]: {v:g}ms > "
                     f"{b * band:.3g}ms — serving p99 regressed at "
                     f"{q} qps")
+
+    # --- fleet chaos invariants (docs/SERVING.md "Scaling out") ---------
+    # The bench_serving.py --fleet sweep kills a replica mid-sweep; its
+    # lines carry the chaos acceptance: the kill fired, every non-shed
+    # request was served, scores match the single-process oracle, the
+    # dead shard re-homed within the configured deadline, and p99 during
+    # the failure window stays inside the band (vs the committed
+    # baseline when it has the line, else vs the sweep's own steady p99
+    # scaled by FLEET_FAILURE_P99_FACTOR — detection + failover may
+    # cost that much at the tail, never more).
+    rehome = fresh.get("fleet_rehome_seconds")
+    if rehome is not None:
+        ddl = float(fresh.get("fleet_rehome_deadline_s", 5.0))
+        ok = float(rehome) <= ddl
+        print(f"fleet_rehome_seconds: {rehome:g}s vs deadline {ddl:g}s "
+              f"{'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"fleet_rehome_seconds: {rehome:g}s > {ddl:g}s — the "
+                f"dead replica's shards re-homed too slowly")
+        if fresh.get("fleet_kill_fired") is False:
+            failures.append(
+                "fleet_kill_fired: the injected replica_kill never "
+                "fired — the chaos sweep measured nothing")
+            print("fleet_kill_fired: False REGRESSION")
+        unserved = fresh.get("fleet_unserved_total")
+        if unserved is not None:
+            ok = int(unserved) == 0
+            print(f"fleet_unserved_total: {unserved} (must be 0) "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"fleet_unserved_total: {unserved} non-shed "
+                    f"request(s) went unserved — the failover ladder "
+                    f"dropped traffic")
+        if fresh.get("fleet_parity_ok") is False:
+            failures.append(
+                f"fleet_parity_ok: "
+                f"{fresh.get('fleet_parity_mismatches')} fleet "
+                f"score(s) differ from the single-process oracle "
+                f"(max |d| {fresh.get('fleet_parity_max_abs_diff')}) — "
+                f"routed scoring is WRONG, not merely slow")
+            print("fleet_parity_ok: False REGRESSION")
+        p99_fail = fresh.get("fleet_p99_during_failure_ms")
+        p99_steady = fresh.get("fleet_p99_steady_ms")
+        base_fail = base.get("fleet_p99_during_failure_ms")
+        if p99_fail is not None:
+            if base_fail is not None:
+                limit = float(base_fail) * band
+                src = f"baseline {base_fail:g}ms +{args.tolerance:.0%}"
+            elif p99_steady is not None:
+                limit = float(p99_steady) * FLEET_FAILURE_P99_FACTOR
+                src = (f"steady {p99_steady:g}ms x "
+                       f"{FLEET_FAILURE_P99_FACTOR:g}")
+            else:
+                limit = None
+            if limit is not None:
+                ok = float(p99_fail) <= limit
+                print(f"fleet_p99_during_failure_ms: {p99_fail:g}ms vs "
+                      f"{src} (limit {limit:.3g}) "
+                      f"{'OK' if ok else 'REGRESSION'}")
+                if not ok:
+                    failures.append(
+                        f"fleet_p99_during_failure_ms: {p99_fail:g}ms "
+                        f"> {limit:.3g}ms — the failure-window tail "
+                        f"broke its band")
 
     # --- convergence gate (docs/OBSERVABILITY.md "The run ledger") ------
     # Time-to-target regressions fail CI even when wall totals look

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Fleet smoke (run_tier1.sh): 2 replicas, kill one, assert recovery +
+parity. Seconds on CPU; catches a broken fleet layer before it reaches
+a real deployment (docs/SERVING.md "Scaling out").
+
+Asserts the whole failure ladder end to end through the REAL paths
+(subprocess replicas, HTTP forwarding, health probes):
+
+1. serial single requests through the fleet score BIT-identically to
+   the single-process ScoringService (same flush shape → same program
+   → same bits; the PR 1 parity discipline);
+2. SIGKILL of replica 0 mid-traffic: every subsequent request still
+   answers with the same bits (the survivor serves the dead shard from
+   its host store), the re-home lands inside the deadline, and the
+   ShardRehomed event fires;
+3. /healthz shows degraded while the replica is away and clears after
+   the supervised restart returns its shards home;
+4. photon_fleet_* metrics moved: a death, a re-home, a restart — a
+   recovery that happens without moving its counter is a bug by
+   contract (docs/ROBUSTNESS.md).
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving import ScoringRequest, ScoringService
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import events as ev
+
+    rng = np.random.default_rng(7)
+    E, dg, dr = 32, 6, 4
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, dr)).astype(np.float32))),
+    })
+    td = tempfile.mkdtemp(prefix="pml_fleet_smoke_")
+    model_dir = os.path.join(td, "model")
+    model_io.save_game_model(model, model_dir)
+
+    objs = [{"features": {
+                 "global": rng.normal(size=dg).astype(
+                     np.float32).tolist(),
+                 "re_userId": rng.normal(size=dr).astype(
+                     np.float32).tolist()},
+             "entity_ids": {"userId": int(i % E)}, "uid": i}
+            for i in range(12)]
+
+    # Single-process oracle through the SAME flush shape (submit one at
+    # a time → bucket-1 programs on both sides → bit parity).
+    oracle = ScoringService(model, max_wait_ms=0.5)
+    expected = np.asarray([
+        float(oracle.submit(ScoringRequest(
+            features={k: np.asarray(v, np.float32)
+                      for k, v in o["features"].items()},
+            entity_ids=o["entity_ids"])).result(timeout=60))
+        for o in objs], np.float32)
+    oracle.close()
+
+    events = []
+    ev.default_emitter.register(events.append)
+
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir, "--max-wait-ms", "0.5"],
+        num_replicas=2, workdir=os.path.join(td, "fleet"),
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        rehome_deadline_s=5.0)
+    fleet.start()
+    server = make_fleet_http_server(fleet, port=0)
+    import threading
+
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post_one(obj):
+        body = json.dumps({"requests": [obj]}).encode()
+        req = urllib.request.Request(
+            url + "/score", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60.0) as resp:
+            return float(json.loads(resp.read())["scores"][0])
+
+    def healthz():
+        with urllib.request.urlopen(url + "/healthz",
+                                    timeout=5.0) as resp:
+            return json.loads(resp.read())
+
+    try:
+        got = np.asarray([post_one(o) for o in objs], np.float32)
+        assert np.array_equal(got, expected), \
+            f"fleet scores not bit-identical pre-kill: " \
+            f"max |d| {np.max(np.abs(got - expected))}"
+        assert healthz()["status"] == "ok"
+
+        # Kill replica 0; every request must keep answering identically.
+        os.kill(fleet.supervisor.replicas[0].proc.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        got2 = np.asarray([post_one(o) for o in objs], np.float32)
+        assert np.array_equal(got2, expected), \
+            "post-kill scores differ — the re-homed shard scored wrong"
+
+        # Degraded must have been observable while the replica was away.
+        saw_degraded = healthz()["degraded"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            hz = healthz()
+            saw_degraded = saw_degraded or hz["degraded"]
+            if not hz["degraded"] and hz["status"] == "ok":
+                break
+            time.sleep(0.1)
+        assert saw_degraded, "healthz never showed degraded after a kill"
+        assert not hz["degraded"], \
+            f"fleet did not recover within 60s: {hz}"
+        assert hz["shards_away_from_home"] == 0
+        recover_s = time.monotonic() - t_kill
+
+        got3 = np.asarray([post_one(o) for o in objs], np.float32)
+        assert np.array_equal(got3, expected), \
+            "post-recovery scores differ"
+
+        snap = fleet.metrics.snapshot()
+        assert snap["replica_deaths_total"] >= 1, snap
+        assert snap["rehomes_total"] >= 1, snap
+        assert snap["replica_restarts_total"] >= 1, snap
+        assert snap["rehome_seconds_last"] <= fleet.rehome_deadline_s, \
+            snap
+        assert snap["unserved_total"] == 0, snap
+        rehomed = [e for e in events if isinstance(e, ev.ShardRehomed)]
+        assert rehomed, "no ShardRehomed event"
+        assert any(isinstance(e, ev.ReplicaDied) for e in events)
+        assert any(isinstance(e, ev.ReplicaRecovered) for e in events)
+        text = fleet.metrics_text()
+        assert "photon_fleet_rehomes_total 1" in text, text
+        print(f"fleet smoke ok: 2 replicas, kill->serve bit-identical, "
+              f"re-homed {len(rehomed[0].shards)} shard(s) in "
+              f"{rehomed[0].seconds * 1e3:.1f}ms, full recovery in "
+              f"{recover_s:.1f}s, 36/36 requests exact")
+        return 0
+    finally:
+        ev.default_emitter.unregister(events.append)
+        server.shutdown()
+        server.server_close()
+        fleet.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,14 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/serving_trace_smoke.py; rc=$?
 fi
 
+# Fleet smoke (docs/SERVING.md "Scaling out"): 2 subprocess replicas,
+# SIGKILL one mid-traffic, assert bit-identical scores through the
+# failure, shard re-home within deadline, degraded /healthz that
+# clears, and moved photon_fleet_* counters. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/fleet_smoke.py; rc=$?
+fi
+
 # Ledger smoke (docs/OBSERVABILITY.md "The run ledger"): a tiny fit
 # must leave a CRC-committed, seq-contiguous run ledger whose
 # run-vs-itself diff reports zero convergence regression. Seconds on CPU.

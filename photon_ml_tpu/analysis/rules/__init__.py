@@ -5,8 +5,9 @@ the CLI selects/ignores a subset."""
 from __future__ import annotations
 
 from photon_ml_tpu.analysis.rules import (concurrency, device, lifecycle,
-                                          numeric, obs_discipline,
-                                          robustness, timeclock)
+                                          network, numeric,
+                                          obs_discipline, robustness,
+                                          timeclock)
 
 # id → (check, one-line summary). Order is report order.
 ALL_RULES = {
@@ -32,4 +33,6 @@ ALL_RULES = {
     "PML010": (obs_discipline.check_ledger_io_discipline,
                "raw telemetry/artifact write inside a loop (use the "
                "buffered run-ledger API)"),
+    "PML011": (network.check_blocking_network_timeout,
+               "blocking socket/HTTP call without an explicit timeout"),
 }

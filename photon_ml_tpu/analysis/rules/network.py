@@ -1,0 +1,105 @@
+"""PML011 — blocking network call without an explicit timeout.
+
+The fleet (serving/router.py, serving/supervisor.py) made the repo a
+distributed system: routers forward over HTTP, supervisors probe
+replicas, and every one of those calls BLOCKS a thread. A blocking
+socket/HTTP call without a timeout turns a dead peer into a hung
+thread — the exact failure mode the heartbeat-deadline machinery exists
+to prevent, reintroduced one layer down. The degradation ladder
+(docs/ROBUSTNESS.md) demands "never hang"; this rule mechanizes it the
+way PML004 mechanized wall-clock durations:
+
+- ``urllib.request.urlopen(...)`` must pass ``timeout=`` (or the third
+  positional argument);
+- ``socket.create_connection(...)`` must pass ``timeout=`` (or the
+  second positional);
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)`` must
+  pass ``timeout=`` (or the third positional);
+- ``requests.get/post/...`` must pass ``timeout=`` (requests never
+  times out by default — the classic production hang);
+- ``sock.settimeout(None)`` / an explicit ``timeout=None`` literal is
+  ALSO a finding: deliberately unbounded blocking needs a
+  ``# pml: allow[PML011] <reason>`` stating why a hang is acceptable.
+
+Sites with a genuinely unbounded contract (an interactive REPL, a
+drain-forever worker) carry the inline allow like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.taint import dotted_name
+
+# call leaf → (dotted-suffix requirements, positional index of timeout).
+# A call matches when its dotted name ends with one of the suffixes;
+# bare leaves like ``get`` never match without their module base (or
+# ``dict.get`` would light up the repo).
+_BLOCKING = {
+    "urlopen": (("urllib.request.urlopen", "request.urlopen",
+                 "urlopen"), 2),
+    "create_connection": (("socket.create_connection",), 1),
+    "HTTPConnection": (("http.client.HTTPConnection",
+                        "client.HTTPConnection"), 2),
+    "HTTPSConnection": (("http.client.HTTPSConnection",
+                         "client.HTTPSConnection"), 2),
+    "get": (("requests.get",), None),
+    "post": (("requests.post",), None),
+    "put": (("requests.put",), None),
+    "delete": (("requests.delete",), None),
+    "head": (("requests.head",), None),
+    "request": (("requests.request",), None),
+}
+
+
+def _timeout_kwarg(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return kw
+    return None
+
+
+def _is_none(expr) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is None
+
+
+def check_blocking_network_timeout(ctx: ModuleContext) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        spec = _BLOCKING.get(leaf)
+        if spec is not None:
+            suffixes, pos = spec
+            if not any(name == s or name.endswith("." + s)
+                       for s in suffixes):
+                continue
+            kw = _timeout_kwarg(node)
+            if kw is not None:
+                if _is_none(kw.value):
+                    out.append(ctx.finding(
+                        "PML011", node,
+                        f"{name}(timeout=None) blocks unboundedly — a "
+                        f"dead peer hangs this thread forever; pass a "
+                        f"finite timeout or allow with a reason"))
+                continue
+            if pos is not None and len(node.args) > pos:
+                continue  # timeout rode in positionally
+            out.append(ctx.finding(
+                "PML011", node,
+                f"blocking network call {name}() without an explicit "
+                f"timeout — a dead peer hangs this thread forever "
+                f"(the never-hang contract, docs/ROBUSTNESS.md); pass "
+                f"timeout=..."))
+        elif leaf == "settimeout" and node.args \
+                and _is_none(node.args[0]):
+            out.append(ctx.finding(
+                "PML011", node,
+                "settimeout(None) puts the socket in unbounded "
+                "blocking mode — a dead peer hangs this thread "
+                "forever; use a finite timeout or allow with a reason"))
+    return out

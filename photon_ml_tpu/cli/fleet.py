@@ -1,0 +1,195 @@
+"""Replicated serving driver: N scoring replicas behind an
+entity-affinity router (docs/SERVING.md "Scaling out").
+
+One process, one device cannot serve "millions of users" (ROADMAP item
+3); this driver spawns ``--replicas`` full ``photon-game-serve``
+subprocesses over the same model, hash-assigns routing shards to them so
+every entity's requests land on one replica (its device LRU stays hot),
+and fronts them with one HTTP door that survives replica death:
+health probes + heartbeat deadlines, shard re-homing to survivors within
+``--rehome-deadline-s``, bounded-retry forwards with optional hedged
+second-sends, and supervised restart (photon_ml_tpu/serving/fleet.py).
+
+Quickstart:
+
+    photon-game-fleet --model-dir out/best --replicas 4 --port 8080
+    curl -s localhost:8080/score -d '{"requests": [{"features": \
+        {"global": [0.1, ...]}, "entity_ids": {"userId": 7}}]}'
+    curl -s localhost:8080/healthz   # degraded flag while re-homing
+    curl -s localhost:8080/metrics   # photon_fleet_* lines
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import tempfile
+
+from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                         make_fleet_http_server)
+from photon_ml_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    # -- model flags, forwarded verbatim to every replica ----------------
+    p.add_argument("--model-dir", required=True, help="GameModel directory")
+    p.add_argument("--model-format", default="NPZ",
+                   choices=["NPZ", "AVRO"])
+    p.add_argument("--feature-index-dir",
+                   help="REQUIRED with --model-format AVRO")
+    p.add_argument("--entity-vocabs",
+                   help="entity-vocabs.json for raw-key entity ids")
+    p.add_argument("--as-mean", action="store_true")
+    # -- fleet shape -----------------------------------------------------
+    p.add_argument("--replicas", type=int, default=2,
+                   help="scoring replica subprocesses")
+    p.add_argument("--num-shards", type=int, default=None,
+                   help="routing shards hash-assigned to replicas "
+                        "(default max(8, 2*replicas); more shards = "
+                        "finer re-home granularity)")
+    p.add_argument("--route-re-type",
+                   help="which entity id carries routing affinity when "
+                        "requests name several (default: "
+                        "lexicographically first)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="fleet front door; 0 picks a free port")
+    p.add_argument("--workdir", default=None,
+                   help="replica logs + ready files (default: a fresh "
+                        "temp dir)")
+    # -- failure knobs (docs/SERVING.md failure ladder) ------------------
+    p.add_argument("--probe-interval-s", type=float, default=0.25,
+                   help="health-probe cadence per replica")
+    p.add_argument("--heartbeat-deadline-s", type=float, default=2.0,
+                   help="a replica silent this long is declared dead")
+    p.add_argument("--rehome-deadline-s", type=float, default=5.0,
+                   help="detection -> shards re-homed + new owners "
+                        "confirmed; over it counts a deadline miss "
+                        "(photon_fleet_rehome_deadline_misses_total)")
+    p.add_argument("--retries", type=int, default=3,
+                   help="bounded forward retries (connection-class "
+                        "failures only; deterministic backoff)")
+    p.add_argument("--retry-backoff-s", type=float, default=0.1,
+                   help="deterministic backoff step; the ladder's "
+                        "total patience (sum of backoffs) covers "
+                        "death detection at the default probe "
+                        "interval, so a SIGKILL retries onto the "
+                        "re-homed owner instead of shedding")
+    p.add_argument("--hedge-after-ms", type=float, default=None,
+                   help="send a duplicate to the next healthy replica "
+                        "when the primary is slower than this; first "
+                        "response wins (off by default)")
+    p.add_argument("--request-timeout-s", type=float, default=30.0,
+                   help="per-forward HTTP timeout (every blocking call "
+                        "carries one - PML011)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="restart budget per replica before it is "
+                        "declared failed (fleet stays degraded)")
+    p.add_argument("--max-inflight", type=int, default=None,
+                   help="fleet admission bound on in-flight /score "
+                        "bodies (default 16*replicas); overflow sheds "
+                        "503 with fleet depth in the body")
+    p.add_argument("--start-timeout-s", type=float, default=120.0)
+    p.add_argument("--fault-plan",
+                   help="JSON FaultPlan armed in the DRIVER and every "
+                        "replica (chaos drills: replica_kill, delay, "
+                        "partition - docs/ROBUSTNESS.md)")
+    # -- replica knobs, forwarded --------------------------------------
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--cache-entities", type=int, default=4096)
+    p.add_argument("--store-shards", type=int, default=8)
+    p.add_argument("--max-queue", type=int, default=None)
+    p.add_argument("--request-deadline-s", type=float, default=30.0)
+    # -- fleet SLO -------------------------------------------------------
+    p.add_argument("--slo-window-s", type=float, default=60.0)
+    p.add_argument("--slo-availability", type=float, default=0.999)
+    p.add_argument("--slo-latency-ms", type=float, default=None)
+    return p
+
+
+def replica_args_from(args) -> list[str]:
+    """The ``photon_ml_tpu.cli.serve`` argv tail every replica shares."""
+    out = ["--model-dir", args.model_dir,
+           "--model-format", args.model_format,
+           "--max-batch", str(args.max_batch),
+           "--max-wait-ms", str(args.max_wait_ms),
+           "--cache-entities", str(args.cache_entities),
+           "--store-shards", str(args.store_shards),
+           "--request-deadline-s", str(args.request_deadline_s)]
+    if args.feature_index_dir:
+        out += ["--feature-index-dir", args.feature_index_dir]
+    if args.entity_vocabs:
+        out += ["--entity-vocabs", args.entity_vocabs]
+    if args.as_mean:
+        out += ["--as-mean"]
+    if args.max_queue is not None:
+        out += ["--max-queue", str(args.max_queue)]
+    return out
+
+
+def create_fleet(args) -> ServingFleet:
+    """Build (not yet started) the fleet from parsed CLI args — split
+    out so tests and the bench drive the same construction path."""
+    workdir = args.workdir or tempfile.mkdtemp(prefix="photon-fleet-")
+    if args.fault_plan:
+        # Arm the driver-side sites (fleet.route, fleet.probe) here;
+        # replicas arm their own copy through the forwarded flag.
+        from photon_ml_tpu import faults as flt
+
+        with open(args.fault_plan) as f:
+            flt.install(flt.FaultPlan.from_json(f.read()))
+        logger.warning("fault plan %s ARMED in the fleet driver",
+                       args.fault_plan)
+    return ServingFleet(
+        replica_args=replica_args_from(args),
+        num_replicas=args.replicas,
+        workdir=workdir,
+        num_shards=args.num_shards,
+        route_re_type=args.route_re_type,
+        request_timeout_s=args.request_timeout_s,
+        retries=args.retries,
+        retry_backoff_s=args.retry_backoff_s,
+        hedge_after_s=(None if args.hedge_after_ms is None
+                       else args.hedge_after_ms / 1e3),
+        probe_interval_s=args.probe_interval_s,
+        heartbeat_deadline_s=args.heartbeat_deadline_s,
+        rehome_deadline_s=args.rehome_deadline_s,
+        start_timeout_s=args.start_timeout_s,
+        max_restarts=args.max_restarts,
+        max_inflight=args.max_inflight,
+        fault_plan_file=args.fault_plan,
+        slo_window_s=args.slo_window_s,
+        slo_availability=args.slo_availability,
+        slo_latency_ms=args.slo_latency_ms)
+
+
+def run(args) -> None:
+    setup_logging()
+    fleet = create_fleet(args)
+    fleet.start()
+    server = make_fleet_http_server(fleet, host=args.host,
+                                    port=args.port)
+    host, port = server.server_address[:2]
+    logger.info(
+        "fleet of %d replica(s) x %d shard(s) on http://%s:%d "
+        "(POST /score, GET /metrics, /slo, /healthz); replica logs in %s",
+        fleet.num_replicas, fleet.num_shards, host, port, fleet.workdir)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down fleet")
+    finally:
+        server.server_close()
+        fleet.close()
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "scoreboard + cross-stack registry) to this "
                         "file at shutdown, also from a finally — the "
                         "game_train --metrics-dump parity flag")
+    # -- fleet-replica plumbing (serving/fleet.py spawns these) ----------
+    p.add_argument("--ready-file",
+                   help="after binding, atomically write {pid, host, "
+                        "port} JSON here — the supervisor's handshake "
+                        "for --port 0 replicas (no port-allocation "
+                        "race, no pipe to overflow)")
+    p.add_argument("--replica-id", type=int, default=None,
+                   help="this server's stable fleet index: fault site "
+                        "fleet.replica_flush fires with it, logs carry "
+                        "it (set by the fleet supervisor)")
+    p.add_argument("--fault-plan",
+                   help="JSON FaultPlan installed at startup — the "
+                        "game_train --fault-plan parity flag; how "
+                        "fleet chaos drills reach inside a replica "
+                        "(docs/ROBUSTNESS.md)")
     return p
 
 
@@ -129,6 +144,13 @@ def create_server(args):
 
     Split from ``main`` so tests and embedding callers can drive the
     server loop themselves; returns (server, service)."""
+    if getattr(args, "fault_plan", None):
+        from photon_ml_tpu import faults as flt
+
+        with open(args.fault_plan) as f:
+            flt.install(flt.FaultPlan.from_json(f.read()))
+        logger.warning("fault plan %s ARMED in this server",
+                       args.fault_plan)
     enable_compilation_cache()
     model, vocabs = load_model(args)
     service = ScoringService(
@@ -139,8 +161,17 @@ def create_server(args):
         request_deadline_s=(args.request_deadline_s or None),
         slo_window_s=getattr(args, "slo_window_s", 60.0),
         slo_availability=getattr(args, "slo_availability", 0.999),
-        slo_latency_ms=getattr(args, "slo_latency_ms", None))
+        slo_latency_ms=getattr(args, "slo_latency_ms", None),
+        replica_id=getattr(args, "replica_id", None))
     server = make_http_server(service, host=args.host, port=args.port)
+    if getattr(args, "ready_file", None):
+        # Atomic: the supervisor polling this file must never read a
+        # torn write (same tmp+rename discipline as every commit point).
+        host, port = server.server_address[:2]
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "host": host, "port": port}, f)
+        os.replace(tmp, args.ready_file)
     return server, service
 
 

@@ -8,6 +8,7 @@ tooling without dragging JAX in.
 from photon_ml_tpu.faults.injector import (FaultInjector, FaultPlan,
                                            FaultSpec, InjectedFault,
                                            InjectedIOError,
+                                           InjectedPartition,
                                            InjectedThreadDeath, active,
                                            corrupt_file, current_plan,
                                            fire, install, installed,
@@ -19,6 +20,7 @@ __all__ = [
     "FaultSpec",
     "InjectedFault",
     "InjectedIOError",
+    "InjectedPartition",
     "InjectedThreadDeath",
     "active",
     "corrupt_file",

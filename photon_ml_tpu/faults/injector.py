@@ -18,7 +18,24 @@ and says what happens there:
 
 - ``raise``        — raise an exception (worker crash, transient I/O);
 - ``sleep``        — delay ``seconds`` (slow shard / straggler);
+- ``delay``        — delay ``seconds``, the NETWORK-latency class: same
+                     mechanics as ``sleep``, but named for what it
+                     models — injected link/RPC latency at a send site
+                     (a router forward, a chunk transfer, a probe), the
+                     fault hedged sends and heartbeat deadlines exist
+                     for. Usable at any site, training ones included;
+- ``partition``    — raise ``InjectedPartition`` (a ConnectionError):
+                     the site's traffic is DROPPED, as if the network
+                     between the caller and its peer went away —
+                     distinct from ``raise`` because callers that
+                     retry/fail over catch connection errors
+                     specifically. Usable at any site;
 - ``kill``         — SIGKILL the calling process (worker/driver death);
+- ``replica_kill`` — SIGKILL the calling process, the replica-death
+                     class: same mechanics as ``kill``, named so fleet
+                     fault plans read as what they drill (a scoring
+                     replica dying mid-flush; aim it at
+                     ``fleet.replica_flush`` with index = replica id);
 - ``corrupt``      — garble the bytes of the file a save-site just wrote
                      (corrupted cache shard / checkpoint artifact);
 - ``thread_death`` — raise ``InjectedThreadDeath`` (a BaseException, so
@@ -59,6 +76,13 @@ class InjectedIOError(OSError):
     """An injector-raised transient I/O failure."""
 
 
+class InjectedPartition(ConnectionError):
+    """An injector-dropped network edge: the peer is (simulated) on the
+    other side of a partition. A ConnectionError, because that is what
+    routers and supervisors catch to fail over — a partition drill that
+    raised a generic error would test the wrong handler."""
+
+
 class InjectedThreadDeath(BaseException):
     """Deliberately NOT an Exception: escapes ``except Exception``
     handlers the way a real interpreter-level thread death (MemoryError,
@@ -69,9 +93,11 @@ class InjectedThreadDeath(BaseException):
 _EXC_TYPES = {
     "InjectedFault": InjectedFault,
     "InjectedIOError": InjectedIOError,
+    "InjectedPartition": InjectedPartition,
     "RuntimeError": RuntimeError,
     "OSError": OSError,
     "IOError": OSError,
+    "ConnectionError": ConnectionError,
     "ValueError": ValueError,
 }
 
@@ -106,8 +132,9 @@ class FaultSpec:
     scope: str = "any"
 
     def __post_init__(self):
-        if self.kind not in ("raise", "sleep", "kill", "corrupt",
-                             "thread_death", "nan"):
+        if self.kind not in ("raise", "sleep", "delay", "kill",
+                             "replica_kill", "corrupt", "thread_death",
+                             "nan", "partition"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.scope not in ("any", "worker", "driver"):
             raise ValueError(f"unknown fault scope {self.scope!r}")
@@ -201,15 +228,18 @@ class FaultInjector:
         """Crash/delay/kill hook: every instrumented execution point
         calls this once per occurrence."""
         spec = self._match(site, index,
-                           ("raise", "sleep", "kill", "thread_death"))
+                           ("raise", "sleep", "delay", "kill",
+                            "replica_kill", "thread_death", "partition"))
         if spec is None:
             return
-        if spec.kind == "sleep":
+        if spec.kind in ("sleep", "delay"):
             time.sleep(spec.seconds)
-        elif spec.kind == "kill":
+        elif spec.kind in ("kill", "replica_kill"):
             os.kill(os.getpid(), signal.SIGKILL)
         elif spec.kind == "thread_death":
             raise InjectedThreadDeath(f"{spec.message} [site={site}]")
+        elif spec.kind == "partition":
+            raise InjectedPartition(f"{spec.message} [site={site}]")
         else:
             raise _EXC_TYPES[spec.exc](f"{spec.message} [site={site}]")
 

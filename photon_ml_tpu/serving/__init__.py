@@ -10,13 +10,21 @@ and streams micro-batched requests through a shape-bucketed jitted scorer.
 from photon_ml_tpu.serving.batcher import (BatcherDied, BatcherQueueFull,
                                            DeadlineExceeded, MicroBatcher,
                                            bucket_batch)
+from photon_ml_tpu.serving.fleet import (FleetMetrics, ServingFleet,
+                                         make_fleet_http_server)
 from photon_ml_tpu.serving.metrics import (STAGES, SLOTracker,
                                            ServingMetrics)
 from photon_ml_tpu.serving.model_store import (HashShardedStore,
                                                ResidentModelStore)
+from photon_ml_tpu.serving.router import (FleetRouter, ReplicaHTTPError,
+                                          ReplicaShed, ReplicaUnavailable,
+                                          ShardMap, route_key)
 from photon_ml_tpu.serving.service import (ScoringRequest, ScoringService,
                                            make_http_server,
                                            requests_from_dataset)
+
+from photon_ml_tpu.serving.supervisor import (ReplicaStartupError,
+                                              ReplicaSupervisor)
 
 __all__ = [
     "BatcherDied",
@@ -24,6 +32,17 @@ __all__ = [
     "DeadlineExceeded",
     "MicroBatcher",
     "bucket_batch",
+    "FleetMetrics",
+    "FleetRouter",
+    "ReplicaHTTPError",
+    "ReplicaShed",
+    "ReplicaStartupError",
+    "ReplicaSupervisor",
+    "ReplicaUnavailable",
+    "ServingFleet",
+    "ShardMap",
+    "make_fleet_http_server",
+    "route_key",
     "STAGES",
     "SLOTracker",
     "ServingMetrics",

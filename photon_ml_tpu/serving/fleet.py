@@ -1,0 +1,560 @@
+"""photon-fleet: replicated serving with entity-affinity routing.
+
+The single-process ``ScoringService`` (serving/service.py) is the
+degenerate case ROADMAP item 3 promised to outgrow: one process, one
+device cannot serve "millions of users". ``ServingFleet`` instates the
+multi-host layout the host store was designed around:
+
+    clients ──▶ fleet front door (this module)
+                  │  admission control (503: replica id + fleet depth)
+                  ▼
+              FleetRouter (router.py): entity → shard → owning replica,
+                  bounded retry, hedged second-sends
+                  │
+        ┌─────────┼─────────┐
+        ▼         ▼         ▼
+    replica 0  replica 1  replica N-1     ← ReplicaSupervisor
+    (full ScoringService subprocesses:      (supervisor.py): probes,
+     fixed effects replicated, host          heartbeat deadlines,
+     store complete, device LRU hot          death → re-home →
+     on OWN shards only)                     bounded restart
+
+Failure half (the robustness core — docs/SERVING.md failure ladder):
+replica death fails in-flight forwards fast (connection errors, the
+``BatcherDied`` discipline one level up), the dead replica's shards
+re-home to survivors within ``rehome_deadline_s`` (table swap + health
+confirmation; survivors serve them from their own host stores with the
+SAME scores), the supervisor restarts the replica, and its shards come
+home. Every step is observable: ``ReplicaDied`` / ``ShardRehomed`` /
+``ReplicaRecovered`` events, ``photon_fleet_*`` metrics, a ``degraded``
+flag on ``/healthz`` while any shard is away from home, and a
+fleet-level ``SLOTracker`` burning error budget on shed/unserved
+requests.
+
+Parity contract (the PR 1 discipline): every routed request's score is
+bit-identical to the single-process ``ScoringService`` on the same
+model — replicas RUN that service, and re-homing only changes which one
+answers. ``tests/test_fleet.py`` proves it through SIGKILL chaos.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from photon_ml_tpu.serving.metrics import SLOTracker
+from photon_ml_tpu.serving.router import (FleetRouter, ReplicaHTTPError,
+                                          ReplicaShed, ReplicaUnavailable,
+                                          ShardMap)
+from photon_ml_tpu.serving.supervisor import UP, ReplicaSupervisor
+from photon_ml_tpu.utils.events import (ReplicaDied, ReplicaRecovered,
+                                        ShardRehomed, default_emitter)
+
+logger = logging.getLogger("photon_ml_tpu.serving.fleet")
+
+
+class FleetMetrics:
+    """The fleet scoreboard: ``photon_fleet_*`` exposition +
+    fleet-level SLO window. Thread-safe (router pool threads, the
+    supervisor monitor, and HTTP handler threads all record)."""
+
+    def __init__(self, num_replicas: int, slo_window_s: float = 60.0,
+                 slo_availability: float = 0.999,
+                 slo_latency_ms: Optional[float] = None):
+        self._lock = threading.Lock()
+        self.num_replicas = num_replicas
+        self.requests_total = 0
+        self.requests_by_replica = {i: 0 for i in range(num_replicas)}
+        self.shed_total = 0  # fleet admission + replica-shed translations
+        self.error_total = 0  # non-retryable replica HTTP errors
+        self.unserved_total = 0  # retry budget exhausted (ReplicaUnavailable)
+        self.forward_retries_total = 0
+        self.forward_errors_total = 0
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
+        self.rehomes_total = 0
+        self.rehome_seconds_last = 0.0
+        self.rehome_seconds_max = 0.0
+        self.rehome_deadline_misses_total = 0
+        self.replica_deaths_total = 0
+        self.replica_restarts_total = 0
+        self.slo = SLOTracker(window_s=slo_window_s,
+                              availability_objective=slo_availability,
+                              latency_objective_ms=slo_latency_ms)
+
+    # Router callbacks (FleetRouter.metrics protocol).
+    def record_retry(self, n: int = 1) -> None:
+        with self._lock:
+            self.forward_retries_total += n
+
+    def record_forward_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.forward_errors_total += n
+
+    def record_hedge(self) -> None:
+        with self._lock:
+            self.hedges_total += 1
+
+    def record_hedge_win(self) -> None:
+        with self._lock:
+            self.hedge_wins_total += 1
+
+    # Fleet-side records.
+    def record_routed(self, replica_counts: dict[int, int]) -> None:
+        with self._lock:
+            for rid, n in replica_counts.items():
+                self.requests_by_replica[rid] = \
+                    self.requests_by_replica.get(rid, 0) + n
+                self.requests_total += n
+
+    def record_ok(self, latency_s: float, n: int = 1) -> None:
+        for _ in range(n):
+            self.slo.record_ok(latency_s)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed_total += n
+        self.slo.record_bad("shed", n)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.error_total += n
+        self.slo.record_bad("error", n)
+
+    def record_unserved(self, n: int = 1) -> None:
+        with self._lock:
+            self.unserved_total += n
+        self.slo.record_bad("error", n)
+
+    def record_death(self) -> None:
+        with self._lock:
+            self.replica_deaths_total += 1
+
+    def record_restart(self) -> None:
+        with self._lock:
+            self.replica_restarts_total += 1
+
+    def record_rehome(self, seconds: float, deadline_s: float) -> None:
+        with self._lock:
+            self.rehomes_total += 1
+            self.rehome_seconds_last = seconds
+            self.rehome_seconds_max = max(self.rehome_seconds_max,
+                                          seconds)
+            if seconds > deadline_s:
+                self.rehome_deadline_misses_total += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "requests_by_replica": dict(self.requests_by_replica),
+                "shed_total": self.shed_total,
+                "error_total": self.error_total,
+                "unserved_total": self.unserved_total,
+                "forward_retries_total": self.forward_retries_total,
+                "forward_errors_total": self.forward_errors_total,
+                "hedges_total": self.hedges_total,
+                "hedge_wins_total": self.hedge_wins_total,
+                "rehomes_total": self.rehomes_total,
+                "rehome_seconds_last": self.rehome_seconds_last,
+                "rehome_seconds_max": self.rehome_seconds_max,
+                "rehome_deadline_misses_total":
+                    self.rehome_deadline_misses_total,
+                "replica_deaths_total": self.replica_deaths_total,
+                "replica_restarts_total": self.replica_restarts_total,
+            }
+
+    def render_text(self, states: dict[int, str],
+                    degraded: bool) -> str:
+        """Prometheus-style ``photon_fleet_*`` lines (the metric
+        catalog rows in docs/OBSERVABILITY.md)."""
+        s = self.snapshot()
+        lines = [
+            f"photon_fleet_replicas {self.num_replicas}",
+            f"photon_fleet_degraded {1 if degraded else 0}",
+            f"photon_fleet_requests_total {s['requests_total']}",
+            f"photon_fleet_shed_total {s['shed_total']}",
+            f"photon_fleet_errors_total {s['error_total']}",
+            f"photon_fleet_unserved_total {s['unserved_total']}",
+            f"photon_fleet_forward_retries_total "
+            f"{s['forward_retries_total']}",
+            f"photon_fleet_forward_errors_total "
+            f"{s['forward_errors_total']}",
+            f"photon_fleet_hedges_total {s['hedges_total']}",
+            f"photon_fleet_hedge_wins_total {s['hedge_wins_total']}",
+            f"photon_fleet_rehomes_total {s['rehomes_total']}",
+            f"photon_fleet_rehome_seconds{{window=\"last\"}} "
+            f"{s['rehome_seconds_last']:.6f}",
+            f"photon_fleet_rehome_seconds{{window=\"max\"}} "
+            f"{s['rehome_seconds_max']:.6f}",
+            f"photon_fleet_rehome_deadline_misses_total "
+            f"{s['rehome_deadline_misses_total']}",
+            f"photon_fleet_replica_deaths_total "
+            f"{s['replica_deaths_total']}",
+            f"photon_fleet_replica_restarts_total "
+            f"{s['replica_restarts_total']}",
+        ]
+        for rid in sorted(states):
+            lines.append(
+                f"photon_fleet_replica_up{{replica=\"{rid}\"}} "
+                f"{1 if states[rid] == UP else 0}")
+            lines.append(
+                f"photon_fleet_requests_routed_total"
+                f"{{replica=\"{rid}\"}} "
+                f"{s['requests_by_replica'].get(rid, 0)}")
+        slo = self.slo.snapshot()
+        lines.append(f"photon_fleet_slo_requests_in_window "
+                     f"{slo['requests_in_window']}")
+        lines.append(f"photon_fleet_slo_bad_in_window "
+                     f"{slo['bad_in_window']}")
+        lines.append(f"photon_fleet_slo_availability "
+                     f"{slo['availability']:.6f}")
+        lines.append(f"photon_fleet_slo_budget_burn_rate "
+                     f"{slo['budget_burn_rate']:.6f}")
+        for q in ("p50", "p95", "p99"):
+            lines.append(f"photon_fleet_slo_latency_ms"
+                         f"{{quantile=\"{q}\"}} {slo[q + '_ms']:.4f}")
+        return "\n".join(lines) + "\n"
+
+
+class ServingFleet:
+    """N supervised scoring replicas behind one entity-affinity router.
+
+    ``replica_args`` is the ``photon_ml_tpu.cli.serve`` argv tail every
+    replica shares (model flags, batching knobs); the fleet appends the
+    per-replica plumbing (``--port 0 --ready-file … --replica-id …`` and
+    the fault plan, when drilling). Replicas inherit this process's
+    environment, so ``JAX_PLATFORMS=cpu`` tests stay on CPU.
+    """
+
+    def __init__(
+        self,
+        replica_args: Sequence[str],
+        num_replicas: int,
+        workdir: str,
+        num_shards: Optional[int] = None,
+        route_re_type: Optional[str] = None,
+        request_timeout_s: float = 30.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.1,
+        hedge_after_s: Optional[float] = None,
+        probe_interval_s: float = 0.25,
+        probe_timeout_s: float = 1.0,
+        heartbeat_deadline_s: float = 2.0,
+        rehome_deadline_s: float = 5.0,
+        start_timeout_s: float = 120.0,
+        max_restarts: int = 3,
+        max_inflight: Optional[int] = None,
+        fault_plan_file: Optional[str] = None,
+        slo_window_s: float = 60.0,
+        slo_availability: float = 0.999,
+        slo_latency_ms: Optional[float] = None,
+        emitter=default_emitter,
+    ):
+        self.replica_args = list(replica_args)
+        self.num_replicas = int(num_replicas)
+        self.num_shards = int(num_shards if num_shards is not None
+                              else max(8, 2 * self.num_replicas))
+        self.workdir = workdir
+        self.rehome_deadline_s = float(rehome_deadline_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.fault_plan_file = fault_plan_file
+        self.emitter = emitter
+        # Fleet admission control: beyond this many in-flight /score
+        # bodies the front door sheds (the replicas' own queues are the
+        # deeper backstop; this bound keeps the router pool sane).
+        self.max_inflight = (int(max_inflight) if max_inflight is not None
+                             else 16 * self.num_replicas)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.metrics = FleetMetrics(self.num_replicas,
+                                    slo_window_s=slo_window_s,
+                                    slo_availability=slo_availability,
+                                    slo_latency_ms=slo_latency_ms)
+        self.shard_map = ShardMap(self.num_shards, self.num_replicas)
+        self.supervisor = ReplicaSupervisor(
+            self._replica_argv, self.num_replicas, workdir,
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            heartbeat_deadline_s=heartbeat_deadline_s,
+            start_timeout_s=start_timeout_s,
+            max_restarts=max_restarts,
+            on_death=self._on_death,
+            on_recovered=self._on_recovered)
+        self.router = FleetRouter(
+            self.shard_map, self.supervisor.endpoint,
+            route_re_type=route_re_type,
+            request_timeout_s=request_timeout_s,
+            retries=retries, retry_backoff_s=retry_backoff_s,
+            hedge_after_s=hedge_after_s, metrics=self.metrics)
+        self._degraded = False
+        self._rehoming = False
+        self._closed = False
+
+    # -- replica plumbing ----------------------------------------------------
+
+    def _replica_argv(self, replica_id: int, ready_file: str) -> list[str]:
+        argv = [sys.executable, "-m", "photon_ml_tpu.cli.serve",
+                *self.replica_args,
+                "--host", "127.0.0.1", "--port", "0",
+                "--ready-file", ready_file,
+                "--replica-id", str(replica_id)]
+        if self.fault_plan_file:
+            argv += ["--fault-plan", self.fault_plan_file]
+        return argv
+
+    # -- failure half --------------------------------------------------------
+
+    def _on_death(self, replica_id: int) -> None:
+        """Supervisor monitor-thread callback: the rehome window starts
+        HERE (detection) and closes when every moved shard's new owner
+        confirmed healthy."""
+        t0 = time.monotonic()
+        self.metrics.record_death()
+        self._degraded = True
+        self._rehoming = True
+        self.emitter.emit(ReplicaDied(replica_id=replica_id,
+                                      reason="declared dead by probe"))
+        try:
+            moved = self.shard_map.mark_down(replica_id)
+        except ReplicaUnavailable:
+            logger.error("replica %d died and no survivor remains — "
+                         "the fleet is down until a restart succeeds",
+                         replica_id)
+            self._rehoming = False
+            return
+        # Confirm each new owner actually serves before declaring the
+        # re-home done — a table swap to another corpse is not recovery.
+        from photon_ml_tpu.serving.supervisor import _probe_healthz
+        for rid in sorted(set(moved.values())):
+            host, port = self.supervisor.endpoint(rid)
+            try:
+                _probe_healthz(f"http://{host}:{port}",
+                               self.probe_timeout_s)
+            except (OSError, ValueError) as e:
+                logger.warning("re-home target %d not yet healthy "
+                               "(%s) — the monitor will handle it", rid, e)
+        seconds = time.monotonic() - t0
+        self._rehoming = False
+        self.metrics.record_rehome(seconds, self.rehome_deadline_s)
+        self.emitter.emit(ShardRehomed(
+            replica_id=replica_id, shards=tuple(sorted(moved)),
+            new_owners=tuple(moved[s] for s in sorted(moved)),
+            seconds=seconds))
+        level = (logger.error if seconds > self.rehome_deadline_s
+                 else logger.info)
+        level("re-homed %d shard(s) of dead replica %d in %.3fs "
+              "(deadline %.3fs)", len(moved), replica_id, seconds,
+              self.rehome_deadline_s)
+
+    def _on_recovered(self, replica_id: int) -> None:
+        back = self.shard_map.restore(replica_id)
+        self.metrics.record_restart()
+        self.emitter.emit(ReplicaRecovered(
+            replica_id=replica_id, shards_restored=tuple(back)))
+        states = self.supervisor.states()
+        if all(st == UP for st in states.values()):
+            self._degraded = False
+        logger.info("replica %d recovered; %d shard(s) back home; "
+                    "fleet %s", replica_id, len(back),
+                    "healthy" if not self._degraded else "still degraded")
+
+    # -- serving -------------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(self.workdir, exist_ok=True)
+        self.supervisor.start()
+
+    def score(self, request_objs: Sequence[dict],
+              want_trace: bool = False) -> dict:
+        """Route one /score body through the fleet; returns the merged
+        response payload. Raises the router's defined errors — the HTTP
+        front end maps them to status codes; programmatic callers get
+        the same exception taxonomy."""
+        counts: dict[int, int] = {}
+        for obj in request_objs:
+            rid = self.router.replica_for(obj)
+            counts[rid] = counts.get(rid, 0) + 1
+        self.metrics.record_routed(counts)
+        t0 = time.monotonic()
+        out = self.router.score(request_objs, want_trace=want_trace)
+        dt = time.monotonic() - t0
+        self.metrics.record_ok(dt, n=len(request_objs))
+        return out
+
+    def admission_acquire(self) -> bool:
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def admission_release(self) -> None:
+        with self._inflight_lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def healthz(self) -> dict:
+        states = self.supervisor.states()
+        degraded = self._degraded or any(st != UP
+                                         for st in states.values())
+        return {
+            "status": "degraded" if degraded else "ok",
+            "degraded": degraded,
+            "rehoming": self._rehoming,
+            "fleet_depth": self.num_replicas,
+            "replicas": {str(k): v for k, v in states.items()},
+            "num_shards": self.num_shards,
+            "shards_away_from_home": sum(
+                1 for s in range(self.num_shards)
+                if self.shard_map.owner(s) != self.shard_map.home(s)),
+        }
+
+    def metrics_text(self) -> str:
+        return self.metrics.render_text(self.supervisor.states(),
+                                        self.healthz()["degraded"])
+
+    def slo_snapshot(self) -> dict:
+        out = self.metrics.slo.snapshot()
+        out["lifetime"] = self.metrics.snapshot()
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.router.close()
+        self.supervisor.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- fleet HTTP front door ---------------------------------------------------
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    """POST /score, GET /metrics, GET /slo, GET /healthz — the same
+    surface as one replica, so clients cannot tell the fleet from a
+    single ``photon-game-serve`` (except via the richer /healthz)."""
+
+    fleet: ServingFleet = None  # bound by make_fleet_http_server
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = self.fleet.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/slo":
+            self._json(200, self.fleet.slo_snapshot())
+        elif self.path == "/healthz":
+            hz = self.fleet.healthz()
+            # Degraded is still SERVING (shards re-homed) — 200 with the
+            # flag, not a 5xx that would page as an outage.
+            self._json(200, hz)
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        fleet = self.fleet
+        if self.path != "/score":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("request body must be a JSON object")
+            reqs = payload.get("requests", [])
+            if not isinstance(reqs, list) or not reqs:
+                raise ValueError("no requests")
+            want_trace = bool(payload.get("trace", False))
+        except (ValueError, TypeError, AttributeError, KeyError) as exc:
+            self._json(400, {"error": f"malformed request: {exc}"})
+            return
+        if not fleet.admission_acquire():
+            # Fleet-level admission: the 503 names the FLEET (no single
+            # replica shed) and carries the depth context the ISSUE's
+            # degradation contract requires.
+            fleet.metrics.record_shed(len(reqs))
+            self._json(503, {
+                "error": "fleet admission control: too many in-flight "
+                         "score bodies",
+                "replica_id": None,
+                "fleet_depth": fleet.num_replicas,
+                "inflight": fleet.inflight,
+                "max_inflight": fleet.max_inflight,
+            })
+            return
+        try:
+            out = fleet.score(reqs, want_trace=want_trace)
+        except ReplicaShed as exc:
+            fleet.metrics.record_shed(len(reqs))
+            self._json(503, {
+                "error": str(exc),
+                "replica_id": exc.replica_id,
+                "fleet_depth": fleet.num_replicas,
+                "queue_depth": exc.queue_depth,
+                "degraded": fleet.healthz()["degraded"],
+            })
+            return
+        except ReplicaUnavailable as exc:
+            fleet.metrics.record_unserved(len(reqs))
+            self._json(503, {
+                "error": str(exc),
+                "replica_id": exc.replica_id,
+                "fleet_depth": fleet.num_replicas,
+                "degraded": True,
+            })
+            return
+        except ReplicaHTTPError as exc:
+            fleet.metrics.record_error(len(reqs))
+            self._json(exc.status if exc.status >= 400 else 500, {
+                "error": str(exc),
+                "replica_id": exc.replica_id,
+                "fleet_depth": fleet.num_replicas,
+            })
+            return
+        finally:
+            fleet.admission_release()
+        body = {"scores": out["scores"],
+                "uids": [r.get("uid") for r in reqs]}
+        if want_trace and out.get("attribution") is not None:
+            body["attribution"] = out["attribution"]
+        self._json(200, body)
+
+    def log_message(self, fmt, *args):  # access logs off stderr
+        logger.debug("fleet http: " + fmt, *args)
+
+
+def make_fleet_http_server(fleet: ServingFleet, host: str = "127.0.0.1",
+                           port: int = 8080) -> ThreadingHTTPServer:
+    """Bind the fleet front door (call ``serve_forever`` to serve);
+    ``port=0`` picks a free port — it is ``server.server_address[1]``."""
+    handler = type("BoundFleetHandler", (_FleetHandler,),
+                   {"fleet": fleet})
+    return ThreadingHTTPServer((host, port), handler)

@@ -1,0 +1,426 @@
+"""Entity-affinity routing: shard → replica assignment + HTTP forwarding.
+
+The single-process ``HashShardedStore`` shards the random-effect host
+store by ``entity_id % num_shards`` and its docstring promised the
+multi-host reading: "shard s would live on host s". The fleet instates
+that layout one level up: the SAME modulo now picks a **routing shard**,
+and a shard→replica table assigns each shard to the replica that serves
+it. Every request for an entity therefore lands on one replica — its
+device LRU stays hot on exactly its shard's entities (the Snap ML
+hierarchical-sharding observation: partition per-entity state, keep each
+worker's local cache hot).
+
+Replicas hold the FULL host store (host DRAM is the cheap tier; device
+HBM is the scarce one the affinity exists for), so any replica *can*
+serve any entity bit-identically — affinity is a performance contract,
+ownership is a routing-table entry. That is what makes recovery cheap:
+when a replica dies, its shards **re-home** to survivors by table swap,
+the survivors serve them from their own host stores (cold device cache,
+same scores), and when the replica returns its shards come home.
+
+Failure handling per forward (docs/ROBUSTNESS.md failure ladder):
+
+- **bounded retry with deterministic backoff** on connection errors and
+  timeouts — re-resolving the owner each attempt, so a retry lands on
+  the NEW owner once the supervisor re-homed a dead replica's shards;
+- **hedged second-send**: a primary slower than ``hedge_after_s`` gets a
+  duplicate sent to the next healthy replica; first response wins, the
+  loser is discarded under a winner lock (exactly-one response — safe
+  because scoring is pure), ``hedge_wins_total`` counts upsets;
+- a replica's 503 (its own admission control) is FINAL — retrying an
+  overloaded replica amplifies the overload; the fleet translates it to
+  a fleet 503 carrying the replica id and fleet depth.
+
+Every blocking HTTP call carries an explicit timeout (PML011).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable, Optional, Sequence
+
+from photon_ml_tpu import faults as flt
+
+logger = logging.getLogger("photon_ml_tpu.serving.fleet")
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The forward retry budget is exhausted and no replica answered;
+    the request was possibly scored but never acknowledged — callers
+    get a defined 503, never a silent wrong answer."""
+
+    def __init__(self, message: str, replica_id: Optional[int] = None):
+        super().__init__(message)
+        self.replica_id = replica_id
+
+
+class ReplicaShed(RuntimeError):
+    """A replica's own admission control shed the forwarded batch
+    (HTTP 503). Final: shedding is the replica telling us to back off.
+    Carries the replica id and its reported queue depth for the fleet
+    503 body."""
+
+    def __init__(self, message: str, replica_id: int,
+                 queue_depth: Optional[int] = None):
+        super().__init__(message)
+        self.replica_id = replica_id
+        self.queue_depth = queue_depth
+
+
+class ReplicaHTTPError(RuntimeError):
+    """A replica answered with a non-retryable error status (400/500
+    class): the forward reached a live replica and FAILED there, so
+    retrying elsewhere cannot help (same model, same code)."""
+
+    def __init__(self, message: str, replica_id: int, status: int):
+        super().__init__(message)
+        self.replica_id = replica_id
+        self.status = status
+
+
+def route_key(value) -> int:
+    """A request's entity id → stable non-negative routing key.
+
+    Integer ids (the NPZ vocabulary-row contract) route by value so the
+    router's modulo matches the host store's shard modulo exactly; raw
+    string keys (the Avro entity-vocabs contract) hash via crc32 —
+    stable across processes and runs (Python's ``hash`` is salted per
+    process and would scatter a user's requests across replicas on
+    every restart, defeating affinity).
+    """
+    if isinstance(value, bool) or value is None:
+        return 0
+    if isinstance(value, int):
+        return abs(int(value))
+    return zlib.crc32(str(value).encode())
+
+
+class ShardMap:
+    """The shard → replica assignment table (thread-safe).
+
+    ``home(shard) = shard % num_replicas`` is the balanced layout;
+    ``mark_down`` re-homes a dead replica's shards to the surviving
+    replicas round-robin (deterministic — a drill replays identically),
+    and ``restore`` sends a recovered replica's home shards back. The
+    table is tiny and swapped under one lock: re-homing is O(shards),
+    never O(entities) — the host stores already hold every row.
+    """
+
+    def __init__(self, num_shards: int, num_replicas: int):
+        if num_shards < num_replicas:
+            raise ValueError(
+                f"num_shards ({num_shards}) must be >= num_replicas "
+                f"({num_replicas}) or some replicas would own nothing")
+        self.num_shards = int(num_shards)
+        self.num_replicas = int(num_replicas)
+        self._lock = threading.Lock()
+        self._owner = [s % num_replicas for s in range(num_shards)]
+        self._up = set(range(num_replicas))
+
+    def home(self, shard: int) -> int:
+        return shard % self.num_replicas
+
+    def owner(self, shard: int) -> int:
+        with self._lock:
+            return self._owner[shard]
+
+    def up(self) -> list[int]:
+        with self._lock:
+            return sorted(self._up)
+
+    def is_up(self, replica_id: int) -> bool:
+        with self._lock:
+            return replica_id in self._up
+
+    def shards_of(self, replica_id: int) -> list[int]:
+        with self._lock:
+            return [s for s, r in enumerate(self._owner)
+                    if r == replica_id]
+
+    def mark_down(self, replica_id: int) -> dict[int, int]:
+        """Re-home ``replica_id``'s shards to survivors; returns
+        {shard: new_owner}. Raises when no survivor remains (a fleet of
+        zero replicas cannot degrade gracefully — it is down)."""
+        with self._lock:
+            self._up.discard(replica_id)
+            survivors = sorted(self._up)
+            if not survivors:
+                raise ReplicaUnavailable(
+                    "no surviving replica to re-home to",
+                    replica_id=replica_id)
+            moved = {}
+            ring = itertools.cycle(survivors)
+            for s, r in enumerate(self._owner):
+                if r == replica_id:
+                    new = next(ring)
+                    self._owner[s] = new
+                    moved[s] = new
+            return moved
+
+    def restore(self, replica_id: int) -> list[int]:
+        """Mark ``replica_id`` healthy again and return its HOME shards
+        to it; returns the shards that moved back."""
+        with self._lock:
+            self._up.add(replica_id)
+            back = []
+            for s in range(self.num_shards):
+                if (self.home(s) == replica_id
+                        and self._owner[s] != replica_id):
+                    self._owner[s] = replica_id
+                    back.append(s)
+            return back
+
+    def next_up(self, after: int) -> int:
+        """The next healthy replica on the ring after ``after`` (the
+        hedge target: deterministic, never ``after`` itself unless it
+        is the only survivor)."""
+        with self._lock:
+            if not self._up:
+                raise ReplicaUnavailable("no replica is up")
+            for delta in range(1, self.num_replicas + 1):
+                cand = (after + delta) % self.num_replicas
+                if cand in self._up:
+                    return cand
+            return after  # pragma: no cover — unreachable (set nonempty)
+
+
+class FleetRouter:
+    """Routes scoring requests to shard-owning replicas over HTTP.
+
+    ``endpoint_fn(replica_id) -> (host, port)`` resolves live endpoints
+    (the supervisor's — a restarted replica has a new port).
+    ``route_re_type`` picks which entity id carries the affinity when a
+    request names several (default: lexicographically first key, so
+    routing is deterministic under dict-order changes).
+    """
+
+    def __init__(
+        self,
+        shard_map: ShardMap,
+        endpoint_fn: Callable[[int], tuple[str, int]],
+        route_re_type: Optional[str] = None,
+        request_timeout_s: float = 30.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.1,
+        hedge_after_s: Optional[float] = None,
+        metrics=None,
+    ):
+        self.shard_map = shard_map
+        self._endpoint = endpoint_fn
+        self.route_re_type = route_re_type
+        self.request_timeout_s = float(request_timeout_s)
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.hedge_after_s = (None if hedge_after_s is None
+                              else float(hedge_after_s))
+        self.metrics = metrics
+        self._rr = itertools.count()  # entity-less requests round-robin
+        # Forward pool: grouped per-replica sends of one /score body run
+        # concurrently; hedges ride the same pool.
+        # TWO pools, strictly layered: group threads (one per per-replica
+        # sub-batch of a body) block on send futures, and send threads
+        # never block on anything pool-managed — a single shared pool
+        # here can fill up with group threads all WAITING on send tasks
+        # that have no worker left to run on (nested-submit deadlock).
+        self._group_pool = ThreadPoolExecutor(
+            max_workers=max(16, 4 * shard_map.num_replicas),
+            thread_name_prefix="photon-fleet-group")
+        self._send_pool = ThreadPoolExecutor(
+            max_workers=max(32, 8 * shard_map.num_replicas),
+            thread_name_prefix="photon-fleet-send")
+
+    # -- routing -------------------------------------------------------------
+
+    def shard_for(self, request_obj: dict) -> Optional[int]:
+        """The routing shard of one /score request object (None =
+        entity-less: any replica serves it identically)."""
+        ents = request_obj.get("entity_ids") or {}
+        if not ents:
+            return None
+        if self.route_re_type is not None:
+            if self.route_re_type in ents:
+                key = ents[self.route_re_type]
+            else:
+                return None
+        else:
+            key = ents[min(ents)]
+        return route_key(key) % self.shard_map.num_shards
+
+    def replica_for(self, request_obj: dict) -> int:
+        shard = self.shard_for(request_obj)
+        if shard is None:
+            up = self.shard_map.up()
+            if not up:
+                raise ReplicaUnavailable("no replica is up")
+            return up[next(self._rr) % len(up)]
+        return self.shard_map.owner(shard)
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _post_score(self, replica_id: int, body: bytes) -> dict:
+        """One POST /score to one replica. Raises ReplicaShed on its
+        503, ReplicaHTTPError on other HTTP errors, OSError-family on
+        connection trouble (the retryable class)."""
+        # Injection seam for the network edge: `delay` = slow link (what
+        # hedging exists for), `partition` = dropped traffic to this
+        # replica (drop-by-site: indices=[replica_id]).
+        flt.fire("fleet.route", index=replica_id)
+        host, port = self._endpoint(replica_id)
+        req = urllib.request.Request(
+            f"http://{host}:{port}/score", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {}
+            if e.code == 503:
+                raise ReplicaShed(
+                    payload.get("error", "replica shed the batch"),
+                    replica_id=replica_id,
+                    queue_depth=payload.get("queue_depth")) from e
+            raise ReplicaHTTPError(
+                payload.get("error", f"replica HTTP {e.code}"),
+                replica_id=replica_id, status=e.code) from e
+
+    def _forward_group(self, replica_id: int, body: bytes,
+                       hedged: bool) -> dict:
+        """Forward one per-replica sub-batch, hedging when the primary
+        is slow. Returns the replica's JSON response; the losing send of
+        a hedge is discarded (its pool thread finishes harmlessly —
+        scoring is pure, so the duplicate work is latency insurance, not
+        a correctness hazard)."""
+        primary = self._send_pool.submit(self._post_score, replica_id,
+                                         body)
+        if not hedged or self.hedge_after_s is None:
+            return primary.result(timeout=self.request_timeout_s + 1)
+        done, _ = wait([primary], timeout=self.hedge_after_s)
+        if done:
+            return primary.result()
+        # Primary is slow: duplicate to the next healthy replica. Both
+        # futures race; the first SUCCESSFUL response wins (a fast
+        # failure must not beat a slow success).
+        hedge_to = self.shard_map.next_up(replica_id)
+        if hedge_to == replica_id:
+            return primary.result(timeout=self.request_timeout_s + 1)
+        if self.metrics is not None:
+            self.metrics.record_hedge()
+        logger.info("hedging slow replica %d → %d", replica_id, hedge_to)
+        secondary = self._send_pool.submit(self._post_score, hedge_to,
+                                           body)
+        pending = {primary: replica_id, secondary: hedge_to}
+        deadline = time.monotonic() + self.request_timeout_s + 1
+        first_exc = None
+        while pending:
+            done, _ = wait(list(pending),
+                           timeout=max(deadline - time.monotonic(), 0.01),
+                           return_when=FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                rid = pending.pop(fut)
+                exc = fut.exception()
+                if exc is None:
+                    if fut is secondary and self.metrics is not None:
+                        self.metrics.record_hedge_win()
+                    return fut.result()
+                first_exc = first_exc or exc
+        raise first_exc or ReplicaUnavailable(
+            "hedged sends both timed out", replica_id=replica_id)
+
+    def score(self, request_objs: Sequence[dict],
+              want_trace: bool = False) -> dict:
+        """Route and score one /score body's requests across the fleet.
+
+        Returns ``{"scores": [...], "attribution": [...] | None}`` in
+        the INPUT order. Connection-class failures retry with
+        deterministic backoff, re-grouping each round so retries follow
+        re-homed shards; shed and HTTP-error outcomes are final and
+        raise (``ReplicaShed`` / ``ReplicaHTTPError`` /
+        ``ReplicaUnavailable``).
+        """
+        n = len(request_objs)
+        scores: list[Optional[float]] = [None] * n
+        attributions: list[Optional[dict]] = [None] * n
+        want_attr = False
+        remaining = list(range(n))
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            if not remaining:
+                break
+            if attempt:
+                # Deterministic backoff; the supervisor's re-home runs
+                # concurrently, so by the retry the owner table usually
+                # already points at a survivor.
+                time.sleep(self.retry_backoff_s * attempt)
+                if self.metrics is not None:
+                    self.metrics.record_retry(len(remaining))
+            groups: dict[int, list[int]] = {}
+            for i in remaining:
+                groups.setdefault(
+                    self.replica_for(request_objs[i]), []).append(i)
+            futures = {}
+            for rid, idxs in groups.items():
+                body = json.dumps(
+                    {"requests": [request_objs[i] for i in idxs],
+                     "trace": want_trace}).encode()
+                futures[self._group_pool.submit(
+                    self._forward_group, rid, body,
+                    hedged=(attempt == 0))] = (rid, idxs)
+            still_failed: list[int] = []
+            for fut, (rid, idxs) in futures.items():
+                try:
+                    payload = fut.result(
+                        timeout=2 * self.request_timeout_s + 2)
+                except (ReplicaShed, ReplicaHTTPError):
+                    raise  # final: defined fleet error, no retry
+                except (OSError, TimeoutError, RuntimeError) as exc:
+                    # Connection-class: the replica died or the edge
+                    # dropped (InjectedPartition lands here). Fail these
+                    # indices over to the next round's owner.
+                    last_exc = exc
+                    if self.metrics is not None:
+                        self.metrics.record_forward_error()
+                    logger.warning(
+                        "forward to replica %d failed (%s: %s) — "
+                        "%d request(s) will retry", rid,
+                        type(exc).__name__, exc, len(idxs))
+                    still_failed.extend(idxs)
+                    continue
+                got = payload.get("scores", [])
+                if len(got) != len(idxs):
+                    raise ReplicaHTTPError(
+                        f"replica {rid} returned {len(got)} scores for "
+                        f"{len(idxs)} requests", replica_id=rid,
+                        status=500)
+                attr = payload.get("attribution")
+                for k, i in enumerate(idxs):
+                    scores[i] = float(got[k])
+                    if attr is not None and attr[k] is not None:
+                        attributions[i] = attr[k]
+                        want_attr = True
+            remaining = still_failed
+        if remaining:
+            raise ReplicaUnavailable(
+                f"{len(remaining)} request(s) unserved after "
+                f"{self.retries + 1} attempts: "
+                f"{type(last_exc).__name__ if last_exc else 'unknown'}: "
+                f"{last_exc}")
+        return {"scores": scores,
+                "attribution": attributions if want_attr else None}
+
+    def close(self) -> None:
+        self._group_pool.shutdown(wait=False)
+        self._send_pool.shutdown(wait=False)

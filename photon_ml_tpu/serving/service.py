@@ -109,8 +109,13 @@ class ScoringService:
         slo_window_s: float = 60.0,
         slo_availability: float = 0.999,
         slo_latency_ms: Optional[float] = None,
+        replica_id: Optional[int] = None,
         emitter=default_emitter,
     ):
+        # Fleet membership (serving/fleet.py): the id is this replica's
+        # stable index for fault addressing (`fleet.replica_flush`
+        # fires with it) and for log/error attribution.
+        self.replica_id = replica_id
         # A flush's unique entities must fit the cache simultaneously
         # (model_store pins them during resolve), so the effective budget
         # is at least max_batch.
@@ -271,9 +276,14 @@ class ScoringService:
     def _flush(self, entries):
         t_flush0 = time.monotonic()  # same clock as _Entry.enqueued_at
         try:
-            # Injection site first: a fault here is indistinguishable
+            # Injection sites first: a fault here is indistinguishable
             # from the scorer failing (InjectedThreadDeath, being a
             # BaseException, still sails through to the supervisor).
+            # The fleet site carries the replica id as its index, so a
+            # `replica_kill` spec can SIGKILL exactly one replica of a
+            # fleet mid-flush (indices=[id], occurrences=[k]).
+            if self.replica_id is not None:
+                flt.fire("fleet.replica_flush", index=self.replica_id)
             flt.fire("serving.flush")
             scores, marks = self._score_chunk(
                 [e.request for e in entries])

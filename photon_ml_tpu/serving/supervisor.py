@@ -1,0 +1,348 @@
+"""Replica supervision: spawn, probe, and restart scoring replicas.
+
+The fleet (serving/fleet.py) scales the single-process ``ScoringService``
+horizontally: N OS-process replicas, each a full ``photon-game-serve``
+server over the same model. This module owns their LIFECYCLE — the
+process-level analogue of the micro-batcher's supervised worker thread
+(PR 4's ``BatcherDied`` discipline, lifted one level):
+
+- **Spawn.** Replicas are ``spawn``-style subprocesses (fresh
+  interpreters — the parent holds live XLA runtime threads and forking
+  them is undefined, the utils/workers.py rule). Child output goes to
+  FILES, never pipes: XLA's CPU warnings alone can overflow a 64 KB pipe
+  buffer, and an undrained pipe blocks the child mid-request (the
+  tests/test_multiprocess.py lesson). The bound port travels back
+  through a ready-file the replica writes after binding (``--ready-file``
+  in cli/serve.py) — no port-allocation race.
+- **Probe.** A monitor thread polls each replica: ``proc.poll()`` for
+  process death, then GET ``/healthz`` (explicit timeout — PML011) for
+  liveness. A replica whose last good probe is older than
+  ``heartbeat_deadline_s`` is DECLARED dead even if the process lingers
+  (a wedged replica is dead for routing purposes; the lingering process
+  is SIGKILLed so it cannot answer a stale hedge later).
+- **Recover.** Death fires ``on_death(replica_id)`` synchronously on the
+  monitor thread — the fleet re-homes the replica's shards there, inside
+  the detection-to-recovery window the rehome deadline measures — then
+  the supervisor restarts the replica (bounded ``max_restarts``,
+  deterministic backoff) and fires ``on_recovered(replica_id)`` once the
+  newcomer answers ``/healthz``.
+
+Every blocking network call in this module carries an explicit timeout
+(lint rule PML011 mechanizes that for router/supervisor code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional, Sequence
+
+from photon_ml_tpu import faults as flt
+
+logger = logging.getLogger("photon_ml_tpu.serving.fleet")
+
+# Replica states (the /healthz fleet view renders these verbatim).
+STARTING = "starting"
+UP = "up"
+DOWN = "down"
+RESTARTING = "restarting"
+FAILED = "failed"  # restart budget exhausted — stays down, fleet degraded
+
+
+class ReplicaStartupError(RuntimeError):
+    """A replica did not reach ready/healthy within its deadline."""
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One supervised replica process (mutable; guarded by the
+    supervisor's lock for state transitions)."""
+
+    replica_id: int
+    proc: Optional[subprocess.Popen] = None
+    host: str = "127.0.0.1"
+    port: int = 0
+    state: str = STARTING
+    last_ok: float = 0.0  # monotonic instant of the last good probe
+    restarts: int = 0
+    log_path: str = ""
+
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def _probe_healthz(url: str, timeout_s: float) -> dict:
+    """GET ``url``/healthz with an explicit timeout; raises on any
+    failure (connection refused/reset, HTTP error, bad JSON)."""
+    with urllib.request.urlopen(f"{url}/healthz",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class ReplicaSupervisor:
+    """Spawns and babysits ``num_replicas`` scoring-replica processes.
+
+    ``make_argv(replica_id, ready_file)`` returns the child's argv (the
+    fleet builds it around ``python -m photon_ml_tpu.cli.serve``); the
+    supervisor owns ready-file handshakes, health probing, death
+    declaration, and bounded restart. ``on_death`` / ``on_recovered``
+    run on the monitor thread — re-homing happens inside ``on_death`` so
+    the rehome clock starts at detection.
+    """
+
+    def __init__(
+        self,
+        make_argv: Callable[[int, str], Sequence[str]],
+        num_replicas: int,
+        workdir: str,
+        probe_interval_s: float = 0.25,
+        probe_timeout_s: float = 1.0,
+        heartbeat_deadline_s: float = 2.0,
+        start_timeout_s: float = 120.0,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.1,
+        on_death: Optional[Callable[[int], None]] = None,
+        on_recovered: Optional[Callable[[int], None]] = None,
+    ):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, "
+                             f"got {num_replicas}")
+        self._make_argv = make_argv
+        self.workdir = workdir
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.heartbeat_deadline_s = float(heartbeat_deadline_s)
+        self.start_timeout_s = float(start_timeout_s)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self._on_death = on_death
+        self._on_recovered = on_recovered
+        self.replicas = [ReplicaHandle(replica_id=i)
+                         for i in range(num_replicas)]
+        self._lock = threading.Lock()
+        self._running = False
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- spawn / handshake ---------------------------------------------------
+
+    def _ready_file(self, rid: int, generation: int) -> str:
+        # Generation in the name: a restart must never trust the ready
+        # file the DEAD incarnation wrote (its port is gone).
+        return os.path.join(self.workdir, f"replica-{rid}.g{generation}.ready")
+
+    def _spawn(self, handle: ReplicaHandle) -> None:
+        rid = handle.replica_id
+        ready = self._ready_file(rid, handle.restarts)
+        if os.path.exists(ready):
+            os.unlink(ready)
+        handle.log_path = os.path.join(self.workdir, f"replica-{rid}.log")
+        argv = list(self._make_argv(rid, ready))
+        # The child's cwd is the workdir (its logs and ready files stay
+        # together), so put the package's root on its path explicitly —
+        # a dev checkout that was never pip-installed must still fleet.
+        import photon_ml_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(photon_ml_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+        log_f = open(handle.log_path, "ab")
+        try:
+            handle.proc = subprocess.Popen(
+                argv, stdout=log_f, stderr=subprocess.STDOUT,
+                cwd=self.workdir, env=env)
+        finally:
+            log_f.close()  # the child holds its own descriptor now
+        handle.state = STARTING
+        logger.info("replica %d spawned (pid %d, log %s)", rid,
+                    handle.proc.pid, handle.log_path)
+
+    def _await_ready(self, handle: ReplicaHandle) -> None:
+        """Wait for the ready-file handshake, then a first good probe."""
+        rid = handle.replica_id
+        ready = self._ready_file(rid, handle.restarts)
+        deadline = time.monotonic() + self.start_timeout_s
+        while time.monotonic() < deadline:
+            if handle.proc.poll() is not None:
+                raise ReplicaStartupError(
+                    f"replica {rid} exited rc={handle.proc.returncode} "
+                    f"before ready (see {handle.log_path})")
+            if os.path.exists(ready):
+                try:
+                    with open(ready) as f:
+                        info = json.load(f)
+                    break
+                except (OSError, ValueError):
+                    pass  # torn read of a mid-write file; poll again
+            time.sleep(0.02)
+        else:
+            raise ReplicaStartupError(
+                f"replica {rid} not ready within {self.start_timeout_s}s "
+                f"(see {handle.log_path})")
+        handle.host = info.get("host", "127.0.0.1")
+        handle.port = int(info["port"])
+        while time.monotonic() < deadline:
+            try:
+                _probe_healthz(handle.base_url(), self.probe_timeout_s)
+                with self._lock:
+                    handle.state = UP
+                    handle.last_ok = time.monotonic()
+                logger.info("replica %d healthy at %s", rid,
+                            handle.base_url())
+                return
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        raise ReplicaStartupError(
+            f"replica {rid} bound {handle.base_url()} but never answered "
+            f"/healthz within {self.start_timeout_s}s")
+
+    def start(self) -> None:
+        """Spawn every replica and wait until all answer /healthz."""
+        os.makedirs(self.workdir, exist_ok=True)
+        for handle in self.replicas:
+            self._spawn(handle)
+        try:
+            for handle in self.replicas:
+                self._await_ready(handle)
+        except ReplicaStartupError:
+            self.stop()
+            raise
+        self._running = True
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="photon-fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+
+    # -- monitoring ----------------------------------------------------------
+
+    def _probe_once(self, handle: ReplicaHandle) -> bool:
+        """One liveness check; True = the replica looked alive."""
+        if handle.proc is None or handle.proc.poll() is not None:
+            return False
+        try:
+            # Injection seam: a `partition` spec here models the
+            # monitor losing sight of a replica (probes dropped while
+            # the replica itself is fine) — the false-positive death
+            # the heartbeat deadline turns into a defined re-home.
+            flt.fire("fleet.probe", index=handle.replica_id)
+            _probe_healthz(handle.base_url(), self.probe_timeout_s)
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def _monitor_loop(self) -> None:
+        while self._running:
+            for handle in self.replicas:
+                if not self._running:
+                    return
+                if handle.state not in (UP,):
+                    continue
+                now = time.monotonic()
+                if self._probe_once(handle):
+                    with self._lock:
+                        handle.last_ok = now
+                elif (handle.proc.poll() is not None
+                      or now - handle.last_ok
+                      >= self.heartbeat_deadline_s):
+                    self._handle_death(handle)
+            time.sleep(self.probe_interval_s)
+
+    def _handle_death(self, handle: ReplicaHandle) -> None:
+        rid = handle.replica_id
+        with self._lock:
+            if handle.state != UP:
+                return
+            handle.state = DOWN
+        rc = handle.proc.poll()
+        logger.error("replica %d declared dead (%s; last good probe "
+                     "%.2fs ago)", rid,
+                     f"exited rc={rc}" if rc is not None
+                     else "heartbeat deadline",
+                     time.monotonic() - handle.last_ok)
+        # A wedged-but-alive process must not answer a stale request
+        # after its shards re-home — kill it before announcing death.
+        if rc is None:
+            try:
+                handle.proc.send_signal(signal.SIGKILL)
+                handle.proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                logger.warning("could not reap wedged replica %d", rid)
+        if self._on_death is not None:
+            try:
+                self._on_death(rid)
+            except Exception:
+                logger.exception("on_death(%d) callback failed", rid)
+        self._restart(handle)
+
+    def _restart(self, handle: ReplicaHandle) -> None:
+        rid = handle.replica_id
+        if handle.restarts >= self.max_restarts:
+            with self._lock:
+                handle.state = FAILED
+            logger.error("replica %d exhausted its %d restarts — fleet "
+                         "stays degraded", rid, self.max_restarts)
+            return
+        with self._lock:
+            handle.state = RESTARTING
+            handle.restarts += 1
+        # Deterministic backoff (no jitter: drills must replay exactly).
+        time.sleep(self.restart_backoff_s * handle.restarts)
+        try:
+            self._spawn(handle)
+            self._await_ready(handle)
+        except ReplicaStartupError as e:
+            logger.error("replica %d restart failed: %s", rid, e)
+            with self._lock:
+                handle.state = DOWN
+            # Next monitor pass will not see UP, so retry from here.
+            self._restart(handle)
+            return
+        if self._on_recovered is not None:
+            try:
+                self._on_recovered(rid)
+            except Exception:
+                logger.exception("on_recovered(%d) callback failed", rid)
+
+    # -- views / lifecycle ---------------------------------------------------
+
+    def states(self) -> dict[int, str]:
+        with self._lock:
+            return {h.replica_id: h.state for h in self.replicas}
+
+    def up_replicas(self) -> list[int]:
+        with self._lock:
+            return [h.replica_id for h in self.replicas if h.state == UP]
+
+    def endpoint(self, replica_id: int) -> tuple[str, int]:
+        h = self.replicas[replica_id]
+        return h.host, h.port
+
+    def stop(self) -> None:
+        self._running = False
+        if self._monitor is not None and self._monitor.is_alive():
+            self._monitor.join(timeout=5.0)
+        for handle in self.replicas:
+            if handle.proc is not None and handle.proc.poll() is None:
+                handle.proc.terminate()
+        for handle in self.replicas:
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    handle.proc.kill()
+                    handle.proc.wait(timeout=5.0)
+            handle.state = DOWN
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -240,6 +240,39 @@ class ScoringFinish(Event):
     wall_seconds: float
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplicaDied(Event):
+    """A fleet scoring replica was declared dead (process exit or
+    heartbeat-deadline expiry) — the `CheckpointRecovered` of the
+    serving fleet's failure ladder (docs/SERVING.md "Scaling out")."""
+
+    replica_id: int
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRehomed(Event):
+    """A dead replica's routing shards were re-assigned to survivors
+    (serving/router.py ShardMap). ``seconds`` is detection → the new
+    owners confirmed healthy — the window `fleet_rehome_seconds`
+    gates against the configured deadline."""
+
+    replica_id: int
+    shards: tuple[int, ...]
+    new_owners: tuple[int, ...]
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaRecovered(Event):
+    """A restarted replica answered /healthz and its home shards moved
+    back; the fleet leaves the degraded state when every replica is
+    healthy again."""
+
+    replica_id: int
+    shards_restored: tuple[int, ...]
+
+
 class EventEmitter:
     """Synchronous listener registry (EventEmitter trait parity)."""
 

@@ -733,7 +733,7 @@ def test_cli_rejects_unknown_rule_and_reasonless_baseline_write(tmp_path):
 
 def test_rule_catalog_is_complete():
     assert sorted(ALL_RULES) == \
-        [f"PML00{i}" for i in range(1, 10)] + ["PML010"]
+        [f"PML00{i}" for i in range(1, 10)] + ["PML010", "PML011"]
     for rid, (check, doc) in ALL_RULES.items():
         assert callable(check) and doc
 
@@ -891,3 +891,96 @@ def test_pml010_clean_on_real_telemetry_writers():
         with open(os.path.join(REPO, rel)) as f:
             ctx = ModuleContext.parse(rel, f.read())
         assert ALL_RULES["PML010"][0](ctx) == [], rel
+
+
+# ---------------------------------------------------------------- PML011
+
+
+def test_pml011_flags_urlopen_without_timeout():
+    # The fleet-era hang: a router forward to a dead replica with no
+    # timeout blocks its pool thread forever — the exact failure the
+    # heartbeat machinery exists to prevent, reintroduced a layer down.
+    src = """
+        import urllib.request
+
+        def forward(url, body):
+            with urllib.request.urlopen(url, data=body) as resp:
+                return resp.read()
+    """
+    out = findings_for("PML011", src)
+    assert len(out) == 1 and out[0].rule == "PML011"
+    assert "timeout" in out[0].message
+
+
+def test_pml011_flags_timeout_none_and_settimeout_none():
+    src = """
+        import socket
+        import urllib.request
+
+        def probe(url):
+            return urllib.request.urlopen(url, timeout=None).read()
+
+        def stream(sock):
+            sock.settimeout(None)
+            return sock.recv(1024)
+    """
+    out = findings_for("PML011", src)
+    assert len(out) == 2
+    assert all("unbounded" in f.message for f in out)
+
+
+def test_pml011_flags_requests_and_connections_without_timeout():
+    src = """
+        import http.client
+        import socket
+
+        import requests
+
+        def a(host):
+            return http.client.HTTPConnection(host, 80)
+
+        def b(addr):
+            return socket.create_connection(addr)
+
+        def c(url):
+            return requests.get(url)
+    """
+    out = findings_for("PML011", src)
+    assert len(out) == 3
+
+
+def test_pml011_accepts_explicit_timeouts_and_unrelated_gets():
+    src = """
+        import http.client
+        import socket
+        import urllib.request
+
+        def forward(url, body):
+            with urllib.request.urlopen(url, data=body,
+                                        timeout=5.0) as resp:
+                return resp.read()
+
+        def positional(url, body):
+            return urllib.request.urlopen(url, body, 5.0)
+
+        def conn(host):
+            return http.client.HTTPConnection(host, 80, 5.0)
+
+        def create(addr, t):
+            return socket.create_connection(addr, timeout=t)
+
+        def not_network(d, key):
+            return d.get(key)   # dict.get, not requests.get
+    """
+    assert findings_for("PML011", src) == []
+
+
+def test_pml011_clean_on_real_router_and_supervisor():
+    # The modules the rule was written for must pass without
+    # suppressions — every blocking call in them carries its timeout.
+    for rel in ("photon_ml_tpu/serving/router.py",
+                "photon_ml_tpu/serving/supervisor.py",
+                "photon_ml_tpu/serving/fleet.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            ctx = ModuleContext.parse(rel, f.read())
+        assert ALL_RULES["PML011"][0](ctx) == [], rel
