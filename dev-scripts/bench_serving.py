@@ -589,25 +589,27 @@ def run_fleet(args, load_seconds_unused=None):
         import concurrent.futures as cf
 
         pool = cf.ThreadPoolExecutor(max_workers=64)
-        cursor = 0
-        futs = []
-        t_bench0 = time.perf_counter()
-        for qps in qps_levels:
-            n = max(1, int(round(qps * args.seconds_per_level)))
-            period = 1.0 / qps
-            t0 = time.perf_counter()
-            for i in range(n):
-                t_sched = t0 + i * period
-                delay = t_sched - time.perf_counter()
-                if delay > 0:
-                    time.sleep(delay)
-                obj = objs[cursor]
-                futs.append(pool.submit(_one, cursor, obj, t_sched))
-                cursor += 1
-            print(f"[fleet] level {qps:g} qps dispatched",
-                  file=sys.stderr)
-        cf.wait(futs, timeout=args.drain_timeout_s)
-        pool.shutdown(wait=False)
+        try:
+            cursor = 0
+            futs = []
+            t_bench0 = time.perf_counter()
+            for qps in qps_levels:
+                n = max(1, int(round(qps * args.seconds_per_level)))
+                period = 1.0 / qps
+                t0 = time.perf_counter()
+                for i in range(n):
+                    t_sched = t0 + i * period
+                    delay = t_sched - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    obj = objs[cursor]
+                    futs.append(pool.submit(_one, cursor, obj, t_sched))
+                    cursor += 1
+                print(f"[fleet] level {qps:g} qps dispatched",
+                      file=sys.stderr)
+            cf.wait(futs, timeout=args.drain_timeout_s)
+        finally:
+            pool.shutdown(wait=False)
         # Let the restart land so the degraded window closes on tape.
         deadline = time.perf_counter() + 30.0
         while time.perf_counter() < deadline:

@@ -6,8 +6,28 @@ cd "$(dirname "$0")/.."
 
 # Static-analysis gate first: pure AST, no JAX import, seconds repo-wide.
 # Findings (or a reasonless suppression/baseline entry) fail the run
-# before any test spins up. See docs/ANALYSIS.md.
+# before any test spins up. See docs/ANALYSIS.md. The project graph
+# (PML012-016) is on; its summary cache makes the warm re-run cheap,
+# and both runs are held to the documented wall-clock budget
+# (cold <= 15 s, warm <= 3 s) so "lint finishes in seconds" stays a
+# tested promise, not a docstring.
+rm -f .photon-lint-cache.json
+t0=$(date +%s%N)
 python -m photon_ml_tpu.cli.lint photon_ml_tpu/ || exit $?
+t1=$(date +%s%N)
+python -m photon_ml_tpu.cli.lint photon_ml_tpu/ > /dev/null || exit $?
+t2=$(date +%s%N)
+cold_ms=$(( (t1 - t0) / 1000000 )); warm_ms=$(( (t2 - t1) / 1000000 ))
+echo "photon-lint wall: cold ${cold_ms}ms (budget 15000), warm ${warm_ms}ms (budget 3000)"
+if [ "$cold_ms" -gt 15000 ] || [ "$warm_ms" -gt 3000 ]; then
+  echo "photon-lint exceeded its wall-clock budget" >&2; exit 1
+fi
+
+# The string-keyed seams cross into tests and dev-scripts (fault plans,
+# metric needles, span assertions) — hold those trees to the
+# whole-program rules against the package registries.
+python -m photon_ml_tpu.cli.lint --no-baseline \
+  --select PML012,PML013,PML014,PML015,PML016 tests dev-scripts || exit $?
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 

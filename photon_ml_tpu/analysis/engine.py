@@ -1,23 +1,40 @@
-"""The photon-lint engine: walk files, run rules, apply suppressions and
-the baseline, report.
+"""The photon-lint engine: walk files, run per-file rules, build the
+project graph, run whole-program rules, apply suppressions and the
+baseline, report.
 
 Pure stdlib + AST — importing this package must NEVER import JAX (the
 lint gate runs before/without a working accelerator stack and finishes in
 seconds on the whole repo; tests assert the no-JAX property).
+
+Two rule tiers share one parse per file:
+
+- per-file rules (PML001-PML011) see a :class:`ModuleContext`;
+- project rules (PML012-PML016) see a
+  :class:`~photon_ml_tpu.analysis.project.ProjectGraph` built from
+  per-file summaries extracted in the same pass.
+
+The summaries and per-file findings are cached on disk keyed by file
+size/mtime/CRC32 (``.photon-lint-cache.json``, fenced by a signature
+over the analysis package's own sources), so a warm repo-wide run
+re-parses only changed files and stays inside the CI wall-clock budget
+(cold ≤ 15 s, warm ≤ 3 s — enforced by dev-scripts/run_tier1.sh).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import logging
 import os
 from typing import Iterable, Optional
 
 from photon_ml_tpu.analysis import baseline as bl
+from photon_ml_tpu.analysis import project as pj
 from photon_ml_tpu.analysis.context import ModuleContext
 from photon_ml_tpu.analysis.findings import Finding
-from photon_ml_tpu.analysis.rules import ALL_RULES
-from photon_ml_tpu.analysis.suppressions import (apply_suppressions,
+from photon_ml_tpu.analysis.rules import ALL_RULES, PROJECT_RULES
+from photon_ml_tpu.analysis.suppressions import (Suppression,
+                                                 apply_suppressions,
                                                  next_code_lines,
                                                  parse_suppressions)
 
@@ -34,6 +51,10 @@ class LintResult:
         dataclasses.field(default_factory=list)
     unused_suppressions: list[tuple[str, int]] = \
         dataclasses.field(default_factory=list)  # (path, line)
+    graph_files: int = 0     # files summarized into the project graph
+    cache_hits: int = 0
+    cache_misses: int = 0
+    catalog: Optional[dict] = None  # built on demand (CLI --catalog)
 
     @property
     def exit_code(self) -> int:
@@ -56,9 +77,10 @@ def iter_python_files(paths: Iterable[str]) -> list[str]:
     return sorted(dict.fromkeys(os.path.normpath(p) for p in out))
 
 
-def _rule_items(select: Optional[set[str]], ignore: Optional[set[str]]):
+def _rule_items(select: Optional[set[str]], ignore: Optional[set[str]],
+                registry=None):
     items = []
-    for rid, (check, _doc) in ALL_RULES.items():
+    for rid, (check, _doc) in (registry or ALL_RULES).items():
         if select and rid not in select:
             continue
         if ignore and rid in ignore:
@@ -70,8 +92,20 @@ def _rule_items(select: Optional[set[str]], ignore: Optional[set[str]]):
 def lint_file(path: str, select: Optional[set[str]] = None,
               ignore: Optional[set[str]] = None
               ) -> tuple[list[Finding], list[tuple[str, int]]]:
-    """(findings, unused-suppression sites) for one file. Findings
-    include PML000 meta-diagnostics (reasonless allows, parse errors)."""
+    """(findings, unused-suppression sites) for one file, per-file rules
+    only. Findings include PML000 meta-diagnostics (reasonless allows,
+    parse errors). Project rules need :func:`lint_paths`."""
+    kept, unused, _sups, _summary = _lint_file_full(path)
+    if select or ignore:
+        keep_ids = {rid for rid, _ in _rule_items(select, ignore)}
+        keep_ids.add("PML000")
+        kept = [f for f in kept if f.rule in keep_ids]
+    return kept, unused
+
+
+def _lint_file_full(path: str):
+    """One parse of ``path`` → (kept findings for ALL per-file rules,
+    unused suppression sites, suppression records, project summary)."""
     rel = os.path.relpath(path).replace(os.sep, "/")
     with open(path, encoding="utf-8") as fh:
         source = fh.read()
@@ -83,39 +117,175 @@ def lint_file(path: str, select: Optional[set[str]] = None,
         meta.append(Finding(
             rule="PML000", path=rel, line=exc.lineno or 0, col=0,
             message=f"file does not parse: {exc.msg}"))
-        return meta, []
-    findings: list[Finding] = []
-    for rid, check in _rule_items(select, ignore):
-        try:
-            findings.extend(check(ctx))
-        except Exception as exc:  # a broken rule must fail loud, not pass
-            findings.append(Finding(
-                rule="PML000", path=rel, line=0, col=0,
-                message=f"rule {rid} crashed on this file: "
-                        f"{type(exc).__name__}: {exc}"))
+        return meta, [], [], None
+    findings = [f for rid, check in _rule_items(None, None)
+                for f in _check_safely(rid, check, ctx)]
     code_after = next_code_lines(lines)
     kept = apply_suppressions(findings, sups, code_after)
     unused = [(rel, s.line) for s in sups if not s.used]
     kept.extend(meta)  # meta-diagnostics are never suppressible
-    return kept, unused
+    try:
+        summary = pj.summarize_file(rel, ctx.tree, source)
+    except Exception as exc:
+        # A summary crash must not break per-file lint, but it silently
+        # removes this file from the project graph — say so.
+        logging.getLogger("photon_ml_tpu.analysis").warning(
+            "project summary failed for %s: %s: %s", rel,
+            type(exc).__name__, exc)
+        summary = None
+    sup_records = [[s.line, list(s.rules), s.reason, s.standalone,
+                    code_after.get(s.line, 0), s.used] for s in sups]
+    return kept, unused, sup_records, summary
+
+
+def _check_safely(rid: str, check, ctx: ModuleContext) -> list[Finding]:
+    try:
+        return check(ctx)
+    except Exception as exc:
+        return [Finding(
+            rule="PML000", path=ctx.path, line=0, col=0,
+            message=f"rule {rid} crashed on this file: "
+                    f"{type(exc).__name__}: {exc}")]
+
+
+def _findings_to_json(findings: list[Finding]) -> list[dict]:
+    return [f.to_json() for f in findings]
+
+
+def _findings_from_json(rows: list[dict]) -> list[Finding]:
+    return [Finding(**row) for row in rows]
+
+
+class _SnippetCache:
+    def __init__(self):
+        self._lines: dict[str, list[str]] = {}
+
+    def get(self, path: str, line: int) -> str:
+        if path not in self._lines:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    self._lines[path] = fh.read().splitlines()
+            except OSError:
+                self._lines[path] = []
+        lines = self._lines[path]
+        if 1 <= line <= len(lines):
+            return lines[line - 1].strip()
+        return ""
 
 
 def lint_paths(paths: Iterable[str],
                select: Optional[set[str]] = None,
                ignore: Optional[set[str]] = None,
-               baseline_path: Optional[str] = None) -> LintResult:
-    files = iter_python_files(paths)
+               baseline_path: Optional[str] = None,
+               project: bool = True,
+               cache_path: Optional[str] = None,
+               package_prefix: str = "photon_ml_tpu",
+               want_catalog: bool = False) -> LintResult:
+    requested = iter_python_files(paths)
+    graph_files = list(requested)
+    if project and os.path.isdir(package_prefix):
+        # The registries PML014 resolves against live in the package;
+        # linting tests/ or dev-scripts/ alone must still see them.
+        graph_files = sorted(set(requested)
+                             | set(iter_python_files([package_prefix])))
+
+    cache = pj.ProjectCache(cache_path) if cache_path else None
+    requested_set = set(requested)
     findings: list[Finding] = []
-    unused: list[tuple[str, int]] = []
-    for path in files:
-        f, u = lint_file(path, select=select, ignore=ignore)
-        findings.extend(f)
-        unused.extend(u)
-    result = LintResult(findings=findings, files=len(files),
-                        unused_suppressions=unused)
+    unused_candidates: list[tuple[str, int]] = []
+    summaries: dict[str, pj.FileSummary] = {}
+    sups_by_path: dict[str, list[Suppression]] = {}
+    nextcode_by_path: dict[str, dict[int, int]] = {}
+
+    for path in graph_files:
+        rel = os.path.relpath(path).replace(os.sep, "/")
+        entry = cache.lookup(path) if cache else None
+        if entry is not None:
+            kept = _findings_from_json(entry["findings"])
+            unused = [tuple(u) for u in entry["unused"]]
+            sup_records = entry["suppressions"]
+            summary = (pj.summary_from_dict(entry["summary"])
+                       if entry["summary"] is not None else None)
+        else:
+            kept, unused, sup_records, summary = _lint_file_full(path)
+            if cache:
+                cache.store(path, summary, _findings_to_json(kept),
+                            [list(u) for u in unused], sup_records)
+        sups = []
+        nextcode = {}
+        for line, rules, reason, standalone, next_code, used in \
+                sup_records:
+            s = Suppression(line=line, rules=tuple(rules), reason=reason,
+                            standalone=standalone, used=used)
+            sups.append(s)
+            nextcode[line] = next_code
+        sups_by_path[rel] = sups
+        nextcode_by_path[rel] = nextcode
+        if summary is not None:
+            summaries[rel] = summary
+        if path in requested_set:
+            findings.extend(kept)
+            unused_candidates.extend(unused)
+
+    graph = pj.ProjectGraph(summaries, package_prefix=package_prefix) \
+        if (project or want_catalog) else None
+
+    project_findings: list[Finding] = []
+    if project and graph is not None:
+        for rid, check in _rule_items(select, ignore, PROJECT_RULES):
+            try:
+                project_findings.extend(check(graph))
+            except Exception as exc:
+                project_findings.append(Finding(
+                    rule="PML000", path="<project>", line=0, col=0,
+                    message=f"project rule {rid} crashed: "
+                            f"{type(exc).__name__}: {exc}"))
+        # Fill snippets (project rules only know line numbers) and
+        # apply the owning file's inline suppressions.
+        snip = _SnippetCache()
+        requested_rel = {os.path.relpath(p).replace(os.sep, "/")
+                         for p in requested_set}
+        kept_project = []
+        for f in project_findings:
+            f = dataclasses.replace(f, snippet=snip.get(f.path, f.line))
+            covered = False
+            for s in sups_by_path.get(f.path, ()):
+                nxt = nextcode_by_path.get(f.path, {}).get(s.line, 0)
+                if s.covers(f.rule, f.line, nxt):
+                    s.used = True
+                    covered = True
+                    break
+            if not covered and (f.path in requested_rel
+                                or f.path == "<project>"):
+                kept_project.append(f)
+        findings.extend(kept_project)
+        # A suppression the per-file pass left unused may have just been
+        # consumed by a project finding.
+        unused_candidates = [
+            (p, line) for p, line in unused_candidates
+            if not any(s.line == line and s.used
+                       for s in sups_by_path.get(p, ()))]
+
+    if select or ignore:
+        keep_ids = {rid for rid, _ in _rule_items(select, ignore)}
+        keep_ids |= {rid for rid, _ in _rule_items(select, ignore,
+                                                   PROJECT_RULES)}
+        keep_ids.add("PML000")
+        findings = [f for f in findings if f.rule in keep_ids]
+
+    if cache:
+        cache.save(graph_files)
+
+    result = LintResult(findings=findings, files=len(requested),
+                        unused_suppressions=unused_candidates,
+                        graph_files=len(graph_files),
+                        cache_hits=cache.hits if cache else 0,
+                        cache_misses=cache.misses if cache else 0)
+    if want_catalog and graph is not None:
+        result.catalog = pj.build_catalog(graph)
     if baseline_path and os.path.exists(baseline_path):
         entries = bl.load_baseline(baseline_path)
-        res = bl.apply_baseline(findings, entries, baseline_path)
+        res = bl.apply_baseline(result.findings, entries, baseline_path)
         result.findings = res.kept + res.meta
         result.baselined = res.matched
         result.stale_baseline = res.stale

@@ -1,0 +1,1062 @@
+"""The project graph: a repo-wide symbol table + call graph for the
+interprocedural lint rules (PML012-PML016), with an mtime/CRC-keyed
+on-disk cache so repo-wide lint stays inside the seconds photon-lint
+promises.
+
+Per-file rules (PML001-PML011) see one AST at a time; the bug classes
+this PR mechanizes cross module boundaries — a helper in ``ops/`` that
+syncs inside a caller's loop in ``optim/streaming.py``, a raw write into
+a ledger directory from a helper two files away, a callback handed
+across a class boundary onto another object's monitor thread. For
+those, every file is distilled ONCE (sharing the parse with the
+per-file rules) into a :class:`FileSummary` — functions with their call
+sites, sync/write/resource behavior, classes with their lock/entrypoint
+topology, plus the raw material of the string-keyed catalogs (fault
+sites, events, ``photon_*`` metrics, span names). The summaries are
+plain JSON-able data: the :class:`ProjectCache` persists them (keyed by
+file size + mtime_ns + CRC32, fenced by a signature over the analysis
+package's own sources), so a warm repo-wide run re-parses only changed
+files.
+
+Resolution is intra-package and deliberately conservative: import
+aliases and ``from``-imports resolve exactly; a bare ``obj.method()``
+attribute call falls back to a method-name lookup only when the name is
+UNIQUE across the whole project (two candidates = no edge — an
+interprocedural lint rule must prefer silence to a wrong edge).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import zlib
+from typing import Iterable, Optional
+
+from photon_ml_tpu.analysis.astwalk import (scope_statements,
+                                            self_attribute,
+                                            statement_exprs)
+from photon_ml_tpu.analysis.taint import (TaintScope, call_func_name,
+                                          dotted_name)
+
+# Leaf names whose call acquires an OS resource the caller must release
+# (PML016's seed set; intra-package factory functions that RETURN one of
+# these propagate resource-ness through the call graph).
+RESOURCE_LEAFS = {"Popen", "create_connection", "create_server",
+                  "HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                  "UDPServer", "ThreadPoolExecutor",
+                  "ProcessPoolExecutor", "make_pool"}
+RESOURCE_NAMES = {"socket.socket", "mmap.mmap", "multiprocessing.Pool"}
+# Method leafs that release a resource.
+CLOSER_LEAFS = {"close", "server_close", "terminate", "kill", "shutdown",
+                "stop", "release", "closed", "join"}
+# Release-ish methods a class may use to free resources it stores.
+RELEASE_METHODS = {"close", "stop", "shutdown", "server_close",
+                   "terminate", "__exit__", "__del__", "join"}
+
+_SYNC_CASTS = {"float", "int", "bool"}
+_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_WRITE_MODES = set("wax+")
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+_FAULT_HOOKS = {"fire": 0, "poison_scalar": 0, "corrupt_file": 0}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+# --------------------------------------------------------------- summaries
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    name: str           # the callee as written ("flt.fire", "self._m")
+    line: int
+    depth: int          # enclosing loop depth within the function
+    device_args: list   # positional indices whose expr is device-tainted
+    device_kwargs: list  # kwarg names whose expr is device-tainted
+    param_args: dict    # positional index (as str) -> caller param index
+    param_kwargs: dict  # kwarg name -> caller param index
+    selfattr_args: dict = dataclasses.field(default_factory=dict)
+    # ^ positional index (as str) -> "attr" for ``self.attr`` arguments
+    selfattr_kwargs: dict = dataclasses.field(default_factory=dict)
+    # ^ kwarg name -> "attr" for ``kw=self.attr`` arguments
+    arg_count: int = 0
+    kwarg_names: list = dataclasses.field(default_factory=list)
+    # Result binding (PML016): how the call's value is held.
+    binding: str = "bare"   # "local:<n>" | "self:<attr>" | "other" | "bare"
+    with_item: bool = False
+    is_returned: bool = False
+    bound_closed: bool = False
+    bound_closed_finally: bool = False
+    bound_returned: bool = False
+    bound_escapes: bool = False
+
+    @property
+    def leaf(self) -> str:
+        return self.name.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class WriteSite:
+    """One raw write primitive (open-for-write / np.save* / json.dump)."""
+
+    line: int
+    kind: str
+    param_paths: list   # caller param indices the target path derives from
+    in_atomic: bool     # lexically inside an atomic_write(...) argument
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    name: str          # "func" or "Class.method"
+    line: int
+    params: list
+    calls: list        # [CallSite]
+    sync_params: list  # param indices this function host-syncs directly
+    device_sync: bool  # syncs a device-tainted local of its own
+    sync_witness: str  # "line:<n> <desc>" of one direct sync
+    writes: list       # [WriteSite]
+    write_params: list  # param indices raw-written (derived from writes)
+    returns_resource: bool = False
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    writes: list        # [[attr, line, locked]]
+    touched: list       # self attrs referenced at all
+    self_calls: list    # self.m() callees
+    stores_params: dict  # param name -> self attr it is stored to
+    invokes_attrs: list  # self.<attr>(...) invocations
+    closes_attrs: list   # attrs X with a self.X.<closer>() call
+
+
+@dataclasses.dataclass
+class ClassSummary:
+    name: str
+    line: int
+    methods: dict       # name -> MethodInfo
+    lock_attrs: list
+    entrypoints: list   # PML005-style worker entrypoints
+    init_params: list   # __init__ params, self excluded, in order
+
+
+@dataclasses.dataclass
+class FileSummary:
+    path: str           # repo-relative posix path
+    module: str         # dotted module name derived from the path
+    imports: dict       # alias -> dotted target (module or module.symbol)
+    functions: dict     # qname ("f" / "C.m") -> FunctionSummary
+    classes: dict       # name -> ClassSummary
+    crash_module: bool  # participates in the .ok-marker/CRC protocol
+    site_literals: list  # [[site, line, context]]
+    metric_defs: list    # [[name, line, exact]]
+    metric_refs: list    # [[name, line]]
+    span_defs: list      # [[name, line]]
+    event_classes: list  # Event subclasses defined here
+    event_maps: list     # [[key, line]] dict keys mapping to photon_* values
+    event_compares: list  # [[literal, line, func_qname]] CamelCase == lits
+    registry_constants: dict  # NAME -> value (module-level str constants)
+
+
+def _module_name(path: str) -> str:
+    p = path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".").lstrip(".")
+
+
+# ------------------------------------------------------ summary extraction
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _param_derived(body: list[ast.stmt], params: list[str]) -> set[str]:
+    """Names derived (transitively, two passes) from the parameters —
+    the local taint that lets ``tmp = path + '.tmp'`` carry ``path``'s
+    param-ness into a write site."""
+    derived = set(params)
+    for _ in range(2):
+        for stmt, _d in scope_statements(body):
+            if isinstance(stmt, ast.Assign) and stmt.value is not None:
+                if _names_in(stmt.value) & derived:
+                    for t in stmt.targets:
+                        derived |= {n.id for n in ast.walk(t)
+                                    if isinstance(n, ast.Name)}
+    return derived
+
+
+def _atomic_arg_ids(fn_body: list[ast.stmt]) -> set[int]:
+    """ids of every node inside an argument of an atomic_write(...) call
+    (writes there are the SANCTIONED path, not raw writes)."""
+    out: set[int] = set()
+    for stmt, _d in scope_statements(fn_body):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                leaf = (call_func_name(node) or "").rsplit(".", 1)[-1]
+                if leaf in ("atomic_write", "_atomic_write"):
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        for sub in ast.walk(a):
+                            out.add(id(sub))
+    return out
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open`` call when it writes; None for
+    reads, dynamic modes, or non-open calls."""
+    name = call_func_name(call)
+    if name is None or name.rsplit(".", 1)[-1] != "open" \
+            or name not in ("open", "io.open", "os.fdopen"):
+        return None
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for k in call.keywords:
+        if k.arg == "mode":
+            mode = k.value
+    if mode is None:
+        return None  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if set(mode.value) & _WRITE_MODES else None
+    return None  # dynamic mode: benefit of the doubt (PML010 precedent)
+
+
+def _extract_writes(body: list[ast.stmt], params: list[str]
+                    ) -> tuple[list[WriteSite], list[int]]:
+    derived = _param_derived(body, params)
+    atomic_ids = _atomic_arg_ids(body)
+    pidx = {p: i for i, p in enumerate(params)}
+    writes: list[WriteSite] = []
+    wparams: set[int] = set()
+
+    def param_hits(expr: Optional[ast.AST]) -> list[int]:
+        if expr is None:
+            return []
+        names = _names_in(expr)
+        hit = [pidx[p] for p in params if p in names]
+        if not hit and names & derived:
+            # Derived local: attribute the write to EVERY param that
+            # could have fed it (conservative; rules only need "any").
+            hit = [pidx[p] for p in params]
+        return hit
+
+    seen: set[int] = set()
+    for stmt, _d in scope_statements(body):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            in_atomic = id(node) in atomic_ids
+            mode = _open_write_mode(node)
+            if mode is not None:
+                subject = node.args[0] if node.args else None
+                writes.append(WriteSite(
+                    line=node.lineno, kind=f"open(mode={mode!r})",
+                    param_paths=param_hits(subject),
+                    in_atomic=in_atomic))
+                continue
+            name = call_func_name(node) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in ("save", "savez", "savez_compressed") \
+                    and name.split(".", 1)[0] in ("np", "numpy"):
+                subject = node.args[0] if node.args else None
+                writes.append(WriteSite(
+                    line=node.lineno, kind=name,
+                    param_paths=param_hits(subject),
+                    in_atomic=in_atomic))
+            elif name == "json.dump":
+                subject = node.args[1] if len(node.args) > 1 else None
+                writes.append(WriteSite(
+                    line=node.lineno, kind=name,
+                    param_paths=param_hits(subject),
+                    in_atomic=in_atomic))
+    for w in writes:
+        if not w.in_atomic:
+            wparams.update(w.param_paths)
+    return writes, sorted(wparams)
+
+
+def _sync_subject(call: ast.Call) -> Optional[ast.AST]:
+    """The expression a sync-shaped call materializes on the host, or
+    None when the call is not sync-shaped."""
+    name = call_func_name(call)
+    if name in _SYNC_CASTS or name in _SYNC_NP:
+        return call.args[0] if call.args else None
+    if name is not None and name.rsplit(".", 1)[-1] == "device_get":
+        return call.args[0] if call.args else ast.Constant(value=True)
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+            and not call.args:
+        return call.func.value
+    return None
+
+
+def _binding_annotations(body: list[ast.stmt]):
+    """Per-local-name usage facts for PML016's ownership analysis:
+    which names get a ``.closer()`` call (and whether inside a
+    ``finally``), get returned, or escape into another object."""
+    finally_ids: set[int] = set()
+    for stmt, _d in scope_statements(body):
+        if isinstance(stmt, ast.Try):
+            for s in stmt.finalbody:
+                for sub in ast.walk(s):
+                    finally_ids.add(id(sub))
+    closed: dict[str, bool] = {}          # name -> closed anywhere
+    closed_fin: dict[str, bool] = {}      # name -> closed under finally
+    returned: set[str] = set()
+    escapes: set[str] = set()
+    for stmt, _d in scope_statements(body):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in CLOSER_LEAFS \
+                        and isinstance(func.value, ast.Name):
+                    n = func.value.id
+                    closed[n] = True
+                    if id(node) in finally_ids:
+                        closed_fin[n] = True
+                else:
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        if isinstance(a, ast.Name):
+                            escapes.add(a.id)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                returned |= _names_in(node.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        if isinstance(node.value, ast.Name):
+                            escapes.add(node.value.id)
+                        else:
+                            escapes |= _names_in(node.value)
+            elif isinstance(node, (ast.Tuple, ast.List, ast.Dict)):
+                escapes |= _names_in(node)
+    return closed, closed_fin, returned, escapes
+
+
+def _summarize_function(owner: Optional[str], fn, path: str
+                        ) -> FunctionSummary:
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                              + fn.args.kwonlyargs)]
+    if params and params[0] == "self":
+        params = params[1:]
+    pidx = {p: i for i, p in enumerate(params)}
+    body = fn.body
+    scope = TaintScope(body)
+    closed, closed_fin, returned, escapes = _binding_annotations(body)
+
+    calls: list[CallSite] = []
+    sync_params: set[int] = set()
+    device_sync = False
+    witness = ""
+    derived = _param_derived(body, params)
+    returns_resource = False
+
+    def record_call(node: ast.Call, depth: int, binding: str,
+                    with_item: bool, is_returned: bool) -> None:
+        name = call_func_name(node)
+        if name is None:
+            return
+        device_args = [i for i, a in enumerate(node.args)
+                       if scope.is_device(a)]
+        device_kwargs = [k.arg for k in node.keywords
+                         if k.arg and scope.is_device(k.value)]
+        param_args = {str(i): pidx[a.id] for i, a in enumerate(node.args)
+                      if isinstance(a, ast.Name) and a.id in pidx}
+        param_kwargs = {k.arg: pidx[k.value.id] for k in node.keywords
+                        if k.arg and isinstance(k.value, ast.Name)
+                        and k.value.id in pidx}
+        selfattr_args = {str(i): a for i, arg in enumerate(node.args)
+                         if (a := self_attribute(arg)) is not None}
+        selfattr_kwargs = {k.arg: a for k in node.keywords
+                           if k.arg
+                           and (a := self_attribute(k.value)) is not None}
+        cs = CallSite(
+            name=name, line=node.lineno, depth=depth,
+            device_args=device_args, device_kwargs=device_kwargs,
+            param_args=param_args, param_kwargs=param_kwargs,
+            selfattr_args=selfattr_args, selfattr_kwargs=selfattr_kwargs,
+            arg_count=len(node.args),
+            kwarg_names=[k.arg for k in node.keywords if k.arg],
+            binding=binding, with_item=with_item, is_returned=is_returned)
+        if binding.startswith("local:"):
+            n = binding.split(":", 1)[1]
+            cs.bound_closed = closed.get(n, False)
+            cs.bound_closed_finally = closed_fin.get(n, False)
+            cs.bound_returned = n in returned
+            cs.bound_escapes = n in escapes
+        calls.append(cs)
+
+    for stmt, depth in scope_statements(body):
+        # How does this statement bind call results?
+        bindings: dict[int, tuple[str, bool, bool]] = {}
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                bindings[id(stmt.value)] = (f"local:{t.id}", False, False)
+            elif self_attribute(t) is not None:
+                bindings[id(stmt.value)] = \
+                    (f"self:{self_attribute(t)}", False, False)
+            else:
+                bindings[id(stmt.value)] = ("other", False, False)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call):
+                    bindings[id(ce)] = ("other", True, False)
+        elif isinstance(stmt, ast.Return) and isinstance(stmt.value,
+                                                         ast.Call):
+            bindings[id(stmt.value)] = ("other", False, True)
+
+        for node in statement_exprs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            binding, with_item, is_ret = bindings.get(
+                id(node), ("bare" if isinstance(stmt, ast.Expr)
+                           and stmt.value is node else "other",
+                           False, False))
+            record_call(node, depth, binding, with_item, is_ret)
+            subject = _sync_subject(node)
+            if subject is not None:
+                names = _names_in(subject)
+                hit = {pidx[p] for p in pidx if p in names}
+                if not hit and names & derived:
+                    hit = set(pidx.values())
+                if hit:
+                    sync_params |= hit
+                    if not witness:
+                        witness = f"{path}:{node.lineno}"
+                if scope.is_device(subject):
+                    device_sync = True
+                    witness = witness or f"{path}:{node.lineno}"
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            if isinstance(stmt.value, ast.Call):
+                rn = call_func_name(stmt.value) or ""
+                if rn in RESOURCE_NAMES \
+                        or rn.rsplit(".", 1)[-1] in RESOURCE_LEAFS:
+                    returns_resource = True
+
+    writes, write_params = _extract_writes(body, params)
+    name = fn.name if owner is None else f"{owner}.{fn.name}"
+    return FunctionSummary(
+        name=name, line=fn.lineno, params=params, calls=calls,
+        sync_params=sorted(sync_params), device_sync=device_sync,
+        sync_witness=witness, writes=writes, write_params=write_params,
+        returns_resource=returns_resource)
+
+
+def _summarize_class(cls: ast.ClassDef) -> ClassSummary:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    lock_attrs: set[str] = set()
+    for fn in methods.values():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                leaf = (call_func_name(node.value) or "").rsplit(".", 1)[-1]
+                if leaf in _LOCK_TYPES:
+                    for t in node.targets:
+                        attr = self_attribute(t)
+                        if attr:
+                            lock_attrs.add(attr)
+    # Worker entrypoints, PML005-style (target=, submit, callbacks, a
+    # bound method escaping into a constructor).
+    eps: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                eps |= {a for n in ast.walk(kw.value)
+                        if (a := self_attribute(n)) is not None}
+        name = call_func_name(node) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("submit", "map", "apply_async",
+                    "add_done_callback") and node.args:
+            eps |= {a for n in ast.walk(node.args[0])
+                    if (a := self_attribute(n)) is not None}
+    eps &= set(methods)
+
+    infos: dict[str, MethodInfo] = {}
+    for mname, fn in methods.items():
+        writes: list[list] = []
+
+        def visit(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                now = locked or any(
+                    self_attribute(i.context_expr) in lock_attrs
+                    for i in node.items)
+                for c in node.body:
+                    visit(c, now)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = self_attribute(t)
+                    if attr is None and isinstance(t, ast.Subscript):
+                        attr = self_attribute(t.value)
+                    if attr is not None:
+                        writes.append([attr, node.lineno, locked])
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.expr) \
+                        or isinstance(child, (ast.With, ast.AsyncWith)):
+                    visit(child, locked)
+
+        for stmt in fn.body:
+            visit(stmt, False)
+        touched = sorted({a for n in ast.walk(fn)
+                          if (a := self_attribute(n)) is not None})
+        self_calls = sorted({a for n in ast.walk(fn)
+                             if isinstance(n, ast.Call)
+                             and (a := self_attribute(n.func)) is not None
+                             and a in methods})
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)
+                  if a.arg != "self"]
+        stores = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in params:
+                for t in node.targets:
+                    attr = self_attribute(t)
+                    if attr:
+                        stores[node.value.id] = attr
+        invokes = sorted({a for n in ast.walk(fn)
+                          if isinstance(n, ast.Call)
+                          and (a := self_attribute(n.func)) is not None})
+        closes = sorted({
+            self_attribute(n.func.value)
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr in CLOSER_LEAFS
+            and self_attribute(n.func.value) is not None})
+        infos[mname] = MethodInfo(
+            name=mname, writes=writes, touched=touched,
+            self_calls=self_calls, stores_params=stores,
+            invokes_attrs=invokes, closes_attrs=closes)
+    init = methods.get("__init__")
+    init_params = []
+    if init is not None:
+        init_params = [a.arg for a in (init.args.posonlyargs
+                                       + init.args.args
+                                       + init.args.kwonlyargs)
+                       if a.arg != "self"]
+    return ClassSummary(name=cls.name, line=cls.lineno, methods=infos,
+                        lock_attrs=sorted(lock_attrs),
+                        entrypoints=sorted(eps), init_params=init_params)
+
+
+def _extract_imports(tree: ast.Module, module: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    pkg_parts = module.split(".")[:-1] if module else []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".", 1)[0]
+                out[name] = alias.name if alias.asname else \
+                    alias.name.split(".", 1)[0]
+                if alias.asname:
+                    out[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                up = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(up + ([base] if base else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+    return out
+
+
+def _fstring_leading(node: ast.JoinedStr) -> tuple[str, bool]:
+    """(leading constant text, fully_static) of an f-string."""
+    if not node.values:
+        return "", True
+    first = node.values[0]
+    if not (isinstance(first, ast.Constant)
+            and isinstance(first.value, str)):
+        return "", False
+    return first.value, len(node.values) == 1
+
+
+_METRIC_RE = re.compile(r"^photon_[a-z0-9_]*[a-z0-9]")
+_METRIC_FULL_RE = re.compile(r"^photon_[a-z0-9_]*[a-z0-9]$")
+_CAMEL_RE = re.compile(r"^[A-Z][a-z]+(?:[A-Z][a-z]+)+$")
+_DOTTED_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+$")
+
+
+def _extract_string_facts(tree: ast.Module, summary: "FileSummary",
+                          func_of: dict[int, str]) -> None:
+    """Fault-site / metric / span / event string usage, for PML014 and
+    the ``--catalog`` emission."""
+
+    dyn_span_fns: set[str] = set()
+    dotted_by_fn: dict[str, list] = {}
+
+    def add_metric_def(text: str, line: int, fully_static: bool) -> None:
+        m = _METRIC_RE.match(text)
+        if not m:
+            return
+        name = m.group(0)
+        rest = text[len(name):]
+        if fully_static or (rest and rest[0] in " {"):
+            # The name ends at a render boundary: exact.
+            summary.metric_defs.append([name, line, True])
+        else:
+            # The leading constant runs straight into a dynamic part
+            # (f"photon_serving_{name}_..."): a prefix family.
+            summary.metric_defs.append([text, line, False])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_func_name(node) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in _FAULT_HOOKS and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, str):
+                    summary.site_literals.append(
+                        [a0.value, node.lineno, leaf])
+            if leaf == "FaultSpec":
+                site = node.args[0] if node.args else None
+                for k in node.keywords:
+                    if k.arg == "site":
+                        site = k.value
+                if isinstance(site, ast.Constant) \
+                        and isinstance(site.value, str):
+                    summary.site_literals.append(
+                        [site.value, node.lineno, "FaultSpec"])
+            if leaf in _METRIC_FACTORIES and node.args \
+                    and isinstance(node.func, ast.Attribute):
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, str):
+                    add_metric_def(a0.value, node.lineno, True)
+                elif isinstance(a0, ast.JoinedStr):
+                    text, full = _fstring_leading(a0)
+                    add_metric_def(text, node.lineno, full)
+            if leaf in ("span", "record_complete") and node.args \
+                    and isinstance(node.func, ast.Attribute):
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, str):
+                    summary.span_defs.append([a0.value, node.lineno])
+                elif isinstance(a0, ast.Name):
+                    # Span name fed from a variable: the function's
+                    # dotted literals (a stage-name tuple, say) are the
+                    # candidate names — collected below.
+                    dyn_span_fns.add(func_of.get(id(node), ""))
+        elif isinstance(node, ast.Dict):
+            # {"site": "..."} literals (fault plans built as dicts) and
+            # event-name -> photon_* counter maps (the bridge).
+            vals = [v for v in node.values
+                    if isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)]
+            str_vals = [v.value for v in vals]
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "site" \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    summary.site_literals.append(
+                        [v.value, v.lineno, "dict"])
+            if str_vals and len(str_vals) == len(node.values) \
+                    and all(_METRIC_FULL_RE.match(v) for v in str_vals):
+                keys = [k for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                # Only the bridge shape (CamelCase event-class keys) is
+                # held to the event catalog; a bench-name -> metric map
+                # is a different, legitimate dict.
+                if keys and all(_CAMEL_RE.match(k.value) for k in keys):
+                    for k in keys:
+                        summary.event_maps.append([k.value, k.lineno])
+                for v in vals:
+                    add_metric_def(v.value, v.lineno, True)
+        elif isinstance(node, ast.Compare):
+            for cmp_ in node.comparators:
+                if isinstance(cmp_, ast.Constant) \
+                        and isinstance(cmp_.value, str) \
+                        and _CAMEL_RE.match(cmp_.value):
+                    summary.event_compares.append(
+                        [cmp_.value, cmp_.lineno,
+                         func_of.get(id(node), "")])
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _METRIC_FULL_RE.match(node.value):
+                summary.metric_refs.append([node.value, node.lineno])
+            if _DOTTED_RE.match(node.value):
+                dotted_by_fn.setdefault(
+                    func_of.get(id(node), ""), []).append(
+                        [node.value, node.lineno])
+        elif isinstance(node, ast.JoinedStr):
+            text, full = _fstring_leading(node)
+            if _METRIC_RE.match(text):
+                add_metric_def(text, node.lineno, full)
+
+    for fn in dyn_span_fns:
+        summary.span_defs.extend(dotted_by_fn.get(fn, []))
+
+
+def summarize_file(path: str, tree: ast.Module,
+                   source: str = "") -> FileSummary:
+    module = _module_name(path)
+    summary = FileSummary(
+        path=path, module=module,
+        imports=_extract_imports(tree, module),
+        functions={}, classes={}, crash_module=False,
+        site_literals=[], metric_defs=[], metric_refs=[],
+        span_defs=[], event_classes=[], event_maps=[],
+        event_compares=[], registry_constants={})
+
+    # Map expression nodes to the function that owns them (for the
+    # event-compare heuristic's per-function grouping).
+    func_of: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                func_of.setdefault(id(sub), node.name)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fs = _summarize_function(None, node, path)
+            summary.functions[fs.name] = fs
+        elif isinstance(node, ast.ClassDef):
+            summary.classes[node.name] = _summarize_class(node)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    fs = _summarize_function(node.name, sub, path)
+                    summary.functions[fs.name] = fs
+            if any((dotted_name(b) or "").rsplit(".", 1)[-1] == "Event"
+                   for b in node.bases):
+                summary.event_classes.append(node.name)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.targets[0].id.isupper():
+            summary.registry_constants[node.targets[0].id] = \
+                node.value.value
+
+    imported = set(summary.imports.values())
+    # Importing the atomic-write module IS the marker-protocol opt-in:
+    # a module that writes through utils/diskio participates in the
+    # .ok/CRC commit discipline, so PML013 holds it to it everywhere.
+    summary.crash_module = any(
+        t.endswith(".diskio") or ".diskio." in t for t in imported)
+    _extract_string_facts(tree, summary, func_of)
+    return summary
+
+
+# ----------------------------------------------------------------- graph
+
+
+class ProjectGraph:
+    """Resolved view over one :class:`FileSummary` per file."""
+
+    def __init__(self, files: dict[str, FileSummary],
+                 package_prefix: str = "photon_ml_tpu"):
+        self.files = files
+        if os.path.isabs(package_prefix):
+            # Summaries carry cwd-relative paths; match the prefix in
+            # the same coordinate system.
+            package_prefix = os.path.relpath(package_prefix)
+        self.package_prefix = \
+            package_prefix.replace(os.sep, "/").rstrip("/")
+        self.modules: dict[str, FileSummary] = {}
+        for fs in files.values():
+            self.modules[fs.module] = fs
+        # Unique-method fallback index: leaf name -> [(file, qname)].
+        self._method_index: dict[str, list[tuple[str, str]]] = {}
+        self._class_index: dict[str, list[tuple[str, str]]] = {}
+        for fs in files.values():
+            for qname in fs.functions:
+                leaf = qname.rsplit(".", 1)[-1]
+                self._method_index.setdefault(leaf, []).append(
+                    (fs.path, qname))
+            for cname in fs.classes:
+                self._class_index.setdefault(cname, []).append(
+                    (fs.path, cname))
+
+    # -- membership --------------------------------------------------------
+
+    def is_package_file(self, path: str) -> bool:
+        return path.replace(os.sep, "/").startswith(
+            self.package_prefix + "/") or path == self.package_prefix
+
+    def package_files(self) -> list[FileSummary]:
+        return [fs for fs in self.files.values()
+                if self.is_package_file(fs.path)]
+
+    # -- resolution --------------------------------------------------------
+
+    def _module_for(self, dotted: str) -> Optional[FileSummary]:
+        return self.modules.get(dotted)
+
+    def _lookup_symbol(self, fs: FileSummary, symbol: str
+                      ) -> Optional[tuple[FileSummary, str]]:
+        """symbol inside module fs: function, class (-> __init__), or a
+        re-exported import."""
+        if symbol in fs.functions:
+            return fs, symbol
+        if symbol in fs.classes:
+            init = f"{symbol}.__init__"
+            return fs, init if init in fs.functions else symbol
+        target = fs.imports.get(symbol)
+        if target:
+            mod, _, sym = target.rpartition(".")
+            m = self._module_for(target)
+            if m is not None:  # a submodule re-export
+                return None
+            m = self._module_for(mod)
+            if m is not None and sym:
+                return self._lookup_symbol(m, sym)
+        return None
+
+    def resolve_call(self, fs: FileSummary, call: CallSite,
+                     caller: Optional[str] = None
+                     ) -> Optional[tuple[FileSummary, FunctionSummary]]:
+        """The FunctionSummary a call lands on, or None. ``caller`` is
+        the calling function's qname (for ``self.m`` resolution)."""
+        name = call.name
+        parts = name.split(".")
+        if parts[0] == "self" and caller and "." in caller:
+            cls = caller.split(".", 1)[0]
+            q = f"{cls}.{parts[1]}" if len(parts) == 2 else None
+            if q and q in fs.functions:
+                return fs, fs.functions[q]
+            return None
+        if len(parts) == 1:
+            hit = self._lookup_symbol(fs, parts[0])
+            if hit and hit[1] in hit[0].functions:
+                return hit[0], hit[0].functions[hit[1]]
+            return None
+        # alias.attr... : resolve the longest module prefix.
+        target = fs.imports.get(parts[0])
+        if target is not None:
+            rest = parts[1:]
+            # try alias->module, then alias.sub->module, deepest first
+            cands = []
+            for i in range(len(rest), -1, -1):
+                mod = ".".join([target] + rest[:i])
+                m = self._module_for(mod)
+                if m is not None and i < len(rest):
+                    cands.append((m, rest[i:]))
+                    break
+            for m, tail in cands:
+                if len(tail) == 1:
+                    hit = self._lookup_symbol(m, tail[0])
+                    if hit and hit[1] in hit[0].functions:
+                        return hit[0], hit[0].functions[hit[1]]
+                elif len(tail) == 2 and tail[0] in m.classes:
+                    q = ".".join(tail)
+                    if q in m.functions:
+                        return m, m.functions[q]
+            if cands:
+                return None
+        # Conservative fallback: obj.method() with a UNIQUE method name
+        # across the project (two candidates = no edge).
+        leaf = parts[-1]
+        cands = [(p, q) for p, q in self._method_index.get(leaf, ())
+                 if "." in q]  # methods only — free functions need imports
+        if len(cands) == 1:
+            p, q = cands[0]
+            m = self.files[p]
+            return m, m.functions[q]
+        return None
+
+    def resolve_class(self, fs: FileSummary, name: str
+                      ) -> Optional[tuple[FileSummary, ClassSummary]]:
+        parts = name.split(".")
+        if len(parts) == 1:
+            if parts[0] in fs.classes:
+                return fs, fs.classes[parts[0]]
+            target = fs.imports.get(parts[0])
+            if target:
+                mod, _, sym = target.rpartition(".")
+                m = self._module_for(mod)
+                if m is not None and sym in m.classes:
+                    return m, m.classes[sym]
+            return None
+        target = fs.imports.get(parts[0])
+        if target is not None:
+            for i in range(len(parts) - 1, 0, -1):
+                mod = ".".join([target] + parts[1:i])
+                m = self._module_for(mod)
+                if m is not None and parts[i] in m.classes \
+                        and i == len(parts) - 1:
+                    return m, m.classes[parts[i]]
+        return None
+
+    # -- catalogs ----------------------------------------------------------
+
+    def fault_site_registry(self) -> dict[str, str]:
+        """site string -> constant name, from faults/sites.py-shaped
+        registry modules (empty when the graph has none)."""
+        out: dict[str, str] = {}
+        for fs in self.files.values():
+            if fs.path.replace(os.sep, "/").endswith("faults/sites.py"):
+                for k, v in fs.registry_constants.items():
+                    out[v] = k
+        return out
+
+    def event_catalog(self) -> set[str]:
+        out: set[str] = set()
+        for fs in self.files.values():
+            if fs.path.replace(os.sep, "/").endswith("events.py"):
+                out |= set(fs.event_classes)
+        return out
+
+    def metric_catalog(self) -> tuple[set[str], set[str]]:
+        """(exact names, dynamic prefixes) defined by package files."""
+        exact: set[str] = set()
+        prefixes: set[str] = set()
+        for fs in self.package_files():
+            for name, _line, is_exact in fs.metric_defs:
+                (exact if is_exact else prefixes).add(name)
+        return exact, prefixes
+
+    def span_catalog(self) -> set[str]:
+        out: set[str] = set()
+        for fs in self.package_files():
+            out |= {name for name, _line in fs.span_defs}
+        return out
+
+
+def build_catalog(graph: ProjectGraph) -> dict:
+    """The ``photon-lint --catalog`` payload: every string-keyed seam's
+    registry, as JSON for docs and CI to consume."""
+    registry = graph.fault_site_registry()
+    exact, prefixes = graph.metric_catalog()
+    return {
+        "fault_sites": {site: registry[site] for site in sorted(registry)},
+        "events": sorted(graph.event_catalog()),
+        "metrics": {"exact": sorted(exact),
+                    "prefixes": sorted(prefixes)},
+        "spans": sorted(graph.span_catalog()),
+    }
+
+
+# ----------------------------------------------------------------- cache
+
+
+CACHE_VERSION = 3
+DEFAULT_CACHE = ".photon-lint-cache.json"
+
+
+def _file_key(path: str) -> Optional[list]:
+    try:
+        st = os.stat(path)
+        with open(path, "rb") as f:
+            crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+        return [st.st_size, st.st_mtime_ns, crc]
+    except OSError:
+        return None
+
+
+def analysis_signature() -> str:
+    """CRC over the analysis package's own sources — a rule edit must
+    invalidate every cached summary and finding."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    crc = 0
+    for sub, _dirs, names in sorted(os.walk(root)):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                with open(os.path.join(sub, n), "rb") as f:
+                    crc = zlib.crc32(f.read(), crc)
+    return f"{CACHE_VERSION}:{crc & 0xFFFFFFFF:08x}"
+
+
+class ProjectCache:
+    """mtime/CRC-keyed store of per-file summaries + per-file-rule
+    findings, fenced by :func:`analysis_signature`."""
+
+    def __init__(self, path: str = DEFAULT_CACHE):
+        self.path = path
+        self.signature = analysis_signature()
+        self._entries: dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        if doc.get("signature") != self.signature:
+            return  # rules changed: every entry is stale
+        self._entries = doc.get("files", {})
+
+    def lookup(self, path: str) -> Optional[dict]:
+        entry = self._entries.get(path)
+        if entry is None:
+            self.misses += 1
+            return None
+        if entry.get("key") != _file_key(path):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return entry
+
+    def store(self, path: str, summary: Optional[FileSummary],
+              findings: list, unused: list, suppressions: list) -> None:
+        self._entries[path] = {
+            "key": _file_key(path),
+            "summary": (summary_to_dict(summary)
+                        if summary is not None else None),
+            "findings": findings,
+            "unused": unused,
+            "suppressions": suppressions,
+        }
+
+    def save(self, live_paths: Iterable[str]) -> None:
+        live = set(live_paths)
+        doc = {"signature": self.signature,
+               "files": {p: e for p, e in self._entries.items()
+                         if p in live}}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a read-only checkout still lints, just never warm
+
+
+# -------------------------------------------------- summary (de)serialize
+
+
+def summary_to_dict(s: FileSummary) -> dict:
+    return dataclasses.asdict(s)
+
+
+def summary_from_dict(d: dict) -> FileSummary:
+    fns = {}
+    for q, f in d.get("functions", {}).items():
+        f = dict(f)
+        f["calls"] = [CallSite(**c) for c in f.get("calls", [])]
+        f["writes"] = [WriteSite(**w) for w in f.get("writes", [])]
+        fns[q] = FunctionSummary(**f)
+    classes = {}
+    for n, c in d.get("classes", {}).items():
+        c = dict(c)
+        c["methods"] = {m: MethodInfo(**mi)
+                        for m, mi in c.get("methods", {}).items()}
+        classes[n] = ClassSummary(**c)
+    d = dict(d)
+    d["functions"] = fns
+    d["classes"] = classes
+    return FileSummary(**d)

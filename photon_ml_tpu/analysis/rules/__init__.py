@@ -1,13 +1,16 @@
-"""Rule registry. Each rule is a callable ``check(ctx) -> list[Finding]``
-registered under its PML id; the engine runs every registered rule unless
-the CLI selects/ignores a subset."""
+"""Rule registry. Per-file rules are callables ``check(ctx) ->
+list[Finding]`` over one :class:`ModuleContext`; project rules (PML012+)
+are callables ``check(graph) -> list[Finding]`` over the whole
+:class:`~photon_ml_tpu.analysis.project.ProjectGraph`. The engine runs
+every registered rule unless the CLI selects/ignores a subset."""
 
 from __future__ import annotations
 
-from photon_ml_tpu.analysis.rules import (concurrency, device, lifecycle,
-                                          network, numeric,
-                                          obs_discipline, robustness,
-                                          timeclock)
+from photon_ml_tpu.analysis.rules import (concurrency, device, drift,
+                                          interproc, lifecycle, network,
+                                          numeric, obs_discipline,
+                                          resources, robustness,
+                                          timeclock, xclass)
 
 # id → (check, one-line summary). Order is report order.
 ALL_RULES = {
@@ -35,4 +38,24 @@ ALL_RULES = {
                "buffered run-ledger API)"),
     "PML011": (network.check_blocking_network_timeout,
                "blocking socket/HTTP call without an explicit timeout"),
+}
+
+# Whole-program rules over the project graph (analysis/project.py):
+# id → (check(graph), one-line summary). Same report order contract.
+PROJECT_RULES = {
+    "PML012": (interproc.check_cross_module_sync,
+               "cross-module call chain syncing host-device inside a "
+               "loop"),
+    "PML013": (interproc.check_crash_consistency,
+               "raw write inside (or handed out of) a .ok-marker "
+               "crash-consistency module"),
+    "PML014": (drift.check_registry_drift,
+               "string-registry drift: unknown fault site / metric / "
+               "span / event name"),
+    "PML015": (xclass.check_cross_class_locks,
+               "cross-class callback writing shared state off-thread "
+               "without the lock"),
+    "PML016": (resources.check_resource_lifecycle,
+               "subprocess/socket/server/pool acquired without a "
+               "guaranteed release"),
 }

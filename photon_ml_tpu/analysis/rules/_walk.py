@@ -1,101 +1,12 @@
-"""Shared walkers: per-scope statement iteration with loop depth, and
-expression iteration that respects deferred-execution boundaries."""
+"""Shim: the shared AST walkers moved to ``analysis/astwalk.py`` so the
+project graph (analysis/project.py) can use them without importing the
+rules package (which imports the project-rule modules, which import the
+project graph — a cycle). Rule modules keep importing from here."""
 
-from __future__ import annotations
+from photon_ml_tpu.analysis.astwalk import (assigned_names,  # noqa: F401
+                                            scope_statements,
+                                            self_attribute,
+                                            statement_exprs)
 
-import ast
-from typing import Iterator
-
-_SCOPE_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-
-
-def scope_statements(body: list[ast.stmt], depth: int = 0
-                     ) -> Iterator[tuple[ast.stmt, int]]:
-    """Yield (statement, loop_depth) for one scope, NOT descending into
-    nested function/class bodies (those are separate scopes — their code
-    runs when called, not where it is written)."""
-    for stmt in body:
-        yield stmt, depth
-        if isinstance(stmt, _SCOPE_STMTS):
-            continue
-        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
-            yield from scope_statements(stmt.body, depth + 1)
-            yield from scope_statements(stmt.orelse, depth)
-        elif isinstance(stmt, ast.If):
-            yield from scope_statements(stmt.body, depth)
-            yield from scope_statements(stmt.orelse, depth)
-        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-            yield from scope_statements(stmt.body, depth)
-        elif isinstance(stmt, ast.Try):
-            yield from scope_statements(stmt.body, depth)
-            for h in stmt.handlers:
-                yield from scope_statements(h.body, depth)
-            yield from scope_statements(stmt.orelse, depth)
-            yield from scope_statements(stmt.finalbody, depth)
-
-
-def statement_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
-    """Every expression node executed AS PART of this statement: skips
-    nested def/class/lambda bodies (deferred) and the statement's own
-    nested block statements (yielded separately by scope_statements)."""
-    blocks = []
-    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.If)):
-        blocks = [stmt.body, stmt.orelse]
-    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-        blocks = [stmt.body]
-    elif isinstance(stmt, ast.Try):
-        blocks = [stmt.body, stmt.orelse, stmt.finalbody] \
-            + [h.body for h in stmt.handlers]
-    skip = {id(s) for b in blocks for s in b}
-
-    def walk(node: ast.AST) -> Iterator[ast.AST]:
-        for child in ast.iter_child_nodes(node):
-            if id(child) in skip or isinstance(child, _SCOPE_STMTS):
-                continue
-            if isinstance(child, ast.Lambda):
-                continue
-            yield child
-            yield from walk(child)
-
-    if isinstance(stmt, _SCOPE_STMTS):
-        # Only the decorators/defaults run here, not the body.
-        for dec in getattr(stmt, "decorator_list", []):
-            yield dec
-            yield from walk(dec)
-        return
-    yield from walk(stmt)
-
-
-def assigned_names(stmt: ast.stmt) -> set[str]:
-    """Plain names bound by this statement (tuple targets flattened)."""
-    out: set[str] = set()
-
-    def grab(t: ast.AST) -> None:
-        if isinstance(t, ast.Name):
-            out.add(t.id)
-        elif isinstance(t, (ast.Tuple, ast.List)):
-            for e in t.elts:
-                grab(e)
-        elif isinstance(t, ast.Starred):
-            grab(t.value)
-
-    if isinstance(stmt, ast.Assign):
-        for t in stmt.targets:
-            grab(t)
-    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
-        grab(stmt.target)
-    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-        grab(stmt.target)
-    for node in statement_exprs(stmt):
-        if isinstance(node, ast.NamedExpr):
-            grab(node.target)
-    return out
-
-
-def self_attribute(node: ast.AST) -> str | None:
-    """'x' when node is ``self.x`` (one level), else None."""
-    if isinstance(node, ast.Attribute) \
-            and isinstance(node.value, ast.Name) \
-            and node.value.id == "self":
-        return node.attr
-    return None
+__all__ = ["assigned_names", "scope_statements", "self_attribute",
+           "statement_exprs"]

@@ -1,0 +1,160 @@
+"""PML014 — string-registry drift across the string-keyed seams.
+
+The fault injector, the metrics registry, the span tracer, and the
+event bridge are all STRING-keyed: a site/metric/span/event only exists
+at the moment two strings match. Nothing fails on a typo — the fault
+plan silently never fires (the chaos drill "passes" while exercising
+nothing), the metric lookup silently reads zero, the bridge counter
+silently never increments. This rule resolves every such literal
+against the generated registries:
+
+- **fault sites** — dotted literals at ``fire()`` / ``poison_scalar()``
+  / ``corrupt_file()`` calls, ``FaultSpec(site=...)``, and ``"site"``
+  keys in fault-plan dict literals must be members of the
+  ``faults/sites.py`` registry (undotted names are the injector unit
+  tests' synthetic sites and are exempt by convention);
+- **metrics** — ``photon_*`` literals OUTSIDE the package (tests,
+  dev-scripts: `metric_value` lookups, assertion needles, bench↔metric
+  maps) must resolve against the names the package actually emits
+  (exact registrations, render-time f-string names, known
+  ``_peak``/quantile suffixes, dynamic-prefix families);
+- **spans** — dotted span names started outside the package must be
+  names the package starts somewhere;
+- **events** — dict literals mapping event-class names to ``photon_*``
+  counters (the bridge shape), and CamelCase equality switches in
+  functions that demonstrably switch on event names, must use class
+  names that exist in ``utils/events.py``.
+
+``photon-lint --catalog`` emits the same registries as JSON.
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.project import ProjectGraph
+
+_METRIC_SUFFIXES = ("_peak", "_count", "_sum", "_p50", "_p95", "_p99")
+
+
+def check_registry_drift(graph: ProjectGraph) -> list[Finding]:
+    out: list[Finding] = []
+    out += _check_fault_sites(graph)
+    out += _check_metric_refs(graph)
+    out += _check_span_refs(graph)
+    out += _check_event_names(graph)
+    return out
+
+
+def _check_fault_sites(graph: ProjectGraph) -> list[Finding]:
+    registry = graph.fault_site_registry()
+    if not registry:
+        return []  # no sites module in this graph: nothing to drift from
+    out = []
+    for fs in graph.files.values():
+        if fs.path.replace("\\", "/").endswith("faults/sites.py"):
+            continue
+        for site, line, ctx in fs.site_literals:
+            if "." not in site:
+                continue  # undotted = injector-unit-test synthetic site
+            if site not in registry:
+                out.append(Finding(
+                    rule="PML014", path=fs.path, line=line, col=0,
+                    message=(
+                        f"unknown fault site {site!r} at a {ctx} call "
+                        f"— not in the faults/sites.py registry, so "
+                        f"this fault silently NEVER fires; fix the "
+                        f"typo or register the site")))
+    return out
+
+
+def _check_metric_refs(graph: ProjectGraph) -> list[Finding]:
+    exact, prefixes = graph.metric_catalog()
+    if not exact and not prefixes:
+        return []
+    out = []
+    pkg_name = graph.package_prefix.replace("/", ".").split(".")[-1]
+    for fs in graph.files.values():
+        if graph.is_package_file(fs.path):
+            continue
+        local_defs = {name for name, _l, _e in fs.metric_defs}
+        for name, line in fs.metric_refs:
+            if name == pkg_name:
+                continue  # the package's own name, not a metric
+            if _metric_resolves(name, exact | local_defs, prefixes):
+                continue
+            out.append(Finding(
+                rule="PML014", path=fs.path, line=line, col=0,
+                message=(
+                    f"metric {name!r} is not a name the package "
+                    f"emits — a lookup on it silently reads nothing; "
+                    f"check against `photon-lint --catalog`")))
+    return out
+
+
+def _metric_resolves(name: str, exact: set[str],
+                     prefixes: set[str]) -> bool:
+    if name in exact:
+        return True
+    for suf in _METRIC_SUFFIXES:
+        if name.endswith(suf) and name[: -len(suf)] in exact:
+            return True
+    return any(name.startswith(p) for p in prefixes)
+
+
+def _check_span_refs(graph: ProjectGraph) -> list[Finding]:
+    spans = graph.span_catalog()
+    if not spans:
+        return []
+    # Only names in a namespace the PACKAGE owns are checked: a
+    # dev-script inventing its own "flagship.*" spans is defining, not
+    # referencing; "serving.quue_wait" is a typo'd reference.
+    namespaces = {s.split(".", 1)[0] for s in spans if "." in s}
+    out = []
+    for fs in graph.files.values():
+        if graph.is_package_file(fs.path):
+            continue
+        for name, line in fs.span_defs:
+            if "." not in name or name in spans \
+                    or name.split(".", 1)[0] not in namespaces:
+                continue
+            out.append(Finding(
+                rule="PML014", path=fs.path, line=line, col=0,
+                message=(
+                    f"span name {name!r} is not one the package "
+                    f"starts — an assertion or summary keyed on it "
+                    f"silently matches nothing; check against "
+                    f"`photon-lint --catalog`")))
+    return out
+
+
+def _check_event_names(graph: ProjectGraph) -> list[Finding]:
+    events = graph.event_catalog()
+    if not events:
+        return []
+    out = []
+    for fs in graph.files.values():
+        for key, line in fs.event_maps:
+            if key not in events:
+                out.append(Finding(
+                    rule="PML014", path=fs.path, line=line, col=0,
+                    message=(
+                        f"{key!r} maps to a photon_* counter but is "
+                        f"not an event class in utils/events.py — "
+                        f"the bridge would silently never count it")))
+        # Equality switches: only functions that PROVABLY switch on
+        # event names (at least one literal resolves) are checked.
+        by_fn: dict[str, list[tuple[str, int]]] = {}
+        for lit, line, fn in fs.event_compares:
+            by_fn.setdefault(fn, []).append((lit, line))
+        for fn, lits in by_fn.items():
+            if not any(lit in events for lit, _l in lits):
+                continue
+            for lit, line in lits:
+                if lit not in events:
+                    out.append(Finding(
+                        rule="PML014", path=fs.path, line=line, col=0,
+                        message=(
+                            f"{fn}() switches on event-class names "
+                            f"but {lit!r} is not one — that branch "
+                            f"silently never runs")))
+    return out

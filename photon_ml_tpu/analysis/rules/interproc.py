@@ -1,0 +1,197 @@
+"""PML012/PML013 — interprocedural rules over the project graph.
+
+**PML012** is PML001's sync-in-loop analysis propagated through the call
+graph: PML001 sees one file, so a helper in ``ops/`` that hides a
+``float()``/``.item()``/``np.asarray()`` behind a function boundary goes
+dark the moment its caller's loop lives in another module. Here the
+helper's summary carries "syncs parameter i" / "syncs a device value of
+its own", those facts close over the call graph, and a CROSS-MODULE call
+inside a loop that reaches one is the finding — at the caller's line,
+naming the witness sync.
+
+**PML013** mechanizes the ``.ok``-marker crash-consistency discipline
+(docs/ROBUSTNESS.md): inside a module that participates in the
+marker/CRC protocol (it imports ``utils/diskio``), every artifact write
+must flow through ``diskio.atomic_write`` so the commit marker stays
+LAST — a raw ``open(.., "w")``/``np.save`` there (or a call handing a
+protected path to a helper module that raw-writes it) can leave a
+half-written artifact that the marker already vouches for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.project import ProjectGraph
+
+
+def _qkey(path: str, qname: str) -> tuple[str, str]:
+    return (path, qname)
+
+
+def _resolved_calls(graph: ProjectGraph):
+    """[(file, qname, fn, call, target_key or None)] for every call in
+    the graph — resolved once, shared by the fixpoint and the report."""
+    out = []
+    targets: dict[tuple[str, str], object] = {}
+    for fs in graph.files.values():
+        for qname, fn in fs.functions.items():
+            for c in fn.calls:
+                r = graph.resolve_call(fs, c, caller=qname)
+                tkey = None
+                if r is not None:
+                    tfs, tfn = r
+                    tkey = _qkey(tfs.path, tfn.name)
+                    targets[tkey] = (tfs, tfn)
+                out.append((fs, qname, fn, c, tkey))
+    return out, targets
+
+
+# ---------------------------------------------------------------- PML012
+
+
+def check_cross_module_sync(graph: ProjectGraph) -> list[Finding]:
+    calls, targets = _resolved_calls(graph)
+    sync_params: dict[tuple, set[int]] = {}
+    trans: dict[tuple, bool] = {}
+    witness: dict[tuple, str] = {}
+    for fs in graph.files.values():
+        for qname, fn in fs.functions.items():
+            k = _qkey(fs.path, qname)
+            sync_params[k] = set(fn.sync_params)
+            trans[k] = fn.device_sync
+            witness[k] = fn.sync_witness
+
+    def kw_position(tfn, kw: str) -> Optional[int]:
+        try:
+            return tfn.params.index(kw)
+        except ValueError:
+            return None
+
+    for _ in range(6):  # bounded fixpoint over call-graph depth
+        changed = False
+        for fs, qname, fn, c, tkey in calls:
+            if tkey is None or tkey not in targets:
+                continue
+            k = _qkey(fs.path, qname)
+            tfs, tfn = targets[tkey]
+            # Param passthrough: my param p flows into a synced param.
+            for pos_s, pi in c.param_args.items():
+                if int(pos_s) in sync_params[tkey] \
+                        and pi not in sync_params[k]:
+                    sync_params[k].add(pi)
+                    witness[k] = witness[k] or witness.get(tkey, "")
+                    changed = True
+            for kw, pi in c.param_kwargs.items():
+                tp = kw_position(tfn, kw)
+                if tp is not None and tp in sync_params[tkey] \
+                        and pi not in sync_params[k]:
+                    sync_params[k].add(pi)
+                    witness[k] = witness[k] or witness.get(tkey, "")
+                    changed = True
+            # A call that ALWAYS syncs (callee syncs its own device
+            # value, or I feed a device value into a synced param)
+            # makes me transitively syncing.
+            hits_sync = trans.get(tkey, False) or any(
+                pos in sync_params[tkey] for pos in c.device_args) or any(
+                (tp := kw_position(tfn, kw)) is not None
+                and tp in sync_params[tkey] for kw in c.device_kwargs)
+            if hits_sync and not trans[k]:
+                trans[k] = True
+                witness[k] = witness[k] or witness.get(tkey, "")
+                changed = True
+        if not changed:
+            break
+
+    out: list[Finding] = []
+    seen: set[tuple] = set()
+    for fs, qname, fn, c, tkey in calls:
+        if c.depth < 1 or tkey is None or tkey not in targets:
+            continue
+        if not graph.is_package_file(fs.path):
+            continue  # a test looping whole driver runs is the norm,
+            # not the hot-path bug class this rule targets
+        tfs, tfn = targets[tkey]
+        if tfs.path == fs.path:
+            continue  # same-file chains are PML001's jurisdiction
+        wit = witness.get(tkey, "") or f"{tfs.path}:{tfn.line}"
+        msg = None
+        if trans.get(tkey):
+            msg = (f"{qname}() calls {tfn.name}() ({tfs.path}) inside a "
+                   f"loop, and that call reaches a host-device sync "
+                   f"({wit}) — every iteration blocks the host on the "
+                   f"device stream; hoist the call or batch the "
+                   f"transfer")
+        else:
+            synced_pos = sync_params.get(tkey, set())
+            feeds = [p for p in c.device_args if p in synced_pos]
+            feeds_kw = [kw for kw in c.device_kwargs
+                        if (tp := kw_position(tfn, kw)) is not None
+                        and tp in synced_pos]
+            if feeds or feeds_kw:
+                which = ", ".join(
+                    [tfn.params[p] if p < len(tfn.params) else str(p)
+                     for p in feeds] + feeds_kw)
+                msg = (f"{qname}() passes a device value into "
+                       f"{tfn.name}({which}) ({tfs.path}) inside a loop "
+                       f"— the callee host-syncs that argument ({wit}); "
+                       f"sync once outside the loop instead")
+        if msg is not None:
+            key = (fs.path, c.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(rule="PML012", path=fs.path,
+                                   line=c.line, col=0, message=msg))
+    return out
+
+
+# ---------------------------------------------------------------- PML013
+
+
+def check_crash_consistency(graph: ProjectGraph) -> list[Finding]:
+    out: list[Finding] = []
+    for fs in graph.files.values():
+        if not fs.crash_module:
+            continue
+        path = fs.path.replace("\\", "/")
+        if path.endswith("utils/diskio.py"):
+            continue  # the sanctioned writer itself
+        for qname, fn in fs.functions.items():
+            for w in fn.writes:
+                if w.in_atomic:
+                    continue
+                out.append(Finding(
+                    rule="PML013", path=fs.path, line=w.line, col=0,
+                    message=(
+                        f"raw {w.kind} in {qname}() — this module "
+                        f"participates in the .ok-marker/CRC commit "
+                        f"protocol; route artifact writes through "
+                        f"utils/diskio.atomic_write so a crash can "
+                        f"never leave bytes the marker vouches for")))
+            for c in fn.calls:
+                r = graph.resolve_call(fs, c, caller=qname)
+                if r is None:
+                    continue
+                tfs, tfn = r
+                if tfs.path == fs.path or tfs.crash_module:
+                    continue  # the callee owns its own discipline
+                provided = [
+                    p for p in tfn.write_params
+                    if p < c.arg_count
+                    or (p < len(tfn.params)
+                        and tfn.params[p] in c.kwarg_names)]
+                if not provided:
+                    continue
+                which = ", ".join(tfn.params[p] for p in provided
+                                  if p < len(tfn.params))
+                out.append(Finding(
+                    rule="PML013", path=fs.path, line=c.line, col=0,
+                    message=(
+                        f"{qname}() hands a path to {tfn.name}() "
+                        f"({tfs.path}), which raw-writes its "
+                        f"argument ({which}) outside "
+                        f"utils/diskio.atomic_write — a crash "
+                        f"mid-write leaves a torn artifact inside this "
+                        f"module's marker-committed tree")))
+    return out

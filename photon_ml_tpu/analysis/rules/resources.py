@@ -1,0 +1,112 @@
+"""PML016 — resource lifecycle: acquire implies a guaranteed release.
+
+The fleet layer's bug class: a subprocess, socket, HTTP server, mmap,
+or worker pool acquired on a path where an exception between acquire
+and release leaks it — a leaked replica subprocess keeps serving stale
+shards, a leaked server socket blocks the next bind, a leaked pool
+leaks OS threads for the process lifetime. The discipline this rule
+mechanizes:
+
+- acquire as a ``with`` item, or release in a ``finally``;
+- or hand the resource off: return it, store it on another object,
+  pass it to an owner — ownership transfer is fine, the new owner is
+  then on the hook;
+- or store it on ``self`` — then the CLASS must have a release method
+  (``close``/``stop``/``shutdown``/``__exit__``/...) that closes that
+  attribute.
+
+Resource-ness propagates through the call graph: an intra-package
+factory that returns ``ThreadingHTTPServer(...)`` makes its callers'
+bindings resources too (``make_fleet_http_server`` is the repo's own
+example). A release that exists but sits in straight-line code is
+still flagged — it is not on the exception paths.
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.project import (RESOURCE_LEAFS, RESOURCE_NAMES,
+                                            ProjectGraph)
+
+
+def _is_resource_call(c) -> bool:
+    return c.name in RESOURCE_NAMES or c.leaf in RESOURCE_LEAFS
+
+
+def check_resource_lifecycle(graph: ProjectGraph) -> list[Finding]:
+    # Resource-ness fixpoint: a function returning a resource makes its
+    # call sites acquisitions too.
+    rr: dict[tuple[str, str], bool] = {}
+    resolved: dict[tuple[str, str, int], tuple] = {}
+    items = []
+    for fs in graph.files.values():
+        for qname, fn in fs.functions.items():
+            rr[(fs.path, qname)] = fn.returns_resource
+            for c in fn.calls:
+                r = graph.resolve_call(fs, c, caller=qname)
+                if r is not None:
+                    resolved[(fs.path, qname, id(c))] = \
+                        (r[0].path, r[1].name)
+                items.append((fs, qname, fn, c))
+    for _ in range(4):
+        changed = False
+        for fs, qname, fn, c in items:
+            if not (c.is_returned or c.bound_returned):
+                continue
+            tkey = resolved.get((fs.path, qname, id(c)))
+            if _is_resource_call(c) or (tkey and rr.get(tkey)):
+                if not rr[(fs.path, qname)]:
+                    rr[(fs.path, qname)] = True
+                    changed = True
+        if not changed:
+            break
+
+    out: list[Finding] = []
+    for fs, qname, fn, c in items:
+        tkey = resolved.get((fs.path, qname, id(c)))
+        if not (_is_resource_call(c) or (tkey and rr.get(tkey))):
+            continue
+        if c.with_item or c.is_returned:
+            continue
+        what = c.leaf if _is_resource_call(c) else c.name
+        if c.binding == "bare":
+            out.append(Finding(
+                rule="PML016", path=fs.path, line=c.line, col=0,
+                message=(
+                    f"{qname}() acquires {what}(...) and discards the "
+                    f"handle — nothing can ever release it; bind it "
+                    f"and close in a finally, or use `with`")))
+        elif c.binding.startswith("local:"):
+            if c.bound_returned or c.bound_escapes \
+                    or c.bound_closed_finally:
+                continue
+            if c.bound_closed:
+                out.append(Finding(
+                    rule="PML016", path=fs.path, line=c.line, col=0,
+                    message=(
+                        f"{qname}() closes its {what}(...) in "
+                        f"straight-line code — a raise between acquire "
+                        f"and close leaks it; move the close into a "
+                        f"finally or use `with`")))
+            else:
+                out.append(Finding(
+                    rule="PML016", path=fs.path, line=c.line, col=0,
+                    message=(
+                        f"{qname}() acquires {what}(...) into a local "
+                        f"and never closes it on any path; close in a "
+                        f"finally, use `with`, or hand it to an owner")))
+        elif c.binding.startswith("self:"):
+            attr = c.binding.split(":", 1)[1]
+            cls_name = qname.split(".", 1)[0] if "." in qname else None
+            cls = fs.classes.get(cls_name) if cls_name else None
+            released = cls is not None and any(
+                attr in m.closes_attrs for m in cls.methods.values())
+            if not released:
+                out.append(Finding(
+                    rule="PML016", path=fs.path, line=c.line, col=0,
+                    message=(
+                        f"{qname}() stores {what}(...) on self.{attr} "
+                        f"but no method of "
+                        f"{cls_name or 'the class'} ever closes it — "
+                        f"add a close()/stop() that releases it")))
+    return out

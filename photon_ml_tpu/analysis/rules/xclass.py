@@ -1,0 +1,134 @@
+"""PML015 — PML005's race detector lifted across class boundaries.
+
+PML005 finds a class's OWN worker seams (``target=self.m``, pool
+submits) and flags unlocked writes reachable from them. The fleet era
+added a topology it cannot see: a bound method handed ACROSS a class
+boundary — ``ReplicaSupervisor(..., on_death=self._on_death)`` — runs
+on the *other* object's monitor thread, so every write it makes back
+into its own object's state is a cross-thread write, with no
+``Thread(...)`` anywhere near the caller's class to tip PML005 off.
+
+The project graph closes the loop: a class summary knows which of its
+constructor parameters are stored and later INVOKED from a method
+reachable from its own worker entrypoints ("worker-invoked callback
+params"). Any ``self.m`` passed into such a parameter makes ``m`` a
+worker entrypoint of the *calling* class, and the PML005 write
+discipline applies to everything reachable from it: writes to state
+shared with caller-side methods must hold the class lock or carry a
+reasoned ``# pml: allow[PML015]``.
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.project import ClassSummary, ProjectGraph
+
+
+def _closure(cls: ClassSummary, roots: set[str]) -> set[str]:
+    seen: set[str] = set()
+    frontier = [r for r in roots if r in cls.methods]
+    while frontier:
+        m = frontier.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        for callee in cls.methods[m].self_calls:
+            if callee not in seen and callee in cls.methods:
+                frontier.append(callee)
+    return seen
+
+
+def _worker_invoked_params(cls: ClassSummary) -> set[str]:
+    """Constructor params stored on self and invoked from a method
+    reachable from the class's own worker entrypoints."""
+    reach = _closure(cls, set(cls.entrypoints))
+    if not reach:
+        return set()
+    param_attr: dict[str, str] = {}
+    for m in cls.methods.values():
+        for p, attr in m.stores_params.items():
+            param_attr[attr] = p
+    invoked: set[str] = set()
+    for mname in reach:
+        invoked |= set(cls.methods[mname].invokes_attrs)
+    return {param_attr[a] for a in invoked if a in param_attr} \
+        & set(cls.init_params)
+
+
+def check_cross_class_locks(graph: ProjectGraph) -> list[Finding]:
+    # Pass 1: which classes invoke which constructor params from
+    # worker context.
+    cb_params: dict[tuple[str, str], set[str]] = {}
+    for fs in graph.files.values():
+        for cname, cls in fs.classes.items():
+            cbs = _worker_invoked_params(cls)
+            if cbs:
+                cb_params[(fs.path, cname)] = cbs
+
+    # Pass 2: find self.m handed into such a parameter; collect cross
+    # entrypoints per calling class.
+    cross: dict[tuple[str, str], dict[str, str]] = {}  # -> {method: seam}
+    for fs in graph.files.values():
+        for qname, fn in fs.functions.items():
+            if "." not in qname:
+                continue
+            caller_cls = qname.split(".", 1)[0]
+            for c in fn.calls:
+                if not c.selfattr_args and not c.selfattr_kwargs:
+                    continue
+                rc = graph.resolve_class(fs, c.name)
+                if rc is None:
+                    continue
+                tfs, tcls = rc
+                cbs = cb_params.get((tfs.path, tcls.name))
+                if not cbs:
+                    continue
+                hooked: list[tuple[str, str]] = []
+                for kw, attr in c.selfattr_kwargs.items():
+                    if kw in cbs:
+                        hooked.append((attr, kw))
+                for pos_s, attr in c.selfattr_args.items():
+                    pos = int(pos_s)
+                    if pos < len(tcls.init_params) \
+                            and tcls.init_params[pos] in cbs:
+                        hooked.append((attr, tcls.init_params[pos]))
+                for attr, param in hooked:
+                    cross.setdefault((fs.path, caller_cls), {})[attr] = \
+                        f"{tcls.name}({param}=...)"
+
+    # Pass 3: PML005's write discipline over the cross entrypoints.
+    out: list[Finding] = []
+    for (path, cname), eps in sorted(cross.items()):
+        fs = graph.files[path]
+        cls = fs.classes.get(cname)
+        if cls is None:
+            continue
+        own_reach = _closure(cls, set(cls.entrypoints))
+        reach = _closure(cls, set(eps))
+        outside = {m for m in cls.methods
+                   if m not in reach and m != "__init__"}
+        shared: set[str] = set()
+        for m in outside:
+            shared |= set(cls.methods[m].touched)
+        locks = set(cls.lock_attrs)
+        for mname in sorted(reach):
+            if mname == "__init__" or mname in own_reach:
+                continue  # own-seam writes are PML005's findings
+            seam_root = next((eps[r] for r in eps
+                              if mname in _closure(cls, {r})), "?")
+            for attr, line, locked in cls.methods[mname].writes:
+                if locked or attr in locks or attr not in shared:
+                    continue
+                why = (f"the class lock ("
+                       f"{', '.join(sorted('self.' + a for a in locks))})"
+                       if locks else
+                       "any lock (the class defines none)")
+                out.append(Finding(
+                    rule="PML015", path=path, line=line, col=0,
+                    message=(
+                        f"{cname}.{mname}() runs on another object's "
+                        f"worker thread (handed across the class "
+                        f"boundary via {seam_root}) and writes "
+                        f"self.{attr} — also used by caller-side "
+                        f"methods — without {why}")))
+    return out

@@ -486,6 +486,7 @@ class GameEstimator:
             bound = (led.bound(grid=grid_index) if led is not None
                      else contextlib.nullcontext())
             with bound:
+                # pml: allow[PML012] grid-search outer loop: each call is an ENTIRE coordinate-descent fit; its per-update materialization (validation, checkpoint) amortizes over minutes of device work
                 model, history = descent.run(
                     self.task, coords,
                     descent.CoordinateDescentConfig(

@@ -170,20 +170,28 @@ def create_fleet(args) -> ServingFleet:
 def run(args) -> None:
     setup_logging()
     fleet = create_fleet(args)
-    fleet.start()
-    server = make_fleet_http_server(fleet, host=args.host,
-                                    port=args.port)
-    host, port = server.server_address[:2]
-    logger.info(
-        "fleet of %d replica(s) x %d shard(s) on http://%s:%d "
-        "(POST /score, GET /metrics, /slo, /healthz); replica logs in %s",
-        fleet.num_replicas, fleet.num_shards, host, port, fleet.workdir)
+    server = None
+    # The finally covers the whole acquire sequence (PML016's shape):
+    # a front-door bind failure (port in use) after fleet.start() must
+    # still tear the replica subprocesses down, or they leak and keep
+    # serving stale shards with no supervisor.
     try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, host=args.host,
+                                        port=args.port)
+        host, port = server.server_address[:2]
+        logger.info(
+            "fleet of %d replica(s) x %d shard(s) on http://%s:%d "
+            "(POST /score, GET /metrics, /slo, /healthz); replica logs "
+            "in %s",
+            fleet.num_replicas, fleet.num_shards, host, port,
+            fleet.workdir)
         server.serve_forever()
     except KeyboardInterrupt:
         logger.info("shutting down fleet")
     finally:
-        server.server_close()
+        if server is not None:
+            server.server_close()
         fleet.close()
 
 

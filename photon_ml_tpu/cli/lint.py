@@ -2,11 +2,20 @@
 
     photon-lint photon_ml_tpu/                 # human output, exit 0/1
     photon-lint --format json photon_ml_tpu/   # machine output
+    photon-lint --catalog                      # string-registry JSON
     photon-lint --write-baseline --reason "…"  # grandfather current findings
 
 Exit codes: 0 clean (baselined findings and stale-entry warnings do not
 gate), 1 findings, 2 usage/internal error. The baseline defaults to
 ``.photon-lint-baseline.json`` in the working directory when present.
+
+Per-file rules (PML001-PML011) run on each file alone; project rules
+(PML012-PML016) run on a repo-wide symbol table + call graph
+(analysis/project.py) whose per-file summaries are cached in
+``.photon-lint-cache.json`` keyed by size/mtime/CRC — a warm repo-wide
+run re-parses only changed files. ``--catalog`` emits the string-keyed
+registries (fault sites, events, metrics, spans) that rule PML014
+resolves call-site literals against.
 
 Deliberately JAX-free: this module (and everything under analysis/) is
 pure stdlib, so the gate runs in seconds anywhere — CI sets it before the
@@ -22,6 +31,7 @@ import sys
 from typing import Optional
 
 from photon_ml_tpu.analysis import (ALL_RULES, DEFAULT_BASELINE,
+                                    DEFAULT_CACHE, PROJECT_RULES,
                                     entries_from_findings, lint_paths,
                                     save_baseline)
 
@@ -30,7 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="photon-lint",
         description="AST lint for this repo's JAX/concurrency/robustness "
-                    "bug classes (PML001-PML008)")
+                    "bug classes (per-file PML001-PML011, whole-program "
+                    "PML012-PML016)")
     p.add_argument("paths", nargs="*", default=["photon_ml_tpu"],
                    help="files/directories to lint "
                         "(default: photon_ml_tpu)")
@@ -45,6 +56,16 @@ def build_parser() -> argparse.ArgumentParser:
                         f"when it exists)")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline file")
+    p.add_argument("--no-project", action="store_true",
+                   help="skip the project graph and rules PML012-016 "
+                        "(fast single-file mode)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="do not read or write the summary cache")
+    p.add_argument("--cache", default=DEFAULT_CACHE,
+                   help=f"summary cache file (default: {DEFAULT_CACHE})")
+    p.add_argument("--catalog", action="store_true",
+                   help="emit the string-keyed registries (fault sites, "
+                        "events, metrics, spans) as JSON and exit 0")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline file "
                         "and exit 0 (requires --reason)")
@@ -60,26 +81,34 @@ def _rule_set(spec: str) -> Optional[set[str]]:
     ids = {s.strip().upper() for s in spec.split(",") if s.strip()}
     if not ids:
         return None
-    unknown = ids - set(ALL_RULES)
+    known = set(ALL_RULES) | set(PROJECT_RULES)
+    unknown = ids - known
     if unknown:
         raise SystemExit(
             f"photon-lint: unknown rule id(s): {', '.join(sorted(unknown))}"
-            f" (known: {', '.join(ALL_RULES)})")
+            f" (known: {', '.join(sorted(known))})")
     return ids
 
 
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rid, (_check, doc) in ALL_RULES.items():
+        for rid, (_check, doc) in {**ALL_RULES, **PROJECT_RULES}.items():
             print(f"{rid}  {doc}")
         return 0
     baseline = None if args.no_baseline else (
         args.baseline or (DEFAULT_BASELINE
                           if os.path.exists(DEFAULT_BASELINE) else None))
+    cache = None if args.no_cache else args.cache
     try:
         select = _rule_set(args.select)
         ignore = _rule_set(args.ignore)
+        if args.catalog:
+            result = lint_paths(args.paths, select=select, ignore=ignore,
+                                baseline_path=None, project=False,
+                                cache_path=cache, want_catalog=True)
+            print(json.dumps(result.catalog, indent=2, sort_keys=True))
+            return 0
         if args.write_baseline:
             if not args.reason.strip():
                 print("photon-lint: --write-baseline requires --reason "
@@ -87,7 +116,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                       file=sys.stderr)
                 return 2
             result = lint_paths(args.paths, select=select, ignore=ignore,
-                                baseline_path=None)
+                                baseline_path=None,
+                                project=not args.no_project,
+                                cache_path=cache)
             target = args.baseline or DEFAULT_BASELINE
             save_baseline(target, entries_from_findings(result.findings,
                                                         args.reason))
@@ -96,7 +127,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                   f"to {target}")
             return 0
         result = lint_paths(args.paths, select=select, ignore=ignore,
-                            baseline_path=baseline)
+                            baseline_path=baseline,
+                            project=not args.no_project,
+                            cache_path=cache)
     except SystemExit:
         raise
     except Exception as exc:
@@ -107,6 +140,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.format == "json":
         print(json.dumps({
             "files": result.files,
+            "graph_files": result.graph_files,
+            "cache": {"hits": result.cache_hits,
+                      "misses": result.cache_misses},
             "findings": [f.to_json() for f in result.findings],
             "baselined": result.baselined,
             "stale_baseline": [e.to_json()
@@ -129,6 +165,10 @@ def main(argv: Optional[list[str]] = None) -> int:
               f"delete it")
     n = len(result.findings)
     bits = [f"{result.files} files", f"{n} finding{'s' * (n != 1)}"]
+    if result.graph_files > result.files:
+        bits.append(f"graph over {result.graph_files}")
+    if result.cache_hits or result.cache_misses:
+        bits.append(f"cache {result.cache_hits}/{result.cache_hits + result.cache_misses} warm")
     if result.baselined:
         bits.append(f"{result.baselined} baselined")
     if result.stale_baseline:

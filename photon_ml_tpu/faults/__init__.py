@@ -5,6 +5,7 @@ Pure stdlib — importable from process-pool workers and the lint-adjacent
 tooling without dragging JAX in.
 """
 
+from photon_ml_tpu.faults import sites
 from photon_ml_tpu.faults.injector import (FaultInjector, FaultPlan,
                                            FaultSpec, InjectedFault,
                                            InjectedIOError,
@@ -15,6 +16,7 @@ from photon_ml_tpu.faults.injector import (FaultInjector, FaultPlan,
                                            poison_scalar)
 
 __all__ = [
+    "sites",
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",

@@ -1,0 +1,64 @@
+"""The fault-site registry: every injection point, as a named constant.
+
+A fault site only exists at the moment a string at a ``fire()`` /
+``poison_scalar()`` / ``corrupt_file()`` call matches a string in a
+``FaultSpec`` — there is no registration step, so a typo on either side
+does not fail, it silently never fires, and the chaos drill that
+"passed" exercised nothing. This module is the fix: production call
+sites import these constants instead of repeating literals, and lint
+rule **PML014** (docs/ANALYSIS.md) checks every dotted site literal in
+the tree — test fault plans included — against this registry.
+``photon-lint --catalog`` emits the same registry as JSON for docs/CI.
+
+Grouped by the subsystem that owns the instrumentation point; the
+failure-ladder semantics of each site live in docs/ROBUSTNESS.md.
+Sites addressed by ``corrupt_file`` keep their own name even when they
+share a code path with a ``fire`` site: the two hooks count occurrences
+independently, and sharing a name would interleave their occurrence
+spaces (the ``stream.checkpoint_write`` / ``stream.checkpoint_artifact``
+lesson, game/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+# -- random-effect staging (game/staging.py, game/staging_cache.py) ----------
+STAGING_PHASE_A = "staging.phase_a"
+STAGING_PHASE_B = "staging.phase_b"
+STAGING_CACHE_SAVE_SHARD = "staging_cache.save_shard"
+STAGING_CACHE_LOAD_SHARD = "staging_cache.load_shard"
+STAGING_CACHE_SHARD_FILE = "staging_cache.shard_file"  # corrupt_file
+
+# -- descent checkpoints (game/checkpoint.py) --------------------------------
+CHECKPOINT_SAVE = "checkpoint.save"
+CHECKPOINT_LOAD = "checkpoint.load"
+CHECKPOINT_ARTIFACT = "checkpoint.artifact"  # corrupt_file
+
+# -- streamed fixed-effect path (ops/streaming_sparse.py, optim/streaming.py,
+#    game/checkpoint.py StreamingStateStore) ---------------------------------
+STREAM_CHUNK_TRANSFER = "stream.chunk_transfer"
+STREAM_OBJECTIVE = "stream.objective"  # poison_scalar (nan kind)
+STREAM_CHECKPOINT_WRITE = "stream.checkpoint_write"
+STREAM_CHECKPOINT_LOAD = "stream.checkpoint_load"
+STREAM_CHECKPOINT_ARTIFACT = "stream.checkpoint_artifact"  # corrupt_file
+
+# -- Avro ingestion (ingest/pipeline.py, ingest/cache.py) --------------------
+INGEST_DECODE_BLOCK = "ingest.decode_block"
+INGEST_CACHE_WRITE = "ingest.cache_write"
+INGEST_CACHE_FILE = "ingest.cache_file"  # corrupt_file
+
+# -- single-process serving (serving/service.py, serving/model_store.py) -----
+SERVING_FLUSH = "serving.flush"
+SERVING_FETCH = "serving.fetch"
+
+# -- replicated fleet (serving/router.py, serving/supervisor.py,
+#    serving/service.py) -----------------------------------------------------
+FLEET_ROUTE = "fleet.route"
+FLEET_PROBE = "fleet.probe"
+FLEET_REPLICA_FLUSH = "fleet.replica_flush"
+
+# Every registered site. Computed from the module's own constants so the
+# registry cannot drift from itself; PML014 reads the CONSTANTS above
+# via AST (this comprehension never runs under the linter).
+ALL_SITES = frozenset(
+    v for k, v in dict(globals()).items()
+    if not k.startswith("_") and isinstance(v, str) and k.isupper())

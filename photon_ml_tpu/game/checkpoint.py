@@ -72,6 +72,7 @@ from photon_ml_tpu import obs
 from photon_ml_tpu.game.models import CoordinateModel, GameModel
 from photon_ml_tpu.game.staging_cache import file_crc32
 from photon_ml_tpu.models import io as model_io
+from photon_ml_tpu.utils.diskio import atomic_write
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils import events as ev_mod
 
@@ -153,7 +154,7 @@ class CheckpointManager:
         corruption shape the CRC must catch later)."""
         path = self._abs(rel)
         self._crcs[rel] = file_crc32(path)
-        flt.corrupt_file("checkpoint.artifact", path)
+        flt.corrupt_file(flt.sites.CHECKPOINT_ARTIFACT, path)
 
     # -- write -------------------------------------------------------------
 
@@ -194,7 +195,7 @@ class CheckpointManager:
 
     def _write(self, task, models, *, done_steps, records, complete,
                fingerprint, updated, residual_total) -> None:
-        flt.fire("checkpoint.save")
+        flt.fire(flt.sites.CHECKPOINT_SAVE)
         model_dir = os.path.join(self.directory, _MODEL)
         os.makedirs(model_dir, exist_ok=True)
         write_set = (set(models)
@@ -223,10 +224,8 @@ class CheckpointManager:
         res_path = os.path.join(self.directory, _RESIDUALS)
         self._preserve(_RESIDUALS)
         if residual_total is not None:
-            tmp = res_path + ".tmp"
-            with open(tmp, "wb") as f:
-                np.savez(f, total=np.asarray(residual_total))
-            os.replace(tmp, res_path)
+            atomic_write(res_path, lambda f: np.savez(
+                f, total=np.asarray(residual_total)))
             self._commit_file(_RESIDUALS)
         else:
             if os.path.exists(res_path):
@@ -235,16 +234,15 @@ class CheckpointManager:
         # Commit point: state.json last, atomically — carrying the CRC of
         # every artifact this generation consists of.
         self._preserve(_STATE)
-        tmp = os.path.join(self.directory, _STATE + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump({
-                "done_steps": done_steps,
-                "records": records,
-                "complete": complete,
-                "fingerprint": fingerprint,
-                "artifacts": self._crcs,
-            }, f, indent=2)
-        os.replace(tmp, os.path.join(self.directory, _STATE))
+        state_body = json.dumps({
+            "done_steps": done_steps,
+            "records": records,
+            "complete": complete,
+            "fingerprint": fingerprint,
+            "artifacts": self._crcs,
+        }, indent=2)
+        atomic_write(os.path.join(self.directory, _STATE),
+                     lambda f: f.write(state_body.encode()))
         self._full_snapshot_written = True
         logger.info("checkpoint committed: %d step(s) -> %s", done_steps,
                     self.directory)
@@ -321,7 +319,7 @@ class CheckpointManager:
         announced with a ``CheckpointRecovered`` event; if that
         generation is unusable too, returns None (train from scratch).
         """
-        flt.fire("checkpoint.load")
+        flt.fire(flt.sites.CHECKPOINT_LOAD)
         state_path = os.path.join(self.directory, _STATE)
         if not os.path.exists(state_path) \
                 and not os.path.exists(state_path + _PREV):
@@ -459,7 +457,7 @@ class StreamingStateStore:
         with obs.span("checkpoint.stream_state", cat="checkpoint",
                       iteration=int(state["it"])):
             os.makedirs(self.directory, exist_ok=True)
-            flt.fire("stream.checkpoint_write")
+            flt.fire(flt.sites.STREAM_CHECKPOINT_WRITE)
             path = os.path.join(self.directory, _STREAM_STATE)
             _preserve_file(path)
             arrays = {k: np.asarray(v) for k, v in state.items()}
@@ -471,7 +469,7 @@ class StreamingStateStore:
             # occurrences, so sharing a name would interleave the two
             # hooks' occurrence spaces.
             crc = file_crc32(path)
-            flt.corrupt_file("stream.checkpoint_artifact", path)
+            flt.corrupt_file(flt.sites.STREAM_CHECKPOINT_ARTIFACT, path)
             meta_path = os.path.join(self.directory, _STREAM_META)
             _preserve_file(meta_path)
             atomic_write(meta_path, lambda f: f.write(json.dumps({
@@ -523,7 +521,7 @@ class StreamingStateStore:
         """The newest committed snapshot, or None (absent, corrupt in
         both generations, or written under a different fingerprint —
         the step then re-optimizes from its warm start)."""
-        flt.fire("stream.checkpoint_load")
+        flt.fire(flt.sites.STREAM_CHECKPOINT_LOAD)
         meta_path = os.path.join(self.directory, _STREAM_META)
         meta = self._read_meta(meta_path)
         state = self._load_generation(meta)

@@ -289,7 +289,7 @@ def _phase_a(task: ShardTask, d: int, intercept_index: Optional[int],
              ratio: Optional[float]):
     """Unique active (lane, col) pairs of one shard + the lane-count max
     that feeds the bucket's d_active reduce."""
-    flt.fire("staging.phase_a", index=task.index)
+    flt.fire(flt.sites.STAGING_PHASE_A, index=task.index)
     live = np.flatnonzero(np.asarray(task.entity_rows) >= 0).astype(
         np.int64)
     u_lane, u_col = prj.active_pairs(
@@ -305,7 +305,7 @@ def _phase_b(task: ShardTask, cols: np.ndarray, d_active: int,
              ctx: Optional[dict] = None):
     """One shard's staged tuple, laid out exactly as the serial
     coordinate staging: (Xb, yb, wb, ex, rows[, cols][, f_p][, s_p])."""
-    flt.fire("staging.phase_b", index=task.index)
+    flt.fire(flt.sites.STAGING_PHASE_B, index=task.index)
     if ctx is None:
         ctx = pools.worker_ctx()
     sub = bkt.EntityBucket(entity_rows=task.entity_rows,

@@ -78,7 +78,7 @@ def save_shard(cache_dir: str, key: str, index: int,
                arrays: tuple[np.ndarray, ...]) -> None:
     """Persist one staged shard; the ``.ok`` marker (carrying each
     array file's CRC32) commits it last."""
-    flt.fire("staging_cache.save_shard", index=index)
+    flt.fire(flt.sites.STAGING_CACHE_SAVE_SHARD, index=index)
     path = os.path.join(cache_dir, key)
     os.makedirs(path, exist_ok=True)
     crcs = []
@@ -90,7 +90,7 @@ def save_shard(cache_dir: str, key: str, index: int,
         crcs.append(file_crc32(fpath))
         # Injected bit rot lands AFTER the checksum was taken over the
         # good bytes — the torn-page/bit-rot shape CRC must catch.
-        flt.corrupt_file("staging_cache.shard_file", fpath, index=index)
+        flt.corrupt_file(flt.sites.STAGING_CACHE_SHARD_FILE, fpath, index=index)
     marker = json.dumps({"arity": len(arrays), "crc": crcs,
                          "version": STAGING_VERSION}).encode()
     _atomic_write(os.path.join(path, f"s{index}.ok"),
@@ -105,7 +105,7 @@ def load_shard(cache_dir: str, key: str, index: int
     marker (silent corruption)."""
     path = os.path.join(cache_dir, key)
     try:
-        flt.fire("staging_cache.load_shard", index=index)
+        flt.fire(flt.sites.STAGING_CACHE_LOAD_SHARD, index=index)
         with open(os.path.join(path, f"s{index}.ok")) as f:
             marker = json.load(f)
         if marker.get("version") != STAGING_VERSION:

@@ -164,7 +164,7 @@ def save_chunk(cache_dir: str, key: str, index: int,
                d: DecodedFile) -> None:
     """Persist one decoded chunk as a single aligned blob; the ``.ok``
     marker (column directory + blob CRC32) commits it last."""
-    flt.fire("ingest.cache_write", index=index)
+    flt.fire(flt.sites.INGEST_CACHE_WRITE, index=index)
     path = os.path.join(cache_dir, key)
     os.makedirs(path, exist_ok=True)
     arrs = _chunk_arrays(d)
@@ -186,7 +186,7 @@ def save_chunk(cache_dir: str, key: str, index: int,
     crc = file_crc32(fpath)
     # Injected bit rot lands AFTER the checksum was taken over the good
     # bytes — the shape a CRC verification must catch.
-    flt.corrupt_file("ingest.cache_file", fpath, index=index)
+    flt.corrupt_file(flt.sites.INGEST_CACHE_FILE, fpath, index=index)
     marker = json.dumps({"version": INGEST_CACHE_VERSION,
                          "cols": cols, "crc": crc, "nbytes": pos,
                          "records": int(d.num_records),

@@ -100,7 +100,7 @@ def _decode_chunk_task(spec: ChunkSpec, plan: np.ndarray, n_bags: int,
     process pool can pickle it; in thread mode it runs in the driver
     process, so the ``ingest.cache_write`` fault site fires there (the
     chaos suite's driver-kill drill)."""
-    flt.fire("ingest.decode_block", index=spec.index)
+    flt.fire(flt.sites.INGEST_DECODE_BLOCK, index=spec.index)
     d = nd.decode_span(spec.path, spec.header_len, spec.start, spec.end,
                        plan, n_bags)
     if cache_dir and key:

@@ -25,6 +25,7 @@ from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.diskio import atomic_write
 
 _METADATA = "metadata.json"
 
@@ -89,9 +90,8 @@ def save_coordinate(path: str, cid: str, m) -> dict:
         cid)
     os.makedirs(sub, exist_ok=True)
     payload = coordinate_arrays(m)
-    tmp = os.path.join(sub, "coefficients.tmp.npz")
-    np.savez(tmp, **payload)
-    os.replace(tmp, os.path.join(sub, "coefficients.npz"))
+    atomic_write(os.path.join(sub, "coefficients.npz"),
+                 lambda f: np.savez(f, **payload))
     return meta
 
 
@@ -118,10 +118,9 @@ def write_metadata(path: str, task: TaskType,
                    coordinates_meta: dict[str, dict]) -> None:
     """Atomically write a GameModel directory's metadata.json."""
     meta = {"task": TaskType(task).value, "coordinates": coordinates_meta}
-    tmp = os.path.join(path, _METADATA + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f, indent=2, sort_keys=True)
-    os.replace(tmp, os.path.join(path, _METADATA))
+    body = json.dumps(meta, indent=2, sort_keys=True)
+    atomic_write(os.path.join(path, _METADATA),
+                 lambda f: f.write(body.encode()))
 
 
 def save_game_model(model: GameModel, path: str) -> None:
@@ -192,11 +191,12 @@ def save_glm(model: GeneralizedLinearModel, path: str) -> None:
     payload = {"means": np.asarray(model.coefficients.means)}
     if model.coefficients.variances is not None:
         payload["variances"] = np.asarray(model.coefficients.variances)
-    np.savez(path if path.endswith(".npz") else path + ".npz", **payload)
+    atomic_write(path if path.endswith(".npz") else path + ".npz",
+                 lambda f: np.savez(f, **payload))
     meta_path = (path[:-4] if path.endswith(".npz") else path) + ".json"
-    with open(meta_path, "w") as f:
-        json.dump({"task": TaskType(model.task).value,
-                   "dim": int(model.coefficients.dim)}, f)
+    meta_body = json.dumps({"task": TaskType(model.task).value,
+                            "dim": int(model.coefficients.dim)})
+    atomic_write(meta_path, lambda f: f.write(meta_body.encode()))
 
 
 def load_glm(path: str) -> GeneralizedLinearModel:

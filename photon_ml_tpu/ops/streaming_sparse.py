@@ -467,7 +467,7 @@ def _transfer(ch: CanonicalChunk, index: int,
     """
     for attempt in range(TRANSFER_MAX_RETRIES + 1):
         try:
-            flt.fire("stream.chunk_transfer", index=index)
+            flt.fire(flt.sites.STREAM_CHUNK_TRANSFER, index=index)
             mx, tr = obs.metrics(), obs.tracer()
             if mx is None and tr is None:
                 return (jax.device_put(ch, device) if device is not None

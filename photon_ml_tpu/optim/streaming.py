@@ -233,7 +233,7 @@ def minimize_streaming(
                 # Watchdog chaos seam (docs/ROBUSTNESS.md): a "nan"
                 # fault spec here is the injected form of a numerically
                 # sick objective.
-                f_try_h = flt.poison_scalar("stream.objective", f_try_h)
+                f_try_h = flt.poison_scalar(flt.sites.STREAM_OBJECTIVE, f_try_h)
                 if np.isfinite(f_try_h) and \
                         f_try_h <= fv + config.wolfe_c1 * step * dg:
                     accepted = True

@@ -317,8 +317,9 @@ class ServingFleet:
         confirmed healthy."""
         t0 = time.monotonic()
         self.metrics.record_death()
+        # pml: allow[PML015] single-writer publish: only the monitor thread flips these bools; /healthz readers tolerate staleness by design
         self._degraded = True
-        self._rehoming = True
+        self._rehoming = True  # pml: allow[PML015] same single-writer monitor-thread publish as above
         self.emitter.emit(ReplicaDied(replica_id=replica_id,
                                       reason="declared dead by probe"))
         try:
@@ -327,7 +328,7 @@ class ServingFleet:
             logger.error("replica %d died and no survivor remains — "
                          "the fleet is down until a restart succeeds",
                          replica_id)
-            self._rehoming = False
+            self._rehoming = False  # pml: allow[PML015] single-writer monitor-thread publish; readers poll
             return
         # Confirm each new owner actually serves before declaring the
         # re-home done — a table swap to another corpse is not recovery.
@@ -341,7 +342,7 @@ class ServingFleet:
                 logger.warning("re-home target %d not yet healthy "
                                "(%s) — the monitor will handle it", rid, e)
         seconds = time.monotonic() - t0
-        self._rehoming = False
+        self._rehoming = False  # pml: allow[PML015] single-writer monitor-thread publish; readers poll
         self.metrics.record_rehome(seconds, self.rehome_deadline_s)
         self.emitter.emit(ShardRehomed(
             replica_id=replica_id, shards=tuple(sorted(moved)),
@@ -360,7 +361,7 @@ class ServingFleet:
             replica_id=replica_id, shards_restored=tuple(back)))
         states = self.supervisor.states()
         if all(st == UP for st in states.values()):
-            self._degraded = False
+            self._degraded = False  # pml: allow[PML015] single-writer monitor-thread publish; healthz re-derives from supervisor states anyway
         logger.info("replica %d recovered; %d shard(s) back home; "
                     "fleet %s", replica_id, len(back),
                     "healthy" if not self._degraded else "still degraded")

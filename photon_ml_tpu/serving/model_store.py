@@ -130,7 +130,7 @@ def _fetch_with_retry(store: "HashShardedStore", ids: np.ndarray,
     attempt = 0
     while True:
         try:
-            flt.fire("serving.fetch")
+            flt.fire(flt.sites.SERVING_FETCH)
             return store.fetch(ids)
         except OSError as e:
             attempt += 1

@@ -272,7 +272,7 @@ class FleetRouter:
         # Injection seam for the network edge: `delay` = slow link (what
         # hedging exists for), `partition` = dropped traffic to this
         # replica (drop-by-site: indices=[replica_id]).
-        flt.fire("fleet.route", index=replica_id)
+        flt.fire(flt.sites.FLEET_ROUTE, index=replica_id)
         host, port = self._endpoint(replica_id)
         req = urllib.request.Request(
             f"http://{host}:{port}/score", data=body,

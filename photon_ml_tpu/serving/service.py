@@ -283,8 +283,8 @@ class ScoringService:
             # `replica_kill` spec can SIGKILL exactly one replica of a
             # fleet mid-flush (indices=[id], occurrences=[k]).
             if self.replica_id is not None:
-                flt.fire("fleet.replica_flush", index=self.replica_id)
-            flt.fire("serving.flush")
+                flt.fire(flt.sites.FLEET_REPLICA_FLUSH, index=self.replica_id)
+            flt.fire(flt.sites.SERVING_FLUSH)
             scores, marks = self._score_chunk(
                 [e.request for e in entries])
         except Exception:

@@ -232,7 +232,7 @@ class ReplicaSupervisor:
             # monitor losing sight of a replica (probes dropped while
             # the replica itself is fine) — the false-positive death
             # the heartbeat deadline turns into a defined re-home.
-            flt.fire("fleet.probe", index=handle.replica_id)
+            flt.fire(flt.sites.FLEET_PROBE, index=handle.replica_id)
             _probe_healthz(handle.base_url(), self.probe_timeout_s)
             return True
         except (OSError, ValueError):

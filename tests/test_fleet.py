@@ -284,18 +284,26 @@ def fleet_env(tmp_path_factory):
         probe_interval_s=0.1, heartbeat_deadline_s=1.0,
         rehome_deadline_s=5.0, hedge_after_s=0.2,
         retry_backoff_s=0.1, retries=3)
-    fleet.start()
-    server = make_fleet_http_server(fleet, port=0)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    url = f"http://127.0.0.1:{server.server_address[1]}"
-    objs = _request_objs(12)
-    env = {"fleet": fleet, "url": url, "model": model, "objs": objs,
-           "model_dir": model_dir,
-           "expected": _oracle_scores(model, objs)}
-    yield env
-    server.shutdown()
-    server.server_close()
-    fleet.close()
+    server = None
+    # finally-guarded teardown (PML016): a bind failure after
+    # fleet.start(), or a test body raising, must still reap the
+    # replica subprocesses.
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        objs = _request_objs(12)
+        env = {"fleet": fleet, "url": url, "model": model, "objs": objs,
+               "model_dir": model_dir,
+               "expected": _oracle_scores(model, objs)}
+        yield env
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
 
 
 def test_fleet_parity_bit_identical_and_affinity(fleet_env):
