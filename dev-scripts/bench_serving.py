@@ -101,6 +101,23 @@ def build_parser():
                    help="target-QPS levels of the fleet sweep (smaller "
                         "than the single-process sweep: every request "
                         "crosses one more HTTP hop)")
+    # -- publish arm (docs/SERVING.md "Continuous publication") --------------
+    p.add_argument("--publish", action="store_true",
+                   help="measure a live delta publish: open-loop "
+                        "constant-QPS traffic with a refit→delta→"
+                        "hot-swap landing mid-stream; reports "
+                        "publish_swap_seconds, p99 inside the swap "
+                        "window vs steady state, and unserved counts "
+                        "(must be zero — the zero-drop contract)")
+    p.add_argument("--publish-qps", type=float, default=150.0)
+    p.add_argument("--publish-seconds", type=float, default=4.0,
+                   help="open-loop dispatch duration; the swap lands at "
+                        "the half-way mark")
+    p.add_argument("--publish-dirty-entities", type=int, default=48,
+                   help="entities refit into the published delta (the "
+                        "hottest ones — their rows are device-cached, "
+                        "so the swap exercises LRU invalidation)")
+    p.add_argument("--publish-tuples-per-entity", type=int, default=4)
     return p
 
 
@@ -433,6 +450,174 @@ def run_closed_loop(args, service, make_request, load_seconds):
     return out
 
 
+# -- publish arm (continuous publication under load) -------------------------
+
+
+def run_publish(args):
+    """One open-loop constant-QPS stream with a refit→delta→hot-swap
+    landing at the half-way mark: the bench form of the zero-drop
+    contract. Gated lines (check_bench_regression.py): the swap wall is
+    bounded, p99 inside the swap window stays within band of steady
+    state, and NOT ONE request goes unserved."""
+    import tempfile
+
+    from photon_ml_tpu.game.refit import RefitBatch, refit_rows
+    from photon_ml_tpu.serving import (BatcherQueueFull,
+                                       DeadlineExceeded, DeltaStore,
+                                       ScoringService)
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    model = build_model(args)
+    t_load0 = time.perf_counter()
+    service = ScoringService(
+        model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        cache_entities=args.cache_entities)
+    load_seconds = time.perf_counter() - t_load0
+    make_request = make_request_factory(args)
+    warmup(service, make_request, args)
+    compiles_after_warmup = service.metrics.snapshot()["compiles_total"]
+
+    # Cut the delta through the real path: logged tuples for the
+    # hottest entities (device-cached under Zipf — the swap must
+    # invalidate live slots), per-entity refit, versioned artifact.
+    rng = np.random.default_rng(args.seed + 17)
+    k = min(args.publish_dirty_entities, args.num_entities)
+    per = max(1, args.publish_tuples_per_entity)
+    ids = np.repeat(np.arange(k, dtype=np.int64), per)
+    n = ids.shape[0]
+    batch = RefitBatch(
+        "userId", "re_userId", ids,
+        rng.normal(size=(n, args.d_re)).astype(np.float32),
+        (rng.random(n) < 0.5).astype(np.float32),
+        (rng.normal(size=n) * 0.3).astype(np.float32))
+    t_refit0 = time.perf_counter()
+    dirty, rows, refit_stats = refit_rows(model, "per-user", batch)
+    refit_seconds = time.perf_counter() - t_refit0
+    store = DeltaStore(tempfile.mkdtemp(prefix="photon-publish-bench-"))
+    delta = store.write({"per-user": (dirty, rows)})
+
+    qps = args.publish_qps
+    total = max(1, int(round(qps * args.publish_seconds)))
+    period = 1.0 / qps
+    reqs = [make_request(rng) for _ in range(total)]
+    swap = {"t0": None, "t1": None}
+
+    def _swap():
+        swap["t0"] = time.perf_counter()
+        service.apply_delta(store.read(delta.version))
+        swap["t1"] = time.perf_counter()
+
+    timer = threading.Timer(args.publish_seconds / 2.0, _swap)
+    lock = threading.Lock()
+    records = []  # (t_sched, latency_s | None, kind)
+    drained = threading.Event()
+    state = {"dispatched": 0, "completed": 0, "done": False}
+
+    def _cb(t_sched):
+        def _inner(fut):
+            t_end = time.perf_counter()
+            exc = fut.exception()
+            with lock:
+                state["completed"] += 1
+                if exc is None:
+                    records.append((t_sched, t_end - t_sched, "ok"))
+                elif isinstance(exc, DeadlineExceeded):
+                    records.append((t_sched, None, "deadline"))
+                else:
+                    records.append((t_sched, None, "error"))
+                if state["done"] and \
+                        state["completed"] == state["dispatched"]:
+                    drained.set()
+        return _inner
+
+    shed = 0
+    timer.start()
+    t0 = time.perf_counter()
+    try:
+        for i, req in enumerate(reqs):
+            t_sched = t0 + i * period
+            delay = t_sched - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                fut = service.submit(req)
+            except BatcherQueueFull:
+                with lock:
+                    records.append((t_sched, None, "shed"))
+                shed += 1
+                continue
+            with lock:
+                state["dispatched"] += 1
+            fut.add_done_callback(_cb(t_sched))
+        with lock:
+            state["done"] = True
+            if state["completed"] == state["dispatched"]:
+                drained.set()
+        drained.wait(timeout=args.drain_timeout_s)
+        timer.join()
+    finally:
+        timer.cancel()
+        snap = service.metrics.snapshot()
+        service.close()
+    if swap["t1"] is None:
+        raise RuntimeError("the swap never ran — raise "
+                           "--publish-seconds")
+    swap_seconds = swap["t1"] - swap["t0"]
+    # The swap window, padded a batcher flush either side: requests
+    # scheduled here felt the swap (if anything did).
+    pad = max(0.05, 4 * args.max_wait_ms / 1e3)
+    w0, w1 = swap["t0"] - pad, swap["t1"] + pad
+    lat_in = [l for t, l, kind in records
+              if kind == "ok" and w0 <= t <= w1]
+    lat_out = [l for t, l, kind in records
+               if kind == "ok" and not w0 <= t <= w1]
+    unserved = sum(1 for _, _, kind in records
+                   if kind in ("deadline", "error"))
+
+    def _p99(xs):
+        return (round(float(np.percentile(np.asarray(xs) * 1e3, 99)), 4)
+                if xs else None)
+
+    out = {
+        "metric": "publish_swap_seconds",
+        "value": round(swap_seconds, 6),
+        "unit": "s",
+        "secondary": {
+            "publish_qps": qps,
+            "publish_requests_offered": total,
+            "publish_ok": len(lat_in) + len(lat_out),
+            "publish_shed": shed,
+            "publish_unserved": unserved,
+            "publish_rows_swapped": int(delta.num_rows),
+            "publish_dirty_entities": int(dirty.shape[0]),
+            "publish_refit_seconds": round(refit_seconds, 4),
+            "publish_refit_groups": refit_stats["groups"],
+            "publish_applied_version": snap["model_version"],
+            "publish_invalidated_slots_possible": int(k),
+            "publish_swap_window_s": round(w1 - w0, 4),
+            "publish_requests_in_swap_window": len(lat_in),
+            "publish_p99_steady_ms": _p99(lat_out),
+            "publish_p99_swap_window_ms": _p99(lat_in),
+            "publish_p50_steady_ms": (round(float(np.percentile(
+                np.asarray(lat_out) * 1e3, 50)), 4) if lat_out
+                else None),
+            # A swap must never recompile: the score program is a
+            # function of the cache TABLES, not the rows in them.
+            "publish_sweep_recompiles":
+                snap["compiles_total"] - compiles_after_warmup,
+            "model_load_seconds": round(load_seconds, 3),
+            "config": f"E={args.num_entities} d_re={args.d_re} "
+                      f"skew={args.entity_skew} publish open-loop",
+        },
+    }
+    if unserved:
+        print(f"WARNING: {unserved} request(s) went unserved across "
+              f"the publish — the zero-drop contract is broken",
+              file=sys.stderr)
+    return out
+
+
 # -- fleet chaos sweep -------------------------------------------------------
 
 
@@ -736,6 +921,11 @@ def run_fleet(args, load_seconds_unused=None):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.publish:
+        out = run_publish(args)
+        json.dump(out, sys.stdout)
+        print()
+        return 0
     if args.fleet:
         out = run_fleet(args)
         json.dump(out, sys.stdout)

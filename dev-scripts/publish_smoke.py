@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Publish smoke (run_tier1.sh): a tiny fleet runs one full
+continuous-publication cycle — refit → versioned delta → canary →
+fleet-wide hot-swap — plus the rejection leg. Seconds on CPU; catches
+a broken publication ladder before it reaches a real deployment
+(docs/SERVING.md "Continuous publication").
+
+Asserts the whole ladder end to end through the REAL paths (subprocess
+replicas, delta artifacts on disk, the POST /publish front door):
+
+1. incremental refit from logged tuples cuts a committed delta whose
+   rows are finite and validated;
+2. publishing it through the canary ladder flips BOTH replicas to the
+   new version, and served scores afterwards are BIT-identical to a
+   cold single-process service on the updated model (zero-drop
+   hot-swap parity);
+3. a finite-but-insane delta is REJECTED at the canary probe and
+   auto-rolled back: no replica serves it, scores keep the published
+   version's bits, and the RollbackExecuted event fires;
+4. the publish ledger holds the ladder's rows (canary verdicts,
+   rollback, published) and `photon-obs tail --publish` renders them;
+5. photon_publish_* metrics moved on the fleet scoreboard.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _post(url, path, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.game.refit import RefitBatch, refit_rows
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving import (DeltaStore, ScoringRequest,
+                                       ScoringService)
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import events as ev
+
+    rng = np.random.default_rng(7)
+    E, dg, dr = 32, 6, 4
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, dr)).astype(np.float32)
+                        * 0.1)),
+    })
+    td = tempfile.mkdtemp(prefix="pml_publish_smoke_")
+    model_dir = os.path.join(td, "model")
+    model_io.save_game_model(model, model_dir)
+    publish_dir = os.path.join(td, "publish")
+
+    # -- 1. refit from logged tuples → committed delta -------------------
+    ids = np.repeat(np.arange(8), 4).astype(np.int64)
+    n = ids.shape[0]
+    batch = RefitBatch(
+        "userId", "re_userId", ids,
+        rng.normal(size=(n, dr)).astype(np.float32),
+        (rng.random(n) < 0.5).astype(np.float32),
+        (rng.normal(size=n) * 0.3).astype(np.float32))
+    dirty, rows, stats = refit_rows(model, "per-user", batch)
+    assert np.all(np.isfinite(rows)), "refit produced non-finite rows"
+    store = DeltaStore(publish_dir)
+    delta = store.write({"per-user": (dirty, rows)})
+    assert store.versions() == [1]
+    print(f"[publish-smoke] delta v{delta.version}: "
+          f"{delta.num_rows} row(s) from {stats['groups']} refit "
+          f"group(s)")
+
+    events = []
+    ev.default_emitter.register(events.append)
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir, "--max-wait-ms", "0.5"],
+        num_replicas=2, workdir=os.path.join(td, "work"),
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        publish_dir=publish_dir, publish_bake_s=0.2)
+    server = None
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+
+        objs = []
+        req_rng = np.random.default_rng(11)
+        for i in range(8):
+            objs.append({
+                "features": {
+                    "global": req_rng.normal(size=dg).astype(
+                        np.float32).tolist(),
+                    "re_userId": req_rng.normal(size=dr).astype(
+                        np.float32).tolist()},
+                "entity_ids": {"userId": int(i % E)}, "uid": i})
+
+        def fleet_scores():
+            return np.asarray(
+                [_post(url, "/score",
+                       {"requests": [o]})["scores"][0]
+                 for o in objs], np.float32)
+
+        def oracle(m):
+            svc = ScoringService(m, max_wait_ms=0.5)
+            try:
+                return np.asarray(
+                    [float(svc.submit(ScoringRequest(
+                        features={k: np.asarray(v, np.float32)
+                                  for k, v in o["features"].items()},
+                        entity_ids=o["entity_ids"])).result(timeout=60))
+                     for o in objs], np.float32)
+            finally:
+                svc.close()
+
+        # -- 2. canary → fleet-wide swap, cold-restart parity -----------
+        out = _post(url, "/publish",
+                    {"path": store.delta_dir(delta.version),
+                     "bake_s": 0.2,
+                     "probe": {"requests": objs,
+                               "max_abs_score": 1e3}})
+        assert out["version"] == 1 and sorted(out["replicas"]) == [0, 1]
+        means = np.array(np.asarray(model.models["per-user"].means),
+                         copy=True)
+        means[dirty] = rows
+        updated = dc.replace(model, models={
+            **model.models,
+            "per-user": dc.replace(model.models["per-user"],
+                                   means=jnp.asarray(means))})
+        got = fleet_scores()
+        want = oracle(updated)
+        np.testing.assert_array_equal(got, want)
+        print(f"[publish-smoke] v1 live on both replicas in "
+              f"{out['swap_seconds']:.3f}s; {len(objs)}/{len(objs)} "
+              f"scores bit-identical to a cold restart on the new "
+              f"model")
+
+        # -- 3. insane delta rejected at the canary + rolled back -------
+        from photon_ml_tpu.serving import CanaryRejected
+
+        bad = store.write({"per-user": (
+            np.arange(E, dtype=np.int64),
+            np.full((E, dr), 1e6, np.float32))})
+        try:
+            fleet.publish_delta(store.delta_dir(bad.version),
+                                probe_objs=objs, probe_max_abs=1e3)
+        except CanaryRejected as e:
+            print(f"[publish-smoke] insane delta rejected: {e.reason}")
+        else:
+            raise AssertionError("insane delta was NOT rejected")
+        store.retract(bad.version)
+        np.testing.assert_array_equal(fleet_scores(), want)
+        assert any(isinstance(e, ev.RollbackExecuted) for e in events)
+        for rid in (0, 1):
+            hz = fleet._replica_get_json(rid, "/healthz")
+            assert hz["model_version"] == 1, hz
+
+        # -- 5. metrics moved -------------------------------------------
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=10.0) as resp:
+            text = resp.read().decode()
+        for needle in ("photon_publish_model_version 1",
+                       "photon_publish_deltas_total 1",
+                       "photon_publish_canary_rejects_total 1",
+                       "photon_publish_rollbacks_total 1"):
+            assert needle in text, f"missing metric line: {needle}"
+    finally:
+        ev.default_emitter.unregister(events.append)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
+
+    # -- 4. the ledger renders through photon-obs tail --publish ---------
+    from photon_ml_tpu.cli.obs import render_publish_tail, tail_ledger
+
+    tail = tail_ledger(os.path.join(publish_dir, "ledger"))
+    pub = tail.get("publish") or {}
+    assert pub.get("current_version") == 1, pub
+    assert pub.get("rollbacks"), pub
+    rendered = render_publish_tail(tail)
+    assert "REJECTED" in rendered and "published" in rendered
+    print("[publish-smoke] OK: refit->delta->canary->swap, rejection "
+          "rolled back, ledger renders, metrics moved")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

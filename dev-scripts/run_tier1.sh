@@ -61,6 +61,14 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/ledger_smoke.py; rc=$?
 fi
 
+# Publish smoke (docs/SERVING.md "Continuous publication"): a 2-replica
+# fleet runs one refit->delta->canary->hot-swap cycle with cold-restart
+# score parity, plus a rejected delta auto-rolled back; the publish
+# ledger renders and photon_publish_* counters move. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/publish_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

@@ -108,6 +108,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-window-s", type=float, default=60.0)
     p.add_argument("--slo-availability", type=float, default=0.999)
     p.add_argument("--slo-latency-ms", type=float, default=None)
+    # -- continuous publication (docs/SERVING.md) ------------------------
+    p.add_argument("--publish-dir", default=None,
+                   help="publish-ledger home: POST /publish canary "
+                        "ladders record their rows here (photon-obs "
+                        "tail --publish renders them)")
+    p.add_argument("--publish-bake-s", type=float, default=0.5,
+                   help="default canary bake window of POST /publish")
+    p.add_argument("--publish-burn-threshold", type=float, default=1.0,
+                   help="default max canary error-budget burn rate "
+                        "before auto-rollback")
     return p
 
 
@@ -164,7 +174,10 @@ def create_fleet(args) -> ServingFleet:
         fault_plan_file=args.fault_plan,
         slo_window_s=args.slo_window_s,
         slo_availability=args.slo_availability,
-        slo_latency_ms=args.slo_latency_ms)
+        slo_latency_ms=args.slo_latency_ms,
+        publish_dir=args.publish_dir,
+        publish_bake_s=args.publish_bake_s,
+        publish_burn_threshold=args.publish_burn_threshold)
 
 
 def run(args) -> None:

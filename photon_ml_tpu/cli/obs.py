@@ -324,6 +324,42 @@ def _find_max_iterations(node, coordinate: Optional[str]) -> Optional[int]:
     return None
 
 
+def publish_summary(rows: list[dict]) -> dict:
+    """The publication view of a ledger's ``publish`` rows (the
+    serving/publish.py ladder records one per phase): delta versions,
+    canary verdicts, rollbacks — what ``tail --publish`` renders."""
+    pubs = [r for r in rows if r.get("kind") == "publish"]
+    if not pubs:
+        return {}
+    published = [r for r in pubs if r.get("phase") == "published"]
+    out: dict = {
+        "rows": len(pubs),
+        "published": len(published),
+        "current_version": (int(published[-1].get("version", 0))
+                            if published else 0),
+        "canary_verdicts": [
+            {"version": r.get("version"), "replica": r.get("replica"),
+             "accepted": bool(r.get("accepted")),
+             "reason": r.get("reason"),
+             "burn_rate": r.get("burn_rate")}
+            for r in pubs if r.get("phase") == "canary_verdict"],
+        "rollbacks": [
+            {"version": r.get("version"), "reason": r.get("reason"),
+             "replicas": r.get("replicas")}
+            for r in pubs if r.get("phase") == "rollback"],
+        "events": [
+            {k: r.get(k) for k in ("t", "phase", "version", "replica",
+                                   "accepted", "reason", "entities",
+                                   "swap_seconds", "burn_rate")
+             if r.get(k) is not None}
+            for r in pubs],
+    }
+    if published:
+        out["last_swap_seconds"] = published[-1].get("swap_seconds")
+        out["last_entities"] = published[-1].get("entities")
+    return out
+
+
 def tail_ledger(directory: str) -> dict:
     """Snapshot of a (possibly live) run from its ledger: run identity,
     last position, iteration-time EMA + ETA, transfer fraction."""
@@ -343,6 +379,9 @@ def tail_ledger(directory: str) -> dict:
         out["status"] = f"finished ({ends[-1].get('status', 'ok')})"
     if rows:
         out["wall_seconds"] = float(rows[-1]["t"])
+    publish = publish_summary(rows)
+    if publish:
+        out["publish"] = publish
     alerts = [r for r in rows if r.get("kind") == "watchdog"]
     if alerts:
         out["watchdog_alerts"] = [
@@ -424,6 +463,50 @@ def render_tail(tail: dict) -> str:
                        f"{cur['transfer_fraction_of_wall']:.1%} of wall")
     for a in tail.get("watchdog_alerts", []):
         out.append(f"  WATCHDOG[{a['kind']}/{a['action']}]: {a['detail']}")
+    pub = tail.get("publish")
+    if pub:
+        out.append(f"  publication: v{pub['current_version']} live, "
+                   f"{pub['published']} publish(es), "
+                   f"{len(pub['rollbacks'])} rollback(s) "
+                   f"(--publish for the ladder view)")
+    for p in tail.get("problems", []):
+        out.append(f"  (tail problem: {p})")
+    return "\n".join(out)
+
+
+def render_publish_tail(tail: dict) -> str:
+    """``tail --publish``: the publication ladder, chronologically —
+    delta versions, canary verdicts, rollback events."""
+    pub = tail.get("publish")
+    head = (f"run {tail.get('run_id', '?')}  [{tail['status']}]  "
+            f"{tail['rows']} rows")
+    if not pub:
+        return head + "\n  no publish rows in this ledger"
+    out = [head,
+           f"  serving v{pub['current_version']}  "
+           f"({pub['published']} published, "
+           f"{len(pub['canary_verdicts'])} canary verdict(s), "
+           f"{len(pub['rollbacks'])} rollback(s))"]
+    if pub.get("last_swap_seconds") is not None:
+        out.append(f"  last swap {pub['last_swap_seconds']:.3f}s "
+                   f"({pub.get('last_entities', '?')} row(s))")
+    for e in pub["events"]:
+        t = f"{e.get('t', 0):9.3f}s"
+        phase = e.get("phase", "?")
+        line = f"  {t}  v{e.get('version', '?')} {phase}"
+        if phase == "canary_verdict":
+            line += (" ACCEPTED" if e.get("accepted")
+                     else f" REJECTED: {e.get('reason', '')}")
+            if e.get("burn_rate") is not None:
+                line += f" (burn {e['burn_rate']:.3f})"
+        elif phase == "rollback":
+            line += f" — {e.get('reason', '')}"
+        elif phase == "published":
+            line += (f" ({e.get('entities', '?')} row(s), swap "
+                     f"{e.get('swap_seconds', 0):.3f}s)")
+        elif e.get("replica") is not None:
+            line += f" (replica {e['replica']})"
+        out.append(line)
     for p in tail.get("problems", []):
         out.append(f"  (tail problem: {p})")
     return "\n".join(out)
@@ -549,6 +632,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("ledger", help="run-ledger directory "
                                   "(game_train --ledger-dir)")
     t.add_argument("--json", action="store_true")
+    t.add_argument("--publish", action="store_true",
+                   help="publication view: delta versions, canary "
+                        "verdicts, rollback events from the ledger's "
+                        "publish rows (serving/publish.py ladder)")
     d = sub.add_parser("diff",
                        help="compare two run ledgers: config delta, "
                             "convergence overlay, time-to-target, "
@@ -563,7 +650,12 @@ def _main_ledger(args) -> int:
     try:
         if args.command == "tail":
             tail = tail_ledger(args.ledger)
-            print(json.dumps(tail) if args.json else render_tail(tail))
+            if getattr(args, "publish", False):
+                print(json.dumps(tail.get("publish", {}))
+                      if args.json else render_publish_tail(tail))
+            else:
+                print(json.dumps(tail) if args.json
+                      else render_tail(tail))
             return 0
         diff = diff_ledgers(args.run_a, args.run_b)
         if args.json:

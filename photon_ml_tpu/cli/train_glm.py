@@ -196,6 +196,7 @@ def run(args) -> dict:
                           "final_loss": float(result.value)}))
 
     candidates = []
+    val_scores = []
     for lam, coef, fit_stats in fits:
         # Export coefficients in the ORIGINAL feature space (reference:
         # models are transformed back before writing).
@@ -207,11 +208,27 @@ def run(args) -> dict:
             task=task, coefficients=Coefficients(raw_means, raw_vars))
         record = {"reg_weight": lam, **fit_stats}
         if val_batch is not None:
-            scores = model.compute_score(jnp.asarray(val_batch[0]))
-            record[evaluator] = float(ev.evaluate(
-                et, scores, jnp.asarray(val_batch[1])))
-        logger.info("lambda=%g: %s", lam, record)
+            # Device work only inside the sweep — the scores stay put;
+            # the metrics evaluate batched after it.
+            val_scores.append(model.compute_score(jnp.asarray(
+                val_batch[0])))
         candidates.append((model, record))
+
+    if val_batch is not None:
+        # Batched evaluation AFTER the sweep: every candidate's metric
+        # computes in ONE vmapped program and crosses the device
+        # boundary in ONE host transfer — no per-lambda sync inside the
+        # model-selection loop (the last .photon-lint-baseline.json
+        # debt, retired).
+        import jax
+
+        yv = jnp.asarray(val_batch[1])
+        metric_vec = np.asarray(jax.vmap(
+            lambda s: ev.evaluate(et, s, yv))(jnp.stack(val_scores)))
+        for (_, record), value in zip(candidates, metric_vec):
+            record[evaluator] = float(value)
+    for _, record in candidates:
+        logger.info("lambda=%g: %s", record["reg_weight"], record)
 
     if val_batch is not None:
         best_i = max(range(len(candidates)),

@@ -56,6 +56,14 @@ FLEET_ROUTE = "fleet.route"
 FLEET_PROBE = "fleet.probe"
 FLEET_REPLICA_FLUSH = "fleet.replica_flush"
 
+# -- continuous publication (serving/publish.py, serving/fleet.py,
+#    serving/model_store.py) -------------------------------------------------
+PUBLISH_DELTA_WRITE = "publish.delta_write"
+PUBLISH_DELTA_ARTIFACT = "publish.delta_artifact"  # corrupt_file
+PUBLISH_CANARY_APPLY = "publish.canary_apply"
+PUBLISH_SWAP = "publish.swap"
+PUBLISH_ROLLBACK = "publish.rollback"
+
 # Every registered site. Computed from the module's own constants so the
 # registry cannot drift from itself; PML014 reads the CONSTANTS above
 # via AST (this comprehension never runs under the linter).

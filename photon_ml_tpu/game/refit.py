@@ -1,0 +1,219 @@
+"""Incremental per-entity refit: the cheap half of the production loop.
+
+A GLMix deployment splits its training hierarchically (Snap ML's
+resource-matching design, PAPERS.md): a HEAVY offline fit produces the
+base model, and per-entity random effects refresh continuously as
+traffic arrives. Each random-effect row is an independent tiny solve
+against fixed offsets — exactly the warm-started per-coordinate solves
+of distributed coordinate descent (Trofimov–Genkin, PAPERS.md) — so a
+refresh is embarrassingly parallel over the DIRTY entity set and reuses
+the existing vmapped bucket solvers verbatim (game/coordinates/
+random_effect.py): build a tiny dataset from the logged tuples, bucket
+it, solve every dirty entity simultaneously, and cut the changed rows
+into a versioned delta (serving/publish.py).
+
+The refit CONTRACT that makes served scores provable (the continuity
+proof tests/test_publish.py runs):
+
+* a refit batch carries an entity's COMPLETE logged history ``(features,
+  label, offset[, weight])``, in a stable per-entity order — the
+  incremental unit is the ENTITY, not the example;
+* every solve warm-starts from the BASE model's row (the offline fit the
+  log accumulates against), with the same optimizer configuration;
+* solves are quantized into FIXED-size lane groups (``lane_group``,
+  default = the bucketing pad multiple): the dirty set is chunked by
+  sorted entity id and each chunk solves against a compact
+  ``lane_group``-row table, so every entity's compiled program shape is
+  ``(lane_group, its own pow-2 capacity, d)`` — INDEPENDENT of how many
+  other entities happened to be dirty. Without this, a bigger dirty set
+  changes the vmap lane count, XLA vectorizes the solve differently,
+  and 1-ulp input jitter amplifies through L-BFGS into ~1e-5 row drift
+  (measured; the per-lane math is only bit-stable at a fixed shape).
+
+Together these make the row an entity gets from publish k bit-identical
+to the row an offline FULL refit over the union of all logged tuples
+would give it — incremental publication never drifts from the offline
+answer, no matter how the dirty sets were batched. Group program
+shapes repeat, so the persistent compilation cache
+(utils/compile_cache) serves every group after the first of a given
+capacity with a disk hit instead of an XLA compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.optim.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.utils.diskio import atomic_write
+
+logger = logging.getLogger("photon_ml_tpu.game")
+
+
+@dataclasses.dataclass(frozen=True)
+class RefitBatch:
+    """Logged scoring traffic for one random-effect coordinate: the
+    ``(features, label, offset)`` tuples of every DIRTY entity (offset =
+    the rest of the model's score for that example — the fixed effects
+    and other coordinates the per-entity solve holds constant)."""
+
+    re_type: str
+    shard_id: str
+    entity_ids: np.ndarray  # (n,) int64 vocabulary rows
+    features: np.ndarray  # (n, d) float32 dense feature rows
+    labels: np.ndarray  # (n,)
+    offsets: np.ndarray  # (n,) rest-of-model scores
+    weights: Optional[np.ndarray] = None  # (n,); ones when None
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.entity_ids.shape[0])
+
+    @property
+    def dirty_entities(self) -> np.ndarray:
+        return np.unique(np.asarray(self.entity_ids, np.int64))
+
+
+def save_refit_batch(path: str, batch: RefitBatch) -> None:
+    """Persist one logged-tuple batch atomically (the npz handoff
+    between the traffic logger and ``photon-game-publish``)."""
+    payload = {
+        "re_type": np.asarray(batch.re_type),
+        "shard_id": np.asarray(batch.shard_id),
+        "entity_ids": np.asarray(batch.entity_ids, np.int64),
+        "features": np.asarray(batch.features, np.float32),
+        "labels": np.asarray(batch.labels, np.float32),
+        "offsets": np.asarray(batch.offsets, np.float32),
+    }
+    if batch.weights is not None:
+        payload["weights"] = np.asarray(batch.weights, np.float32)
+    atomic_write(path, lambda f: np.savez(f, **payload))
+
+
+def load_refit_batch(path: str) -> RefitBatch:
+    with np.load(path, allow_pickle=False) as z:
+        return RefitBatch(
+            re_type=str(z["re_type"]),
+            shard_id=str(z["shard_id"]),
+            entity_ids=np.asarray(z["entity_ids"], np.int64),
+            features=np.asarray(z["features"], np.float32),
+            labels=np.asarray(z["labels"], np.float32),
+            offsets=np.asarray(z["offsets"], np.float32),
+            weights=(np.asarray(z["weights"], np.float32)
+                     if "weights" in z.files else None))
+
+
+def refit_rows(
+    model: GameModel,
+    cid: str,
+    batch: RefitBatch,
+    config: Optional[GLMOptimizationConfiguration] = None,
+    mesh=None,
+    lane_group: Optional[int] = None,
+) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Refit the dirty entities of coordinate ``cid`` from logged tuples.
+
+    Returns ``(entity_ids, rows, stats)``: the refit vocabulary rows (the
+    delta payload serving/publish.py versions) plus refit accounting.
+    The base coordinate model provides the warm starts; entities absent
+    from the batch are untouched (their rows are not in the delta).
+
+    ``lane_group`` is the batch-invariance quantum (module docstring):
+    keep it at its default (the mesh's entity pad multiple) unless every
+    publisher in the deployment agrees on another value — rows are only
+    bit-comparable between refits run with the SAME group size.
+    """
+    from photon_ml_tpu.game.coordinates.random_effect import \
+        RandomEffectCoordinate
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    base = model.models.get(cid)
+    if base is None:
+        raise ValueError(f"model has no coordinate {cid!r} "
+                         f"(has {sorted(model.models)})")
+    if not isinstance(base, RandomEffectModel):
+        raise ValueError(
+            f"coordinate {cid!r} is {type(base).__name__}; incremental "
+            f"refit serves dense RandomEffectModel coordinates (subspace/"
+            f"factored refit needs the full staging path)")
+    if batch.num_rows == 0:
+        raise ValueError("refit batch carries no logged tuples")
+    if batch.features.shape[1] != base.dim:
+        raise ValueError(
+            f"logged features are {batch.features.shape[1]}-dimensional, "
+            f"coordinate {cid!r} expects {base.dim}")
+    t0 = time.perf_counter()
+    mesh = mesh if mesh is not None else make_mesh()
+    if lane_group is None:
+        # The same pad multiple RandomEffectCoordinate buckets with —
+        # every group's lane axis pads to exactly this.
+        lane_group = max(8, int(np.prod(list(mesh.shape.values()))))
+    all_ids = np.asarray(batch.entity_ids, np.int64)
+    if all_ids.size and (int(all_ids.min()) < 0
+                         or int(all_ids.max()) >= base.num_entities):
+        raise ValueError(
+            f"logged entity ids outside [0, {base.num_entities})")
+    weights = (np.ones(batch.num_rows, np.float32)
+               if batch.weights is None
+               else np.asarray(batch.weights, np.float32))
+    labels = np.asarray(batch.labels, np.float32)
+    offsets = np.asarray(batch.offsets, np.float32)
+    features = np.asarray(batch.features, np.float32)
+    base_means = np.asarray(base.means, np.float32)
+    loss = losses_mod.loss_for_task(model.task)
+    config = config or GLMOptimizationConfiguration()
+    dirty = np.unique(all_ids)
+    parts: list = []  # (k, (lane_group, d) device table) per group
+    groups = 0
+    for lo in range(0, dirty.shape[0], lane_group):
+        group = dirty[lo: lo + lane_group]
+        k = group.shape[0]
+        sel = np.isin(all_ids, group)
+        # Compact local table: entity i of the group is row i; the
+        # table pads to lane_group rows so the compiled scatter shape
+        # never depends on the group's fill (zero rows never train —
+        # no examples reference them).
+        local = np.searchsorted(group, all_ids[sel])
+        warm = np.zeros((lane_group, base.dim), np.float32)
+        warm[:k] = base_means[group]
+        data = GameDataset(
+            response=labels[sel],
+            offsets=offsets[sel],
+            weights=weights[sel],
+            feature_shards={batch.shard_id: features[sel]},
+            entity_ids={batch.re_type: local},
+            num_entities={batch.re_type: int(lane_group)},
+        )
+        coord = RandomEffectCoordinate(
+            data, batch.re_type, batch.shard_id, loss, config, mesh)
+        initial = RandomEffectModel(
+            re_type=batch.re_type, shard_id=batch.shard_id,
+            means=jnp.asarray(warm))
+        refit = coord.train_model(jnp.asarray(data.offsets),
+                                  initial=initial)
+        parts.append((k, refit.means))
+        groups += 1
+    # ONE device->host transfer for the whole dirty set (the group
+    # results stay on device until here).
+    out_rows = np.asarray(jnp.concatenate(
+        [means[:k] for k, means in parts], axis=0), np.float32)
+    stats = {
+        "coordinate": cid,
+        "dirty_entities": int(dirty.shape[0]),
+        "logged_rows": batch.num_rows,
+        "lane_group": int(lane_group),
+        "groups": groups,
+        "refit_seconds": round(time.perf_counter() - t0, 6),
+    }
+    logger.info("refit %s: %d dirty entit(ies) from %d logged row(s) "
+                "in %d group(s), %.3fs", cid, stats["dirty_entities"],
+                batch.num_rows, groups, stats["refit_seconds"])
+    return dirty.astype(np.int64), out_rows, stats

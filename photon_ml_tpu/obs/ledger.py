@@ -39,6 +39,12 @@ coordinate, outer iteration, descent step, grid point, tuning trial):
 * ``tuning_trial`` — one hyperparameter trial: sampled point, expected
   improvement (GP search), objective, wall seconds.
 * ``watchdog`` — a convergence-watchdog alert (obs/watchdog.py).
+* ``publish`` — one continuous-publication ladder phase
+  (serving/publish.py): ``refit`` / ``delta_write`` / ``canary_apply``
+  / ``canary_verdict`` / ``swap`` / ``rollback`` / ``published`` /
+  ``reapply`` rows carrying the delta version and verdict context,
+  appended as produced like every other kind — ``photon-obs tail
+  --publish`` renders the ladder.
 * ``run_end`` — clean shutdown marker (its absence means the run is
   live or was killed — ``photon-obs tail`` reports exactly that).
 

@@ -16,6 +16,10 @@ from photon_ml_tpu.serving.metrics import (STAGES, SLOTracker,
                                            ServingMetrics)
 from photon_ml_tpu.serving.model_store import (HashShardedStore,
                                                ResidentModelStore)
+from photon_ml_tpu.serving.publish import (BadDelta, CanaryRejected,
+                                           DeltaCorrupt, DeltaStore,
+                                           ModelDelta, PublishError,
+                                           read_delta, validate_delta)
 from photon_ml_tpu.serving.router import (FleetRouter, ReplicaHTTPError,
                                           ReplicaShed, ReplicaUnavailable,
                                           ShardMap, route_key)
@@ -48,6 +52,14 @@ __all__ = [
     "ServingMetrics",
     "HashShardedStore",
     "ResidentModelStore",
+    "BadDelta",
+    "CanaryRejected",
+    "DeltaCorrupt",
+    "DeltaStore",
+    "ModelDelta",
+    "PublishError",
+    "read_delta",
+    "validate_delta",
     "ScoringRequest",
     "ScoringService",
     "make_http_server",

@@ -42,19 +42,27 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
+from photon_ml_tpu import faults as flt
 from photon_ml_tpu.serving.metrics import SLOTracker
+from photon_ml_tpu.serving.publish import (CanaryRejected, ModelDelta,
+                                           PublishError, read_delta)
 from photon_ml_tpu.serving.router import (FleetRouter, ReplicaHTTPError,
                                           ReplicaShed, ReplicaUnavailable,
                                           ShardMap)
 from photon_ml_tpu.serving.supervisor import UP, ReplicaSupervisor
-from photon_ml_tpu.utils.events import (ReplicaDied, ReplicaRecovered,
-                                        ShardRehomed, default_emitter)
+from photon_ml_tpu.utils.events import (CanaryVerdict, DeltaPublished,
+                                        ReplicaDied, ReplicaRecovered,
+                                        RollbackExecuted, ShardRehomed,
+                                        default_emitter)
 
 logger = logging.getLogger("photon_ml_tpu.serving.fleet")
 
@@ -84,6 +92,13 @@ class FleetMetrics:
         self.rehome_deadline_misses_total = 0
         self.replica_deaths_total = 0
         self.replica_restarts_total = 0
+        # Continuous publication (serving/publish.py canary ladder).
+        self.published_version = 0
+        self.publishes_total = 0
+        self.canary_rejects_total = 0
+        self.publish_rollbacks_total = 0
+        self.publish_swap_seconds_last = 0.0
+        self.publish_swap_seconds_max = 0.0
         self.slo = SLOTracker(window_s=slo_window_s,
                               availability_objective=slo_availability,
                               latency_objective_ms=slo_latency_ms)
@@ -140,6 +155,22 @@ class FleetMetrics:
         with self._lock:
             self.replica_restarts_total += 1
 
+    def record_publish(self, version: int, swap_seconds: float) -> None:
+        with self._lock:
+            self.published_version = int(version)
+            self.publishes_total += 1
+            self.publish_swap_seconds_last = swap_seconds
+            self.publish_swap_seconds_max = max(
+                self.publish_swap_seconds_max, swap_seconds)
+
+    def record_canary_reject(self) -> None:
+        with self._lock:
+            self.canary_rejects_total += 1
+
+    def record_publish_rollback(self, n: int = 1) -> None:
+        with self._lock:
+            self.publish_rollbacks_total += n
+
     def record_rehome(self, seconds: float, deadline_s: float) -> None:
         with self._lock:
             self.rehomes_total += 1
@@ -168,6 +199,14 @@ class FleetMetrics:
                     self.rehome_deadline_misses_total,
                 "replica_deaths_total": self.replica_deaths_total,
                 "replica_restarts_total": self.replica_restarts_total,
+                "published_version": self.published_version,
+                "publishes_total": self.publishes_total,
+                "canary_rejects_total": self.canary_rejects_total,
+                "publish_rollbacks_total": self.publish_rollbacks_total,
+                "publish_swap_seconds_last":
+                    self.publish_swap_seconds_last,
+                "publish_swap_seconds_max":
+                    self.publish_swap_seconds_max,
             }
 
     def render_text(self, states: dict[int, str],
@@ -199,6 +238,16 @@ class FleetMetrics:
             f"{s['replica_deaths_total']}",
             f"photon_fleet_replica_restarts_total "
             f"{s['replica_restarts_total']}",
+            f"photon_publish_model_version {s['published_version']}",
+            f"photon_publish_deltas_total {s['publishes_total']}",
+            f"photon_publish_canary_rejects_total "
+            f"{s['canary_rejects_total']}",
+            f"photon_publish_rollbacks_total "
+            f"{s['publish_rollbacks_total']}",
+            f"photon_publish_swap_seconds{{window=\"last\"}} "
+            f"{s['publish_swap_seconds_last']:.6f}",
+            f"photon_publish_swap_seconds{{window=\"max\"}} "
+            f"{s['publish_swap_seconds_max']:.6f}",
         ]
         for rid in sorted(states):
             lines.append(
@@ -255,6 +304,9 @@ class ServingFleet:
         slo_window_s: float = 60.0,
         slo_availability: float = 0.999,
         slo_latency_ms: Optional[float] = None,
+        publish_dir: Optional[str] = None,
+        publish_bake_s: float = 0.5,
+        publish_burn_threshold: float = 1.0,
         emitter=default_emitter,
     ):
         self.replica_args = list(replica_args)
@@ -296,6 +348,19 @@ class ServingFleet:
         self._degraded = False
         self._rehoming = False
         self._closed = False
+        # Continuous publication state (serving/publish.py ladder):
+        # committed deltas newest-last (restarted replicas replay them),
+        # one publish at a time, and the publish ledger (lazy — the row
+        # sink `photon-obs tail --publish` reads).
+        self.publish_dir = publish_dir
+        self.publish_bake_s = float(publish_bake_s)
+        self.publish_burn_threshold = float(publish_burn_threshold)
+        self._published: list[tuple[int, str]] = []
+        # RLock: the monitor thread's recovery replay and the publish
+        # ladder both record ledger rows, and the ladder records while
+        # already holding the lock.
+        self._publish_lock = threading.RLock()
+        self._publish_ledger = None
 
     # -- replica plumbing ----------------------------------------------------
 
@@ -359,12 +424,277 @@ class ServingFleet:
         self.metrics.record_restart()
         self.emitter.emit(ReplicaRecovered(
             replica_id=replica_id, shards_restored=tuple(back)))
+        # A restarted replica loaded the BASE model — replay the
+        # committed delta chain before declaring it healthy, or it would
+        # serve stale rows for every published entity.
+        self._reapply_published(replica_id)
         states = self.supervisor.states()
         if all(st == UP for st in states.values()):
             self._degraded = False  # pml: allow[PML015] single-writer monitor-thread publish; healthz re-derives from supervisor states anyway
         logger.info("replica %d recovered; %d shard(s) back home; "
                     "fleet %s", replica_id, len(back),
                     "healthy" if not self._degraded else "still degraded")
+
+    # -- continuous publication (serving/publish.py; docs/SERVING.md
+    #    "Continuous publication") --------------------------------------------
+
+    @property
+    def published_version(self) -> int:
+        with self._publish_lock:
+            return self._published[-1][0] if self._published else 0
+
+    def _replica_url(self, replica_id: int) -> str:
+        host, port = self.supervisor.endpoint(replica_id)
+        return f"http://{host}:{port}"
+
+    def _replica_post(self, replica_id: int, path: str,
+                      payload: dict, timeout_s: float = 30.0) -> dict:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self._replica_url(replica_id) + path, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _replica_get_json(self, replica_id: int, path: str,
+                          timeout_s: float = 10.0) -> dict:
+        with urllib.request.urlopen(self._replica_url(replica_id) + path,
+                                    timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _publish_record(self, **fields) -> None:
+        """One ``publish`` ledger row (append-as-produced, per-row CRC —
+        the obs/ledger.py discipline; ``photon-obs tail --publish``
+        renders these)."""
+        if self.publish_dir is None:
+            return
+        with self._publish_lock:
+            if self._publish_ledger is None:
+                from photon_ml_tpu.obs.ledger import RunLedger
+
+                self._publish_ledger = RunLedger.resume(
+                    os.path.join(self.publish_dir, "ledger"),
+                    config={"kind": "publish",
+                            "num_replicas": self.num_replicas})
+            self._publish_ledger.record("publish", **fields)
+
+    def _kill_replica(self, replica_id: int) -> None:
+        """Last rung of the rollback ladder: a replica that cannot be
+        rolled back is in an UNKNOWN model state — SIGKILL it so the
+        supervisor restarts it from the base model and
+        ``_reapply_published`` replays only the COMMITTED chain
+        (consistency restored by construction)."""
+        handle = self.supervisor.replicas[replica_id]
+        if handle.proc is not None and handle.proc.poll() is None:
+            logger.error(
+                "replica %d could not roll back — killing it; the "
+                "supervised restart replays the committed delta chain",
+                replica_id)
+            try:
+                handle.proc.send_signal(signal.SIGKILL)
+            except OSError as e:
+                logger.error("could not kill replica %d (%s)",
+                             replica_id, e)
+
+    def _reapply_published(self, replica_id: int) -> None:
+        with self._publish_lock:
+            chain = list(self._published)
+        for version, path in chain:
+            try:
+                self._replica_post(replica_id, "/admin/delta",
+                                   {"path": path})
+                self._publish_record(phase="reapply", version=version,
+                                     replica=replica_id)
+            except (OSError, ValueError) as e:
+                logger.error(
+                    "recovered replica %d failed to re-apply committed "
+                    "delta v%d (%s: %s) — it serves STALE rows until "
+                    "the next restart", replica_id, version,
+                    type(e).__name__, e)
+                return
+
+    def _rollback(self, replica_ids: Sequence[int], delta: ModelDelta,
+                  reason: str) -> None:
+        """Back ``delta`` out of every replica that applied it. A
+        replica whose rollback fails is killed (see ``_kill_replica``) —
+        the ladder never leaves a replica in an unknown state."""
+        rolled = []
+        for rid in replica_ids:
+            try:
+                flt.fire(flt.sites.PUBLISH_ROLLBACK, index=rid)
+                self._replica_post(rid, "/admin/rollback",
+                                   {"to_version": delta.parent})
+                rolled.append(rid)
+            except Exception as e:
+                logger.error("rollback of delta v%d on replica %d "
+                             "failed (%s: %s)", delta.version, rid,
+                             type(e).__name__, e)
+                self._kill_replica(rid)
+        self.metrics.record_publish_rollback(len(replica_ids))
+        self.emitter.emit(RollbackExecuted(
+            version=delta.version, reason=reason,
+            replicas=tuple(rolled)))
+        self._publish_record(phase="rollback", version=delta.version,
+                             reason=reason, replicas=list(rolled))
+
+    def _judge_canary(self, canary: int, delta: ModelDelta,
+                      bake_s: float, burn_threshold: float,
+                      probe_objs: Optional[list] = None,
+                      probe_max_abs: Optional[float] = None
+                      ) -> tuple[bool, str, float]:
+        """The canary judge: bake, then rule on (1) probe scores —
+        finite, and inside ``probe_max_abs`` when given (the quality
+        delta), (2) the canary's error-budget burn and flush errors
+        over the window (the SLO half). Returns (accepted, reason,
+        burn_rate)."""
+        before = self._replica_get_json(canary, "/slo")
+        if probe_objs:
+            try:
+                resp = self._replica_post(
+                    canary, "/score", {"requests": probe_objs})
+                scores = [float(s) for s in resp.get("scores", [])]
+            except (OSError, ValueError) as e:
+                return False, f"canary probe failed ({e})", 0.0
+            if any(s != s or s in (float("inf"), float("-inf"))
+                   for s in scores):
+                return False, "canary probe produced non-finite scores", \
+                    0.0
+            if probe_max_abs is not None and any(
+                    abs(s) > probe_max_abs for s in scores):
+                worst = max(abs(s) for s in scores)
+                return (False,
+                        f"canary probe scores out of band "
+                        f"(|score| {worst:.4g} > {probe_max_abs:.4g})",
+                        0.0)
+        time.sleep(bake_s)
+        after = self._replica_get_json(canary, "/slo")
+        burn = float(after.get("budget_burn_rate", 0.0))
+        flush_delta = (after["lifetime"]["flush_errors_total"]
+                       - before["lifetime"]["flush_errors_total"])
+        if flush_delta > 0:
+            return (False, f"{flush_delta} flush error(s) on the canary "
+                           f"during the bake window", burn)
+        if burn > burn_threshold:
+            return (False, f"canary error-budget burn {burn:.3f} over "
+                           f"threshold {burn_threshold:.3f}", burn)
+        return True, "ok", burn
+
+    def publish_delta(self, delta_dir: str,
+                      bake_s: Optional[float] = None,
+                      burn_threshold: Optional[float] = None,
+                      probe_objs: Optional[list] = None,
+                      probe_max_abs: Optional[float] = None) -> dict:
+        """The publication ladder: canary-apply → bake/judge → roll
+        fleet-wide or auto-roll-back. Raises the defined taxonomy —
+        ``DeltaCorrupt``/``BadDelta`` (nothing applied anywhere),
+        ``CanaryRejected`` (canary rolled back, no other replica ever
+        saw the delta), ``PublishError`` (a fleet-wide swap leg failed;
+        every applied replica rolled back). On success the delta joins
+        the committed chain restarted replicas replay."""
+        bake_s = self.publish_bake_s if bake_s is None else float(bake_s)
+        burn_threshold = (self.publish_burn_threshold
+                          if burn_threshold is None
+                          else float(burn_threshold))
+        # Replicas resolve the path from THEIR cwd (the workdir) — hand
+        # them an absolute one.
+        delta_dir = os.path.abspath(delta_dir)
+        with self._publish_lock:
+            delta = read_delta(delta_dir)  # DeltaCorrupt stops it here
+            current = self._published[-1][0] if self._published else 0
+            if delta.parent != current:
+                raise PublishError(
+                    f"delta v{delta.version} was cut against version "
+                    f"{delta.parent} but the fleet serves {current} — "
+                    f"publish the chain in order")
+            up = self.supervisor.up_replicas()
+            if not up:
+                raise PublishError("no healthy replica to canary on")
+            canary = up[0]
+            self._publish_record(phase="canary_apply",
+                                 version=delta.version, replica=canary)
+            t0 = time.monotonic()
+            try:
+                flt.fire(flt.sites.PUBLISH_CANARY_APPLY, index=canary)
+                self._replica_post(canary, "/admin/delta",
+                                   {"path": delta_dir})
+            except urllib.error.HTTPError as e:
+                # The replica REFUSED (validation, chain break): nothing
+                # applied, nothing to roll back.
+                detail = e.read().decode(errors="replace")
+                self.metrics.record_canary_reject()
+                self.emitter.emit(CanaryVerdict(
+                    version=delta.version, replica_id=canary,
+                    accepted=False, reason=detail, burn_rate=0.0))
+                self._publish_record(phase="canary_verdict",
+                                     version=delta.version,
+                                     replica=canary, accepted=False,
+                                     reason=detail)
+                raise CanaryRejected(delta.version,
+                                     f"replica refused the delta: "
+                                     f"{detail}")
+            except Exception as e:
+                # Ambiguous failure (timeout, injected fault): the
+                # canary MAY have applied — roll it back (idempotent
+                # when it had not).
+                self.metrics.record_canary_reject()
+                self._rollback([canary], delta,
+                               f"canary apply failed: {e}")
+                raise CanaryRejected(delta.version,
+                                     f"canary apply failed: {e}")
+            apply_s = time.monotonic() - t0
+            accepted, reason, burn = self._judge_canary(
+                canary, delta, bake_s, burn_threshold,
+                probe_objs=probe_objs, probe_max_abs=probe_max_abs)
+            self.emitter.emit(CanaryVerdict(
+                version=delta.version, replica_id=canary,
+                accepted=accepted, reason=reason, burn_rate=burn))
+            self._publish_record(phase="canary_verdict",
+                                 version=delta.version, replica=canary,
+                                 accepted=accepted, reason=reason,
+                                 burn_rate=burn)
+            if not accepted:
+                self.metrics.record_canary_reject()
+                self._rollback([canary], delta, reason)
+                raise CanaryRejected(delta.version, reason)
+            # Verdict: roll fleet-wide. A failed leg rolls EVERYTHING
+            # back (the failed replica included — its state is unknown).
+            t1 = time.monotonic()
+            applied = [canary]
+            for rid in up[1:]:
+                try:
+                    flt.fire(flt.sites.PUBLISH_SWAP, index=rid)
+                    self._replica_post(rid, "/admin/delta",
+                                       {"path": delta_dir})
+                    applied.append(rid)
+                    self._publish_record(phase="swap",
+                                         version=delta.version,
+                                         replica=rid)
+                except Exception as e:
+                    reason = (f"fleet-wide swap failed on replica "
+                              f"{rid}: {type(e).__name__}: {e}")
+                    logger.error("%s — rolling every applied replica "
+                                 "back", reason)
+                    self._rollback(applied + [rid], delta, reason)
+                    raise PublishError(reason)
+            swap_seconds = apply_s + (time.monotonic() - t1)
+            self._published.append((delta.version, delta_dir))
+            self.metrics.record_publish(delta.version, swap_seconds)
+            self.emitter.emit(DeltaPublished(
+                version=delta.version, coordinates=delta.coordinates,
+                entities=delta.num_rows, canary_replica=canary,
+                swap_seconds=swap_seconds))
+            self._publish_record(phase="published",
+                                 version=delta.version,
+                                 entities=delta.num_rows,
+                                 replicas=applied,
+                                 swap_seconds=round(swap_seconds, 6),
+                                 burn_rate=burn)
+            logger.info("delta v%d live on %d replica(s) "
+                        "(canary %d, swap %.3fs)", delta.version,
+                        len(applied), canary, swap_seconds)
+            return {"version": delta.version, "canary_replica": canary,
+                    "replicas": applied, "entities": delta.num_rows,
+                    "swap_seconds": swap_seconds, "burn_rate": burn}
 
     # -- serving -------------------------------------------------------------
 
@@ -419,6 +749,7 @@ class ServingFleet:
             "shards_away_from_home": sum(
                 1 for s in range(self.num_shards)
                 if self.shard_map.owner(s) != self.shard_map.home(s)),
+            "published_version": self.published_version,
         }
 
     def metrics_text(self) -> str:
@@ -436,6 +767,8 @@ class ServingFleet:
         self._closed = True
         self.router.close()
         self.supervisor.stop()
+        if self._publish_ledger is not None:
+            self._publish_ledger.close()
 
     def __enter__(self):
         return self
@@ -480,8 +813,49 @@ class _FleetHandler(BaseHTTPRequestHandler):
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
+    def _do_publish(self) -> None:
+        """``POST /publish``: drive the canary ladder from the front
+        door (``photon-game-publish --fleet-url`` lands here). The
+        response carries the verdict; rejections are DEFINED statuses —
+        409 canary-rejected (rolled back), 422 untrustworthy/unservable
+        delta (never applied), 503 swap failure (rolled back)."""
+        fleet = self.fleet
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            delta_dir = str(payload["path"])
+            probe = payload.get("probe") or {}
+        except (ValueError, TypeError, KeyError) as exc:
+            self._json(400, {"error": f"malformed publish request: "
+                                      f"{exc}"})
+            return
+        from photon_ml_tpu.serving.publish import (BadDelta,
+                                                   DeltaCorrupt)
+
+        try:
+            out = fleet.publish_delta(
+                delta_dir,
+                bake_s=payload.get("bake_s"),
+                burn_threshold=payload.get("burn_threshold"),
+                probe_objs=probe.get("requests"),
+                probe_max_abs=probe.get("max_abs_score"))
+        except CanaryRejected as exc:
+            self._json(409, {"error": str(exc), "version": exc.version,
+                             "reason": exc.reason, "rolled_back": True})
+            return
+        except (DeltaCorrupt, BadDelta) as exc:
+            self._json(422, {"error": str(exc), "applied": False})
+            return
+        except PublishError as exc:
+            self._json(503, {"error": str(exc), "rolled_back": True})
+            return
+        self._json(200, out)
+
     def do_POST(self):
         fleet = self.fleet
+        if self.path == "/publish":
+            self._do_publish()
+            return
         if self.path != "/score":
             self._json(404, {"error": f"unknown path {self.path}"})
             return

@@ -202,6 +202,11 @@ class ServingMetrics:
         # Queue depth: observed on every batcher queue transition; the
         # peak is the admission-control headroom number (ISSUE 8).
         self.queue_depth = Gauge()
+        # Continuous publication (serving/publish.py): which model
+        # version this replica serves, and how it got there.
+        self.model_version = 0
+        self.deltas_applied_total = 0
+        self.rollbacks_total = 0
         self.slo = SLOTracker(window_s=slo_window_s,
                               availability_objective=slo_availability,
                               latency_objective_ms=slo_latency_ms)
@@ -260,6 +265,16 @@ class ServingMetrics:
         with self._lock:
             self.recoveries_total += 1
 
+    def record_publish_applied(self, version: int) -> None:
+        with self._lock:
+            self.model_version = int(version)
+            self.deltas_applied_total += 1
+
+    def record_publish_rollback(self, version: int) -> None:
+        with self._lock:
+            self.model_version = int(version)
+            self.rollbacks_total += 1
+
     def record_http_error(self, code: int) -> None:
         with self._lock:
             self.http_errors_total[code] = \
@@ -308,6 +323,9 @@ class ServingMetrics:
                 "retries_total": self.retries_total,
                 "recoveries_total": self.recoveries_total,
                 "http_errors_total": dict(self.http_errors_total),
+                "model_version": self.model_version,
+                "deltas_applied_total": self.deltas_applied_total,
+                "rollbacks_total": self.rollbacks_total,
                 "request_latency": self.request_latency.summary(),
                 "request_latency_sum_seconds": \
                     self.request_latency.values()["sum"],
@@ -337,6 +355,10 @@ class ServingMetrics:
             f"photon_serving_flush_errors_total {s['flush_errors_total']}",
             f"photon_serving_retries_total {s['retries_total']}",
             f"photon_serving_recoveries_total {s['recoveries_total']}",
+            f"photon_serving_model_version {s['model_version']}",
+            f"photon_serving_deltas_applied_total "
+            f"{s['deltas_applied_total']}",
+            f"photon_serving_rollbacks_total {s['rollbacks_total']}",
         ]
         lines.append(f"photon_serving_queue_depth {s['queue_depth']:g}")
         lines.append(

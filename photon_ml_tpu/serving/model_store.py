@@ -78,6 +78,9 @@ class HashShardedStore:
         ids = np.arange(self.num_entities, dtype=np.int64)
         part = [ids[ids % self.num_shards == s]
                 for s in range(self.num_shards)]
+        # Only the dense representation accepts published row deltas
+        # (swap_rows); the flag is the serving store's capability probe.
+        self.mutable = isinstance(model, RandomEffectModel)
         if isinstance(model, RandomEffectModel):
             means = np.asarray(model.means, np.float32)
             self._shards = [(means[p],) for p in part]
@@ -109,6 +112,30 @@ class HashShardedStore:
             out[m] = self._densify(self._shards[s],
                                    ids[m] // self.num_shards)
         return out
+
+    def swap_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Replace the host rows of ``ids`` in place (the publication
+        hot-swap seam — serving/publish.py row deltas land HERE).
+
+        Dense stores only: subspace/factored shards keep coefficients in
+        a representation a dense row cannot be written back into (the
+        refit path produces dense rows), so those coordinates refuse
+        loudly instead of silently mis-writing."""
+        if not self.mutable:
+            raise ValueError(
+                "host store holds a non-dense random-effect "
+                "representation — row hot-swap serves dense "
+                "RandomEffectModel coordinates only")
+        ids = np.asarray(ids, np.int64)
+        rows = np.asarray(rows, np.float32)
+        sid = ids % self.num_shards
+        for s in np.unique(sid):
+            m = sid == s
+            table = self._shards[int(s)][0]
+            if not table.flags.writeable:  # e.g. a mmap-backed load
+                table = table.copy()
+                self._shards[int(s)] = (table,)
+            table[ids[m] // self.num_shards] = rows[m]
 
     def host_bytes(self) -> int:
         return sum(int(a.nbytes) for payload in self._shards
@@ -239,6 +266,22 @@ class REServingState:
     def cached_entities(self) -> list[int]:
         return list(self._lru)
 
+    def apply_rows(self, ids: np.ndarray, rows: np.ndarray) -> int:
+        """Hot-swap published rows into this coordinate: write the host
+        shards, then invalidate ONLY the affected device-LRU slots (the
+        next resolve of those entities re-fills from the new host rows;
+        every other cached entity stays hot). Returns the number of
+        device slots invalidated. Caller holds the store lock — swaps
+        happen BETWEEN flushes, never under one."""
+        self.store.swap_rows(ids, rows)
+        invalidated = 0
+        for e in np.asarray(ids, np.int64):
+            slot = self._lru.pop(int(e), None)
+            if slot is not None:
+                self._free.append(slot)
+                invalidated += 1
+        return invalidated
+
 
 class ResidentModelStore:
     """A loaded GameModel arranged for low-latency online scoring."""
@@ -258,6 +301,11 @@ class ResidentModelStore:
         self.random: list[REServingState] = []
         self.shard_dims: dict[str, int] = {}
         self._lock = threading.Lock()
+        # Publication state (serving/publish.py): the version this
+        # store serves and the undo rows of every applied delta, newest
+        # last — rollback restores them in reverse.
+        self.version = 0
+        self._undo: list[tuple[int, dict]] = []
         for cid, m in model.models.items():
             if isinstance(m, FixedEffectModel):
                 w = jax.device_put(jnp.asarray(m.coefficients.means,
@@ -315,3 +363,74 @@ class ResidentModelStore:
 
     def caches(self) -> dict[str, jax.Array]:
         return {st.cid: st.cache for st in self.random}
+
+    # -- continuous publication (serving/publish.py) -------------------------
+
+    def delta_dims(self) -> dict[str, tuple[int, int]]:
+        """Coordinate → (num_entities, dim) for delta validation."""
+        return {st.cid: (st.num_entities, st.dim) for st in self.random}
+
+    def apply_delta(self, delta) -> dict:
+        """Install one committed :class:`~photon_ml_tpu.serving.publish.
+        ModelDelta` into the live store: validate, swap host rows,
+        invalidate affected device-LRU slots — all under the store lock,
+        so in-flight flushes complete against the OLD version and every
+        later flush sees the NEW one (no mixed-version batch can exist).
+
+        The delta chain is enforced (``delta.parent == self.version``):
+        a replica that missed a version cannot silently apply on top of
+        the wrong base — it must catch up in order (the fleet replays
+        committed deltas to restarted replicas). Undo rows are captured
+        before the swap so :meth:`rollback_to` is exact.
+        """
+        from photon_ml_tpu.serving.publish import (BadDelta,
+                                                   validate_delta)
+
+        with self._lock:
+            validate_delta(delta, self.delta_dims())
+            if delta.parent != self.version:
+                raise BadDelta(
+                    f"delta v{delta.version} was cut against version "
+                    f"{delta.parent} but this store serves "
+                    f"{self.version} — apply the chain in order")
+            by_cid = {st.cid: st for st in self.random}
+            undo: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+            invalidated = 0
+            for cid, (ids, rows) in delta.rows.items():
+                st = by_cid[cid]
+                undo[cid] = (ids, st.store.fetch(ids))
+                invalidated += st.apply_rows(ids, rows)
+            self._undo.append((delta.version, undo))
+            self.version = delta.version
+        logger.info(
+            "delta v%d applied: %d row(s) across %s, %d device slot(s) "
+            "invalidated", delta.version, delta.num_rows,
+            delta.coordinates, invalidated)
+        return {"version": self.version, "rows": delta.num_rows,
+                "invalidated_slots": invalidated}
+
+    def rollback_to(self, version: int) -> dict:
+        """Back out every applied delta newer than ``version`` (newest
+        first, restoring the captured undo rows). Exact inverse of the
+        applied chain — after it, served bits equal a store that never
+        saw the rolled-back deltas."""
+        with self._lock:
+            if version > self.version:
+                raise ValueError(
+                    f"cannot roll back FORWARD (serving {self.version}, "
+                    f"asked for {version})")
+            by_cid = {st.cid: st for st in self.random}
+            restored = 0
+            while self.version > version:
+                if not self._undo:
+                    raise ValueError(
+                        f"no undo rows recorded past version "
+                        f"{self.version} — cannot reach {version}")
+                v, undo = self._undo.pop()
+                for cid, (ids, old_rows) in undo.items():
+                    by_cid[cid].apply_rows(ids, old_rows)
+                    restored += int(ids.shape[0])
+                self.version = v - 1
+        logger.info("rolled back to v%d (%d row(s) restored)",
+                    self.version, restored)
+        return {"version": self.version, "rows_restored": restored}

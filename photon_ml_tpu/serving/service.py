@@ -352,6 +352,44 @@ class ScoringService:
                                    t0_epoch_ns=_at(mono), dur_s=dur,
                                    parent=sid)
 
+    # -- continuous publication (serving/publish.py) -----------------------
+
+    @property
+    def model_version(self) -> int:
+        return self.store.version
+
+    def apply_delta(self, delta) -> dict:
+        """Zero-drop hot-swap: install one committed delta while traffic
+        flows. The service lock serializes against ``_score_chunk``, so
+        the in-flight flush finishes against the OLD version, the swap
+        lands, and every later flush sees the NEW one — queued requests
+        are never dropped and no batch mixes versions. Post-swap scores
+        are bit-identical to a cold restart on the new model (the store
+        re-fills invalidated cache slots from the swapped host rows
+        through the unchanged resolve path)."""
+        with self._lock:
+            out = self.store.apply_delta(delta)
+        self.metrics.record_publish_applied(out["version"])
+        return out
+
+    def apply_delta_dir(self, path: str) -> dict:
+        """Load + validate + apply a committed delta directory (the
+        ``POST /admin/delta`` body). Defined errors only: DeltaCorrupt
+        for untrustworthy bytes, BadDelta for unservable content — the
+        store never mutates on either."""
+        from photon_ml_tpu.serving.publish import read_delta
+
+        return self.apply_delta(read_delta(path))
+
+    def rollback_to(self, version: int) -> dict:
+        """Back out deltas newer than ``version`` (the canary ladder's
+        auto-rollback leg), under the same flush-serialized lock as
+        ``apply_delta``."""
+        with self._lock:
+            out = self.store.rollback_to(version)
+        self.metrics.record_publish_rollback(out["version"])
+        return out
+
     # -- lifecycle ---------------------------------------------------------
 
     def metrics_text(self) -> str:
@@ -441,7 +479,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         elif self.path == "/slo":
             self._json(200, self.service.slo_snapshot())
         elif self.path == "/healthz":
-            self._json(200, {"status": "ok"})
+            self._json(200, {"status": "ok",
+                             "model_version":
+                                 self.service.model_version})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -456,7 +496,41 @@ class _ServingHandler(BaseHTTPRequestHandler):
         body.update({k: v for k, v in extra.items() if v is not None})
         self._json(code, body)
 
+    def _admin(self, payload: dict) -> None:
+        """Publication control plane (``/admin/delta``, ``/admin/
+        rollback``): the fleet's canary ladder drives a replica through
+        these. Errors are DEFINED and counted: 400 for a delta the
+        replica refuses (corrupt bytes, unservable content, chain
+        break), never a silent wrong swap."""
+        from photon_ml_tpu.serving.publish import PublishError
+
+        try:
+            if self.path == "/admin/delta":
+                out = self.service.apply_delta_dir(str(payload["path"]))
+            else:
+                out = self.service.rollback_to(
+                    int(payload["to_version"]))
+        except PublishError as exc:
+            self._error(400, str(exc),
+                        model_version=self.service.model_version)
+            return
+        except (KeyError, TypeError, ValueError) as exc:
+            self._error(400, f"malformed admin request: {exc}")
+            return
+        self._json(200, out)
+
     def do_POST(self):
+        if self.path in ("/admin/delta", "/admin/rollback"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("admin body must be a JSON object")
+            except (ValueError, TypeError) as exc:
+                self._error(400, f"malformed admin request: {exc}")
+                return
+            self._admin(payload)
+            return
         if self.path != "/score":
             self._error(404, f"unknown path {self.path}")
             return

@@ -273,6 +273,45 @@ class ReplicaRecovered(Event):
     shards_restored: tuple[int, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class DeltaPublished(Event):
+    """A versioned model delta finished the canary ladder and is live on
+    EVERY replica (serving/publish.py + fleet.py; docs/SERVING.md
+    "Continuous publication"). ``entities`` is the total dirty-row count
+    across coordinates."""
+
+    version: int
+    coordinates: tuple[str, ...]
+    entities: int
+    canary_replica: int
+    swap_seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class CanaryVerdict(Event):
+    """The canary judge ruled on one delta after its bake window:
+    ``accepted`` False carries the rejection ``reason`` (the delta never
+    reaches a non-canary replica; a RollbackExecuted follows when the
+    canary had already applied it)."""
+
+    version: int
+    replica_id: int
+    accepted: bool
+    reason: str
+    burn_rate: float
+
+
+@dataclasses.dataclass(frozen=True)
+class RollbackExecuted(Event):
+    """A delta was backed out (canary rejection or a failed fleet-wide
+    swap): every replica that applied ``version`` restored the previous
+    rows. ``replicas`` lists who rolled back."""
+
+    version: int
+    reason: str
+    replicas: tuple[int, ...]
+
+
 class EventEmitter:
     """Synchronous listener registry (EventEmitter trait parity)."""
 

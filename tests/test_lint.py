@@ -677,6 +677,19 @@ def test_baseline_fingerprints_survive_line_drift(tmp_path):
     assert res.exit_code == 0 and res.baselined == 1
 
 
+def test_committed_baseline_is_empty():
+    """The ratcheting baseline reached zero: the last tracked debt
+    (train_glm's per-lambda validation-metric sync, retired by the
+    batched post-sweep evaluation in ISSUE 12) is gone, and no new
+    entry may ride in through the baseline instead of being fixed or
+    reason-suppressed inline."""
+    with open(os.path.join(REPO, ".photon-lint-baseline.json")) as f:
+        baseline = json.load(f)
+    assert baseline["entries"] == [], \
+        "the lint baseline must stay empty — fix findings or use an " \
+        "inline `# pml: allow[...]` with a reason"
+
+
 # ------------------------------------------------------- repo gate
 
 

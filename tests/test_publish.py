@@ -1,0 +1,775 @@
+"""The publication ladder under test (photon_ml_tpu/serving/publish.py,
+game/refit.py, the model-store row swap, and the fleet canary ladder —
+docs/SERVING.md "Continuous publication", docs/ROBUSTNESS.md).
+
+The contract:
+
+    a bad or torn delta NEVER reaches users. A SIGKILL mid-publish
+    leaves the previous version fully servable; corrupt bytes fail
+    their CRC before any store row mutates; NaN rows are refused at
+    validation; a delta that applies but misbehaves is rejected at the
+    canary and rolled back without a non-canary replica ever seeing it.
+    And the positive half: after N incremental delta publishes, served
+    scores are BIT-identical to an offline full refit on the same
+    logged tuples (the PR 1 parity pattern, extended in time).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import faults
+from photon_ml_tpu.serving.publish import (BadDelta, CanaryRejected,
+                                           DeltaCorrupt, DeltaStore,
+                                           ModelDelta, PublishError,
+                                           read_delta, validate_delta)
+from photon_ml_tpu.utils import events as ev
+from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+E, DG, DR = 32, 6, 4
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.install(None)
+
+
+def _tiny_model(seed=11):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    return GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=DG).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, DR)).astype(np.float32)
+                        * 0.1)),
+    })
+
+
+def _requests(n, seed=5, entity_fn=None):
+    from photon_ml_tpu.serving import ScoringRequest
+
+    rng = np.random.default_rng(seed)
+    return [ScoringRequest(
+        features={"global": rng.normal(size=DG).astype(np.float32),
+                  "re_userId": rng.normal(size=DR).astype(np.float32)},
+        entity_ids={"userId": int(entity_fn(i)) if entity_fn
+                    else int(i % E)},
+        uid=i) for i in range(n)]
+
+
+def _oracle(model, reqs):
+    """Fresh single-process service on ``model``, scored through the
+    batch API — the cold-restart bit pattern a hot-swapped store must
+    reproduce AT THE SAME flush shape (bit equality is a same-shape
+    contract: a different padded batch vectorizes differently)."""
+    from photon_ml_tpu.serving import ScoringService
+
+    svc = ScoringService(model, max_wait_ms=0.5)
+    try:
+        return svc.score(reqs)
+    finally:
+        svc.close()
+
+
+def _oracle_serial(model, reqs):
+    """Same, at flush shape 1 — what serial singleton HTTP posts
+    through the fleet produce."""
+    from photon_ml_tpu.serving import ScoringService
+
+    svc = ScoringService(model, max_wait_ms=0.5)
+    try:
+        return np.asarray([float(svc.submit(r).result(timeout=60))
+                           for r in reqs], np.float32)
+    finally:
+        svc.close()
+
+
+def _with_rows(model, ids, rows):
+    """The base model with ``ids``' random-effect rows replaced — the
+    offline form of an applied delta."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    means = np.array(np.asarray(model.models["per-user"].means),
+                     copy=True)
+    means[np.asarray(ids, np.int64)] = rows
+    return dc.replace(model, models={
+        **model.models,
+        "per-user": dc.replace(model.models["per-user"],
+                               means=jnp.asarray(means))})
+
+
+def _forge_delta(publish_dir, version, parent, rows_by_cid):
+    """Hand-craft a CRC-VALID delta artifact, bypassing the writer's
+    validation — how a NaN delta (refit gone numerically bad upstream)
+    reaches the ladder in the wild."""
+    d = os.path.join(publish_dir, f"delta-v{version:06d}")
+    os.makedirs(d, exist_ok=True)
+    payload, counts = {}, {}
+    for cid, (ids, mat) in rows_by_cid.items():
+        payload[f"{cid}::ids"] = np.asarray(ids, np.int64)
+        payload[f"{cid}::rows"] = np.asarray(mat, np.float32)
+        counts[cid] = int(len(ids))
+    rows_path = os.path.join(d, "rows.npz")
+    atomic_write(rows_path, lambda f: np.savez(f, **payload))
+    marker = {"format": 1, "version": version, "parent": parent,
+              "crc": file_crc32(rows_path), "counts": counts}
+    atomic_write(os.path.join(d, "delta.json"),
+                 lambda f: f.write(json.dumps(marker).encode()))
+    return d
+
+
+# ------------------------------------------------------ delta store units
+
+
+def test_delta_store_round_trip_monotone_versions(tmp_path):
+    store = DeltaStore(str(tmp_path))
+    assert store.versions() == [] and store.latest_version() == 0
+    ids = np.array([3, 7, 11], np.int64)
+    rows = np.random.default_rng(0).normal(
+        size=(3, DR)).astype(np.float32)
+    d1 = store.write({"per-user": (ids, rows)})
+    assert (d1.version, d1.parent) == (1, 0)
+    d2 = store.write({"per-user": (ids, rows * 2)})
+    assert (d2.version, d2.parent) == (2, 1)
+    assert store.versions() == [1, 2]
+    back = store.read(1)
+    np.testing.assert_array_equal(back.rows["per-user"][0], ids)
+    np.testing.assert_array_equal(back.rows["per-user"][1], rows)
+    assert back.num_rows == 3 and back.coordinates == ("per-user",)
+
+
+def test_torn_publish_is_invisible(tmp_path):
+    """Payload on disk, marker absent (the SIGKILL-between-writes
+    shape): the version does not exist; the previous one still reads."""
+    store = DeltaStore(str(tmp_path))
+    store.write({"per-user": (np.array([1], np.int64),
+                              np.ones((1, DR), np.float32))})
+    torn = str(tmp_path / "delta-v000002")
+    os.makedirs(torn)
+    atomic_write(os.path.join(torn, "rows.npz"),
+                 lambda f: np.savez(f, x=np.ones(3)))
+    assert store.versions() == [1]
+    assert store.latest_version() == 1
+    with pytest.raises(DeltaCorrupt, match="no committed marker"):
+        read_delta(torn)
+    store.read(1)  # previous generation untouched
+
+
+def test_crc_fences_injected_bit_rot(tmp_path):
+    """The publish.delta_artifact corrupt fault garbles the payload
+    AFTER its CRC was committed — read must refuse, loudly."""
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="publish.delta_artifact", kind="corrupt"),))
+    store = DeltaStore(str(tmp_path))
+    with faults.installed(plan) as inj:
+        store.write({"per-user": (np.array([2], np.int64),
+                                  np.ones((1, DR), np.float32))})
+        assert inj.fires("publish.delta_artifact") == 1
+    with pytest.raises(DeltaCorrupt, match="fails its committed CRC"):
+        store.read(1)
+
+
+def test_validate_delta_rejects_unservable_content():
+    ids = np.array([0, 1], np.int64)
+    good = np.ones((2, DR), np.float32)
+
+    def delta(rows, ids_=ids, cid="per-user"):
+        return ModelDelta(version=1, parent=0, rows={cid: (ids_, rows)})
+
+    nan_rows = good.copy()
+    nan_rows[1, 2] = np.nan
+    with pytest.raises(BadDelta, match="non-finite"):
+        validate_delta(delta(nan_rows))
+    with pytest.raises(BadDelta, match="repeats entity ids"):
+        validate_delta(delta(good, ids_=np.array([1, 1], np.int64)))
+    dims = {"per-user": (E, DR)}
+    with pytest.raises(BadDelta, match="store expects"):
+        validate_delta(delta(np.ones((2, DR + 1), np.float32)), dims)
+    with pytest.raises(BadDelta, match="outside"):
+        validate_delta(delta(good, ids_=np.array([0, E], np.int64)),
+                       dims)
+    with pytest.raises(BadDelta, match="does not hold"):
+        validate_delta(delta(good, cid="nope"), dims)
+
+
+def test_retract_removes_version_from_chain(tmp_path):
+    store = DeltaStore(str(tmp_path))
+    ids = np.array([5], np.int64)
+    store.write({"per-user": (ids, np.ones((1, DR), np.float32))})
+    store.write({"per-user": (ids, np.full((1, DR), 2, np.float32))})
+    assert store.retract(2) is not None
+    assert store.versions() == [1]
+    # The number is reused; the chain stays gapless.
+    d = store.write({"per-user": (ids, np.full((1, DR), 3,
+                                               np.float32))})
+    assert (d.version, d.parent) == (2, 1)
+    # The rejected artifact survives for forensics, out of the chain.
+    assert any(n.startswith("rejected-v000002")
+               for n in os.listdir(tmp_path))
+
+
+# ------------------------------------------------------------ refit units
+
+
+def _logged_tuples(seed=3, counts=(3, 5, 2, 7, 4, 3, 6, 2)):
+    """Logged (features, label, offset) tuples for entities 0..len-1."""
+    rng = np.random.default_rng(seed)
+    ids = np.repeat(np.arange(len(counts)), counts).astype(np.int64)
+    n = ids.shape[0]
+    return (ids, rng.normal(size=(n, DR)).astype(np.float32),
+            (rng.random(n) < 0.5).astype(np.float32),
+            rng.normal(size=n).astype(np.float32) * 0.3)
+
+
+def test_refit_batch_npz_round_trip(tmp_path):
+    from photon_ml_tpu.game.refit import (RefitBatch, load_refit_batch,
+                                          save_refit_batch)
+
+    ids, X, y, off = _logged_tuples()
+    path = str(tmp_path / "tuples.npz")
+    save_refit_batch(path, RefitBatch("userId", "re_userId", ids, X, y,
+                                      off))
+    back = load_refit_batch(path)
+    assert (back.re_type, back.shard_id) == ("userId", "re_userId")
+    np.testing.assert_array_equal(back.entity_ids, ids)
+    np.testing.assert_array_equal(back.features, X)
+    assert back.weights is None
+    np.testing.assert_array_equal(back.dirty_entities, np.arange(8))
+
+
+def test_incremental_refit_bit_identical_to_offline_full_refit():
+    """THE refit contract: however the dirty set is batched, each
+    entity's refit row equals the offline full refit's row — bit for
+    bit (per-entity solves are lane-independent and warm-start from
+    the same base rows)."""
+    from photon_ml_tpu.game.refit import RefitBatch, refit_rows
+
+    model = _tiny_model()
+    ids, X, y, off = _logged_tuples()
+    full = RefitBatch("userId", "re_userId", ids, X, y, off)
+    ids_f, rows_f, stats = refit_rows(model, "per-user", full)
+    assert stats["dirty_entities"] == 8
+    # Two disjoint incremental batches, each carrying its entities'
+    # complete history (the refit contract).
+    got = {}
+    for mask in (ids < 4, ids >= 4):
+        b = RefitBatch("userId", "re_userId", ids[mask], X[mask],
+                       y[mask], off[mask])
+        for e, r in zip(*refit_rows(model, "per-user", b)[:2]):
+            got[int(e)] = r
+    for e, row in zip(ids_f, rows_f):
+        np.testing.assert_array_equal(got[int(e)], row)
+
+
+def test_refit_refuses_wrong_shapes():
+    from photon_ml_tpu.game.refit import RefitBatch, refit_rows
+
+    model = _tiny_model()
+    ids, X, y, off = _logged_tuples()
+    with pytest.raises(ValueError, match="no coordinate"):
+        refit_rows(model, "nope",
+                   RefitBatch("userId", "re_userId", ids, X, y, off))
+    with pytest.raises(ValueError, match="dimensional"):
+        refit_rows(model, "per-user", RefitBatch(
+            "userId", "re_userId", ids,
+            np.zeros((len(ids), DR + 1), np.float32), y, off))
+
+
+# ------------------------------------------------- store/service hot swap
+
+
+def test_swap_refuses_non_dense_representation():
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import SubspaceRandomEffectModel
+    from photon_ml_tpu.serving.model_store import HashShardedStore
+
+    sub = SubspaceRandomEffectModel(
+        re_type="userId", shard_id="re_userId", num_features=DR,
+        cols=jnp.zeros((E, 2), jnp.int32),
+        means=jnp.zeros((E, 2), jnp.float32))
+    store = HashShardedStore(sub)
+    assert not store.mutable
+    with pytest.raises(ValueError, match="dense"):
+        store.swap_rows(np.array([0], np.int64),
+                        np.zeros((1, DR), np.float32))
+
+
+def test_hot_swap_parity_with_cold_restart_and_lru_invalidation():
+    """Post-swap served scores are bit-identical to a cold restart on
+    the new model — including entities whose rows were device-cached
+    before the swap (only their slots invalidate; others stay hot)."""
+    from photon_ml_tpu.serving import ScoringService
+
+    model = _tiny_model()
+    reqs = _requests(16, seed=21)
+    svc = ScoringService(model, max_wait_ms=0.5)
+    try:
+        before = svc.score(reqs)  # warms the device LRU
+        st = svc.store.random[0]
+        cached_before = set(st.cached_entities())
+        assert cached_before  # the swap has something to invalidate
+        ids = np.array(sorted(cached_before)[:4], np.int64)
+        rows = np.random.default_rng(9).normal(
+            size=(len(ids), DR)).astype(np.float32)
+        delta = ModelDelta(version=1, parent=0,
+                           rows={"per-user": (ids, rows)})
+        out = svc.apply_delta(delta)
+        assert out["invalidated_slots"] == len(ids)
+        assert svc.model_version == 1
+        after = svc.score(reqs)
+    finally:
+        svc.close()
+    expected = _oracle(_with_rows(model, ids, rows), reqs)
+    np.testing.assert_array_equal(after, expected)
+    np.testing.assert_array_equal(before, _oracle(model, reqs))
+
+
+def test_apply_enforces_the_version_chain():
+    from photon_ml_tpu.serving import ScoringService
+
+    svc = ScoringService(_tiny_model(), max_wait_ms=0.5)
+    try:
+        skip = ModelDelta(version=2, parent=1, rows={
+            "per-user": (np.array([1], np.int64),
+                         np.ones((1, DR), np.float32))})
+        with pytest.raises(BadDelta, match="in order"):
+            svc.apply_delta(skip)
+        assert svc.model_version == 0
+        with pytest.raises(BadDelta, match="non-finite"):
+            svc.apply_delta(ModelDelta(version=1, parent=0, rows={
+                "per-user": (np.array([1], np.int64),
+                             np.full((1, DR), np.nan, np.float32))}))
+        assert svc.model_version == 0
+    finally:
+        svc.close()
+
+
+def test_zero_drop_hot_swap_under_live_traffic():
+    """Requests flow WHILE the swap lands: every future resolves, every
+    score matches exactly the old or the new model's bits, and the
+    versions a request observes are monotone (once a score comes off
+    the new rows, no later one comes off the old) — no dropped and no
+    mixed-version responses."""
+    from photon_ml_tpu.serving import ScoringService
+
+    model = _tiny_model()
+    # One entity, fixed features: the score IS the version fingerprint.
+    reqs = _requests(120, seed=33, entity_fn=lambda i: 7)
+    ids = np.array([7], np.int64)
+    rows = np.random.default_rng(4).normal(
+        size=(1, DR)).astype(np.float32)
+    old_expected = _oracle_serial(model, reqs)
+    new_expected = _oracle_serial(_with_rows(model, ids, rows), reqs)
+    svc = ScoringService(model, max_batch=8, max_wait_ms=0.5)
+    try:
+        futures = []
+        swap_at = 40
+
+        def feed():
+            for i, r in enumerate(reqs):
+                futures.append((i, svc.submit(r)))
+                time.sleep(0.001)
+
+        t = threading.Thread(target=feed)
+        t.start()
+        while len(futures) < swap_at:
+            time.sleep(0.001)
+        svc.apply_delta(ModelDelta(version=1, parent=0,
+                                   rows={"per-user": (ids, rows)}))
+        t.join()
+        got = [(i, float(f.result(timeout=60))) for i, f in futures]
+    finally:
+        svc.close()
+    assert len(got) == len(reqs)  # zero dropped
+    # Live flush shapes vary (1..max_batch), so version membership is
+    # judged by closeness: the two versions' scores differ by O(1)
+    # (a random row swap) while same-version shape jitter is O(ulp).
+    saw_new = False
+    for i, score in got:
+        is_new = abs(score - new_expected[i]) <= 1e-4
+        is_old = abs(score - old_expected[i]) <= 1e-4
+        assert is_new != is_old, \
+            f"request {i} matches neither/both versions ({score})"
+        if is_new:
+            saw_new = True
+        else:
+            assert not saw_new, \
+                f"request {i} served old rows after the swap"
+    assert saw_new  # the swap actually landed mid-stream
+
+
+def test_continuity_proof_n_publishes_equal_offline_full_refit(tmp_path):
+    """END-TO-END continuity: three incremental delta publishes through
+    the live store leave served scores BIT-identical to an offline full
+    refit over the union of the same logged tuples."""
+    from photon_ml_tpu.game.refit import RefitBatch, refit_rows
+    from photon_ml_tpu.serving import ScoringService
+
+    model = _tiny_model()
+    ids, X, y, off = _logged_tuples(seed=13,
+                                    counts=(3, 5, 2, 7, 4, 3, 6, 2, 5,
+                                            3, 4, 6))
+    store = DeltaStore(str(tmp_path))
+    svc = ScoringService(model, max_wait_ms=0.5)
+    probe = _requests(24, seed=44)
+    try:
+        svc.score(probe)  # live traffic before any publish
+        for lo, hi in ((0, 4), (4, 8), (8, 12)):
+            mask = (ids >= lo) & (ids < hi)
+            batch = RefitBatch("userId", "re_userId", ids[mask],
+                               X[mask], y[mask], off[mask])
+            dirty, rows, _ = refit_rows(model, "per-user", batch)
+            delta = store.write({"per-user": (dirty, rows)})
+            svc.apply_delta(store.read(delta.version))
+            svc.score(probe[: 8])  # traffic between publishes
+        assert svc.model_version == 3
+        served = svc.score(probe)
+    finally:
+        svc.close()
+    full = RefitBatch("userId", "re_userId", ids, X, y, off)
+    dirty_f, rows_f, _ = refit_rows(model, "per-user", full)
+    offline = _oracle(_with_rows(model, dirty_f, rows_f), probe)
+    np.testing.assert_array_equal(served, offline)
+
+
+# -------------------------------------------- publisher subprocess chaos
+
+
+def test_sigkill_mid_delta_write_leaves_previous_version(tmp_path):
+    """The photon-game-publish CLI SIGKILLed in the torn window
+    (payload written, marker not): the store still serves the previous
+    version; a clean re-publish commits the same number."""
+    from photon_ml_tpu.game.refit import RefitBatch, save_refit_batch
+    from photon_ml_tpu.models import io as model_io
+
+    model = _tiny_model()
+    model_dir = str(tmp_path / "model")
+    model_io.save_game_model(model, model_dir)
+    ids, X, y, off = _logged_tuples()
+    tuples = str(tmp_path / "tuples.npz")
+    save_refit_batch(tuples, RefitBatch("userId", "re_userId", ids, X,
+                                        y, off))
+    publish_dir = str(tmp_path / "publish")
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="publish.delta_write", kind="kill", occurrences=(1,)),))
+    plan_path = str(tmp_path / "plan.json")
+    atomic_write(plan_path, lambda f: f.write(plan.to_json().encode()))
+    argv = [sys.executable, "-m", "photon_ml_tpu.cli.publish",
+            "--model-dir", model_dir, "--publish-dir", publish_dir,
+            "--refit", f"per-user={tuples}",
+            "--max-iterations", "25"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(argv + ["--fault-plan", plan_path], cwd=REPO,
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert proc.returncode == -9, proc.stdout + proc.stderr
+    store = DeltaStore(publish_dir)
+    assert store.versions() == []  # the torn write is invisible
+    # payload landed but the commit point did not:
+    assert os.path.exists(os.path.join(publish_dir, "delta-v000001",
+                                       "rows.npz"))
+    # A clean rerun commits v1 and it reads back whole.
+    proc = subprocess.run(argv, cwd=REPO, capture_output=True,
+                          text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert store.versions() == [1]
+    delta = store.read(1)
+    validate_delta(delta, {"per-user": (E, DR)})
+    # The publisher's OWN ledger (distinct from a fleet's — one stream,
+    # one writer) kept its rows, append-as-produced.
+    from photon_ml_tpu.obs.ledger import read_rows
+
+    rows, _problems = read_rows(os.path.join(publish_dir,
+                                             "publisher-ledger"))
+    phases = [r.get("phase") for r in rows if r.get("kind") == "publish"]
+    assert "refit" in phases and "delta_write" in phases
+
+
+# --------------------------------------------------- fleet canary ladder
+
+
+def _post(url, path, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get_json(url, path, timeout=10.0):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def publish_fleet(tmp_path_factory):
+    """One 2-replica fleet + the oracle scores of the BASE model (each
+    replica is a JAX interpreter — spawn once; the ladder tests share
+    it and leave it on version their step committed)."""
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+
+    td = tmp_path_factory.mktemp("publish-fleet")
+    model = _tiny_model()
+    model_dir = str(td / "model")
+    model_io.save_game_model(model, model_dir)
+    publish_dir = str(td / "publish")
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir, "--max-wait-ms", "0.5"],
+        num_replicas=2, workdir=str(td / "work"),
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        rehome_deadline_s=5.0, retry_backoff_s=0.1, retries=3,
+        publish_dir=publish_dir, publish_bake_s=0.2)
+    server = None
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        objs = []
+        rng = np.random.default_rng(5)
+        for i in range(10):
+            objs.append({
+                "features": {
+                    "global": rng.normal(size=DG).astype(
+                        np.float32).tolist(),
+                    "re_userId": rng.normal(size=DR).astype(
+                        np.float32).tolist()},
+                "entity_ids": {"userId": int(i % E)}, "uid": i})
+        reqs = _requests(10, seed=5)
+        yield {"fleet": fleet, "url": url, "model": model,
+               "model_dir": model_dir, "publish_dir": publish_dir,
+               "objs": objs, "reqs": reqs,
+               "base_expected": _oracle_serial(model, reqs)}
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
+
+
+def _fleet_scores(env):
+    """Serial singleton posts — the flush shape the oracle uses, so
+    equality is BIT equality (the test_fleet parity discipline)."""
+    return np.asarray(
+        [_post(env["url"], "/score", {"requests": [o]})["scores"][0]
+         for o in env["objs"]], np.float32)
+
+
+def test_fleet_rejects_corrupt_and_nan_deltas(publish_fleet):
+    """Rung 1 and 2 of the ladder: corrupt bytes never leave the
+    artifact layer; CRC-valid NaN rows are refused by the canary
+    replica's validation — either way NO replica's store mutates and
+    served bits stay the base model's."""
+    env = publish_fleet
+    fleet = env["fleet"]
+    store = DeltaStore(env["publish_dir"])
+    # (a) corrupt artifact: DeltaCorrupt before any replica is touched.
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="publish.delta_artifact", kind="corrupt"),))
+    with faults.installed(plan):
+        store.write({"per-user": (np.array([1], np.int64),
+                                  np.ones((1, DR), np.float32))})
+    with pytest.raises(DeltaCorrupt):
+        fleet.publish_delta(store.delta_dir(1))
+    store.retract(1)
+    # (b) NaN rows with a valid CRC: the canary REFUSES (validation),
+    # nothing applied, defined CanaryRejected.
+    events = []
+    ev.default_emitter.register(events.append)
+    try:
+        nan_dir = _forge_delta(
+            env["publish_dir"], 1, 0,
+            {"per-user": (np.array([3], np.int64),
+                          np.full((1, DR), np.nan, np.float32))})
+        with pytest.raises(CanaryRejected, match="non-finite"):
+            fleet.publish_delta(nan_dir)
+    finally:
+        ev.default_emitter.unregister(events.append)
+    store.retract(1)
+    verdicts = [e for e in events if isinstance(e, ev.CanaryVerdict)]
+    assert verdicts and not verdicts[0].accepted
+    for rid in (0, 1):
+        hz = fleet._replica_get_json(rid, "/healthz")
+        assert hz["model_version"] == 0  # no replica ever saw it
+    np.testing.assert_array_equal(_fleet_scores(env),
+                                  env["base_expected"])
+    assert fleet.metrics.snapshot()["canary_rejects_total"] >= 1
+
+
+def test_fleet_canary_probe_rejects_and_rolls_back(publish_fleet):
+    """A finite-but-insane delta passes validation, applies on the
+    canary, fails the probe band — auto-rollback: the canary restores
+    the old rows (bit-exact), the non-canary NEVER applied, and the
+    RollbackExecuted event fires."""
+    env = publish_fleet
+    fleet = env["fleet"]
+    store = DeltaStore(env["publish_dir"])
+    insane = store.write({"per-user": (
+        np.arange(E, dtype=np.int64),
+        np.full((E, DR), 1e6, np.float32))})
+    events = []
+    ev.default_emitter.register(events.append)
+    try:
+        with pytest.raises(CanaryRejected, match="out of band"):
+            fleet.publish_delta(store.delta_dir(insane.version),
+                                probe_objs=env["objs"],
+                                probe_max_abs=1e3)
+    finally:
+        ev.default_emitter.unregister(events.append)
+    store.retract(insane.version)
+    rollbacks = [e for e in events
+                 if isinstance(e, ev.RollbackExecuted)]
+    assert rollbacks and rollbacks[0].version == insane.version
+    for rid in (0, 1):
+        hz = fleet._replica_get_json(rid, "/healthz")
+        assert hz["model_version"] == 0
+    np.testing.assert_array_equal(_fleet_scores(env),
+                                  env["base_expected"])
+    assert fleet.published_version == 0
+
+
+def test_fleet_good_publish_via_front_door(publish_fleet):
+    """The positive leg, through POST /publish (the photon-game-publish
+    HTTP path): canary → bake → fleet-wide swap; served scores flip to
+    the new model's bits on BOTH replicas and the publish ledger +
+    photon_publish_* metrics record it."""
+    env = publish_fleet
+    fleet = env["fleet"]
+    store = DeltaStore(env["publish_dir"])
+    ids = np.arange(0, E, 2, dtype=np.int64)
+    rows = np.random.default_rng(17).normal(
+        size=(len(ids), DR)).astype(np.float32)
+    delta = store.write({"per-user": (ids, rows)})
+    out = _post(env["url"], "/publish",
+                {"path": store.delta_dir(delta.version),
+                 "bake_s": 0.2,
+                 "probe": {"requests": env["objs"],
+                           "max_abs_score": 1e3}})
+    assert out["version"] == delta.version
+    assert sorted(out["replicas"]) == [0, 1]
+    assert out["swap_seconds"] < 30.0
+    expected = _oracle_serial(_with_rows(env["model"], ids, rows),
+                              env["reqs"])
+    np.testing.assert_array_equal(_fleet_scores(env), expected)
+    for rid in (0, 1):
+        hz = fleet._replica_get_json(rid, "/healthz")
+        assert hz["model_version"] == delta.version
+    hz = _get_json(env["url"], "/healthz")
+    assert hz["published_version"] == delta.version
+    metrics_text = urllib.request.urlopen(
+        env["url"] + "/metrics", timeout=10).read().decode()
+    assert f"photon_publish_model_version {delta.version}" \
+        in metrics_text
+    assert "photon_publish_deltas_total 1" in metrics_text
+    assert "photon_publish_swap_seconds" in metrics_text
+    # Publish ledger: the ladder's rows are there and tail --publish
+    # renders them.
+    from photon_ml_tpu.obs.ledger import read_rows
+
+    rows_led, _ = read_rows(os.path.join(env["publish_dir"], "ledger"))
+    phases = [r.get("phase") for r in rows_led
+              if r.get("kind") == "publish"]
+    assert "canary_verdict" in phases and "published" in phases \
+        and "rollback" in phases
+    env["v1"] = (ids, rows)
+    env["v1_version"] = delta.version
+
+
+def test_fleet_swap_fault_rolls_everything_back(publish_fleet):
+    """Chaos at publish.swap (the fleet-wide roll leg): the ladder
+    rolls EVERY applied replica back — the fleet keeps serving the
+    previously published version's bits, consistently."""
+    env = publish_fleet
+    fleet = env["fleet"]
+    store = DeltaStore(env["publish_dir"])
+    v1_ids, v1_rows = env["v1"]
+    before = _fleet_scores(env)
+    ids = np.array([1, 3], np.int64)
+    rows = np.random.default_rng(23).normal(
+        size=(2, DR)).astype(np.float32)
+    delta = store.write({"per-user": (ids, rows)})
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="publish.swap", kind="raise", max_fires=1),))
+    events = []
+    ev.default_emitter.register(events.append)
+    try:
+        with faults.installed(plan) as inj:
+            with pytest.raises(PublishError, match="swap failed"):
+                fleet.publish_delta(store.delta_dir(delta.version),
+                                    bake_s=0.1)
+            assert inj.fires("publish.swap") == 1
+    finally:
+        ev.default_emitter.unregister(events.append)
+    store.retract(delta.version)
+    assert any(isinstance(e, ev.RollbackExecuted) for e in events)
+    assert fleet.published_version == env["v1_version"]
+    for rid in (0, 1):
+        hz = fleet._replica_get_json(rid, "/healthz")
+        assert hz["model_version"] == env["v1_version"]
+    np.testing.assert_array_equal(_fleet_scores(env), before)
+
+
+def test_fleet_canary_apply_fault_is_a_defined_rejection(publish_fleet):
+    """Chaos at publish.canary_apply: an injected failure before the
+    canary POST is an ambiguous apply — the ladder rolls the canary
+    back (a no-op when nothing applied) and rejects, leaving every
+    replica on the published version."""
+    env = publish_fleet
+    fleet = env["fleet"]
+    store = DeltaStore(env["publish_dir"])
+    delta = store.write({"per-user": (np.array([2], np.int64),
+                                      np.ones((1, DR), np.float32))})
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="publish.canary_apply", kind="raise", max_fires=1),))
+    with faults.installed(plan):
+        with pytest.raises(CanaryRejected, match="canary apply failed"):
+            fleet.publish_delta(store.delta_dir(delta.version),
+                                bake_s=0.1)
+    store.retract(delta.version)
+    for rid in (0, 1):
+        hz = fleet._replica_get_json(rid, "/healthz")
+        assert hz["model_version"] == env["v1_version"]
+
+
+def test_obs_tail_publish_renders_the_ladder(publish_fleet, capsys):
+    """`photon-obs tail --publish` over the fleet's publish ledger:
+    delta versions, canary verdicts, rollback events all surface."""
+    from photon_ml_tpu.cli import obs as obs_cli
+
+    env = publish_fleet
+    ledger_dir = os.path.join(env["publish_dir"], "ledger")
+    rc = obs_cli.main(["tail", ledger_dir, "--publish"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"serving v{env['v1_version']}" in out
+    assert "REJECTED" in out and "rollback" in out \
+        and "published" in out
+    rc = obs_cli.main(["tail", ledger_dir, "--publish", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["current_version"] == env["v1_version"]
+    assert doc["rollbacks"] and doc["canary_verdicts"]
