@@ -948,6 +948,124 @@ def bench_stream_pinned(n=1 << 15, d=4096, nnz=16, chunk_rows=1 << 12):
     return out
 
 
+def bench_stream_quant(n=1 << 15, d=4096, nnz=16, chunk_rows=1 << 12,
+                       num_hot=512):
+    """The pinned×quantized scaling matrix (ROADMAP item 3's transfer
+    lever): the streamed pass is transfer-bound, so its wall should
+    track the storage dtype's payload bytes. Stages the SAME rows at
+    f32/bf16/int8, measures pass seconds at 0%% and 100%% pinned per
+    dtype (``stream_quant_matrix_seconds``), and records each dtype's
+    analytic payload per pass next to the ``photon_transfer_bytes_total``
+    counter's measurement of one pass (``stream_quant_metric_bytes_per_
+    pass`` — bench line and metric share provenance, the ≤10%% cross-
+    check check_bench_regression.py gates). ``num_hot=512`` at nnz=16
+    makes the hot block the payload bulk — the flagship regime, where
+    int8 lands ≤0.30× f32. Also counts kernel builds during the timed
+    (post-warmup) passes: must be ZERO (the kernel caches grow a dtype
+    key, not extra steady-state compiles)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+
+    batch, _ = sp.synthetic_sparse(n, d, nnz, seed=7)
+
+    def chunks():
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            yield sp.SparseBatch(
+                indices=np.asarray(batch.indices)[lo:hi],
+                values=np.asarray(batch.values)[lo:hi],
+                labels=np.asarray(batch.labels)[lo:hi],
+                weights=np.asarray(batch.weights)[lo:hi],
+                offsets=np.asarray(batch.offsets)[lo:hi],
+                num_features=d)
+
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    def make_run(vg):
+        def run(iters):
+            w = w0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _, g = vg(w)
+                w = w - 1e-9 * g  # chain: next pass depends on this one
+            np.asarray(w[:8])
+            return time.perf_counter() - t0
+        return run
+
+    out: dict = {
+        "stream_quant_config": f"n={n} d={d} nnz={nnz} "
+                               f"chunk_rows={chunk_rows} "
+                               f"num_hot={num_hot}",
+    }
+    matrix: dict = {}
+    analytic: dict = {}
+    measured: dict = {}
+    transfer_frac: dict = {}
+    warm_builds = 0.0
+    # Metrics on for the byte provenance; restored to off afterwards so
+    # the accounting never perturbs the other bench phases.
+    _, mx = obs.enable(trace=False, metrics=True)
+    try:
+        for dtype in ("float32", "bfloat16", "int8"):
+            chunked = ss.build_chunked(chunks(), d, chunk_rows,
+                                       num_hot=num_hot,
+                                       feature_dtype=dtype)
+            analytic[dtype] = int(
+                sum(ss._chunk_nbytes(ch) for ch in chunked.chunks))
+            vg = ss.make_value_and_gradient(losses.LOGISTIC, chunked)
+            make_run(vg)(1)  # warm-up: compile + first pass
+            counters = obs.parse_prometheus_text(mx.render_text())
+            bytes0 = obs.metric_value(
+                counters, "photon_transfer_bytes_total", default=0.0)
+            secs0 = obs.metric_value(
+                counters, "photon_transfer_seconds_total", default=0.0)
+            builds0 = obs.metric_value(
+                counters, "photon_compile_cache_misses_total",
+                default=0.0)
+            pass_wall = make_run(vg)(1)  # ONE measured pass (counters)
+            counters = obs.parse_prometheus_text(mx.render_text())
+            measured[dtype] = int(obs.metric_value(
+                counters, "photon_transfer_bytes_total",
+                default=0.0) - bytes0)
+            transfer_frac[dtype] = round(
+                (obs.metric_value(counters,
+                                  "photon_transfer_seconds_total",
+                                  default=0.0) - secs0)
+                / max(pass_wall, 1e-9), 4)
+            cells = {}
+            for frac, key in ((0.0, "0"), (1.0, "100")):
+                pinned = ss.pin_chunks(
+                    chunked, int(round(frac * chunked.num_chunks)))
+                vg_p = ss.make_value_and_gradient(losses.LOGISTIC,
+                                                  chunked, pinned=pinned)
+                cells[key] = round(_slope(make_run(vg_p), 2, 8), 4)
+            matrix[dtype] = cells
+            counters = obs.parse_prometheus_text(mx.render_text())
+            warm_builds += obs.metric_value(
+                counters, "photon_compile_cache_misses_total",
+                default=0.0) - builds0
+    finally:
+        obs.disable()
+    out["stream_quant_matrix_seconds"] = matrix
+    out["stream_quant_bytes_per_pass"] = analytic
+    out["stream_quant_metric_bytes_per_pass"] = measured
+    # device_put seconds / pass wall per dtype: the wall band below is
+    # only a quantization claim when the pass is actually transfer-bound
+    # (on a CPU box the "transfer" is a host-side copy and the pass is
+    # compute-bound — check_bench_regression reports instead of gating).
+    out["stream_quant_transfer_fraction"] = transfer_frac
+    out["stream_quant_int8_bytes_ratio_vs_f32"] = round(
+        analytic["int8"] / max(analytic["float32"], 1), 4)
+    out["stream_quant_f32_pass_seconds"] = matrix["float32"]["0"]
+    out["stream_quant_int8_pass_seconds"] = matrix["int8"]["0"]
+    out["stream_quant_warm_compile_misses"] = int(warm_builds)
+    return out
+
+
 def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
     """One GAME coordinate-descent sweep (fixed + per-user + per-item),
     steady-state, by the slope between 1- and 6-iteration runs."""
@@ -1082,6 +1200,8 @@ def main():
     sparse_re = bench_sparse_random_effect()
     _progress("streamed pass: pinned-fraction curve + sharded merge")
     stream = bench_stream_pinned()
+    _progress("streamed pass: pinned x quantized dtype matrix")
+    stream_quant = bench_stream_quant()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
     # Avro ingestion lines ride the fresh-host subprocess suite above
@@ -1118,6 +1238,7 @@ def main():
                 sparse["sparse_hybrid_sharded_samples_per_sec"],
             **sparse_re,
             **stream,
+            **stream_quant,
             **staging,
             **{key: round(v, 1) for key, v in scatter.items()},
             "game_cd_iteration_seconds": round(game_iter_s, 3),

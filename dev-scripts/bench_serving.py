@@ -118,6 +118,23 @@ def build_parser():
                         "hottest ones — their rows are device-cached, "
                         "so the swap exercises LRU invalidation)")
     p.add_argument("--publish-tuples-per-entity", type=int, default=4)
+    # -- quantized-cache sweep (docs/SERVING.md "Quantized device cache") ----
+    p.add_argument("--cache-sweep", action="store_true",
+                   help="sweep the device-LRU storage dtype at a FIXED "
+                        "device-byte budget: f32 vs int8 caches sized to "
+                        "the same HBM spend, one open-loop level each — "
+                        "int8 holds ~4x the entities, so hit rate rises "
+                        "and p99 falls at equal budget (gated by "
+                        "check_bench_regression.py)")
+    p.add_argument("--cache-budget-kb", type=float, default=8.0,
+                   help="device bytes per coordinate the sweep holds "
+                        "fixed across dtypes (cache table + int8 scale "
+                        "vector); small enough by default that the Zipf "
+                        "working set OVERFLOWS the f32 cache — the "
+                        "regime where quadrupled capacity moves the "
+                        "hit rate")
+    p.add_argument("--cache-sweep-qps", type=float, default=200.0)
+    p.add_argument("--cache-sweep-seconds", type=float, default=5.0)
     return p
 
 
@@ -919,8 +936,88 @@ def run_fleet(args, load_seconds_unused=None):
     return out
 
 
+def run_cache_sweep(args):
+    """f32-vs-int8 device LRU at a FIXED HBM budget (ROADMAP item 3's
+    serving half): capacity per dtype = budget // row bytes (f32: 4·d;
+    int8: d + 4 — table row + its scale slot), so the int8 cache holds
+    ~4× the entities of the f32 one on the same spend. One open-loop
+    constant-arrival level per dtype over the SAME Zipf draw; the
+    hit-rate → p99 movement at equal bytes is the BENCH claim
+    (``serving_cache_dtype_sweep``), gated by check_bench_regression.py
+    (int8 capacity ≥ 2× f32, int8 hit rate ≥ f32's)."""
+    from photon_ml_tpu.serving import ScoringService
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    model = build_model(args)
+    make_request = make_request_factory(args)
+    budget = int(args.cache_budget_kb * 1024)
+    row_bytes = {"float32": args.d_re * 4, "int8": args.d_re + 4}
+    sweep = {}
+    for dtype in ("float32", "int8"):
+        capacity = max(args.max_batch, budget // row_bytes[dtype])
+        service = ScoringService(
+            model, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, cache_entities=capacity,
+            cache_dtype=dtype)
+        try:
+            warmup(service, make_request, args)
+            snap0 = service.metrics.snapshot()
+            lv = run_open_loop_level(service, make_request,
+                                     args.cache_sweep_qps,
+                                     args.cache_sweep_seconds,
+                                     args.seed + 31, args.drain_timeout_s)
+            snap1 = service.metrics.snapshot()
+            cache0 = snap0["re_cache"]["per-user"]
+            cache1 = snap1["re_cache"]["per-user"]
+            hits = cache1["hits"] - cache0["hits"]
+            misses = cache1["misses"] - cache0["misses"]
+            sweep[dtype] = {
+                "capacity": int(service.store.random[0].capacity),
+                "device_bytes": service.store.device_cache_bytes(),
+                "hit_rate": round(hits / max(hits + misses, 1), 4),
+                "p99_ms": lv["p99_ms"],
+                "p50_ms": lv["p50_ms"],
+                "ok": lv["ok"],
+                "recompiles": (snap1["compiles_total"]
+                               - snap0["compiles_total"]),
+            }
+            print(f"[cache-sweep] {dtype}: capacity "
+                  f"{sweep[dtype]['capacity']}, hit rate "
+                  f"{sweep[dtype]['hit_rate']:.1%}, p99 "
+                  f"{sweep[dtype]['p99_ms']}ms", file=sys.stderr)
+        finally:
+            service.close()
+    secondary = {
+        "serving_cache_dtype_sweep": sweep,
+        "serving_cache_sweep_budget_bytes": budget,
+        "serving_int8_cache_capacity_ratio": round(
+            sweep["int8"]["capacity"]
+            / max(sweep["float32"]["capacity"], 1), 2),
+        "serving_int8_hit_rate": sweep["int8"]["hit_rate"],
+        "serving_f32_hit_rate": sweep["float32"]["hit_rate"],
+        "serving_cache_sweep_recompiles": (
+            sweep["float32"]["recompiles"] + sweep["int8"]["recompiles"]),
+        "config": f"E={args.num_entities} d_re={args.d_re} "
+                  f"skew={args.entity_skew} budget="
+                  f"{args.cache_budget_kb:g}KiB "
+                  f"qps={args.cache_sweep_qps:g} open-loop",
+    }
+    return {
+        "metric": "serving_int8_cache_capacity_ratio",
+        "value": secondary["serving_int8_cache_capacity_ratio"],
+        "unit": "x",
+        "secondary": secondary,
+    }
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.cache_sweep:
+        out = run_cache_sweep(args)
+        json.dump(out, sys.stdout)
+        print()
+        return 0
     if args.publish:
         out = run_publish(args)
         json.dump(out, sys.stdout)

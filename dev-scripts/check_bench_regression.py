@@ -106,6 +106,12 @@ FLEET_FAILURE_P99_FACTOR = 10.0
 # takes a second has re-staged something, not swapped rows.
 PUBLISH_SWAP_P99_FACTOR = 3.0
 PUBLISH_SWAP_SECONDS_MAX = 1.0
+# Quantized streaming (docs/STREAMING.md): int8 payload vs f32 at
+# matching chunk config — the whole point of the representation — and
+# the minimum device_put fraction of the pass wall for the int8-wall
+# band to be a TRANSFER claim rather than a CPU-convert measurement.
+INT8_BYTES_RATIO_MAX = 0.30
+QUANT_TRANSFER_BOUND_FRACTION = 0.5
 GUARDED = [
     "staging_bucketing_seconds",
     "staging_projection_seconds",
@@ -288,6 +294,110 @@ def main() -> int:
             failures.append(
                 f"stream_pinned_fraction_curve: fully-pinned pass "
                 f"{t100:g}s > {limit:.3g}s — pinning slows the stream")
+    # --- quantized-streaming invariants (docs/STREAMING.md "Quantized
+    # streaming"), within the fresh tail: the int8 chunk format is a
+    # pure transfer-volume play, so its BYTES must land ≤ 0.30× f32 at
+    # matching chunk config, the analytic byte sum must agree with the
+    # photon_transfer_bytes_total measurement of the same pass within
+    # 10% (shared provenance), warm passes must never compile, and —
+    # when the pass is actually transfer-bound — the int8 wall may not
+    # exceed the f32 band (on a compute-bound CPU box the wall line is
+    # reported only, like the <4-core ingest overlap gate).
+    q_bytes = fresh.get("stream_quant_bytes_per_pass")
+    if isinstance(q_bytes, dict) and "float32" in q_bytes \
+            and "int8" in q_bytes:
+        ratio = float(q_bytes["int8"]) / max(float(q_bytes["float32"]),
+                                             1.0)
+        ok = ratio <= INT8_BYTES_RATIO_MAX
+        print(f"stream_quant int8/f32 bytes: {ratio:.4f} (limit "
+              f"{INT8_BYTES_RATIO_MAX:g}) {'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"stream_quant bytes ratio: int8 moves {ratio:.2f}x the "
+                f"f32 payload (> {INT8_BYTES_RATIO_MAX:g}) — the "
+                f"quantized layout stopped being a transfer win")
+        q_meas = fresh.get("stream_quant_metric_bytes_per_pass") or {}
+        for dt, analytic_b in q_bytes.items():
+            meas = q_meas.get(dt)
+            if meas is None:
+                continue
+            denom = max(abs(float(analytic_b)), abs(float(meas)), 1e-9)
+            rel = abs(float(analytic_b) - float(meas)) / denom
+            ok = rel <= METRICS_TOLERANCE
+            print(f"stream_quant[{dt}]: analytic {analytic_b:g}B vs "
+                  f"counter {meas:g}B (delta {rel:.1%}) "
+                  f"{'OK' if ok else 'DISAGREEMENT'}")
+            if not ok:
+                failures.append(
+                    f"stream_quant[{dt}]: analytic byte sum {analytic_b:g}"
+                    f" disagrees with photon_transfer_bytes_total "
+                    f"{meas:g} by {rel:.1%} (> "
+                    f"{METRICS_TOLERANCE:.0%})")
+        misses = fresh.get("stream_quant_warm_compile_misses")
+        if misses is not None:
+            ok = int(misses) == 0
+            print(f"stream_quant_warm_compile_misses: {misses} "
+                  f"(must be 0) {'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"stream_quant_warm_compile_misses: {misses} — a "
+                    f"warmed quantized stream recompiled (the dtype key "
+                    f"broke the one-program-per-stream invariant)")
+        t_f32 = fresh.get("stream_quant_f32_pass_seconds")
+        t_int8 = fresh.get("stream_quant_int8_pass_seconds")
+        frac = (fresh.get("stream_quant_transfer_fraction") or {}).get(
+            "float32")
+        if t_f32 is not None and t_int8 is not None:
+            limit = float(t_f32) * band
+            bound = (frac is not None
+                     and float(frac) >= QUANT_TRANSFER_BOUND_FRACTION)
+            ok = float(t_int8) <= limit
+            verdict = ("OK" if ok else
+                       "REGRESSION" if bound else
+                       "over limit (reported only: pass is compute-"
+                       f"bound, transfer fraction {frac})")
+            print(f"stream_quant_int8_pass_seconds: {t_int8:g}s vs f32 "
+                  f"{t_f32:g}s (limit {limit:.3g}) {verdict}")
+            if bound and not ok:
+                failures.append(
+                    f"stream_quant_int8_pass_seconds: {t_int8:g}s > "
+                    f"{limit:.3g}s on a transfer-bound pass — the "
+                    f"quantized stream is slower than the f32 one")
+
+    # --- quantized device-LRU invariants (docs/SERVING.md "Quantized
+    # device cache"): at a fixed HBM budget the int8 cache must hold
+    # ≥ 2× the entities and its hit rate may never fall below f32's
+    # (equal capacity utility is the floor; the win grows with skew).
+    cache_sweep = fresh.get("serving_cache_dtype_sweep")
+    if isinstance(cache_sweep, dict) and "float32" in cache_sweep \
+            and "int8" in cache_sweep:
+        cap_ratio = (cache_sweep["int8"]["capacity"]
+                     / max(cache_sweep["float32"]["capacity"], 1))
+        ok = cap_ratio >= 2.0
+        print(f"serving int8 cache capacity ratio: {cap_ratio:.2f}x "
+              f"(floor 2x) {'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"serving_cache_dtype_sweep: int8 holds only "
+                f"{cap_ratio:.2f}x the f32 entities at equal bytes "
+                f"(< 2x) — the quantized cache stopped paying")
+        h32 = float(cache_sweep["float32"]["hit_rate"])
+        h8 = float(cache_sweep["int8"]["hit_rate"])
+        ok = h8 >= h32 - 1e-6
+        print(f"serving int8 hit rate: {h8:.4f} vs f32 {h32:.4f} at "
+              f"equal HBM {'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"serving_cache_dtype_sweep: int8 hit rate {h8:.4f} < "
+                f"f32 {h32:.4f} at equal HBM budget — more capacity "
+                f"must never cache worse")
+        rec = fresh.get("serving_cache_sweep_recompiles")
+        if rec is not None and int(rec) != 0:
+            print(f"serving_cache_sweep_recompiles: {rec} REGRESSION")
+            failures.append(
+                f"serving_cache_sweep_recompiles: {rec} — the "
+                f"quantized scorer recompiled in steady state")
+
     sh = fresh.get("stream_sharded_pass_seconds")
     single = fresh.get("stream_single_pass_seconds")
     devs = int(fresh.get("stream_sharded_devices", 0))

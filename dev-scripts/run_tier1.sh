@@ -33,8 +33,11 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 
 # Trace smoke (docs/OBSERVABILITY.md): a tiny traced game_train run must
 # yield a Chrome-loadable trace whose spans nest and whose bridged
-# Start/Finish pairs all closed. Seconds on CPU; catches a broken
-# observability layer before it reaches a 90-minute flagship run.
+# Start/Finish pairs all closed, then a second streamed run at
+# --streaming dtype=int8 must tag every transfer counter/span with its
+# dtype and hold the kernel-build count at warmup levels
+# (docs/STREAMING.md "Quantized streaming"). Seconds on CPU; catches a
+# broken observability layer before it reaches a 90-minute flagship run.
 if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/trace_smoke.py; rc=$?
 fi

@@ -94,6 +94,73 @@ def main() -> int:
               f"{meta['bridge_spans_closed']} bridged scopes closed, "
               f"wall {s['wall_seconds']:.2f}s, top-level coverage "
               f"{s['top_level_coverage']:.0%}")
+    return _streamed_int8_smoke()
+
+
+def _streamed_int8_smoke() -> int:
+    """The quantized-streaming leg (ISSUE 13 satellite): a tiny traced
+    ``game_train --streaming dtype=int8`` run must (1) verify like any
+    trace, (2) tag its transfer counters/spans with dtype="int8", (3)
+    surface the per-dtype attribution in `photon-obs summarize`, and
+    (4) keep the streamed-kernel build count at warmup levels — the
+    dtype key must not recompile steady state."""
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.cli.obs import (load_trace, summarize_trace,
+                                       verify_trace)
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.data.io import save_game_dataset
+    from photon_ml_tpu.data.sparse import synthetic_sparse
+    from photon_ml_tpu.obs.metrics import (metric_value,
+                                           parse_prometheus_text)
+
+    batch, _ = synthetic_sparse(512, 48, 4, seed=5)
+    with tempfile.TemporaryDirectory(prefix="pml_trace_smoke8_") as td:
+        train_dir = os.path.join(td, "train")
+        save_game_dataset(from_sparse_batch(batch), train_dir)
+        trace_path = os.path.join(td, "trace.json")
+        metrics_path = os.path.join(td, "metrics.prom")
+        game_train.run(game_train.build_parser().parse_args([
+            "--train", train_dir,
+            "--coordinate", "name=fixed,type=fixed,shard=global",
+            "--update-sequence", "fixed",
+            "--iterations", "1",
+            "--opt-config", "fixed:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+            "--streaming", "chunk_rows=128,num_hot=8,dtype=int8",
+            "--output-dir", os.path.join(td, "out"),
+            "--trace-out", trace_path,
+            "--metrics-dump", metrics_path,
+        ]))
+        trace = load_trace(trace_path)
+        problems = verify_trace(trace)
+        if problems:
+            print("int8 stream trace verification FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert "stream.pass" in names, \
+            f"no stream.pass span — the run never streamed ({names})"
+        parsed = parse_prometheus_text(open(metrics_path).read())
+        int8_bytes = parsed.get(
+            'photon_transfer_bytes_total{dtype="int8",kind="stream"}')
+        assert int8_bytes and int8_bytes > 0, \
+            f"no dtype=int8 transfer counter in dump: {sorted(parsed)}"
+        total = metric_value(parsed, "photon_transfer_bytes_total")
+        assert total == int8_bytes, \
+            f"non-int8 stream bytes moved ({total} vs {int8_bytes})"
+        builds = metric_value(parsed, "photon_compile_cache_misses_total",
+                              default=0.0)
+        assert builds <= 3, \
+            f"{builds} streamed-kernel builds — int8 recompiled past " \
+            f"warmup (expected ≤ 3: value_grad, value_only, psum merge)"
+        by_dtype = summarize_trace(trace)["attribution"][
+            "transfer_by_dtype"]
+        assert set(by_dtype) == {"int8"}, by_dtype
+        assert by_dtype["int8"]["bytes"] == int8_bytes, by_dtype
+        print(f"int8 stream smoke ok: {by_dtype['int8']['chunks']} chunk "
+              f"transfers, {int8_bytes:.0f} bytes all at dtype=int8, "
+              f"{builds:.0f} kernel builds")
     return 0
 
 

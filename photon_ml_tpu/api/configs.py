@@ -55,7 +55,9 @@ class StreamingConfig:
     chunk storage dtype — None inherits the coordinate's
     ``FixedEffectDataConfiguration.feature_dtype``; "bfloat16" halves
     the host→device stream, the steady-state cost of every objective
-    evaluation. ``prefetch_depth``: transfers in flight ahead of compute
+    evaluation, and "int8" (symmetric per-column quantization, f32
+    accumulation — docs/STREAMING.md "Quantized streaming") quarters
+    it. ``prefetch_depth``: transfers in flight ahead of compute
     per device. ``pin_chunks``: leading chunks pinned resident PER
     DEVICE (spare HBM traded for stream traffic). ``workers``: staging
     canonicalization threads (None = host cores).
@@ -74,10 +76,11 @@ class StreamingConfig:
                 f"chunk_rows must be >= 1, got {self.chunk_rows}")
         if self.num_hot < 1:
             raise ValueError(f"num_hot must be >= 1, got {self.num_hot}")
-        if self.feature_dtype not in (None, "float32", "bfloat16"):
+        if self.feature_dtype not in (None, "float32", "bfloat16",
+                                      "int8"):
             raise ValueError(
                 f"unsupported feature_dtype {self.feature_dtype!r}; "
-                "expected float32 or bfloat16")
+                "expected float32, bfloat16, or int8")
         if self.prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
@@ -307,10 +310,10 @@ def parse_streaming_config(spec: str) -> StreamingConfig:
     every default.
 
     Keys: chunk_rows (rows per streamed chunk), num_hot (hot-dense
-    columns per chunk), dtype (float32|bfloat16 chunk storage; default
-    inherits the coordinate's dtype), depth (prefetch transfers in
-    flight per device), pin (leading chunks pinned per device), workers
-    (staging canonicalization threads).
+    columns per chunk), dtype (float32|bfloat16|int8 chunk storage;
+    default inherits the coordinate's dtype), depth (prefetch transfers
+    in flight per device), pin (leading chunks pinned per device),
+    workers (staging canonicalization threads).
     """
     kv = parse_kv(spec)
     known = {"chunk_rows", "num_hot", "dtype", "depth", "pin", "workers"}

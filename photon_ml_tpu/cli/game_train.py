@@ -173,8 +173,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "host RAM, not HBM; the fit checkpoints mid-"
                         "optimization. Optional mini-DSL "
                         "'chunk_rows=262144,num_hot=512,"
-                        "dtype=float32|bfloat16,depth=2,pin=0,workers=8' "
-                        "(bare --streaming takes every default)")
+                        "dtype=float32|bfloat16|int8,depth=2,pin=0,"
+                        "workers=8' (bare --streaming takes every "
+                        "default; dtype=int8 quarters the streamed "
+                        "bytes — symmetric per-column quantization with "
+                        "f32 accumulation, docs/STREAMING.md)")
     p.add_argument("--ingest-cache-dir",
                    help="persist decoded Avro columns here (columnar "
                         "mmap ingest cache, keyed by file identity + "

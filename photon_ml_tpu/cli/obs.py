@@ -161,6 +161,21 @@ def summarize_trace(trace: dict, top: int = 12) -> dict:
                       if e.get("cat") == "transfer")
     pass_us = sum(e["dur"] for e in spans
                   if e["name"] == "stream.pass")
+    # Per-dtype attribution: every chunk-transfer span carries its
+    # chunk's storage dtype (f32/bf16/int8 — the quantized-streaming
+    # lever), so the stream's byte/second split per dtype falls out of
+    # the same spans (counter counterpart:
+    # photon_transfer_bytes_total{kind="stream",dtype=...}).
+    by_dtype: dict = {}
+    for e in spans:
+        if e.get("cat") != "transfer":
+            continue
+        args = e.get("args", {})
+        d = by_dtype.setdefault(str(args.get("dtype", "unknown")),
+                                {"seconds": 0.0, "bytes": 0, "chunks": 0})
+        d["seconds"] += e["dur"] / 1e6
+        d["bytes"] += int(args.get("bytes", 0) or 0)
+        d["chunks"] += 1
     denom = pass_us if pass_us > 0 else wall_us
     attribution = {
         "transfer_seconds": transfer_us / 1e6,
@@ -168,6 +183,7 @@ def summarize_trace(trace: dict, top: int = 12) -> dict:
         "wall_seconds": wall_us / 1e6,
         "transfer_fraction_of_stream": transfer_us / denom,
         "transfer_fraction_of_wall": transfer_us / wall_us,
+        "transfer_by_dtype": by_dtype,
     }
     root_cover = sum(e["dur"] for e in roots)
     return {
@@ -298,6 +314,10 @@ def render_summary(summary: dict) -> str:
                f"{a['stream_pass_seconds']:.3f}s streamed-pass time "
                f"({a['transfer_fraction_of_stream']:.1%}); "
                f"{a['transfer_fraction_of_wall']:.1%} of wall")
+    for dt, d in sorted(a.get("transfer_by_dtype", {}).items()):
+        out.append(f"    dtype={dt:<9} {d['seconds']:.3f}s  "
+                   f"{d['bytes'] / 2**20:.2f} MiB over "
+                   f"{d['chunks']} chunk transfer(s)")
     return "\n".join(out)
 
 

@@ -36,6 +36,7 @@ CHECKPOINT_ARTIFACT = "checkpoint.artifact"  # corrupt_file
 # -- streamed fixed-effect path (ops/streaming_sparse.py, optim/streaming.py,
 #    game/checkpoint.py StreamingStateStore) ---------------------------------
 STREAM_CHUNK_TRANSFER = "stream.chunk_transfer"
+STREAM_QUANTIZE = "stream.quantize"  # corrupt_file (staged-chunk store)
 STREAM_OBJECTIVE = "stream.objective"  # poison_scalar (nan kind)
 STREAM_CHECKPOINT_WRITE = "stream.checkpoint_write"
 STREAM_CHECKPOINT_LOAD = "stream.checkpoint_load"

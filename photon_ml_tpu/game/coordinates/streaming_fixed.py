@@ -202,8 +202,7 @@ class StreamingSparseFixedEffectCoordinate:
                                      streaming.chunk_rows),
                 int(shard.num_features), streaming.chunk_rows,
                 num_hot=streaming.num_hot,
-                feature_dtype=(jnp.bfloat16 if dtype == "bfloat16"
-                               else jnp.float32),
+                feature_dtype=ss.feature_dtype_name(dtype),
                 workers=workers, log=log)
         finally:
             # Balanced lifecycle (PML007): staging failures still close

@@ -31,6 +31,23 @@ COMPILATION with HBM overflows before any data moved):
 Every chunk has identical array shapes by construction ((n, H), (H,),
 (n, k)), so the WHOLE stream shares ONE compiled program — per-structure
 compiles are multi-minute remote operations in this environment.
+
+**int8 quantized storage (docs/STREAMING.md "Quantized streaming").**
+The streamed pass is transfer-bound (~95% host→device at n=100M), so
+the storage dtype of the chunk payload IS the pass cost. Beyond the
+bf16 half-stream, ``feature_dtype="int8"`` stores ``X_hot`` and
+``cold_vals`` as symmetric per-column affine int8 — q = round(x / s),
+s = max|column| / 127, zero-point pinned at 0 so sparse zeros stay
+EXACT — with f32 scale vectors riding each chunk (``hot_scale`` per hot
+column, ``cold_scale`` per original column). Dequantization happens
+ON DEVICE inside the jitted chunk kernels, and never materializes a
+dense f32 block: the margins pass folds the scales into the coefficient
+gathers (w·(s·q) = (w·s)·q), and the gradient pass scatters raw r·q
+sums and scales the (d+1,) accumulator once at the end — O(d + H)
+dequant flops against an O(n·k) transfer saved. Accumulation stays f32
+throughout, so the compiled program count is unchanged (the kernel
+caches grow a dtype key) and the measured ``photon_transfer_bytes_total``
+per pass drops ~4× vs f32 (~2× vs bf16).
 """
 
 from __future__ import annotations
@@ -66,7 +83,12 @@ TRANSFER_RETRY_BACKOFF_S = 0.05
 @dataclasses.dataclass(frozen=True)
 class CanonicalChunk:
     """One chunk: hot-dense block + cold ELL (leaves may be host numpy —
-    device placement happens at stream time)."""
+    device placement happens at stream time).
+
+    Under int8 storage ``X_hot``/``cold_vals`` hold the quantized codes
+    and the two scale vectors are present (``quantized`` is True); the
+    scheme is symmetric (zero-point ≡ 0), so a zero entry is exactly the
+    code 0 and the pad/hot-inert slots stay inert without masks."""
 
     X_hot: Array  # (n, H) — the chunk's top-H columns, densified
     hot_cols: Array  # (H,) int32 original column ids (pad == d)
@@ -76,6 +98,10 @@ class CanonicalChunk:
     weights: Array  # (n,); 0 marks pad rows of a short final chunk
     offsets: Array  # (n,)
     num_features: int = dataclasses.field(metadata=dict(static=True))
+    # int8 mode only (None otherwise): per-hot-column and per-original-
+    # column f32 dequantization scales (x ≈ scale · q, zero-point 0).
+    hot_scale: Optional[Array] = None  # (H,)
+    cold_scale: Optional[Array] = None  # (d + 1,); sentinel col == 0
 
     @property
     def num_rows(self) -> int:
@@ -85,12 +111,17 @@ class CanonicalChunk:
     def num_hot(self) -> int:
         return self.X_hot.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.cold_scale is not None
+
     def structure(self):
         """Shape signature — equal signatures share one compiled program.
-        Identical across chunks by construction; kept for the invariant
-        test."""
+        Identical across chunks by construction (the storage dtype is
+        part of the signature: a mixed-dtype stream would silently
+        compile two programs); kept for the invariant test."""
         return (self.X_hot.shape, self.cold_cols.shape,
-                self.num_features)
+                self.num_features, chunk_dtype(self))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,13 +146,87 @@ class ChunkedHybrid:
         return len(self.chunks)
 
 
+# Chunk-storage dtype → per-value payload bytes. int8 columns also carry
+# one f32 scale each (the symmetric-quantization dequant vector), so the
+# HBM plan charges it per column — at streaming chunk_rows the 4 bytes
+# per column are noise, but a plan that ignores them would overshoot a
+# tight budget on many-column/few-row configs.
+FEATURE_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
+_SCALE_BYTES_PER_COLUMN = {"float32": 0, "bfloat16": 0, "int8": 4}
+INT8_QMAX = 127.0  # symmetric: codes span [-127, 127], zero-point 0
+
+
+def feature_dtype_name(feature_dtype) -> str:
+    """Canonical name of a chunk-storage dtype spec (string, numpy/jax
+    dtype, or None = float32). Unknown dtypes raise — a silent f32
+    fallback would quietly quadruple a stream someone sized for int8."""
+    if feature_dtype is None:
+        return "float32"
+    if isinstance(feature_dtype, str):
+        name = feature_dtype.lower()
+    else:
+        try:
+            name = np.dtype(feature_dtype).name
+        except TypeError:
+            name = str(feature_dtype)
+    if name not in FEATURE_ITEMSIZE:
+        raise ValueError(
+            f"unsupported streaming feature_dtype {feature_dtype!r}; "
+            f"expected one of {sorted(FEATURE_ITEMSIZE)}")
+    return name
+
+
+def chunk_dtype(ch: "CanonicalChunk") -> str:
+    """The storage dtype a staged chunk actually carries."""
+    if ch.cold_scale is not None:
+        return "int8"
+    if np.dtype(ch.X_hot.dtype) == np.dtype(jnp.bfloat16):
+        return "bfloat16"
+    return "float32"
+
+
 def plan_num_hot(chunk_rows: int, hot_block_bytes: int,
                  feature_dtype) -> int:
     """Hot-block width that fits the byte budget: at streaming scale the
-    binding constraint is HBM (block bytes = chunk_rows × H × dtype),
-    not the throughput-optimal split of hybrid_sparse."""
-    bytes_per = 2 if feature_dtype == jnp.bfloat16 else 4
-    return max(8, int(hot_block_bytes) // (chunk_rows * bytes_per))
+    binding constraint is HBM (block bytes = chunk_rows × H × itemsize,
+    plus the per-column scale under int8), not the throughput-optimal
+    split of hybrid_sparse."""
+    name = feature_dtype_name(feature_dtype)
+    per_column = (chunk_rows * FEATURE_ITEMSIZE[name]
+                  + _SCALE_BYTES_PER_COLUMN[name])
+    return max(8, int(hot_block_bytes) // per_column)
+
+
+def quantize_rows_int8(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-ROW int8 quantization: q = round(x / s) with
+    s = max|row| / 127 (all-zero rows keep scale 0 and code 0, so
+    dequantization is exact for them). Shared by the chunk hot block
+    (transposed) and the serving device-LRU fill path."""
+    x = np.asarray(x, np.float32)
+    scale = np.abs(x).max(axis=-1) / INT8_QMAX if x.size else \
+        np.zeros(x.shape[:-1], np.float32)
+    scale = np.asarray(scale, np.float32)
+    denom = np.where(scale > 0.0, scale, 1.0)
+    q = np.clip(np.rint(x / denom[..., None]), -INT8_QMAX,
+                INT8_QMAX).astype(np.int8)
+    return q, scale
+
+
+def _quantize_cold_int8(cold_vals: np.ndarray, cold_cols: np.ndarray,
+                        d: int) -> tuple[np.ndarray, np.ndarray]:
+    """Per-ORIGINAL-column symmetric int8 over a chunk's cold ELL: the
+    scale table is (d + 1,) so the kernels can gather it exactly like
+    the padded coefficient vector (per-slot, 1-D — the layout rules).
+    Inert entries all point at the sentinel column d, whose scale stays
+    0 by construction (their stored value is exactly 0)."""
+    amax = np.zeros(d + 1, np.float32)
+    np.maximum.at(amax, cold_cols.reshape(-1),
+                  np.abs(cold_vals).reshape(-1))
+    scale = amax / INT8_QMAX
+    denom = np.where(scale > 0.0, scale, 1.0)
+    q = np.clip(np.rint(cold_vals / denom[cold_cols]), -INT8_QMAX,
+                INT8_QMAX).astype(np.int8)
+    return q, scale
 
 
 def _build_canonical(raw, d: int, num_hot: int,
@@ -161,7 +266,9 @@ def _build_canonical(raw, d: int, num_hot: int,
     cold_cols = np.where(dead, d, indices).astype(np.int32)
     cold_vals = np.where(dead, 0.0, values).astype(np.float32)
 
-    if feature_dtype == jnp.bfloat16:
+    dtype_name = feature_dtype_name(feature_dtype)
+    hot_scale = cold_scale = None
+    if dtype_name == "bfloat16":
         # Host-side cast halves the host→device stream — which IS the
         # steady-state cost of every streamed objective evaluation.
         # Values are storage (products upcast to f32 in-kernel).
@@ -169,11 +276,20 @@ def _build_canonical(raw, d: int, num_hot: int,
 
         X_hot = X_hot.astype(ml_dtypes.bfloat16)
         cold_vals = cold_vals.astype(ml_dtypes.bfloat16)
+    elif dtype_name == "int8":
+        # Symmetric per-column int8: quarters the stream vs f32. The hot
+        # block quantizes per hot column (transpose into the per-row
+        # helper); the cold ELL per original column so the scale table
+        # gathers like w_pad.
+        q_hot, hot_scale = quantize_rows_int8(X_hot.T)
+        X_hot = np.ascontiguousarray(q_hot.T)
+        cold_vals, cold_scale = _quantize_cold_int8(cold_vals, cold_cols,
+                                                    d)
     return CanonicalChunk(
         X_hot=X_hot, hot_cols=hot_cols, cold_cols=cold_cols,
         cold_vals=cold_vals, labels=np.asarray(raw.labels),
         weights=np.asarray(raw.weights), offsets=np.asarray(raw.offsets),
-        num_features=d)
+        num_features=d, hot_scale=hot_scale, cold_scale=cold_scale)
 
 
 def build_chunked(
@@ -329,7 +445,20 @@ def _masked(weights: Array, term: Array) -> Array:
 def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array,
                       offsets: Array) -> Array:
     """(n,) wᵀx + offset. Hot: one MXU matvec. Cold: one 1-D gather per
-    ELL slot (per-slot, 1-D — see the module docstring's layout rules)."""
+    ELL slot (per-slot, 1-D — see the module docstring's layout rules).
+
+    int8 dequant prologue: the per-column scales FOLD into the
+    coefficient side — w·(s·q) = (w·s)·q — so the quantized codes feed
+    the same matvec/gathers with f32 accumulation and no dense f32
+    block is ever materialized."""
+    if ch.cold_scale is not None:
+        w_cold = w_pad * ch.cold_scale
+        w_hot = w_pad[ch.hot_cols] * ch.hot_scale
+        z = offsets + _hot_matvec(ch.X_hot.astype(jnp.float32), w_hot)
+        for j in range(ch.cold_cols.shape[1]):
+            z = z + w_cold[ch.cold_cols[:, j]] * \
+                ch.cold_vals[:, j].astype(jnp.float32)
+        return z
     z = offsets + _hot_matvec(ch.X_hot, w_pad[ch.hot_cols])
     for j in range(ch.cold_cols.shape[1]):
         z = z + w_pad[ch.cold_cols[:, j]] * \
@@ -340,7 +469,21 @@ def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array,
 def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
     """Σᵢ rᵢ·xᵢ in original space: hot rmatvec + one (d+1,)-table
     scatter-add per cold ELL slot (pad entries land on the sentinel
-    column d and are dropped)."""
+    column d and are dropped).
+
+    int8 dequant prologue: scatter the RAW r·q sums, then scale the
+    (d+1,) accumulator once per column (g_col = s_col · Σ r·q) — the
+    dequant costs O(d + H) per chunk instead of O(n·k)."""
+    if ch.cold_scale is not None:
+        acc = jnp.zeros((ch.num_features + 1,), jnp.float32)
+        for j in range(ch.cold_cols.shape[1]):
+            acc = acc.at[ch.cold_cols[:, j]].add(
+                r * ch.cold_vals[:, j].astype(jnp.float32))
+        acc = acc * ch.cold_scale
+        g_hot = _hot_rmatvec(ch.X_hot.astype(jnp.float32), r) * \
+            ch.hot_scale
+        acc = acc.at[ch.hot_cols].add(g_hot.astype(jnp.float32))
+        return acc[:ch.num_features]
     acc = jnp.zeros((ch.num_features + 1,), jnp.float32)
     for j in range(ch.cold_cols.shape[1]):
         acc = acc.at[ch.cold_cols[:, j]].add(
@@ -350,29 +493,33 @@ def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
     return acc[:ch.num_features]
 
 
-# Kernels are cached per loss (and the margins kernel is a singleton):
-# a fresh @jax.jit wrapper per call would re-trace the chunk program on
-# every coordinate-descent update.
+# Kernels are cached per (loss, storage dtype) — the dtype key is how
+# quantized streams keep the one-program-per-stream accounting honest
+# (an int8 chunk IS a different compiled program; without the key the
+# jit dispatch would compile it silently past the miss counter). The
+# margins kernel stays a singleton (jit dispatches on chunk structure).
 _VG_KERNELS: dict = {}
 _V_KERNELS: dict = {}
 
 
-def _count_kernel_build(cache: str) -> None:
+def _count_kernel_build(cache: str, dtype: str) -> None:
     """One streamed-kernel program cache missed — a fresh trace/compile.
-    Steady state should show exactly one build per (loss, cache); a
-    climbing counter means the one-program-per-stream invariant broke."""
+    Steady state should show exactly one build per (loss, cache, dtype);
+    a climbing counter means the one-program-per-stream invariant
+    broke."""
     mx = obs.metrics()
     if mx is not None:
-        mx.counter("photon_compile_cache_misses_total", cache=cache).inc()
+        mx.counter("photon_compile_cache_misses_total", cache=cache,
+                   dtype=dtype).inc()
 
 
-def _chunk_value_grad(loss: PointwiseLoss):
+def _chunk_value_grad(loss: PointwiseLoss, dtype: str = "float32"):
     """One jitted per-chunk pass: original-space w in, original-space
     (value, grad) out — shared by every chunk (identical structures)."""
-    f = _VG_KERNELS.get(loss.name)
+    f = _VG_KERNELS.get((loss.name, dtype))
     if f is not None:
         return f
-    _count_kernel_build("stream_value_grad")
+    _count_kernel_build("stream_value_grad", dtype)
 
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
@@ -383,21 +530,21 @@ def _chunk_value_grad(loss: PointwiseLoss):
         r = _masked(ch.weights, dl)
         return value, _chunk_rowterm_grad(ch, r)
 
-    _VG_KERNELS[loss.name] = f
+    _VG_KERNELS[(loss.name, dtype)] = f
     return f
 
 
-def _chunk_value(loss: PointwiseLoss):
+def _chunk_value(loss: PointwiseLoss, dtype: str = "float32"):
     """Value-ONLY per-chunk pass: the margins + loss sum of
     ``_chunk_value_grad`` without the gradient half (the hot rmatvec and
     the per-slot cold scatter-adds — the dominant compute of a chunk
     pass). Armijo line-search probes only need the value to gate
     acceptance (ADVICE r5), so probing with this kernel skips the
     gradient work on every rejected step."""
-    f = _V_KERNELS.get(loss.name)
+    f = _V_KERNELS.get((loss.name, dtype))
     if f is not None:
         return f
-    _count_kernel_build("stream_value_only")
+    _count_kernel_build("stream_value_only", dtype)
 
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
@@ -406,7 +553,7 @@ def _chunk_value(loss: PointwiseLoss):
         l, _ = loss.loss_and_dz(z, ch.labels)
         return jnp.sum(_masked(ch.weights, l))
 
-    _V_KERNELS[loss.name] = f
+    _V_KERNELS[(loss.name, dtype)] = f
     return f
 
 
@@ -488,12 +635,17 @@ def _transfer(ch: CanonicalChunk, index: int,
 
 def _accounted_transfer(ch, index: int, device, mx, tr):
     """The traced/metered half of :func:`_transfer` (split out so the
-    off path stays one None check)."""
+    off path stays one None check). The transfer family is tagged with
+    the chunk's storage dtype — `photon-obs summarize` attributes the
+    stream per dtype, and the quantization bench's byte claims share
+    provenance with these counters (readers that don't care sum the
+    label family via ``obs.metric_value``)."""
     nbytes = _chunk_nbytes(ch)
+    dtype = chunk_dtype(ch)
     t0 = time.perf_counter()
     if tr is not None:
         with tr.span("stream.chunk_transfer", cat="transfer",
-                     index=index, bytes=nbytes):
+                     index=index, bytes=nbytes, dtype=dtype):
             out = (jax.device_put(ch, device) if device is not None
                    else jax.device_put(ch))
     else:
@@ -501,10 +653,12 @@ def _accounted_transfer(ch, index: int, device, mx, tr):
                else jax.device_put(ch))
     if mx is not None:
         dt = time.perf_counter() - t0
-        mx.counter("photon_transfer_bytes_total", kind="stream").inc(
-            nbytes)
-        mx.counter("photon_transfer_seconds_total", kind="stream").inc(dt)
-        mx.counter("photon_transfer_chunks_total", kind="stream").inc()
+        mx.counter("photon_transfer_bytes_total", kind="stream",
+                   dtype=dtype).inc(nbytes)
+        mx.counter("photon_transfer_seconds_total", kind="stream",
+                   dtype=dtype).inc(dt)
+        mx.counter("photon_transfer_chunks_total", kind="stream",
+                   dtype=dtype).inc()
         mx.gauge("photon_stream_inflight_chunks").inc()
     return out
 
@@ -576,6 +730,124 @@ def pin_chunks(chunked: ChunkedHybrid, count: int):
                  for ch in chunked.chunks[:max(0, count)])
 
 
+# ----------------------------------------------------------- chunk store
+#
+# Staged-chunk persistence (the staging_cache/ingest-cache v3 discipline,
+# docs/ROBUSTNESS.md): one npz per chunk written atomically, a CRC32-
+# carrying ``.ok`` commit marker per chunk written after it, and a
+# ``meta.json`` completion record written LAST. The payload round-trips
+# BIT-stable for every storage dtype (the int8 codes and their scale
+# vectors are exact bytes — quantization happens once, at staging). A
+# chunk whose bytes fail the committed CRC (bit rot, a torn write, an
+# injected ``stream.quantize`` fault) degrades to a re-stage of exactly
+# that chunk via the caller's ``rebuild`` hook — never a silently wrong
+# objective, never a whole-stream restage.
+
+CHUNK_STORE_VERSION = 1
+_CHUNK_FIELDS = ("X_hot", "hot_cols", "cold_cols", "cold_vals", "labels",
+                 "weights", "offsets", "hot_scale", "cold_scale")
+
+
+class ChunkStoreError(RuntimeError):
+    """A persisted chunk stream that cannot be served and cannot be
+    rebuilt (no ``rebuild`` hook was provided)."""
+
+
+def save_chunked(directory: str, chunked: ChunkedHybrid) -> None:
+    """Persist a staged ``ChunkedHybrid`` under ``directory``."""
+    import json
+    import os
+
+    from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
+
+    os.makedirs(directory, exist_ok=True)
+    for i, ch in enumerate(chunked.chunks):
+        path = os.path.join(directory, f"chunk_{i}.npz")
+        arrays = {name: np.asarray(getattr(ch, name))
+                  for name in _CHUNK_FIELDS
+                  if getattr(ch, name) is not None}
+        atomic_write(path, lambda f, _a=arrays: np.savez(f, **_a))
+        crc = file_crc32(path)
+        # Injected bit rot lands AFTER the checksum was taken over the
+        # good bytes — the torn-page/bit-rot shape the CRC must catch.
+        flt.corrupt_file(flt.sites.STREAM_QUANTIZE, path, index=i)
+        marker = json.dumps({
+            "version": CHUNK_STORE_VERSION, "crc": crc,
+            "fields": sorted(arrays),
+            "num_features": int(ch.num_features)}).encode()
+        atomic_write(os.path.join(directory, f"chunk_{i}.ok"),
+                     lambda f, _m=marker: f.write(_m))
+    meta = json.dumps({
+        "version": CHUNK_STORE_VERSION, "num_rows": int(chunked.num_rows),
+        "chunk_rows": int(chunked.chunk_rows),
+        "num_chunks": int(chunked.num_chunks),
+        "dtype": chunk_dtype(chunked.chunks[0])}).encode()
+    atomic_write(os.path.join(directory, "meta.json"),
+                 lambda f: f.write(meta))
+
+
+def _load_stored_chunk(directory: str, i: int) -> Optional[CanonicalChunk]:
+    """One committed chunk, or None on any miss (no marker, version
+    skew, CRC mismatch, unreadable npz) — the caller degrades to a
+    single-chunk re-stage."""
+    import json
+    import os
+
+    from photon_ml_tpu.utils.diskio import file_crc32
+
+    path = os.path.join(directory, f"chunk_{i}.npz")
+    try:
+        with open(os.path.join(directory, f"chunk_{i}.ok")) as f:
+            marker = json.load(f)
+        if marker.get("version") != CHUNK_STORE_VERSION:
+            return None
+        got = file_crc32(path)
+        if got != int(marker["crc"]):
+            logger.warning(
+                "chunk store entry %s is corrupt (crc %08x != committed "
+                "%08x) — re-staging exactly this chunk", path, got,
+                int(marker["crc"]))
+            return None
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {name: z[name] for name in marker["fields"]}
+        return CanonicalChunk(
+            num_features=int(marker["num_features"]),
+            **{name: arrays.get(name) for name in _CHUNK_FIELDS})
+    except Exception:
+        logger.debug("chunk store miss for chunk %d under %s",
+                     i, directory, exc_info=True)
+        return None
+
+
+def load_chunked(directory: str, rebuild=None) -> ChunkedHybrid:
+    """Load a persisted chunk stream; a chunk that fails its CRC (or is
+    missing) re-stages through ``rebuild(i) -> CanonicalChunk`` —
+    exactly that chunk, bit-identical to a fresh staging pass — or
+    raises :class:`ChunkStoreError` when no hook was given."""
+    import json
+    import os
+
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("version") != CHUNK_STORE_VERSION:
+        raise ChunkStoreError(
+            f"chunk store {directory} is version {meta.get('version')}, "
+            f"expected {CHUNK_STORE_VERSION}")
+    chunks = []
+    for i in range(int(meta["num_chunks"])):
+        ch = _load_stored_chunk(directory, i)
+        if ch is None:
+            if rebuild is None:
+                raise ChunkStoreError(
+                    f"chunk {i} of {directory} is missing or corrupt and "
+                    f"no rebuild hook was provided")
+            ch = rebuild(i)
+        chunks.append(ch)
+    return ChunkedHybrid(chunks=tuple(chunks),
+                         num_rows=int(meta["num_rows"]),
+                         chunk_rows=int(meta["chunk_rows"]))
+
+
 def make_value_and_gradient(
     loss: PointwiseLoss,
     chunked: ChunkedHybrid,
@@ -592,7 +864,7 @@ def make_value_and_gradient(
     staged in each chunk. ``pinned`` (from :func:`pin_chunks`) skips the
     host→device transfer for the leading chunks.
     """
-    kernel = _chunk_value_grad(loss)
+    kernel = _chunk_value_grad(loss, chunk_dtype(chunked.chunks[0]))
 
     def value_and_grad(w: Array, offsets: Optional[Array] = None):
         with obs.span("stream.pass", cat="stream", kind="value_grad",
@@ -635,7 +907,7 @@ def make_value_only(
     """Streamed Σ-over-chunks VALUE in original column space — the
     line-search probe companion of :func:`make_value_and_gradient` (same
     streaming discipline: prefetch, per-chunk barrier, eager release)."""
-    kernel = _chunk_value(loss)
+    kernel = _chunk_value(loss, chunk_dtype(chunked.chunks[0]))
 
     def value_only(w: Array, offsets: Optional[Array] = None):
         with obs.span("stream.pass", cat="stream", kind="value_only",
@@ -753,7 +1025,8 @@ def _merge_fn(mesh):
     cached = _MERGE_FNS.get(mesh)
     if cached is not None:
         return cached
-    _count_kernel_build("stream_psum_merge")
+    # The merge reduces f32 partials regardless of chunk storage dtype.
+    _count_kernel_build("stream_psum_merge", "float32")
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -916,7 +1189,8 @@ class ShardedChunkStream:
     def value_and_gradient(self, loss: PointwiseLoss):
         """(w, offsets) → replicated global (value, gradient): each
         device streams its range, partials psum-merge (treeAggregate)."""
-        kernel = _chunk_value_grad(loss)
+        kernel = _chunk_value_grad(loss,
+                                   chunk_dtype(self.chunked.chunks[0]))
         d = self.chunked.dim
         merge = _merge_fn(self.mesh)
 
@@ -952,7 +1226,7 @@ class ShardedChunkStream:
 
     def value_only(self, loss: PointwiseLoss):
         """(w, offsets) → global value — the Armijo-probe pass."""
-        kernel = _chunk_value(loss)
+        kernel = _chunk_value(loss, chunk_dtype(self.chunked.chunks[0]))
         merge = _merge_fn(self.mesh)
         d = self.chunked.dim
 

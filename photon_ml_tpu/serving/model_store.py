@@ -171,29 +171,70 @@ def _fetch_with_retry(store: "HashShardedStore", ids: np.ndarray,
 
 
 class REServingState:
-    """One random-effect coordinate's host store + LRU device cache."""
+    """One random-effect coordinate's host store + LRU device cache.
+
+    ``cache_dtype="int8"`` stores the device table as symmetric per-ROW
+    int8 (the streamed chunk format's quantization scheme reused on the
+    serving side — ``ops/streaming_sparse.quantize_rows_int8``): rows
+    quantize once at fill time, the scoring gather dequantizes on device
+    (one per-row f32 scale multiply AFTER the einsum — exact algebra),
+    and the table costs ~dim bytes per row instead of 4·dim, so the same
+    HBM budget caches ~4× the entities (docs/SERVING.md "Quantized
+    device cache"). The LRU bookkeeping — fill, eviction, pinning,
+    publication invalidation — is dtype-blind: ``apply_rows`` pops the
+    same slots and the next resolve re-quantizes from the swapped host
+    rows, so a quantized hot-swap serves the same bits as a quantized
+    cold restart."""
 
     def __init__(self, cid: str, model, cache_entities: int,
-                 store_shards: int):
+                 store_shards: int, cache_dtype: str = "float32"):
+        if cache_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"unsupported cache_dtype {cache_dtype!r}; expected "
+                "float32 or int8")
         self.cid = cid
         self.re_type = model.re_type
         self.shard_id = model.shard_id
+        self.cache_dtype = cache_dtype
         self.store = HashShardedStore(model, num_shards=store_shards)
         self.num_entities = self.store.num_entities
         self.dim = self.store.dim
         # Cache size never exceeds the entity table (plus the fallback row).
         self.capacity = max(1, min(int(cache_entities), self.num_entities))
         self.fallback_slot = self.capacity
-        self.cache = jnp.zeros((self.capacity + 1, self.dim), jnp.float32)
         self._lru: collections.OrderedDict[int, int] = \
             collections.OrderedDict()  # entity id → slot, oldest first
         self._free = list(range(self.capacity))
         # cache.at[slots].set(rows): one scatter per fill, insert count
         # padded to power-of-two buckets so steady state never recompiles.
         # Padding rows are zeros aimed at the fallback slot — which is what
-        # keeps that row zero forever.
-        self._insert = jax.jit(
-            lambda cache, slots, rows: cache.at[slots].set(rows))
+        # keeps that row zero forever (int8 mode scatters the scale vector
+        # in the same program; the fallback scale stays 0, so the fallback
+        # row dequantizes to exactly zero).
+        if cache_dtype == "int8":
+            self.cache = jnp.zeros((self.capacity + 1, self.dim),
+                                   jnp.int8)
+            self.cache_scale = jnp.zeros((self.capacity + 1,),
+                                         jnp.float32)
+            self._insert = jax.jit(
+                lambda cache, scale, slots, rows, row_scale: (
+                    cache.at[slots].set(rows),
+                    scale.at[slots].set(row_scale)))
+        else:
+            self.cache = jnp.zeros((self.capacity + 1, self.dim),
+                                   jnp.float32)
+            self.cache_scale = None
+            self._insert = jax.jit(
+                lambda cache, slots, rows: cache.at[slots].set(rows))
+
+    def device_bytes(self) -> int:
+        """Device-resident bytes of this coordinate's cache (table +
+        scale vector under int8) — the capacity-at-fixed-HBM accounting
+        bench_serving.py sweeps."""
+        rows = self.capacity + 1
+        if self.cache_scale is not None:
+            return rows * self.dim + rows * 4
+        return rows * self.dim * 4
 
     def resolve(self, ids: np.ndarray,
                 on_retry: Optional[Callable[[int], None]] = None
@@ -254,11 +295,28 @@ class REServingState:
                                      on_retry=on_retry)
             k = _next_pow2(len(unique))
             ins_slots = np.full(k, self.fallback_slot, np.int32)
-            ins_rows = np.zeros((k, self.dim), np.float32)
             ins_slots[: len(unique)] = list(unique.values())
-            ins_rows[: len(unique)] = rows
-            self.cache = self._insert(self.cache, jnp.asarray(ins_slots),
-                                      jnp.asarray(ins_rows))
+            if self.cache_scale is not None:
+                # Quantize at fill time (per-row symmetric int8 — the
+                # chunk format's scheme); padding rows keep code 0 and
+                # scale 0 aimed at the fallback slot.
+                from photon_ml_tpu.ops.streaming_sparse import \
+                    quantize_rows_int8
+
+                q, row_scale = quantize_rows_int8(rows)
+                ins_rows = np.zeros((k, self.dim), np.int8)
+                ins_scale = np.zeros((k,), np.float32)
+                ins_rows[: len(unique)] = q
+                ins_scale[: len(unique)] = row_scale
+                self.cache, self.cache_scale = self._insert(
+                    self.cache, self.cache_scale, jnp.asarray(ins_slots),
+                    jnp.asarray(ins_rows), jnp.asarray(ins_scale))
+            else:
+                ins_rows = np.zeros((k, self.dim), np.float32)
+                ins_rows[: len(unique)] = rows
+                self.cache = self._insert(self.cache,
+                                          jnp.asarray(ins_slots),
+                                          jnp.asarray(ins_rows))
             for i in miss_rows:
                 slots[i] = unique[int(ids[i])]
         return slots, stats
@@ -293,10 +351,12 @@ class ResidentModelStore:
         store_shards: int = 8,
         entity_vocabs: Optional[dict[str, dict]] = None,
         metrics_retry: Optional[Callable[[int], None]] = None,
+        cache_dtype: str = "float32",
     ):
         self.task = model.task
         self.entity_vocabs = entity_vocabs or {}
         self._metrics_retry = metrics_retry
+        self.cache_dtype = cache_dtype
         self.fixed: list[tuple[str, str, jax.Array]] = []
         self.random: list[REServingState] = []
         self.shard_dims: dict[str, int] = {}
@@ -313,16 +373,23 @@ class ResidentModelStore:
                 self.fixed.append((cid, m.shard_id, w))
                 self._claim_dim(m.shard_id, int(m.dim))
             else:
-                st = REServingState(cid, m, cache_entities, store_shards)
+                st = REServingState(cid, m, cache_entities, store_shards,
+                                    cache_dtype=cache_dtype)
                 self.random.append(st)
                 self._claim_dim(m.shard_id, st.dim)
         host = sum(st.store.host_bytes() for st in self.random)
         device = sum(int(np.prod(w.shape)) * 4 for _, _, w in self.fixed) \
-            + sum((st.capacity + 1) * st.dim * 4 for st in self.random)
+            + self.device_cache_bytes()
         logger.info(
             "model store resident: %d fixed + %d random coordinates, "
-            "%.1f MB host store, %.1f MB device (coefficients + caches)",
-            len(self.fixed), len(self.random), host / 2**20, device / 2**20)
+            "%.1f MB host store, %.1f MB device (coefficients + %s "
+            "caches)", len(self.fixed), len(self.random), host / 2**20,
+            device / 2**20, cache_dtype)
+
+    def device_cache_bytes(self) -> int:
+        """Device bytes of the random-effect LRU caches (tables + scale
+        vectors under int8) — the quantized-capacity accounting."""
+        return sum(st.device_bytes() for st in self.random)
 
     def _claim_dim(self, shard_id: str, dim: int) -> None:
         prev = self.shard_dims.setdefault(shard_id, dim)
@@ -363,6 +430,12 @@ class ResidentModelStore:
 
     def caches(self) -> dict[str, jax.Array]:
         return {st.cid: st.cache for st in self.random}
+
+    def cache_scales(self) -> dict[str, Optional[jax.Array]]:
+        """Per-coordinate dequant scale vectors (None for f32 caches —
+        an empty pytree leaf, so the scorer's signature is dtype-
+        stable)."""
+        return {st.cid: st.cache_scale for st in self.random}
 
     # -- continuous publication (serving/publish.py) -------------------------
 

@@ -104,6 +104,7 @@ class ScoringService:
         cache_entities: int = 4096,
         store_shards: int = 8,
         entity_vocabs: Optional[dict[str, dict]] = None,
+        cache_dtype: str = "float32",
         max_queue: Optional[int] = None,
         request_deadline_s: Optional[float] = 30.0,
         slo_window_s: float = 60.0,
@@ -122,7 +123,8 @@ class ScoringService:
         self.store = ResidentModelStore(
             model, cache_entities=max(int(cache_entities), int(max_batch)),
             store_shards=store_shards, entity_vocabs=entity_vocabs,
-            metrics_retry=self._record_store_retry)
+            metrics_retry=self._record_store_retry,
+            cache_dtype=cache_dtype)
         self.as_mean = bool(as_mean)
         self.max_batch = int(max_batch)
         self.metrics = ServingMetrics(slo_window_s=slo_window_s,
@@ -161,17 +163,28 @@ class ScoringService:
 
     def _build_score_fn(self):
         fixed = tuple(self.store.fixed)
-        random = tuple((st.cid, st.shard_id) for st in self.store.random)
+        random = tuple((st.cid, st.shard_id, st.cache_scale is not None)
+                       for st in self.store.random)
         mean_fn = (losses_mod.loss_for_task(self.store.task).mean
                    if self.as_mean else None)
 
-        def score(mats, offsets, slots, caches):
+        def score(mats, offsets, slots, caches, scales):
             total = jnp.asarray(offsets)
             for _cid, sid, w in fixed:
                 total = total + mats[sid] @ w
-            for cid, sid in random:
-                total = total + jnp.einsum(
-                    "nd,nd->n", mats[sid], caches[cid][slots[cid]])
+            for cid, sid, quantized in random:
+                rows = caches[cid][slots[cid]]
+                if quantized:
+                    # int8 device cache: gather the codes, accumulate
+                    # the einsum in f32, dequantize with ONE per-row
+                    # scale multiply (x·(s·q) = s·(x·q) — exact).
+                    total = total + jnp.einsum(
+                        "nd,nd->n", mats[sid],
+                        rows.astype(jnp.float32)) * \
+                        scales[cid][slots[cid]]
+                else:
+                    total = total + jnp.einsum("nd,nd->n", mats[sid],
+                                               rows)
             return mean_fn(total) if mean_fn is not None else total
 
         return jax.jit(score)
@@ -242,7 +255,8 @@ class ScoringService:
                 self.metrics.record_compile()
             t_d0 = time.monotonic()  # device: dispatch + block on result
             out = self._score_fn(mats, offsets, slots_full,
-                                 self.store.caches())
+                                 self.store.caches(),
+                                 self.store.cache_scales())
             out = np.asarray(jax.block_until_ready(out))
             t_d1 = time.monotonic()
         dt = t_d1 - t_d0
