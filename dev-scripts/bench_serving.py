@@ -118,6 +118,26 @@ def build_parser():
                         "hottest ones — their rows are device-cached, "
                         "so the swap exercises LRU invalidation)")
     p.add_argument("--publish-tuples-per-entity", type=int, default=4)
+    # -- restart arm (docs/SERVING.md "Sub-second restart") ------------------
+    p.add_argument("--restart", action="store_true",
+                   help="measure the replica-restart tail: kill a warm "
+                        "replica and measure spawn → first scored "
+                        "request for an npz boot vs an mmap generation "
+                        "boot (replica_restart_seconds_{npz,mmap}), "
+                        "plus the in-process model-load walls and a "
+                        "rehome-under-restart p99 leg through a "
+                        "2-replica mmap-booted fleet (unserved must be "
+                        "0; gated by check_bench_regression.py)")
+    p.add_argument("--restart-entities", type=int, default=200_000,
+                   help="entity-table rows of the restart-arm model "
+                        "(large enough that parse-vs-mmap dominates "
+                        "the model phase)")
+    p.add_argument("--restart-probe-requests", type=int, default=32,
+                   help="single-request probes scored after each boot "
+                        "(parity + ready-to-traffic confirmation)")
+    p.add_argument("--restart-traffic-requests", type=int, default=240,
+                   help="requests streamed through the 2-replica fleet "
+                        "while one replica is killed and restarts")
     # -- quantized-cache sweep (docs/SERVING.md "Quantized device cache") ----
     p.add_argument("--cache-sweep", action="store_true",
                    help="sweep the device-LRU storage dtype at a FIXED "
@@ -936,6 +956,240 @@ def run_fleet(args, load_seconds_unused=None):
     return out
 
 
+# -- restart arm -------------------------------------------------------------
+
+
+def _spawn_replica(model_dir, workdir, tag, probe_objs, max_batch):
+    """Spawn one ``photon-game-serve`` subprocess over ``model_dir`` and
+    wait until it SCORES (ready file → healthz → first /score answers);
+    returns (proc, url, ready_to_traffic_seconds). The replica runs with
+    ``--boot-warmup`` and a live metrics registry so its
+    photon_boot_seconds phase gauges are readable at /metrics."""
+    import subprocess
+    import urllib.request
+
+    import photon_ml_tpu
+
+    ready = os.path.join(workdir, f"{tag}.ready")
+    if os.path.exists(ready):
+        os.unlink(ready)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(photon_ml_tpu.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else pkg_root)
+    log_f = open(os.path.join(workdir, f"{tag}.log"), "ab")
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "photon_ml_tpu.cli.serve",
+             "--model-dir", model_dir, "--port", "0",
+             "--max-batch", str(max_batch), "--boot-warmup",
+             "--metrics-dump", os.path.join(workdir, f"{tag}.prom"),
+             "--ready-file", ready],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env)
+    finally:
+        log_f.close()
+    deadline = time.perf_counter() + 300.0
+    info = None
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{tag} replica exited rc={proc.returncode} before "
+                f"ready (see {workdir}/{tag}.log)")
+        if os.path.exists(ready):
+            try:
+                with open(ready) as f:
+                    info = json.load(f)
+                break
+            except (OSError, ValueError):
+                pass
+        time.sleep(0.01)
+    if info is None:
+        raise RuntimeError(f"{tag} replica never wrote its ready file")
+    url = f"http://127.0.0.1:{int(info['port'])}"
+    while time.perf_counter() < deadline:
+        try:
+            _post_score(url, probe_objs[0], timeout_s=10.0)
+            break
+        except OSError:
+            time.sleep(0.01)
+    else:
+        raise RuntimeError(f"{tag} replica never answered /score")
+    return proc, url, time.perf_counter() - t0
+
+
+def _replica_boot_phases(url):
+    """photon_boot_seconds{phase=...} off a live replica's /metrics."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=10.0) as resp:
+            text = resp.read().decode()
+    except OSError:
+        return {}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("photon_boot_seconds{phase="):
+            phase = line.split('"')[1]
+            out[phase] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def run_restart(args):
+    """npz-boot vs mmap-boot ready-to-traffic walls + the
+    rehome-under-restart leg (docs/SERVING.md "Sub-second restart").
+
+    Each format boots twice: the first (cold) spawn warms the OS page
+    cache and the persistent XLA compilation cache, the second (warm —
+    the restart a production fleet actually pays) is the BENCH wall.
+    ``restart_valid`` gates the 0.5× claim to boxes with >= 4 cores:
+    on the 1-core CI box the interpreter tail dominates both formats
+    and the ratio measures scheduling, not the model tier."""
+    import signal
+    import tempfile
+
+    from photon_ml_tpu import boot
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.serving import ScoringRequest, ScoringService
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    args.num_entities = args.restart_entities
+    model = build_model(args)
+    workdir = tempfile.mkdtemp(prefix="photon-restart-bench-")
+    npz_dir = os.path.join(workdir, "model-npz")
+    gen_root = os.path.join(workdir, "model-gens")
+    model_io.save_game_model(model, npz_dir)
+    boot.GenerationStore(gen_root).publish(model)
+
+    # In-process model-load walls: the parse-vs-mmap claim isolated
+    # from interpreter/JAX startup (valid at any core count).
+    t0 = time.perf_counter()
+    model_io.load_game_model(npz_dir, host=True, mapped=False)
+    load_npz = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    boot.GenerationStore(gen_root).load_current()
+    load_mmap = time.perf_counter() - t0
+
+    probe_objs = _fleet_request_objs(args, args.restart_probe_requests,
+                                     args.seed + 77)
+    oracle = ScoringService(model, max_batch=args.max_batch,
+                            max_wait_ms=args.max_wait_ms)
+    expected = np.asarray([float(oracle.score([ScoringRequest(
+        features={k: np.asarray(v, np.float32)
+                  for k, v in o["features"].items()},
+        entity_ids=o["entity_ids"])])[0]) for o in probe_objs],
+        np.float32)
+    oracle.close()
+
+    walls = {}
+    parity_ok = True
+    for tag, model_dir in (("npz", npz_dir), ("mmap", gen_root)):
+        for leg in ("cold", "warm"):
+            proc, url, wall = _spawn_replica(
+                model_dir, workdir, f"{tag}-{leg}", probe_objs,
+                args.max_batch)
+            try:
+                if leg == "warm":
+                    got = np.asarray(
+                        [float(_post_score(url, o)["scores"][0])
+                         for o in probe_objs], np.float32)
+                    parity_ok = parity_ok and np.array_equal(got,
+                                                             expected)
+                    walls[f"{tag}_phases"] = _replica_boot_phases(url)
+                walls[f"{tag}_{leg}"] = wall
+            finally:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+            print(f"[restart] {tag} {leg}: ready-to-traffic "
+                  f"{wall:.3f}s", file=sys.stderr)
+
+    # Rehome-under-restart: a 2-replica mmap-booted fleet, one replica
+    # SIGKILLed mid-stream — every request must still answer (retries
+    # follow the re-home), and the p99 over the stream is the tail a
+    # restart actually costs traffic.
+    fleet = ServingFleet(
+        replica_args=["--model-dir", gen_root,
+                      "--max-batch", str(args.max_batch),
+                      "--max-wait-ms", str(args.max_wait_ms)],
+        num_replicas=2, workdir=os.path.join(workdir, "fleet"),
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        rehome_deadline_s=args.fleet_rehome_deadline_s)
+    server = None
+    unserved = 0
+    lat = []
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        n = args.restart_traffic_requests
+        objs = _fleet_request_objs(args, n, args.seed + 79)
+        kill_at = n // 3
+        for i, obj in enumerate(objs):
+            if i == kill_at:
+                handle = fleet.supervisor.replicas[1]
+                if handle.proc is not None:
+                    os.kill(handle.proc.pid, signal.SIGKILL)
+            t0 = time.perf_counter()
+            try:
+                _post_score(url, obj, timeout_s=60.0)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            except OSError:
+                unserved += 1
+        boot_metrics = {
+            h.replica_id: round(h.boot_seconds, 3)
+            for h in fleet.supervisor.replicas}
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
+
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+    valid = (os.cpu_count() or 1) >= 4
+    secondary = {
+        "replica_restart_seconds_npz": round(walls["npz_warm"], 3),
+        "replica_restart_seconds_mmap": round(walls["mmap_warm"], 3),
+        "replica_restart_cold_seconds_npz": round(walls["npz_cold"], 3),
+        "replica_restart_cold_seconds_mmap": round(walls["mmap_cold"],
+                                                   3),
+        "replica_restart_ratio": round(
+            walls["mmap_warm"] / max(walls["npz_warm"], 1e-9), 3),
+        "replica_boot_phases_npz": walls.get("npz_phases", {}),
+        "replica_boot_phases_mmap": walls.get("mmap_phases", {}),
+        "boot_model_load_seconds_npz": round(load_npz, 4),
+        "boot_model_load_seconds_mmap": round(load_mmap, 4),
+        "boot_map_load_speedup": round(load_npz / max(load_mmap, 1e-9),
+                                       2),
+        "restart_rehome_p99_ms": round(p99, 2),
+        "restart_unserved": unserved,
+        "restart_parity_ok": bool(parity_ok),
+        "restart_fleet_boot_seconds": boot_metrics,
+        "restart_valid": valid,
+        "config": f"E={args.restart_entities} d_re={args.d_re} "
+                  f"probes={args.restart_probe_requests} "
+                  f"traffic={args.restart_traffic_requests} "
+                  f"cores={os.cpu_count()}",
+    }
+    if not valid:
+        secondary["restart_invalid_reason"] = (
+            "box has < 4 cores: interpreter startup dominates both "
+            "boots; ratio gate reported-only")
+    return {
+        "metric": "replica_restart_seconds_mmap",
+        "value": secondary["replica_restart_seconds_mmap"],
+        "unit": "s",
+        "secondary": secondary,
+    }
+
+
 def run_cache_sweep(args):
     """f32-vs-int8 device LRU at a FIXED HBM budget (ROADMAP item 3's
     serving half): capacity per dtype = budget // row bytes (f32: 4·d;
@@ -1013,6 +1267,11 @@ def run_cache_sweep(args):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.restart:
+        out = run_restart(args)
+        json.dump(out, sys.stdout)
+        print()
+        return 0
     if args.cache_sweep:
         out = run_cache_sweep(args)
         json.dump(out, sys.stdout)

@@ -72,6 +72,15 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/publish_smoke.py; rc=$?
 fi
 
+# Boot smoke (docs/SERVING.md "Sub-second restart"): publish a mapped
+# generation, mmap-boot a subprocess replica from the generation root,
+# assert bit-identical scores vs a cold npz boot, the
+# photon_boot_seconds waterfall + generation gauge + compile-cache hits
+# on /metrics, and a clean post-reader CRC verify. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/boot_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

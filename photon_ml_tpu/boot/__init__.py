@@ -1,0 +1,63 @@
+"""photon-boot: mmap model artifacts + atomic generation swap.
+
+ROADMAP item 5's serving half: a restarted replica used to parse the
+full npz host store before taking traffic — the measured floor under
+``fleet_rehome_seconds``. This package publishes GameModels in the
+columnar mmap format the ingest cache already proves
+(``ingest/cache.py`` v3 CRC discipline), so boot becomes an ``mmap()``
+instead of a parse:
+
+* ``boot/mapfmt.py`` — one 64-byte-aligned columnar blob per
+  coordinate + per-blob CRC32 ``.ok`` markers + a directory-level
+  commit marker written LAST (``utils/diskio`` discipline); loads are
+  zero-copy views over the page cache, bit-identical to the npz path.
+* ``boot/generations.py`` — monotone ``gen-%06d`` directories with a
+  two-generation retention, an atomic ``current`` symlink swap, a
+  corruption fallback ladder (``BootRecovered``), and a compaction
+  path folding a committed ``DeltaStore`` chain (serving/publish.py)
+  into the next generation.
+
+Import cost: numpy + stdlib only at the package level (JAX enters only
+through the model classes a load constructs), so the CLI layers stay
+fast. See docs/SERVING.md "Sub-second restart".
+"""
+
+from __future__ import annotations
+
+# mapfmt first: generations imports it back through the package.
+from photon_ml_tpu.boot.mapfmt import (MapCorrupt, MapFormatError,
+                                       is_mapped_array, is_mapped_model,
+                                       load_mapped_model,
+                                       write_mapped_model)
+from photon_ml_tpu.boot.generations import (GenerationError,
+                                            GenerationStore)
+
+__all__ = [
+    "GenerationError", "GenerationStore", "MapCorrupt", "MapFormatError",
+    "is_mapped_array", "is_mapped_model", "load_mapped_model",
+    "resolve_model_path", "write_mapped_model",
+]
+
+
+def resolve_model_path(path: str):
+    """Classify a ``--model-dir`` argument for the boot path: returns
+    ``(kind, resolved_path, meta)`` where ``kind`` is one of
+
+    * ``"generations"`` — a :class:`GenerationStore` root (``gen-*``
+      dirs / ``current`` pointer): boot the CURRENT generation with the
+      fallback ladder; ``meta`` carries generation + model_version;
+    * ``"mapped"``     — a single committed mapped-model directory;
+    * ``"npz"``        — anything else (the classic
+      ``models/io.load_game_model`` layout, Avro included).
+
+    Detection is by layout, not by flag, so every serving entry point
+    (``photon-game-serve``, the fleet's replicas, benches) boots from a
+    generation root with zero new plumbing.
+    """
+    import os
+
+    if GenerationStore.looks_like(path):
+        return "generations", path, None
+    if is_mapped_model(path):
+        return "mapped", path, None
+    return "npz", os.path.normpath(path), None

@@ -1,0 +1,322 @@
+"""Generation store: atomic model publication for sub-second restart.
+
+Layout under one generation root::
+
+    gen-000001/        # a committed mapped model (boot/mapfmt.py)
+    gen-000002/
+    current -> gen-000002   # the serving pointer, swapped ATOMICALLY
+
+Publication writes the next ``gen-%06d`` directory (the mapfmt marker
+is its commit point — a kill mid-write leaves an invisible directory),
+then swaps ``current`` via a temp symlink + ``os.replace``: readers see
+the old generation or the new one, never a mix. Rollback is a re-point.
+Retention keeps the newest two COMMITTED generations (the
+game/checkpoint.py two-generation discipline at the model tier), and
+the pointed-at generation is never pruned.
+
+Boot ladder (docs/ROBUSTNESS.md): ``load_current`` verifies the current
+generation's blob CRCs; corruption falls back ONE committed generation
+with a loud :class:`~photon_ml_tpu.utils.events.BootRecovered` event +
+``photon_boot_recoveries_total``; both generations bad raises the
+defined :class:`GenerationError` — recovery degrades, it never boots
+silently wrong rows.
+
+Compaction folds a committed ``DeltaStore`` chain (serving/publish.py)
+into the NEXT generation: a replica booting the compacted generation
+starts at the folded ``model_version``, so the fleet's restart replay
+has nothing to re-apply — publication cost amortizes into the artifact
+instead of replaying forever. ``compact`` is bit-exact: folding deltas
+v..k into the tables equals replaying v..k onto a booted store (the
+tested contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+import shutil
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu import faults as flt
+from photon_ml_tpu.boot import mapfmt
+from photon_ml_tpu.utils import events as ev_mod
+from photon_ml_tpu.utils.diskio import atomic_write
+
+logger = logging.getLogger("photon_ml_tpu.boot")
+
+_GEN_RE = re.compile(r"^gen-(\d{6,})$")
+_CURRENT = "current"
+
+
+class GenerationError(RuntimeError):
+    """No committed generation can be trusted (or a compaction chain is
+    broken) — the defined end of the boot ladder."""
+
+
+class GenerationStore:
+    """Monotone ``gen-%06d`` mapped-model generations under one root.
+
+    Thread-compatibility mirrors serving/publish.DeltaStore: one writer
+    (the publisher), many readers (booting replicas) that only ever see
+    committed generations.
+    """
+
+    def __init__(self, root: str, retain: int = 2):
+        if retain < 2:
+            raise ValueError(f"retain must keep >= 2 generations "
+                             f"(rollback needs one to fall back to), "
+                             f"got {retain}")
+        self.root = root
+        self.retain = int(retain)
+
+    @staticmethod
+    def looks_like(path: str) -> bool:
+        """Layout probe for the boot path's auto-detection: a
+        ``current`` pointer or any ``gen-*`` directory."""
+        if not os.path.isdir(path):
+            return False
+        if os.path.lexists(os.path.join(path, _CURRENT)):
+            return True
+        return any(_GEN_RE.match(n) for n in os.listdir(path))
+
+    # -- layout --------------------------------------------------------------
+
+    def gen_dir(self, version: int) -> str:
+        return os.path.join(self.root, f"gen-{version:06d}")
+
+    def versions(self) -> list[int]:
+        """Committed generations, ascending (mapfmt marker present;
+        blob CRCs are verified at load time)."""
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            m = _GEN_RE.match(name)
+            if m and mapfmt.is_mapped_model(os.path.join(self.root, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_version(self) -> int:
+        versions = self.versions()
+        return versions[-1] if versions else 0
+
+    def current_version(self) -> int:
+        """The generation ``current`` points at; a missing/dangling
+        pointer degrades to the newest committed generation (a crash
+        between marker and swap must not strand a bootable root)."""
+        link = os.path.join(self.root, _CURRENT)
+        try:
+            target = os.path.basename(os.readlink(link))
+            m = _GEN_RE.match(target)
+            if m and int(m.group(1)) in set(self.versions()):
+                return int(m.group(1))
+        except OSError:
+            pass
+        return self.latest_version()
+
+    def current_path(self) -> str:
+        v = self.current_version()
+        if v == 0:
+            raise GenerationError(
+                f"{self.root} holds no committed generation")
+        return self.gen_dir(v)
+
+    # -- write ---------------------------------------------------------------
+
+    def _swap(self, version: int) -> None:
+        """Re-point ``current`` atomically (temp symlink +
+        ``os.replace`` — the mapfmt/diskio rename discipline applied to
+        the pointer itself)."""
+        link = os.path.join(self.root, _CURRENT)
+        tmp = link + ".tmp"
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        os.symlink(f"gen-{version:06d}", tmp)
+        os.replace(tmp, link)
+
+    def _prune(self) -> None:
+        """Drop generations older than the newest ``retain`` committed
+        ones; the pointed-at generation always survives."""
+        versions = self.versions()
+        keep = set(versions[-self.retain:])
+        keep.add(self.current_version())
+        for v in versions:
+            if v not in keep:
+                shutil.rmtree(self.gen_dir(v), ignore_errors=True)
+                logger.info("generation gen-%06d pruned (retention %d)",
+                            v, self.retain)
+
+    def publish(self, model, model_version: int = 0,
+                extra: Optional[dict] = None) -> tuple[int, str]:
+        """Commit ``model`` as the next generation and swap ``current``
+        to it. ``model_version`` records the newest publication delta
+        (serving/publish.py chain) already FOLDED into these tables
+        (0 = the base offline fit) — a booted replica starts its delta
+        chain there. Returns ``(generation, path)``."""
+        version = self.latest_version() + 1
+        d = self.gen_dir(version)
+        meta = {"generation": version,
+                "model_version": int(model_version)}
+        if extra:
+            meta.update(extra)
+        mapfmt.write_mapped_model(model, d, extra=meta)
+        self._swap(version)
+        self._prune()
+        logger.info("generation gen-%06d live (model_version %d) -> %s",
+                    version, model_version, d)
+        return version, d
+
+    def rollback(self) -> int:
+        """Re-point ``current`` one committed generation back (the
+        publication ladder's model-tier undo). Returns the now-current
+        generation."""
+        versions = self.versions()
+        cur = self.current_version()
+        older = [v for v in versions if v < cur]
+        if not older:
+            raise GenerationError(
+                f"{self.root} has no generation older than gen-{cur:06d} "
+                f"to roll back to")
+        self._swap(older[-1])
+        logger.warning("generation store rolled back: gen-%06d -> "
+                       "gen-%06d", cur, older[-1])
+        return older[-1]
+
+    # -- read (the boot ladder) ----------------------------------------------
+
+    def load_current(self, verify: bool = True):
+        """Boot the current generation; on corruption fall back ONE
+        committed generation with a loud ``BootRecovered`` event.
+
+        Returns ``(GameModel, marker, generation)``. Raises
+        :class:`GenerationError` when no generation can be trusted.
+        """
+        versions = self.versions()
+        if not versions:
+            raise GenerationError(
+                f"{self.root} holds no committed generation")
+        cur = self.current_version()
+        candidates = [cur] + [v for v in reversed(versions) if v < cur][:1]
+        reason = ""
+        for i, v in enumerate(candidates):
+            try:
+                model, marker = mapfmt.load_mapped_model(
+                    self.gen_dir(v), verify=verify)
+            except mapfmt.MapFormatError as e:
+                if not reason:
+                    reason = f"{type(e).__name__}: {e}"
+                logger.warning("generation gen-%06d failed verification "
+                               "(%s)", v, e)
+                continue
+            if i > 0:
+                logger.error(
+                    "current generation gen-%06d is corrupt (%s) — "
+                    "BOOTING the previous committed generation "
+                    "gen-%06d; its rows may be stale until the next "
+                    "publish", cur, reason, v)
+                ev_mod.default_emitter.emit(ev_mod.BootRecovered(
+                    directory=self.root, from_version=cur, to_version=v,
+                    reason=reason))
+                from photon_ml_tpu import obs
+
+                mx = obs.metrics()
+                if mx is not None:
+                    mx.counter("photon_boot_recoveries_total").inc()
+            return model, marker, v
+        raise GenerationError(
+            f"{self.root}: no trustworthy generation "
+            f"({reason or 'nothing committed'}) — refusing to boot "
+            f"silently wrong rows")
+
+    # -- compaction (the DeltaStore fold) ------------------------------------
+
+    def compact(self, delta_store) -> Optional[tuple[int, str]]:
+        """Fold every committed delta NEWER than the current
+        generation's ``model_version`` into the next generation;
+        returns ``(generation, path)``, or None when the chain is
+        already fully folded (idempotent re-runs).
+
+        Bit-exact by construction: a delta's rows are ABSOLUTE
+        replacement rows (serving/publish.py), so folding them into the
+        dense tables in chain order equals replaying the chain onto a
+        booted store. The chain must be gapless from the generation's
+        folded version; a gap raises :class:`GenerationError` (a
+        compacted artifact that silently skipped a delta would serve
+        wrong rows forever).
+
+        Crash seam: ``boot.compact`` fires before any bytes move — a
+        kill mid-compaction leaves a marker-less generation directory
+        (invisible) and the previous generation fully servable.
+        """
+        from photon_ml_tpu.game.models import RandomEffectModel
+
+        model, marker, gen = self.load_current()
+        base_version = int(marker.get("model_version", 0))
+        versions = [v for v in delta_store.versions() if v > base_version]
+        if not versions:
+            # Already fully folded — a re-run of the publisher must be
+            # idempotent, so this is a no-op, not a failure.
+            logger.info("nothing to compact: no committed delta newer "
+                        "than model_version %d (gen-%06d)", base_version,
+                        gen)
+            return None
+        expect = list(range(base_version + 1, versions[-1] + 1))
+        if versions != expect:
+            raise GenerationError(
+                f"delta chain has gaps past model_version "
+                f"{base_version}: found {versions}, need {expect} — "
+                f"refusing to fold an incomplete chain")
+        flt.fire(flt.sites.BOOT_COMPACT)
+        tables: dict[str, np.ndarray] = {}
+        folded_rows = 0
+        for v in versions:
+            delta = delta_store.read(v)
+            for cid, (ids, rows) in delta.rows.items():
+                m = model.models.get(cid)
+                if not isinstance(m, RandomEffectModel):
+                    raise GenerationError(
+                        f"delta v{v} targets coordinate {cid!r} which "
+                        f"is not a dense random effect — compaction "
+                        f"serves the same representations row hot-swap "
+                        f"does")
+                t = tables.get(cid)
+                if t is None:
+                    # ONE writable copy per touched coordinate for the
+                    # whole fold (untouched coordinates stay mapped).
+                    t = np.array(np.asarray(m.means, np.float32))
+                    tables[cid] = t
+                t[np.asarray(ids, np.int64)] = np.asarray(rows,
+                                                          np.float32)
+                folded_rows += int(ids.shape[0])
+        new_models = dict(model.models)
+        for cid, t in tables.items():
+            new_models[cid] = dataclasses.replace(new_models[cid],
+                                                  means=t)
+        compacted = dataclasses.replace(model, models=new_models)
+        out = self.publish(
+            compacted, model_version=versions[-1],
+            extra={"compacted_from": gen,
+                   "deltas_folded": versions})
+        logger.info("compacted %d delta(s) (v%d..v%d, %d row(s)) into "
+                    "gen-%06d", len(versions), versions[0], versions[-1],
+                    folded_rows, out[0])
+        return out
+
+
+def publish_generation(model_dir: str, root: str,
+                       model_version: int = 0) -> tuple[int, str]:
+    """Convenience: load an npz GameModel directory and publish it as
+    the next generation of ``root`` (the ``photon-game-publish
+    --compact-generations`` bootstrap and dev-scripts' one-liner)."""
+    from photon_ml_tpu.models import io as model_io
+
+    model = model_io.load_game_model(model_dir, host=True)
+    return GenerationStore(root).publish(model,
+                                         model_version=model_version)

@@ -1,0 +1,286 @@
+"""Columnar mmap model format: boot is an ``mmap()``, not a parse.
+
+One committed mapped-model directory holds a full GameModel::
+
+    blobs/<cid>.bin   # ALL of one coordinate's persisted arrays as one
+                      # 64-byte-aligned blob (the ingest-cache layout:
+                      # one file per coordinate, one open + one mmap per
+                      # coordinate at boot, one sequential extent for
+                      # the page cache)
+    blobs/<cid>.ok    # that blob's commit marker: column directory
+                      # (name/dtype/shape/offset), the blob's CRC32
+                      # taken over the good bytes, and the coordinate's
+                      # models/io metadata — written atomically AFTER
+                      # the blob
+    model.json        # the DIRECTORY-LEVEL commit point, written LAST:
+                      # format version, task, coordinate list, optional
+                      # publisher metadata (generation, folded delta
+                      # version). A directory without it does not exist.
+
+The arrays inside a blob are exactly ``models/io.coordinate_arrays`` —
+the ONE definition of "the model's bytes", shared with the npz writer
+and the cross-rank digest — so a mapped load is bit-identical to the
+npz load by construction (``game_model_digest`` equality is the tested
+contract, not a tolerance).
+
+Crash/corruption discipline (the ``utils/diskio`` v3 contract): every
+file write is atomic, a kill anywhere before ``model.json`` leaves an
+invisible directory (the previous generation stays fully servable), and
+silent bit rot fails the committed CRC at load time and raises the
+defined :class:`MapCorrupt` — the generation store's cue to fall back
+one generation (``BootRecovered``) instead of serving garbage rows.
+
+Fault sites (docs/ROBUSTNESS.md): ``boot.map_write`` is the crash seam
+(occurrence 1 = before any blob, occurrence 2 = the torn window between
+the last blob and the directory marker); ``boot.map_open`` is the
+corruption seam (injected rot lands AFTER the checksum, the shape a
+load must catch).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mmap as _mmap
+import os
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu import faults as flt
+from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
+
+logger = logging.getLogger("photon_ml_tpu.boot")
+
+MAP_FORMAT = "photon-map"
+MAP_FORMAT_VERSION = 1
+
+_BLOBS = "blobs"
+_MARKER = "model.json"
+_ALIGN = 64  # column sections start on cache-line boundaries
+
+
+class MapFormatError(RuntimeError):
+    """The directory is not a committed mapped model (marker missing,
+    torn, or from an unknown format version)."""
+
+
+class MapCorrupt(MapFormatError):
+    """A committed blob's bytes fail their CRC32 (or a column directory
+    does not describe the blob) — never served, by construction."""
+
+
+def is_mapped_model(path: str) -> bool:
+    """Cheap layout probe: a committed ``model.json`` marker of OUR
+    format (the npz layout's ``metadata.json`` never matches)."""
+    marker = os.path.join(path, _MARKER)
+    if not os.path.exists(marker):
+        return False
+    try:
+        with open(marker) as f:
+            return json.load(f).get("format") == MAP_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def is_mapped_array(a) -> bool:
+    """True when ``a`` is (a view over) a memory-mapped buffer — the
+    host store's zero-copy capability probe."""
+    seen = set()
+    while a is not None and id(a) not in seen:
+        seen.add(id(a))
+        if isinstance(a, (np.memmap, _mmap.mmap)):
+            return True
+        a = getattr(a, "base", None)
+    return False
+
+
+# -- write -------------------------------------------------------------------
+
+
+def _pack_blob(arrays: dict[str, np.ndarray]) -> tuple[list, list, int]:
+    """(column directory, byte pieces, total bytes) for one blob —
+    the ingest cache's aligned packing, column names sorted so two
+    writes of the same model are byte-identical files."""
+    cols = []
+    pieces: list[bytes] = []
+    pos = 0
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        pad = (-pos) % _ALIGN
+        if pad:
+            pieces.append(b"\x00" * pad)
+            pos += pad
+        cols.append({"name": name, "dtype": a.dtype.str,
+                     "shape": list(a.shape), "offset": pos})
+        pieces.append(a.tobytes())
+        pos += a.nbytes
+    return cols, pieces, pos
+
+
+def write_mapped_model(model, path: str,
+                       extra: Optional[dict] = None) -> None:
+    """Commit ``model`` as one mapped-model directory.
+
+    Blobs first (atomic, per-blob CRC ``.ok`` markers), the directory
+    marker LAST — a kill anywhere in between leaves no committed model.
+    ``extra`` rides in the marker (the generation store stamps its
+    generation number and the folded delta version there).
+    """
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.types import TaskType
+
+    flt.fire(flt.sites.BOOT_MAP_WRITE)
+    blob_dir = os.path.join(path, _BLOBS)
+    os.makedirs(blob_dir, exist_ok=True)
+    coords = {}
+    for cid in sorted(model.models):
+        m = model.models[cid]
+        meta = model_io.coordinate_meta(m)
+        cols, pieces, nbytes = _pack_blob(model_io.coordinate_arrays(m))
+        blob_path = os.path.join(blob_dir, f"{cid}.bin")
+        atomic_write(blob_path, lambda f: f.writelines(pieces))
+        crc = file_crc32(blob_path)
+        # Injected bit rot lands AFTER the checksum was taken over the
+        # good bytes — the corruption shape a boot-time load must catch.
+        flt.corrupt_file(flt.sites.BOOT_MAP_OPEN, blob_path)
+        marker = json.dumps({"version": MAP_FORMAT_VERSION, "meta": meta,
+                             "cols": cols, "crc": crc,
+                             "nbytes": nbytes}).encode()
+        atomic_write(os.path.join(blob_dir, f"{cid}.ok"),
+                     lambda f: f.write(marker))
+        coords[cid] = meta
+    # Occurrence 2 of the crash seam: every blob committed, directory
+    # marker not — THE torn window a mid-publish SIGKILL must leave
+    # invisible (the generation store's atomicity test drives it).
+    flt.fire(flt.sites.BOOT_MAP_WRITE)
+    body = json.dumps({
+        "format": MAP_FORMAT,
+        "version": MAP_FORMAT_VERSION,
+        "task": TaskType(model.task).value,
+        "coordinates": coords,
+        **(extra or {}),
+    }, indent=2, sort_keys=True).encode()
+    atomic_write(os.path.join(path, _MARKER), lambda f: f.write(body))
+    logger.info("mapped model committed: %d coordinate(s) -> %s",
+                len(coords), path)
+
+
+# -- read --------------------------------------------------------------------
+
+
+def read_marker(path: str) -> dict:
+    """The directory-level commit marker (raises :class:`MapFormatError`
+    when absent/torn/from an unknown version — the caller's cue that
+    this directory does not hold a committed mapped model)."""
+    marker = os.path.join(path, _MARKER)
+    if not os.path.exists(marker):
+        raise MapFormatError(
+            f"{path} has no committed {_MARKER} marker — torn or absent "
+            f"publish")
+    try:
+        with open(marker) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MapFormatError(f"{path} marker unreadable "
+                             f"({type(e).__name__}: {e})")
+    if meta.get("format") != MAP_FORMAT \
+            or int(meta.get("version", -1)) > MAP_FORMAT_VERSION:
+        raise MapFormatError(
+            f"{path} is not a photon-map model this build can read "
+            f"(format={meta.get('format')!r} "
+            f"version={meta.get('version')!r})")
+    return meta
+
+
+def _map_blob(blob_dir: str, cid: str, verify: bool
+              ) -> tuple[dict, dict[str, np.ndarray]]:
+    """One coordinate's (models/io metadata, column name → read-only
+    mmap-backed array). The CRC pass is ONE sequential read with no
+    decode/copy; the arrays themselves stay lazy views over the page
+    cache."""
+    ok_path = os.path.join(blob_dir, f"{cid}.ok")
+    blob_path = os.path.join(blob_dir, f"{cid}.bin")
+    try:
+        with open(ok_path) as f:
+            marker = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MapCorrupt(f"{blob_path} has no trustworthy commit marker "
+                         f"({type(e).__name__}: {e})")
+    if verify:
+        try:
+            got = file_crc32(blob_path)
+        except OSError as e:
+            raise MapCorrupt(f"{blob_path} unreadable "
+                             f"({type(e).__name__}: {e})")
+        if got != int(marker["crc"]):
+            raise MapCorrupt(
+                f"{blob_path} fails its committed CRC (got {got:#010x}, "
+                f"marker {int(marker['crc']):#010x}) — refusing to "
+                f"serve corrupt coefficient rows")
+    # PML016 note: np.memmap's lifetime is refcounted through the array
+    # views handed to the model — the last view dropping closes the map.
+    blob = np.memmap(blob_path, dtype=np.uint8, mode="r",
+                     shape=(int(marker["nbytes"]),))
+    arrays = {}
+    for col in marker["cols"]:
+        dt = np.dtype(col["dtype"])
+        count = int(np.prod(col["shape"], dtype=np.int64))
+        arr = np.frombuffer(blob, dtype=dt, count=count,
+                            offset=int(col["offset"]))
+        arrays[col["name"]] = arr.reshape(col["shape"])
+    return marker["meta"], arrays
+
+
+def load_mapped_model(path: str, verify: bool = True):
+    """Zero-copy load of a committed mapped model.
+
+    Returns ``(GameModel, marker)`` — every coefficient table a
+    read-only view over its blob's mmap (host numpy, exactly the
+    ``load_game_model(host=True)`` contract), ``marker`` the directory
+    metadata (generation / model_version when a generation store wrote
+    it). Raises :class:`MapFormatError` / :class:`MapCorrupt`; never
+    returns a partially trusted model.
+    """
+    from photon_ml_tpu.game.factored import FactoredRandomEffectModel
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel,
+                                           SubspaceRandomEffectModel)
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.types import TaskType
+
+    marker = read_marker(path)
+    blob_dir = os.path.join(path, _BLOBS)
+    models = {}
+    for cid, info in marker["coordinates"].items():
+        meta, arrs = _map_blob(blob_dir, cid, verify)
+        if meta != info:
+            raise MapCorrupt(
+                f"{path} blob {cid!r} metadata disagrees with the "
+                f"directory marker — mixed-generation directory")
+        kind = info["type"]
+        if kind == "fixed":
+            models[cid] = FixedEffectModel(
+                shard_id=info["shard_id"],
+                coefficients=Coefficients(
+                    means=arrs["means"],
+                    variances=arrs.get("variances")))
+        elif kind == "factored":
+            models[cid] = FactoredRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                projection=arrs["projection"], factors=arrs["factors"])
+        elif kind == "random-subspace":
+            models[cid] = SubspaceRandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                num_features=int(info["dim"]),
+                cols=arrs["cols"], means=arrs["means"],
+                variances=arrs.get("variances"))
+        elif kind == "random":
+            models[cid] = RandomEffectModel(
+                re_type=info["re_type"], shard_id=info["shard_id"],
+                means=arrs["means"], variances=arrs.get("variances"))
+        else:
+            raise MapFormatError(
+                f"{path} blob {cid!r} has unknown coordinate type "
+                f"{kind!r}")
+    return GameModel(task=TaskType(marker["task"]), models=models), marker

@@ -35,7 +35,12 @@ logger = logging.getLogger("photon_ml_tpu.cli")
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     # -- model flags, forwarded verbatim to every replica ----------------
-    p.add_argument("--model-dir", required=True, help="GameModel directory")
+    p.add_argument("--model-dir", required=True,
+                   help="GameModel directory — an npz layout, a mapped "
+                        "model, or a photon-boot GENERATION ROOT "
+                        "(gen-*/current): replicas auto-detect the "
+                        "layout and mmap-boot the current generation "
+                        "for sub-second restart (docs/SERVING.md)")
     p.add_argument("--model-format", default="NPZ",
                    choices=["NPZ", "AVRO"])
     p.add_argument("--feature-index-dir",
@@ -110,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store-shards", type=int, default=8)
     p.add_argument("--max-queue", type=int, default=None)
     p.add_argument("--request-deadline-s", type=float, default=30.0)
+    p.add_argument("--boot-warmup", action="store_true",
+                   help="replicas touch every bucket shape before "
+                        "answering /healthz — a restarted replica "
+                        "re-homes with its programs already warm "
+                        "(docs/SERVING.md \"Sub-second restart\")")
     # -- fleet SLO -------------------------------------------------------
     p.add_argument("--slo-window-s", type=float, default=60.0)
     p.add_argument("--slo-availability", type=float, default=0.999)
@@ -145,6 +155,8 @@ def replica_args_from(args) -> list[str]:
         out += ["--as-mean"]
     if args.max_queue is not None:
         out += ["--max-queue", str(args.max_queue)]
+    if getattr(args, "boot_warmup", False):
+        out += ["--boot-warmup"]
     return out
 
 

@@ -209,6 +209,29 @@ def _pctl(sorted_vals: list, p: float) -> float:
     return float(sorted_vals[k])
 
 
+def _boot_waterfall(spans: list[dict]) -> Optional[dict]:
+    """The ``serving.boot`` span + its ``boot.*`` children (map /
+    compile / warmup) as a phase waterfall — the restart tail,
+    attributed (docs/SERVING.md "Sub-second restart"). None when the
+    trace holds no boot span (a service traced after construction)."""
+    boots = [e for e in spans if e["name"] == "serving.boot"]
+    if not boots:
+        return None
+    boot = max(boots, key=lambda e: e["ts"])  # the newest (re)boot
+    bid = boot.get("args", {}).get("span_id")
+    phases = [{
+        "phase": c["name"],
+        "start_ms": (c["ts"] - boot["ts"]) / 1e3,
+        "dur_ms": c["dur"] / 1e3,
+        "frac": c["dur"] / max(boot["dur"], 1e-9),
+    } for c in sorted((e for e in spans
+                       if e["name"].startswith("boot.")
+                       and e.get("args", {}).get("parent_id") == bid),
+                      key=lambda c: c["ts"])]
+    return {"total_ms": boot["dur"] / 1e3, "boots": len(boots),
+            "phases": phases}
+
+
 def summarize_serving(trace: dict) -> dict:
     """Request-path view of a serving trace (``summarize --serving``):
     request latency percentiles from the ``serving.request`` spans,
@@ -219,8 +242,9 @@ def summarize_serving(trace: dict) -> dict:
     spans = _spans(trace)
     requests = [e for e in spans if e["name"] == "serving.request"]
     flushes = [e for e in spans if e["name"] == "serving.flush"]
+    boot = _boot_waterfall(spans)
     if not requests:
-        return {"requests": 0, "flushes": len(flushes)}
+        return {"requests": 0, "flushes": len(flushes), "boot": boot}
     durs_ms = sorted(e["dur"] / 1e3 for e in requests)
     total_ms = sum(durs_ms)
     by_parent: dict = {}
@@ -243,6 +267,7 @@ def summarize_serving(trace: dict) -> dict:
     return {
         "requests": len(requests),
         "flushes": len(flushes),
+        "boot": boot,
         "request_latency_ms": {
             "p50": _pctl(durs_ms, 50), "p95": _pctl(durs_ms, 95),
             "p99": _pctl(durs_ms, 99), "max": durs_ms[-1],
@@ -262,18 +287,34 @@ def summarize_serving(trace: dict) -> dict:
     }
 
 
+def _render_boot(boot: Optional[dict]) -> list:
+    if not boot:
+        return []
+    out = [f"boot waterfall (serving.boot, {boot['total_ms']:.1f}ms"
+           + (f", {boot['boots']} boot(s) in trace — newest shown"
+              if boot["boots"] > 1 else "") + "):"]
+    for p in boot["phases"]:
+        out.append(f"  {p['start_ms']:8.1f}ms  {_bar(p['frac'])} "
+                   f"{p['dur_ms']:8.1f}ms  {p['phase']}")
+    out.append("")
+    return out
+
+
 def render_serving_summary(summary: dict) -> str:
     if not summary.get("requests"):
-        return (f"no serving.request spans in this trace "
-                f"({summary.get('flushes', 0)} flush span(s)) — was the "
-                f"service traced? (obs.enable() before requests arrive)")
+        head = _render_boot(summary.get("boot"))
+        return "\n".join(head) + (
+            f"no serving.request spans in this trace "
+            f"({summary.get('flushes', 0)} flush span(s)) — was the "
+            f"service traced? (obs.enable() before requests arrive)")
     lat = summary["request_latency_ms"]
-    out = [f"{summary['requests']} request(s) over "
-           f"{summary['flushes']} flush(es); request latency "
-           f"p50 {lat['p50']:.2f}ms  p95 {lat['p95']:.2f}ms  "
-           f"p99 {lat['p99']:.2f}ms  max {lat['max']:.2f}ms", "",
-           "stage attribution (of total request time, "
-           f"{summary['request_seconds_total']:.3f}s):"]
+    out = _render_boot(summary.get("boot"))
+    out += [f"{summary['requests']} request(s) over "
+            f"{summary['flushes']} flush(es); request latency "
+            f"p50 {lat['p50']:.2f}ms  p95 {lat['p95']:.2f}ms  "
+            f"p99 {lat['p99']:.2f}ms  max {lat['max']:.2f}ms", "",
+            "stage attribution (of total request time, "
+            f"{summary['request_seconds_total']:.3f}s):"]
     for stage, a in summary["stage_attribution"].items():
         out.append(f"  {stage:<22} {_bar(a['frac_of_request_time'])} "
                    f"{a['frac_of_request_time']:>6.1%}  "

@@ -97,6 +97,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSON FaultPlan armed in this publisher "
                         "(chaos drills: kill at publish.delta_write, "
                         "corrupt at publish.delta_artifact)")
+    p.add_argument("--compact-generations", metavar="GEN_ROOT",
+                   help="after a successful publish, fold the committed "
+                        "delta chain into the next mmap generation "
+                        "under GEN_ROOT (boot/generations.py) — "
+                        "replicas then restart from one mmap swap "
+                        "instead of replaying the chain (docs/SERVING.md "
+                        "\"Sub-second restart\"). Bootstraps gen-000001 "
+                        "from --model-dir when the root is empty")
     return p
 
 
@@ -149,6 +157,34 @@ def cut_delta(args, ledger) -> "object":
     return delta
 
 
+def compact_generations(args, ledger) -> dict:
+    """Fold the committed delta chain into the next mmap generation
+    (boot/generations.py): the restart path's amortization leg —
+    publication cost moves INTO the artifact, so a rebooted replica has
+    nothing to replay. Bootstraps the base generation from
+    ``--model-dir`` when the root holds none."""
+    from photon_ml_tpu.boot import GenerationStore
+    from photon_ml_tpu.boot.generations import publish_generation
+    from photon_ml_tpu.serving.publish import DeltaStore
+
+    store = GenerationStore(args.compact_generations)
+    if not store.versions():
+        gen, _ = publish_generation(args.model_dir,
+                                    args.compact_generations)
+        ledger.record("publish", phase="generation_bootstrap",
+                      generation=gen)
+    out = store.compact(DeltaStore(args.publish_dir))
+    if out is None:  # chain already folded — idempotent no-op
+        return {"generation": store.current_version(),
+                "compaction_skipped": True}
+    gen, path = out
+    ledger.record("publish", phase="compacted", generation=gen,
+                  path=path)
+    logger.info("delta chain compacted into generation gen-%06d (%s)",
+                gen, path)
+    return {"generation": gen, "generation_path": path}
+
+
 def push_to_fleet(args, delta, ledger) -> dict:
     """Drive the fleet's canary ladder over HTTP; raises the publish
     taxonomy mapped back from the front door's defined statuses."""
@@ -196,6 +232,7 @@ def push_to_fleet(args, delta, ledger) -> dict:
 
 def run(args) -> int:
     setup_logging()
+    from photon_ml_tpu.boot import GenerationError
     from photon_ml_tpu.obs.ledger import RunLedger
     from photon_ml_tpu.serving.publish import (CanaryRejected,
                                                DeltaStore, PublishError)
@@ -222,6 +259,8 @@ def run(args) -> int:
                    "path": delta.path}
         if not args.fleet_url:
             summary["published"] = False
+            if args.compact_generations:
+                summary.update(compact_generations(args, ledger))
             print(json.dumps(summary))
             return 0
         try:
@@ -245,9 +284,11 @@ def run(args) -> int:
             status = "error"
             return 2
         summary.update({"published": True, **verdict})
+        if args.compact_generations:
+            summary.update(compact_generations(args, ledger))
         print(json.dumps(summary))
         return 0
-    except (PublishError, ValueError, OSError) as e:
+    except (PublishError, GenerationError, ValueError, OSError) as e:
         logger.error("publish failed: %s", e)
         status = "error"
         return 2

@@ -67,7 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/SERVING.md \"Quantized device cache\")")
     p.add_argument("--store-shards", type=int, default=8,
                    help="hash shards of the host-resident random-effect "
-                        "store")
+                        "store (mapped boots keep the generation's "
+                        "tables whole and gather directly — the shard "
+                        "count then only names the future RPC seam)")
+    p.add_argument("--boot-warmup", action="store_true",
+                   help="touch every power-of-two bucket shape before "
+                        "serving, so the first real request never pays "
+                        "a compile; with the persistent compilation "
+                        "cache warm these are disk hits "
+                        "(photon_compile_cache_hits_total) — the "
+                        "boot.warmup phase of docs/SERVING.md "
+                        "\"Sub-second restart\"")
     p.add_argument("--max-queue", type=int, default=None,
                    help="admission-control bound on queued requests "
                         "(default 16×max-batch); overflow sheds with "
@@ -118,11 +128,32 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def load_model(args):
-    """Load (model, entity_vocabs) per the driver's format flags."""
+    """Load (model, entity_vocabs, boot_meta) per the driver's format
+    flags. ``boot_meta`` is ``{"generation": g, "model_version": v}``
+    for a mapped/generation boot and ``{}`` for the classic layouts —
+    layout auto-detection (photon_ml_tpu/boot) means a ``--model-dir``
+    pointing at a generation root boots the CURRENT generation with the
+    corruption fallback ladder, with zero new flags."""
+    from photon_ml_tpu import boot
+
     vocabs = None
     if args.entity_vocabs:
         with open(args.entity_vocabs) as f:
             vocabs = json.load(f)
+    kind, path, _ = boot.resolve_model_path(args.model_dir)
+    if kind == "generations" and args.model_format != "AVRO":
+        model, marker, gen = boot.GenerationStore(path).load_current()
+        logger.info("mapped boot: generation gen-%06d (model_version "
+                    "%d) of %s", gen, int(marker.get("model_version", 0)),
+                    path)
+        return model, vocabs, {"generation": gen,
+                               "model_version":
+                                   int(marker.get("model_version", 0))}
+    if kind == "mapped" and args.model_format != "AVRO":
+        model, marker = boot.load_mapped_model(path)
+        return model, vocabs, {"generation": marker.get("generation"),
+                               "model_version":
+                                   int(marker.get("model_version", 0))}
     if args.model_format == "AVRO":
         from photon_ml_tpu.avro.model_io import (load_game_model_avro,
                                                  load_index_maps)
@@ -140,17 +171,47 @@ def load_model(args):
                 with open(vocab_path) as f:
                     vocabs = json.load(f)
         return load_game_model_avro(args.model_dir, imaps,
-                                    entity_vocabs=vocabs), vocabs
+                                    entity_vocabs=vocabs), vocabs, {}
     # host=True: random-effect tables go straight to the host store —
     # never staged through device memory on the way in.
-    return model_io.load_game_model(args.model_dir, host=True), vocabs
+    return model_io.load_game_model(args.model_dir, host=True,
+                                    mapped=False), vocabs, {}
+
+
+def _boot_phase_gauges(phases: dict[str, float],
+                       generation) -> None:
+    """``photon_boot_seconds{phase=...}`` + ``photon_model_generation``
+    — the restart tail as numbers, not a log line (one None check when
+    metrics are off)."""
+    from photon_ml_tpu import obs
+
+    mx = obs.metrics()
+    if mx is None:
+        return
+    for phase, seconds in phases.items():
+        mx.gauge("photon_boot_seconds", phase=phase).set(seconds)
+    if generation is not None:
+        mx.gauge("photon_model_generation").set(float(generation))
 
 
 def create_server(args):
     """Build the resident service + bound HTTP server (not yet serving).
 
     Split from ``main`` so tests and embedding callers can drive the
-    server loop themselves; returns (server, service)."""
+    server loop themselves; returns (server, service).
+
+    Construction is attributed as a ``serving.boot`` span with
+    ``boot.map`` (model load — an mmap for generation/mapped layouts, a
+    parse for npz), ``boot.compile`` (service + program construction)
+    and ``boot.warmup`` (bucket-shape touches, ``--boot-warmup``)
+    children — recorded AFTER the fact via ``record_complete`` so the
+    service's own lifecycle span (the ScoringStart/Finish bridge pair,
+    which outlives boot by the whole serving session) never nests
+    inside a boot phase (docs/SERVING.md "Sub-second restart")."""
+    import time as _time
+
+    from photon_ml_tpu import obs
+
     if getattr(args, "fault_plan", None):
         from photon_ml_tpu import faults as flt
 
@@ -158,11 +219,21 @@ def create_server(args):
             flt.install(flt.FaultPlan.from_json(f.read()))
         logger.warning("fault plan %s ARMED in this server",
                        args.fault_plan)
+    marks = {}
+
+    def _phase(name, t0, e0):
+        marks[name] = (e0, _time.perf_counter() - t0)
+
+    t_boot, e_boot = _time.perf_counter(), _time.time_ns()
     enable_compilation_cache()
-    model, vocabs = load_model(args)
+    t0, e0 = _time.perf_counter(), _time.time_ns()
+    model, vocabs, boot_meta = load_model(args)
+    _phase("boot.map", t0, e0)
+    t0, e0 = _time.perf_counter(), _time.time_ns()
     service = ScoringService(
         model, as_mean=args.as_mean, max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms, cache_entities=args.cache_entities,
+        max_wait_ms=args.max_wait_ms,
+        cache_entities=args.cache_entities,
         cache_dtype=getattr(args, "cache_dtype", "float32"),
         store_shards=args.store_shards, entity_vocabs=vocabs,
         max_queue=args.max_queue,
@@ -170,7 +241,35 @@ def create_server(args):
         slo_window_s=getattr(args, "slo_window_s", 60.0),
         slo_availability=getattr(args, "slo_availability", 0.999),
         slo_latency_ms=getattr(args, "slo_latency_ms", None),
-        replica_id=getattr(args, "replica_id", None))
+        replica_id=getattr(args, "replica_id", None),
+        initial_version=int(boot_meta.get("model_version", 0) or 0),
+        boot_generation=boot_meta.get("generation"))
+    _phase("boot.compile", t0, e0)
+    if getattr(args, "boot_warmup", False):
+        t0, e0 = _time.perf_counter(), _time.time_ns()
+        shapes = service.warmup()
+        _phase("boot.warmup", t0, e0)
+        logger.info("boot warmup: %d bucket shape(s) in %.3fs", shapes,
+                    marks["boot.warmup"][1])
+    total = _time.perf_counter() - t_boot
+    tr = obs.tracer()
+    if tr is not None:
+        bid = tr.record_complete("serving.boot", cat="serving",
+                                 t0_epoch_ns=e_boot, dur_s=total,
+                                 generation=boot_meta.get("generation"))
+        for name, (e0, dur) in marks.items():
+            tr.record_complete(name, cat="serving", t0_epoch_ns=e0,
+                               dur_s=dur, parent=bid)
+    phases = {"map": marks["boot.map"][1],
+              "compile": marks["boot.compile"][1],
+              "warmup": marks.get("boot.warmup", (0, 0.0))[1],
+              "total": total}
+    t_map, t_compile, t_warm = (phases["map"], phases["compile"],
+                                phases["warmup"])
+    _boot_phase_gauges(phases, boot_meta.get("generation"))
+    logger.info("boot: map %.3fs, compile %.3fs, warmup %.3fs "
+                "(generation %s)", t_map, t_compile, t_warm,
+                boot_meta.get("generation"))
     server = make_http_server(service, host=args.host, port=args.port)
     if getattr(args, "ready_file", None):
         # Atomic: the supervisor polling this file must never read a

@@ -57,6 +57,11 @@ FLEET_ROUTE = "fleet.route"
 FLEET_PROBE = "fleet.probe"
 FLEET_REPLICA_FLUSH = "fleet.replica_flush"
 
+# -- boot: mmap model publication (boot/mapfmt.py, boot/generations.py) ------
+BOOT_MAP_WRITE = "boot.map_write"
+BOOT_MAP_OPEN = "boot.map_open"  # corrupt_file (post-CRC bit rot in a blob)
+BOOT_COMPACT = "boot.compact"
+
 # -- continuous publication (serving/publish.py, serving/fleet.py,
 #    serving/model_store.py) -------------------------------------------------
 PUBLISH_DELTA_WRITE = "publish.delta_write"

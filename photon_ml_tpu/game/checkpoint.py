@@ -445,9 +445,19 @@ class StreamingStateStore:
 
     # -- write -------------------------------------------------------------
 
-    def save(self, state: dict, fingerprint: Optional[dict] = None) -> None:
+    def save(self, state: dict, fingerprint: Optional[dict] = None,
+             environment: Optional[dict] = None) -> None:
         """Persist one iteration snapshot (rank 0 only — the store lives
-        on the shared checkpoint filesystem)."""
+        on the shared checkpoint filesystem).
+
+        ``environment`` records where the snapshot was TAKEN (device
+        count, mesh shape) — informational, never validated: the
+        snapshot arrays are all device-count-free ``(d,)``/``(M, d)``
+        driver state (optim/streaming.snapshot_state), and the chunk
+        ranges are re-derived from ``shard_chunk_ranges(num_chunks, D′)``
+        at construction, so a checkpoint written at D devices resumes at
+        D′ ≠ D (docs/STREAMING.md "Elastic resume"). What MUST match
+        rides in ``fingerprint``."""
         import jax
 
         from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
@@ -476,6 +486,7 @@ class StreamingStateStore:
                 "crc": crc,
                 "iteration": int(state["it"]),
                 "fingerprint": fingerprint,
+                "environment": environment,
             }).encode()))
         mx = obs.metrics()
         if mx is not None:
@@ -516,11 +527,18 @@ class StreamingStateStore:
                                type(e).__name__, e)
         return None
 
-    def load(self, expected_fingerprint: Optional[dict] = None
-             ) -> Optional[dict]:
+    def load(self, expected_fingerprint: Optional[dict] = None,
+             environment: Optional[dict] = None) -> Optional[dict]:
         """The newest committed snapshot, or None (absent, corrupt in
         both generations, or written under a different fingerprint —
-        the step then re-optimizes from its warm start)."""
+        the step then re-optimizes from its warm start).
+
+        ``environment`` is the LOADER's device environment; when it
+        differs from the one recorded at save time the resume is
+        ELASTIC — announced loudly (a D→D′ resume changes accumulation
+        order, so values drift within the sharded-parity tolerance
+        instead of staying byte-equal) but never rejected: that is the
+        preemptible-hardware contract (docs/STREAMING.md)."""
         flt.fire(flt.sites.STREAM_CHECKPOINT_LOAD)
         meta_path = os.path.join(self.directory, _STREAM_META)
         meta = self._read_meta(meta_path)
@@ -556,6 +574,15 @@ class StreamingStateStore:
                 directory=self.directory,
                 done_steps=int(meta["iteration"]),
                 reason="stream state CRC mismatch"))
+        saved_env = meta.get("environment")
+        if (environment is not None and saved_env is not None
+                and saved_env != environment):
+            logger.warning(
+                "ELASTIC resume at %s: snapshot written under %s, "
+                "resuming under %s — chunk ranges re-shard over the new "
+                "device count; expect sharded-parity (not byte) "
+                "agreement with the writing run", self.directory,
+                saved_env, environment)
         return state
 
     def clear(self) -> None:

@@ -25,6 +25,15 @@ driver-loop state through game/checkpoint.py's StreamingStateStore
 (CRC + two generations), and a killed fit resumes mid-optimization with
 BIT-identical final coefficients.
 
+Device-ELASTIC resume (docs/STREAMING.md "Elastic resume"): the
+snapshot is pure driver-loop state — ``(d,)`` vectors and the ``(M, d)``
+curvature ring, nothing sharded — and ``shard_chunk_ranges`` re-derives
+each device's chunk range from ``(num_chunks, D′)`` at construction, so
+a checkpoint written at D devices resumes at D′ ≠ D: D′ = D stays
+byte-equal, D → D′ agrees within the established sharded-parity
+tolerance (accumulation order moves with the psum lanes). This is what
+lets the n=100M flagship run on preemptible/resizable hardware.
+
 Streaming contract: the chunks must be staged with ZERO offsets — in
 coordinate descent the full residual (base offsets + other coordinates'
 scores) arrives as the ``offsets`` argument of ``train_model``, and
@@ -297,14 +306,22 @@ class StreamingSparseFixedEffectCoordinate:
         resume_state = None
         if self._ckpt_store is not None:
             fp = self._stream_fingerprint(off, w0)
+            # The device environment rides BESIDE the fingerprint, never
+            # inside it: a snapshot written at D devices must resume at
+            # D′ ≠ D (the preemptible/resize contract — chunk ranges
+            # re-shard at construction), so device count can never be a
+            # reason to discard driver-loop state.
+            env = {"num_devices": (self._stream.num_devices
+                                   if self._stream is not None else 1)}
             store = self._ckpt_store
-            resume_state = store.load(expected_fingerprint=fp)
+            resume_state = store.load(expected_fingerprint=fp,
+                                      environment=env)
             if resume_state is not None:
                 self._log(f"resuming streamed fit from iteration "
                           f"{int(resume_state['it'])} checkpoint")
 
-            def checkpoint_save(state, _store=store, _fp=fp):
-                _store.save(state, fingerprint=_fp)
+            def checkpoint_save(state, _store=store, _fp=fp, _env=env):
+                _store.save(state, fingerprint=_fp, environment=_env)
 
         result = minimize_streaming(vg, w0, self.config.optimizer,
                                     log=self._log, value_only=v,

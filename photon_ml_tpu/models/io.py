@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import Optional
 
@@ -131,7 +132,8 @@ def save_game_model(model: GameModel, path: str) -> None:
     write_metadata(path, model.task, meta)
 
 
-def load_game_model(path: str, host: bool = False) -> GameModel:
+def load_game_model(path: str, host: bool = False,
+                    mapped: Optional[bool] = None) -> GameModel:
     """Inverse of save_game_model (reference: loadGameModelFromHDFS).
 
     ``host=True`` keeps every coefficient table as host numpy instead of
@@ -140,7 +142,24 @@ def load_game_model(path: str, host: bool = False) -> GameModel:
     anyway; staging a multi-GB (E, d) table through device memory first
     would defeat the residency design). Scoring works either way
     (``score`` does its own ``jnp.asarray``).
+
+    ``mapped`` routes through the columnar mmap format (boot/mapfmt.py
+    — zero-copy host views over the page cache, bit-identical to this
+    loader by construction): ``True`` prefers it and FALLS BACK to the
+    npz layout when the directory does not carry one; ``None`` (the
+    default) auto-detects by layout; ``False`` forces npz. Mapped loads
+    are host-resident by nature (the serving contract); ``host=False``
+    still works — scoring's ``jnp.asarray`` commits on first use.
     """
+    if mapped is not False:
+        from photon_ml_tpu.boot import mapfmt
+
+        if mapfmt.is_mapped_model(path):
+            return mapfmt.load_mapped_model(path)[0]
+        if mapped:
+            logging.getLogger("photon_ml_tpu.boot").info(
+                "no mapped model at %s — falling back to the npz "
+                "layout", path)
     put = np.asarray if host else jnp.asarray
     with open(os.path.join(path, _METADATA)) as f:
         meta = json.load(f)

@@ -40,6 +40,7 @@ _EVENT_COUNTERS = {
     "StagingRetry": "photon_staging_retries_total",
     "StagingStraggler": "photon_staging_stragglers_total",
     "CheckpointRecovered": "photon_checkpoint_recoveries_total",
+    "BootRecovered": "photon_boot_recoveries_total",
     "IngestFallback": "photon_ingest_fallbacks_total",
 }
 

@@ -513,11 +513,24 @@ def _count_kernel_build(cache: str, dtype: str) -> None:
                    dtype=dtype).inc()
 
 
+def _count_kernel_hit(cache: str, dtype: str) -> None:
+    """The hit side of the same ledger: a warm pass re-using its
+    compiled program. Boot/warm-restart paths should show HITS climbing
+    beside a flat miss counter — silence there means the cache key
+    rotated and every restart recompiles (docs/SERVING.md "Sub-second
+    restart")."""
+    mx = obs.metrics()
+    if mx is not None:
+        mx.counter("photon_compile_cache_hits_total", cache=cache,
+                   dtype=dtype).inc()
+
+
 def _chunk_value_grad(loss: PointwiseLoss, dtype: str = "float32"):
     """One jitted per-chunk pass: original-space w in, original-space
     (value, grad) out — shared by every chunk (identical structures)."""
     f = _VG_KERNELS.get((loss.name, dtype))
     if f is not None:
+        _count_kernel_hit("stream_value_grad", dtype)
         return f
     _count_kernel_build("stream_value_grad", dtype)
 
@@ -543,6 +556,7 @@ def _chunk_value(loss: PointwiseLoss, dtype: str = "float32"):
     gradient work on every rejected step."""
     f = _V_KERNELS.get((loss.name, dtype))
     if f is not None:
+        _count_kernel_hit("stream_value_only", dtype)
         return f
     _count_kernel_build("stream_value_only", dtype)
 
@@ -981,7 +995,14 @@ def shard_chunk_ranges(num_chunks: int, num_devices: int
     Contiguous (not round-robin) so each device's offsets slice is one
     block of the global (padded_n,) residual array and the short padded
     tail chunk stays on the LAST device — the pad-rows-at-stream-tail
-    invariant holds per device."""
+    invariant holds per device.
+
+    A pure function of ``(num_chunks, num_devices)`` — nothing about
+    the assignment is persisted anywhere. That is the elastic-resume
+    contract (docs/STREAMING.md): a StreamingStateStore snapshot
+    carries only device-count-free driver state, and the ranges are
+    re-derived HERE on every construction, so a fit checkpointed at D
+    devices resumes at D′ ≠ D with re-sharded ranges."""
     if num_devices < 1:
         raise ValueError(f"num_devices must be >= 1, got {num_devices}")
     base, rem = divmod(num_chunks, num_devices)
@@ -1024,6 +1045,7 @@ def _merge_fn(mesh):
 
     cached = _MERGE_FNS.get(mesh)
     if cached is not None:
+        _count_kernel_hit("stream_psum_merge", "float32")
         return cached
     # The merge reduces f32 partials regardless of chunk storage dtype.
     _count_kernel_build("stream_psum_merge", "float32")

@@ -209,8 +209,9 @@ class FleetMetrics:
                     self.publish_swap_seconds_max,
             }
 
-    def render_text(self, states: dict[int, str],
-                    degraded: bool) -> str:
+    def render_text(self, states: dict[int, str], degraded: bool,
+                    boot_seconds: Optional[dict[int, float]] = None
+                    ) -> str:
         """Prometheus-style ``photon_fleet_*`` lines (the metric
         catalog rows in docs/OBSERVABILITY.md)."""
         s = self.snapshot()
@@ -257,6 +258,12 @@ class FleetMetrics:
                 f"photon_fleet_requests_routed_total"
                 f"{{replica=\"{rid}\"}} "
                 f"{s['requests_by_replica'].get(rid, 0)}")
+            if boot_seconds is not None and rid in boot_seconds:
+                # spawn → first healthy probe of the LAST (re)start —
+                # the fleet-side view of photon_boot_seconds.
+                lines.append(
+                    f"photon_fleet_replica_boot_seconds"
+                    f"{{replica=\"{rid}\"}} {boot_seconds[rid]:.6f}")
         slo = self.slo.snapshot()
         lines.append(f"photon_fleet_slo_requests_in_window "
                      f"{slo['requests_in_window']}")
@@ -499,7 +506,21 @@ class ServingFleet:
     def _reapply_published(self, replica_id: int) -> None:
         with self._publish_lock:
             chain = list(self._published)
+        if not chain:
+            return
+        # A replica that mmap-booted a COMPACTED generation
+        # (boot/generations.py) already holds some prefix of the chain
+        # folded into its tables — /healthz says how much; replaying a
+        # folded delta would fail the parent check and strand the rest.
+        base = 0
+        try:
+            base = int(self._replica_get_json(
+                replica_id, "/healthz").get("model_version", 0) or 0)
+        except (OSError, ValueError):
+            pass  # unknown base: replay everything (the classic boot)
         for version, path in chain:
+            if version <= base:
+                continue
             try:
                 self._replica_post(replica_id, "/admin/delta",
                                    {"path": path})
@@ -753,8 +774,11 @@ class ServingFleet:
         }
 
     def metrics_text(self) -> str:
-        return self.metrics.render_text(self.supervisor.states(),
-                                        self.healthz()["degraded"])
+        return self.metrics.render_text(
+            self.supervisor.states(), self.healthz()["degraded"],
+            boot_seconds={h.replica_id: h.boot_seconds
+                          for h in self.supervisor.replicas
+                          if h.boot_seconds > 0.0})
 
     def slo_snapshot(self) -> dict:
         out = self.metrics.slo.snapshot()

@@ -69,18 +69,30 @@ class HashShardedStore:
     """
 
     def __init__(self, model, num_shards: int = 8):
+        from photon_ml_tpu.boot.mapfmt import is_mapped_array
+
         self.num_shards = int(num_shards)
         self.num_entities = int(model.num_entities)
         if isinstance(model, SubspaceRandomEffectModel):
             self.dim = int(model.num_features)
         else:
             self.dim = int(model.dim)
-        ids = np.arange(self.num_entities, dtype=np.int64)
-        part = [ids[ids % self.num_shards == s]
-                for s in range(self.num_shards)]
         # Only the dense representation accepts published row deltas
         # (swap_rows); the flag is the serving store's capability probe.
         self.mutable = isinstance(model, RandomEffectModel)
+        # mmap-backed models (boot/mapfmt.py) take the ZERO-COPY path:
+        # the eager `table[partition]` below would fault every page in
+        # and copy the whole (E, d) tier at boot — exactly the parse
+        # cost sub-second restart exists to kill. Direct mode keeps the
+        # mapped tables whole (fetch gathers just the requested rows off
+        # the page cache) and absorbs published row swaps into a sparse
+        # host OVERLAY instead of copying a table to write 50 rows.
+        self.mapped = self._init_direct(model, is_mapped_array)
+        if self.mapped:
+            return
+        ids = np.arange(self.num_entities, dtype=np.int64)
+        part = [ids[ids % self.num_shards == s]
+                for s in range(self.num_shards)]
         if isinstance(model, RandomEffectModel):
             means = np.asarray(model.means, np.float32)
             self._shards = [(means[p],) for p in part]
@@ -101,10 +113,55 @@ class HashShardedStore:
             raise TypeError(f"unsupported random-effect model type "
                             f"{type(model).__name__}")
 
+    def _init_direct(self, model, is_mapped_array) -> bool:
+        """Arrange the zero-copy representation when the model's tables
+        are mmap-backed; returns False (→ eager sharding) otherwise."""
+        if isinstance(model, RandomEffectModel):
+            table = np.asarray(model.means)
+            if not is_mapped_array(table):
+                return False
+            table = table.astype(np.float32, copy=False)
+            self._direct = (table,)
+            self._densify_direct = lambda payload, ids: payload[0][ids]
+        elif isinstance(model, SubspaceRandomEffectModel):
+            cols = np.asarray(model.cols)
+            means = np.asarray(model.means)
+            if not (is_mapped_array(cols) and is_mapped_array(means)):
+                return False
+            nf = int(model.num_features)
+            self._direct = (cols, means.astype(np.float32, copy=False))
+            self._densify_direct = \
+                lambda payload, ids: dense_rows_from_subspace(
+                    payload[0][ids], payload[1][ids], nf)
+        elif isinstance(model, FactoredRandomEffectModel):
+            factors = np.asarray(model.factors)
+            if not is_mapped_array(factors):
+                return False
+            proj_t = np.asarray(model.projection, np.float32).T
+            self._direct = (factors.astype(np.float32, copy=False),)
+            self._densify_direct = \
+                lambda payload, ids: payload[0][ids] @ proj_t
+        else:
+            return False
+        # Published row swaps land here: entity id → replacement row.
+        self._overlay: dict[int, np.ndarray] = {}
+        return True
+
     def fetch(self, ids: np.ndarray) -> np.ndarray:
         """Dense (len(ids), dim) rows for in-table ids (the cache-fill
         path). Grouped by shard; result rows follow the input order."""
         ids = np.asarray(ids, np.int64)
+        if self.mapped:
+            # One fancy-index gather straight off the mapped pages —
+            # copies exactly the requested rows, nothing else.
+            out = np.asarray(self._densify_direct(self._direct, ids),
+                             np.float32)
+            if self._overlay:
+                for i, e in enumerate(ids):
+                    row = self._overlay.get(int(e))
+                    if row is not None:
+                        out[i] = row
+            return out
         out = np.zeros((ids.shape[0], self.dim), np.float32)
         sid = ids % self.num_shards
         for s in np.unique(sid):
@@ -120,7 +177,10 @@ class HashShardedStore:
         Dense stores only: subspace/factored shards keep coefficients in
         a representation a dense row cannot be written back into (the
         refit path produces dense rows), so those coordinates refuse
-        loudly instead of silently mis-writing."""
+        loudly instead of silently mis-writing. Mapped stores absorb the
+        swap into the overlay — the read-only generation artifact on
+        disk is never written (rollback = dropping overlay rows, and a
+        re-booted replica reads the artifact's committed bytes)."""
         if not self.mutable:
             raise ValueError(
                 "host store holds a non-dense random-effect "
@@ -128,16 +188,25 @@ class HashShardedStore:
                 "RandomEffectModel coordinates only")
         ids = np.asarray(ids, np.int64)
         rows = np.asarray(rows, np.float32)
+        if self.mapped:
+            for e, row in zip(ids, rows):
+                self._overlay[int(e)] = np.array(row, np.float32)
+            return
         sid = ids % self.num_shards
         for s in np.unique(sid):
             m = sid == s
             table = self._shards[int(s)][0]
-            if not table.flags.writeable:  # e.g. a mmap-backed load
+            if not table.flags.writeable:  # e.g. a read-only source
                 table = table.copy()
                 self._shards[int(s)] = (table,)
             table[ids[m] // self.num_shards] = rows[m]
 
     def host_bytes(self) -> int:
+        """Host address-space bytes of the coefficient tier (mapped
+        stores report the mapped extent — RESIDENT bytes are whatever
+        the page cache chose to keep, which is the point)."""
+        if self.mapped:
+            return sum(int(a.nbytes) for a in self._direct)
         return sum(int(a.nbytes) for payload in self._shards
                    for a in payload)
 
@@ -352,6 +421,7 @@ class ResidentModelStore:
         entity_vocabs: Optional[dict[str, dict]] = None,
         metrics_retry: Optional[Callable[[int], None]] = None,
         cache_dtype: str = "float32",
+        initial_version: int = 0,
     ):
         self.task = model.task
         self.entity_vocabs = entity_vocabs or {}
@@ -363,8 +433,11 @@ class ResidentModelStore:
         self._lock = threading.Lock()
         # Publication state (serving/publish.py): the version this
         # store serves and the undo rows of every applied delta, newest
-        # last — rollback restores them in reverse.
-        self.version = 0
+        # last — rollback restores them in reverse. A store booted from
+        # a COMPACTED generation (boot/generations.py) starts at the
+        # folded model_version, so the chain-order check accepts only
+        # deltas genuinely newer than its tables.
+        self.version = int(initial_version)
         self._undo: list[tuple[int, dict]] = []
         for cid, m in model.models.items():
             if isinstance(m, FixedEffectModel):

@@ -111,12 +111,19 @@ class ScoringService:
         slo_availability: float = 0.999,
         slo_latency_ms: Optional[float] = None,
         replica_id: Optional[int] = None,
+        initial_version: int = 0,
+        boot_generation: Optional[int] = None,
         emitter=default_emitter,
     ):
         # Fleet membership (serving/fleet.py): the id is this replica's
         # stable index for fault addressing (`fleet.replica_flush`
         # fires with it) and for log/error attribution.
         self.replica_id = replica_id
+        # Boot provenance (boot/generations.py): which generation this
+        # service mapped (None = a classic npz boot); surfaced on
+        # /healthz + photon_model_generation so the fleet and dashboards
+        # can tell a stale replica from a current one.
+        self.boot_generation = boot_generation
         # A flush's unique entities must fit the cache simultaneously
         # (model_store pins them during resolve), so the effective budget
         # is at least max_batch.
@@ -124,7 +131,7 @@ class ScoringService:
             model, cache_entities=max(int(cache_entities), int(max_batch)),
             store_shards=store_shards, entity_vocabs=entity_vocabs,
             metrics_retry=self._record_store_retry,
-            cache_dtype=cache_dtype)
+            cache_dtype=cache_dtype, initial_version=initial_version)
         self.as_mean = bool(as_mean)
         self.max_batch = int(max_batch)
         self.metrics = ServingMetrics(slo_window_s=slo_window_s,
@@ -250,9 +257,22 @@ class ScoringService:
                     slots[st.cid],
                     np.full(padded - n, st.fallback_slot, np.int32)])
                 for st in self.store.random}
+            mx = obs.metrics()
             if padded not in self._compile_keys:
                 self._compile_keys.add(padded)
                 self.metrics.record_compile()
+                if mx is not None:
+                    mx.counter("photon_compile_cache_misses_total",
+                               cache="serving_score",
+                               dtype=self.store.cache_dtype).inc()
+            elif mx is not None:
+                # The hit side of the program-cache ledger: a warm boot
+                # whose warmup re-runs already-owned bucket shapes shows
+                # HITS here, not silence (docs/SERVING.md "Sub-second
+                # restart").
+                mx.counter("photon_compile_cache_hits_total",
+                           cache="serving_score",
+                           dtype=self.store.cache_dtype).inc()
             t_d0 = time.monotonic()  # device: dispatch + block on result
             out = self._score_fn(mats, offsets, slots_full,
                                  self.store.caches(),
@@ -264,6 +284,28 @@ class ScoringService:
         self.emitter.emit(ScoringBatch(source="serving", rows=n,
                                        padded_rows=padded, seconds=dt))
         return out[:n], (t_a0, t_d0, t_d1)
+
+    def warmup(self) -> int:
+        """Touch every power-of-two bucket shape once so steady state
+        (and the first real request) owns its compiled programs — the
+        ``boot.warmup`` phase of a replica restart. Warmup rows carry no
+        features and no entity ids (fallback slot only), so caches and
+        scores are untouched; with the persistent compilation cache
+        warm, every build here is a disk hit, not a compile. Returns the
+        number of bucket shapes touched."""
+        shapes = 0
+        n = 1
+        while n <= self.max_batch:
+            self._score_chunk([ScoringRequest(features={})
+                               for _ in range(n)])
+            shapes += 1
+            n *= 2
+        # One re-run of the smallest bucket verifies the programs now
+        # dispatch WARM — and moves the hit counter at boot, so a
+        # restart whose cache key rotated (every shape recompiling)
+        # is visible as hits staying at zero.
+        self._score_chunk([ScoringRequest(features={})])
+        return shapes
 
     def score(self, requests: Sequence[ScoringRequest]) -> np.ndarray:
         """Programmatic batch API: score now, bypassing the queue (the
@@ -495,7 +537,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         elif self.path == "/healthz":
             self._json(200, {"status": "ok",
                              "model_version":
-                                 self.service.model_version})
+                                 self.service.model_version,
+                             "generation":
+                                 self.service.boot_generation})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 

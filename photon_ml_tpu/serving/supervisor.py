@@ -73,6 +73,8 @@ class ReplicaHandle:
     last_ok: float = 0.0  # monotonic instant of the last good probe
     restarts: int = 0
     log_path: str = ""
+    boot_seconds: float = 0.0  # spawn → first healthy probe, last (re)start
+    spawned_at: float = 0.0  # monotonic instant of the last _spawn
 
     def base_url(self) -> str:
         return f"http://{self.host}:{self.port}"
@@ -162,12 +164,18 @@ class ReplicaSupervisor:
         finally:
             log_f.close()  # the child holds its own descriptor now
         handle.state = STARTING
+        handle.spawned_at = time.monotonic()
         logger.info("replica %d spawned (pid %d, log %s)", rid,
                     handle.proc.pid, handle.log_path)
 
     def _await_ready(self, handle: ReplicaHandle) -> None:
-        """Wait for the ready-file handshake, then a first good probe."""
+        """Wait for the ready-file handshake, then a first good probe.
+        The spawn→healthy wall lands in ``handle.boot_seconds`` — the
+        replica-restart tail photon-boot attacks, measured where the
+        fleet actually waits for it (``bench_serving.py --restart``
+        reads it back as ``photon_fleet_replica_boot_seconds``)."""
         rid = handle.replica_id
+        t_spawn = handle.spawned_at or time.monotonic()
         ready = self._ready_file(rid, handle.restarts)
         deadline = time.monotonic() + self.start_timeout_s
         while time.monotonic() < deadline:
@@ -195,8 +203,9 @@ class ReplicaSupervisor:
                 with self._lock:
                     handle.state = UP
                     handle.last_ok = time.monotonic()
-                logger.info("replica %d healthy at %s", rid,
-                            handle.base_url())
+                    handle.boot_seconds = handle.last_ok - t_spawn
+                logger.info("replica %d healthy at %s (boot %.3fs)", rid,
+                            handle.base_url(), handle.boot_seconds)
                 return
             except (OSError, ValueError):
                 time.sleep(0.05)

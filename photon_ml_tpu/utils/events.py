@@ -188,6 +188,21 @@ class CheckpointRecovered(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class BootRecovered(Event):
+    """A generation store's CURRENT generation could not be trusted
+    (blob CRC mismatch, torn/unparseable marker) and the boot path fell
+    back one committed generation (boot/generations.py). Loud by
+    contract: a replica that silently booted an older model would serve
+    stale rows with no operator signal — the obs bridge turns this into
+    a timeline instant + ``photon_boot_recoveries_total``."""
+
+    directory: str
+    from_version: int  # the generation that failed verification
+    to_version: int  # the generation actually booted
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
 class WatchdogAlert(Event):
     """A convergence watchdog fired (obs/watchdog.py): ``kind`` names
     the detector (nan/stall/divergence/slow_iter), ``action`` what

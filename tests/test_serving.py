@@ -391,7 +391,8 @@ def test_http_score_and_metrics_endpoints(rng):
         assert "photon_serving_re_cache_hit_rate" in text
         health = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=30).read())
-        assert health == {"status": "ok", "model_version": 0}
+        assert health == {"status": "ok", "model_version": 0,
+                          "generation": None}
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(urllib.request.Request(
                 f"http://127.0.0.1:{port}/score", data=b"{}"), timeout=30)
