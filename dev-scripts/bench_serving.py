@@ -101,6 +101,28 @@ def build_parser():
                    help="target-QPS levels of the fleet sweep (smaller "
                         "than the single-process sweep: every request "
                         "crosses one more HTTP hop)")
+    # -- Zipf-skew sweep (docs/SERVING.md "Elastic fleet") -------------------
+    p.add_argument("--zipf-sweep", action="store_true",
+                   help="sweep the ELASTIC fleet across Zipf exponents "
+                        "(with --fleet): at each skew, find the "
+                        "saturation knee + steady p99 with the elastic "
+                        "control loop armed, and measure the STATIC "
+                        "map's degradation alongside — the acceptance "
+                        "claim is knee retention as the head "
+                        "concentrates (fleet_knee_vs_skew_curve, "
+                        "fleet_p99_vs_skew_curve; gated by "
+                        "check_bench_regression.py)")
+    p.add_argument("--zipf-skews", default="0.0,0.6,0.9,1.2",
+                   help="comma-separated Zipf exponents of the skew "
+                        "sweep")
+    p.add_argument("--zipf-qps", default="30,60,90",
+                   help="target-QPS levels probed per skew (ascending)")
+    p.add_argument("--zipf-seconds-per-level", type=float, default=2.0)
+    p.add_argument("--zipf-static-baseline", dest="zipf_static",
+                   action="store_true", default=True,
+                   help="also measure the static-map baseline per skew")
+    p.add_argument("--no-zipf-static-baseline", dest="zipf_static",
+                   action="store_false")
     # -- publish arm (docs/SERVING.md "Continuous publication") --------------
     p.add_argument("--publish", action="store_true",
                    help="measure a live delta publish: open-loop "
@@ -956,6 +978,203 @@ def run_fleet(args, load_seconds_unused=None):
     return out
 
 
+# -- Zipf-skew sweep (elastic vs static map) ---------------------------------
+
+
+def _fleet_open_loop_level(url, objs, qps, seconds, drain_timeout_s):
+    """One constant-arrival level against a fleet front door; returns
+    a level dict in the find_knee shape (latency measured from the
+    SCHEDULED arrival — no coordinated omission)."""
+    import concurrent.futures as cf
+    import urllib.error
+
+    n = max(1, int(round(qps * seconds)))
+    lock = threading.Lock()
+    state = {"lat": [], "shed": 0, "errors": 0, "t_last": 0.0}
+
+    def _one(obj, t_sched):
+        try:
+            _post_score(url, obj, timeout_s=30.0)
+            t_end = time.perf_counter()
+            with lock:
+                state["lat"].append(t_end - t_sched)
+                state["t_last"] = max(state["t_last"], t_end)
+        except urllib.error.HTTPError as e:
+            with lock:
+                if e.code == 503:
+                    state["shed"] += 1
+                else:
+                    state["errors"] += 1
+        except (OSError, ValueError):
+            with lock:
+                state["errors"] += 1
+
+    pool = cf.ThreadPoolExecutor(max_workers=64)
+    futs = []
+    period = 1.0 / qps
+    t0 = time.perf_counter()
+    try:
+        for i in range(n):
+            t_sched = t0 + i * period
+            delay = t_sched - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(pool.submit(_one, objs[i % len(objs)], t_sched))
+        cf.wait(futs, timeout=drain_timeout_s)
+    finally:
+        pool.shutdown(wait=False)
+    elapsed = max(state["t_last"], time.perf_counter()) - t0
+    lat = np.asarray(state["lat"]) * 1e3
+    ok = len(state["lat"])
+    return {
+        "target_qps": qps,
+        "offered": n,
+        "ok": ok,
+        "shed": state["shed"],
+        "deadline_exceeded": 0,
+        "errors": state["errors"],
+        "achieved_qps": round(ok / elapsed, 1) if elapsed > 0 else 0.0,
+        "p50_ms": round(float(np.percentile(lat, 50)), 4) if ok else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 4) if ok else None,
+    }
+
+
+def _zipf_leg(args, model_dir, workdir, skew, elastic_cfg, tag):
+    """One (skew, map-mode) leg: a fresh 2-replica fleet swept over the
+    ascending QPS levels; returns (knee, p99@lowest level, evidence)."""
+    import argparse as _argparse
+
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+
+    leg_args = _argparse.Namespace(**vars(args))
+    leg_args.entity_skew = skew
+    qps_levels = [float(q) for q in str(args.zipf_qps).split(",") if q]
+    n_objs = int(max(qps_levels) * args.zipf_seconds_per_level) + 64
+    objs = _fleet_request_objs(leg_args, n_objs,
+                               args.seed + int(skew * 100))
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir,
+                      "--max-batch", str(args.max_batch),
+                      "--max-wait-ms", str(args.max_wait_ms),
+                      "--cache-entities", str(args.cache_entities)],
+        num_replicas=args.fleet_replicas,
+        workdir=os.path.join(workdir, tag),
+        num_shards=args.fleet_num_shards,
+        probe_interval_s=0.1, heartbeat_deadline_s=2.0,
+        elastic=elastic_cfg)
+    server = None
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        for i in range(2 * args.fleet_replicas):  # warm both programs
+            _post_score(url, objs[i % len(objs)], timeout_s=60.0)
+        levels = []
+        for qps in qps_levels:
+            lv = _fleet_open_loop_level(
+                url, objs, qps, args.zipf_seconds_per_level,
+                args.drain_timeout_s)
+            levels.append(lv)
+            print(f"[zipf {tag}] s={skew:g} target {qps:g} qps: "
+                  f"achieved {lv['achieved_qps']:g}, p99 "
+                  f"{lv['p99_ms']}ms, shed {lv['shed']}",
+                  file=sys.stderr)
+        knee, _saturated = find_knee(levels)
+        snap = fleet.metrics.snapshot()
+        return knee, levels[0]["p99_ms"], {
+            "levels": levels,
+            "splits": snap["splits_total"],
+            "migrations": snap["migrations_total"],
+            "scale_ups": snap["scale_ups_total"],
+            "final_replicas": len(fleet.shard_map.live()),
+            "final_shards": len(fleet.shard_map.shards()),
+        }
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
+
+
+def run_zipf_sweep(args):
+    """The acceptance sweep of ROADMAP item 2: with the elastic loop
+    armed, knee QPS and steady p99 must hold as Zipf skew rises (the
+    static map's degradation is measured alongside as the comparison
+    line). Gated by check_bench_regression.py: knee at the highest
+    skew >= 0.9x the knee at zero skew, p99 in band; on boxes under 4
+    cores the fleet shares one core and the knee measures scheduling,
+    so the gate is reported-only (`zipf_sweep_valid: false` — the
+    restart-arm discipline)."""
+    import tempfile
+
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.serving import ElasticConfig
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    model = build_model(args)
+    workdir = tempfile.mkdtemp(prefix="photon-zipf-bench-")
+    model_dir = os.path.join(workdir, "model")
+    model_io.save_game_model(model, model_dir)
+    skews = [float(s) for s in str(args.zipf_skews).split(",") if s]
+    elastic_cfg = ElasticConfig(
+        interval_s=0.25, heat_window_s=5.0, split_factor=2.0,
+        min_heat_requests=16, scale_up_heat_frac=0.6,
+        hysteresis_ticks=2, cooldown_s=2.0,
+        max_replicas=args.fleet_replicas + 2,
+        min_replicas=args.fleet_replicas)
+
+    knees, p99s, evidence = {}, {}, {}
+    static_knees, static_p99s = {}, {}
+    for skew in skews:
+        k, p, ev_ = _zipf_leg(args, model_dir, workdir, skew,
+                              elastic_cfg, f"elastic-s{skew:g}")
+        knees[f"{skew:g}"] = k
+        p99s[f"{skew:g}"] = p
+        evidence[f"{skew:g}"] = ev_
+    if args.zipf_static:
+        for skew in skews:
+            k, p, _ = _zipf_leg(args, model_dir, workdir, skew, None,
+                                f"static-s{skew:g}")
+            static_knees[f"{skew:g}"] = k
+            static_p99s[f"{skew:g}"] = p
+
+    lo, hi = f"{min(skews):g}", f"{max(skews):g}"
+    retention = (knees[hi] / knees[lo]) if knees.get(lo) else 0.0
+    valid = (os.cpu_count() or 1) >= 4
+    secondary = {
+        "fleet_knee_vs_skew_curve": knees,
+        "fleet_p99_vs_skew_curve": p99s,
+        "fleet_static_knee_vs_skew_curve": static_knees,
+        "fleet_static_p99_vs_skew_curve": static_p99s,
+        "fleet_zipf_evidence": evidence,
+        "fleet_zipf_qps_levels": str(args.zipf_qps),
+        "zipf_sweep_valid": valid,
+        "config": f"E={args.num_entities} d_global={args.d_global} "
+                  f"d_re={args.d_re} replicas={args.fleet_replicas} "
+                  f"skews={args.zipf_skews} open-loop "
+                  f"cores={os.cpu_count()}",
+    }
+    if not valid:
+        secondary["zipf_sweep_invalid_reason"] = (
+            "box has < 4 cores: the replicas share one core, so the "
+            "knee measures scheduling, not shard balance; gates "
+            "reported-only")
+    if retention < 0.9:
+        print(f"WARNING: elastic knee retention {retention:.2f}x at "
+              f"s={hi} vs s={lo} — the elastic fleet is losing its "
+              f"knee to skew", file=sys.stderr)
+    return {
+        "metric": "fleet_knee_retention_at_max_skew",
+        "value": round(retention, 4),
+        "unit": "x",
+        "secondary": secondary,
+    }
+
+
 # -- restart arm -------------------------------------------------------------
 
 
@@ -1279,6 +1498,11 @@ def main(argv=None):
         return 0
     if args.publish:
         out = run_publish(args)
+        json.dump(out, sys.stdout)
+        print()
+        return 0
+    if args.zipf_sweep:
+        out = run_zipf_sweep(args)
         json.dump(out, sys.stdout)
         print()
         return 0

@@ -99,6 +99,16 @@ METRICS_TOLERANCE = 0.10
 # p99 when no committed baseline carries the line yet (detection +
 # failover + cold re-homed cache, all inside the window).
 FLEET_FAILURE_P99_FACTOR = 10.0
+# The elastic Zipf-sweep acceptance (bench_serving.py --fleet
+# --zipf-sweep; docs/SERVING.md "Elastic fleet"): with the control
+# loop armed, the knee at the highest skew must retain >= this
+# fraction of the zero-skew knee, and the steady p99 at the highest
+# skew may cost at most this factor over zero-skew. Gated only where
+# `zipf_sweep_valid` (>= 4 cores — a shared single core measures
+# scheduling, not shard balance); the static map's collapse is the
+# reported comparison line, never a gate.
+ELASTIC_KNEE_RETENTION = 0.9
+ELASTIC_P99_FACTOR = 2.0
 # The publish arm's bands (bench_serving.py --publish): the swap-window
 # p99 may cost this over the stream's own steady p99 (the swap holds
 # the flush lock for the row writes + LRU invalidation, nothing more),
@@ -522,6 +532,53 @@ def main() -> int:
                         f"fleet_p99_during_failure_ms: {p99_fail:g}ms "
                         f"> {limit:.3g}ms — the failure-window tail "
                         f"broke its band")
+
+    # --- elastic Zipf-sweep invariants (docs/SERVING.md "Elastic
+    # fleet"): knee QPS and steady p99 must HOLD as skew rises with
+    # the control loop armed; the static map's degradation rides
+    # alongside as the reported comparison line.
+    zipf_knees = fresh.get("fleet_knee_vs_skew_curve")
+    if isinstance(zipf_knees, dict) and len(zipf_knees) >= 2:
+        zipf_valid = fresh.get("zipf_sweep_valid") is not False
+        lo = min(zipf_knees, key=float)
+        hi = max(zipf_knees, key=float)
+        k_lo, k_hi = float(zipf_knees[lo]), float(zipf_knees[hi])
+        floor = ELASTIC_KNEE_RETENTION * k_lo
+        ok = k_hi >= floor
+        verdict = ("OK" if ok else
+                   "REGRESSION" if zipf_valid else
+                   "under floor (reported only: "
+                   f"{fresh.get('zipf_sweep_invalid_reason', 'gated')})")
+        print(f"fleet_knee_vs_skew_curve: s={hi} knee {k_hi:g} qps vs "
+              f"s={lo} knee {k_lo:g} qps (floor {floor:.3g}) {verdict}")
+        if zipf_valid and not ok:
+            failures.append(
+                f"fleet_knee_vs_skew_curve: knee at s={hi} is "
+                f"{k_hi:g} < {floor:.3g} qps "
+                f"({ELASTIC_KNEE_RETENTION:g}x the s={lo} knee) — the "
+                f"elastic fleet is losing its knee to skew")
+        zipf_p99 = fresh.get("fleet_p99_vs_skew_curve") or {}
+        p_lo, p_hi = zipf_p99.get(lo), zipf_p99.get(hi)
+        if p_lo is not None and p_hi is not None:
+            limit = float(p_lo) * ELASTIC_P99_FACTOR
+            ok = float(p_hi) <= limit
+            verdict = ("OK" if ok else
+                       "REGRESSION" if zipf_valid else
+                       "over limit (reported only)")
+            print(f"fleet_p99_vs_skew_curve: s={hi} p99 {p_hi:g}ms vs "
+                  f"s={lo} {p_lo:g}ms (limit {limit:.3g}) {verdict}")
+            if zipf_valid and not ok:
+                failures.append(
+                    f"fleet_p99_vs_skew_curve: p99 at s={hi} is "
+                    f"{p_hi:g}ms > {limit:.3g}ms — the elastic tail "
+                    f"broke its skew band")
+        st_knees = fresh.get("fleet_static_knee_vs_skew_curve") or {}
+        if lo in st_knees and hi in st_knees and float(st_knees[lo]):
+            st_ret = float(st_knees[hi]) / float(st_knees[lo])
+            el_ret = k_hi / k_lo if k_lo else 0.0
+            print(f"static-map comparison (reported): knee retention "
+                  f"{st_ret:.2f}x static vs {el_ret:.2f}x elastic at "
+                  f"s={hi}")
 
     # --- publish invariants (docs/SERVING.md "Continuous publication") --
     # The bench_serving.py --publish arm lands a refit→delta→hot-swap

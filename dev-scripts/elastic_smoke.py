@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Elastic-fleet smoke (run_tier1.sh): a 2-replica fleet under a seeded
+hot-spot must SPLIT the hot shard and SCALE UP within a deadline, with
+every score bit-identical throughout (docs/SERVING.md "Elastic fleet").
+Seconds on CPU; catches a broken control loop before it reaches a real
+deployment.
+
+Asserts the whole loop end to end through the REAL paths (subprocess
+replicas, HTTP forwarding, the controller's own thread on its monitor
+cadence):
+
+1. a deterministic hot-spot (entities {1, 5} → one routing shard of 4)
+   concentrates the window's heat → the controller splits the shard
+   live and migrates a child to the idle replica;
+2. a single-entity hot-spot (unsplittable) sustains pressure → the
+   controller scales up: a third replica spawns, warms, is admitted to
+   the map, and the hot shard rebalances onto it;
+3. every response across both phases is bit-identical to the
+   single-process ScoringService oracle — splits, migrations, and the
+   scale-up never change a score, only who answers;
+4. the evidence trail is complete: ShardSplit/ReplicaScaled events,
+   photon_fleet_splits_total / _scale_ups_total / _shard_heat{shard=}
+   on /metrics, and `elastic` ledger rows that render via
+   `photon-obs tail --elastic` (docs/OBSERVABILITY.md).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving import (ElasticConfig, ScoringRequest,
+                                       ScoringService)
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import events as ev
+
+    rng = np.random.default_rng(7)
+    E, dg, dr = 32, 6, 4
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, dr)).astype(np.float32))),
+    })
+    td = tempfile.mkdtemp(prefix="pml_elastic_smoke_")
+    model_dir = os.path.join(td, "model")
+    model_io.save_game_model(model, model_dir)
+
+    def make_objs(entities, seed):
+        r = np.random.default_rng(seed)
+        return [{"features": {
+                     "global": r.normal(size=dg).astype(
+                         np.float32).tolist(),
+                     "re_userId": r.normal(size=dr).astype(
+                         np.float32).tolist()},
+                 "entity_ids": {"userId": int(e)}, "uid": i}
+                for i, e in enumerate(entities)]
+
+    # The hot-spot tape: phase A = two hot entities on ONE shard
+    # (splittable), phase B = one hot entity (unsplittable → scale).
+    objs_a = make_objs([1, 5] * 10, seed=21)
+    objs_b = make_objs([1] * 40, seed=22)
+
+    # Single-process oracle at the same flush shape (bucket-1).
+    oracle = ScoringService(model, max_wait_ms=0.5)
+    def oracle_scores(objs):
+        return np.asarray([
+            float(oracle.submit(ScoringRequest(
+                features={k: np.asarray(v, np.float32)
+                          for k, v in o["features"].items()},
+                entity_ids=o["entity_ids"])).result(timeout=60))
+            for o in objs], np.float32)
+    expected_a = oracle_scores(objs_a)
+    expected_b = oracle_scores(objs_b)
+    oracle.close()
+
+    events = []
+    ev.default_emitter.register(events.append)
+    workdir = os.path.join(td, "fleet")
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir, "--max-wait-ms", "0.5"],
+        num_replicas=2, workdir=workdir, num_shards=4,
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        rehome_deadline_s=5.0,
+        elastic=ElasticConfig(
+            interval_s=0.25, heat_window_s=2.0, split_factor=2.0,
+            min_heat_requests=8, scale_up_heat_frac=0.6,
+            hysteresis_ticks=2, cooldown_s=1.0, max_replicas=3,
+            min_replicas=2))
+    fleet.start()
+    server = make_fleet_http_server(fleet, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post_one(obj):
+        body = json.dumps({"requests": [obj]}).encode()
+        req = urllib.request.Request(
+            url + "/score", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60.0) as resp:
+            return float(json.loads(resp.read())["scores"][0])
+
+    try:
+        t0 = time.monotonic()
+        # Phase A: heat the splittable hot shard until the controller
+        # splits it (its own thread ticks every 0.25 s).
+        deadline = time.monotonic() + 30.0
+        split_seen = False
+        while time.monotonic() < deadline and not split_seen:
+            got = np.asarray([post_one(o) for o in objs_a], np.float32)
+            assert np.array_equal(got, expected_a), \
+                "scores diverged from the oracle during the split phase"
+            split_seen = fleet.metrics.snapshot()["splits_total"] >= 1
+        assert split_seen, "the hot shard never split within deadline"
+        t_split = time.monotonic() - t0
+
+        # Phase B: an unsplittable single-entity hot-spot sustains the
+        # pressure → scale-up (spawns a real third replica).
+        deadline = time.monotonic() + 60.0
+        scaled = False
+        while time.monotonic() < deadline and not scaled:
+            got = np.asarray([post_one(o) for o in objs_b[:10]],
+                             np.float32)
+            assert np.array_equal(got, expected_b[:10]), \
+                "scores diverged from the oracle during the scale phase"
+            scaled = fleet.metrics.snapshot()["scale_ups_total"] >= 1
+        assert scaled, "the fleet never scaled up within deadline"
+        t_scale = time.monotonic() - t0
+
+        # Post-scale: every phase-B request still bit-identical (the
+        # newcomer serves the same model), nothing dropped.
+        got = np.asarray([post_one(o) for o in objs_b], np.float32)
+        assert np.array_equal(got, expected_b), \
+            "post-scale scores differ from the oracle"
+        snap = fleet.metrics.snapshot()
+        assert snap["unserved_total"] == 0, snap
+        assert snap["migrations_total"] >= 1, snap
+        assert len(fleet.supervisor.replicas) == 3
+        hz = fleet.healthz()
+        assert hz["fleet_depth"] == 3, hz
+        assert hz["map_version"] > 1, hz
+
+        # Events + metrics evidence.
+        assert any(isinstance(e, ev.ShardSplit) for e in events), \
+            "no ShardSplit event"
+        assert any(isinstance(e, ev.ReplicaScaled)
+                   and e.direction == "up" for e in events), \
+            "no ReplicaScaled event"
+        text = fleet.metrics_text()
+        for needle in ("photon_fleet_splits_total",
+                       "photon_fleet_scale_ups_total 1",
+                       "photon_fleet_map_version",
+                       'photon_fleet_shard_heat{shard="1"}'):
+            assert needle in text, f"missing {needle} in /metrics"
+
+        # The decision tape renders: elastic ledger rows via the CLI.
+        with fleet._publish_lock:
+            assert fleet._elastic_ledger is not None
+            fleet._elastic_ledger.flush()
+        ledger_dir = os.path.join(workdir, "elastic", "ledger")
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_ml_tpu.cli.obs", "tail",
+             ledger_dir, "--elastic"],
+            capture_output=True, text=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr
+        assert "split" in proc.stdout and "scale_up" in proc.stdout, \
+            proc.stdout
+
+        print(f"elastic smoke ok: split in {t_split:.1f}s, scale-up "
+              f"to 3 replicas in {t_scale:.1f}s, "
+              f"{snap['migrations_total']} migration(s), "
+              f"{len(objs_a) + len(objs_b) + 10}+ requests "
+              f"bit-identical, 0 unserved, ledger renders")
+        return 0
+    finally:
+        ev.default_emitter.unregister(events.append)
+        server.shutdown()
+        server.server_close()
+        fleet.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

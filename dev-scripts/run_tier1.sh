@@ -57,6 +57,14 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/fleet_smoke.py; rc=$?
 fi
 
+# Elastic smoke (docs/SERVING.md "Elastic fleet"): a 2-replica fleet
+# under a seeded hot-spot must split the hot shard + scale up within
+# deadline, scores bit-identical throughout, and the elastic ledger
+# rows + events render via photon-obs tail --elastic. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/elastic_smoke.py; rc=$?
+fi
+
 # Ledger smoke (docs/OBSERVABILITY.md "The run ledger"): a tiny fit
 # must leave a CRC-committed, seq-contiguous run ledger whose
 # run-vs-itself diff reports zero convergence regression. Seconds on CPU.

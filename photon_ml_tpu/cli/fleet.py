@@ -25,6 +25,7 @@ import argparse
 import logging
 import tempfile
 
+from photon_ml_tpu.serving.elastic import parse_elastic_config
 from photon_ml_tpu.serving.fleet import (ServingFleet,
                                          make_fleet_http_server)
 from photon_ml_tpu.utils.logging import setup_logging
@@ -93,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-restarts", type=int, default=3,
                    help="restart budget per replica before it is "
                         "declared failed (fleet stays degraded)")
+    p.add_argument("--backoff-reset-s", type=float, default=60.0,
+                   help="healthy uptime after which a replica's "
+                        "restart-backoff ladder (and budget) resets — "
+                        "a crash-once-then-healthy replica does not "
+                        "pay escalated backoff on its next death")
     p.add_argument("--max-inflight", type=int, default=None,
                    help="fleet admission bound on in-flight /score "
                         "bodies (default 16*replicas); overflow sheds "
@@ -120,6 +126,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "answering /healthz — a restarted replica "
                         "re-homes with its programs already warm "
                         "(docs/SERVING.md \"Sub-second restart\")")
+    # -- elastic fleet (docs/SERVING.md "Elastic fleet") -----------------
+    p.add_argument("--elastic", nargs="?", const="", default=None,
+                   metavar="KEY=VAL,...",
+                   help="arm the elastic control loop: load-aware "
+                        "rebalancing, live hot-shard splitting, "
+                        "burn-driven autoscaling, adaptive hedging, "
+                        "and the per-shard brownout ladder. Bare "
+                        "--elastic takes every default; the mini-DSL "
+                        "tunes it (e.g. 'split_factor=3,interval=0.5,"
+                        "max_replicas=6' — see "
+                        "photon_ml_tpu/serving/elastic.py)")
     # -- fleet SLO -------------------------------------------------------
     p.add_argument("--slo-window-s", type=float, default=60.0)
     p.add_argument("--slo-availability", type=float, default=0.999)
@@ -189,7 +206,10 @@ def create_fleet(args) -> ServingFleet:
         rehome_deadline_s=args.rehome_deadline_s,
         start_timeout_s=args.start_timeout_s,
         max_restarts=args.max_restarts,
+        backoff_reset_s=args.backoff_reset_s,
         max_inflight=args.max_inflight,
+        elastic=(parse_elastic_config(args.elastic)
+                 if args.elastic is not None else None),
         fault_plan_file=args.fault_plan,
         slo_window_s=args.slo_window_s,
         slo_availability=args.slo_availability,

@@ -421,6 +421,84 @@ def publish_summary(rows: list[dict]) -> dict:
     return out
 
 
+def elastic_summary(rows: list[dict]) -> dict:
+    """The elastic-control view of a ledger's ``elastic`` rows (the
+    serving/elastic.py controller records one per decision): splits,
+    migrations, scale events, brownouts, hedge re-tunes — what ``tail
+    --elastic`` renders."""
+    el = [r for r in rows if r.get("kind") == "elastic"]
+    if not el:
+        return {}
+    by_action: dict[str, int] = {}
+    for r in el:
+        a = str(r.get("action", "?"))
+        by_action[a] = by_action.get(a, 0) + 1
+    out = {
+        "decisions": len(el),
+        "by_action": by_action,
+        "map_version": el[-1].get("map_snapshot_version"),
+        "events": [
+            {k: r.get(k) for k in
+             ("t", "action", "shard", "children", "replica", "target",
+              "source", "num_replicas", "reason", "heat_fraction",
+              "burn_rate", "inflight_frac", "hedge_after_s",
+              "hot_shards", "map_version")
+             if r.get(k) is not None} for r in el],
+    }
+    last_hedge = [r for r in el if r.get("action") == "hedge_tune"]
+    if last_hedge:
+        out["hedge_after_s"] = last_hedge[-1].get("hedge_after_s")
+    return out
+
+
+def render_elastic_tail(tail: dict) -> str:
+    """``tail --elastic``: the control loop's decision tape,
+    chronologically — splits, migrations, scale events, brownouts,
+    with each decision's triggering evidence."""
+    el = tail.get("elastic")
+    head = (f"run {tail.get('run_id', '?')}  [{tail['status']}]  "
+            f"{tail['rows']} rows")
+    if not el:
+        return head + "\n  no elastic rows in this ledger"
+    acts = ", ".join(f"{k} ×{v}" for k, v in
+                     sorted(el["by_action"].items()))
+    out = [head,
+           f"  {el['decisions']} decision(s): {acts}  "
+           f"(map v{el.get('map_version', '?')})"]
+    if el.get("hedge_after_s") is not None:
+        out.append(f"  hedge_after auto-tuned to "
+                   f"{el['hedge_after_s']:.3f}s")
+    for e in el["events"]:
+        t = f"{e.get('t', 0):9.3f}s"
+        action = e.get("action", "?")
+        line = f"  {t}  {action}"
+        if action == "split":
+            line += (f" shard {e.get('shard')} → {e.get('children')} "
+                     f"({e.get('heat_fraction', 0):.0%} of window "
+                     f"heat)")
+        elif action == "migrate":
+            line += (f" shard {e.get('shard')}: replica "
+                     f"{e.get('source')} → {e.get('target')} "
+                     f"({e.get('reason', '')})")
+        elif action in ("scale_up", "scale_down"):
+            line += (f" replica {e.get('replica')} "
+                     f"(fleet now {e.get('num_replicas')}): "
+                     f"{e.get('reason', '')}")
+        elif action == "brownout":
+            line += f" shard(s) {e.get('hot_shards')}: " \
+                    f"{e.get('reason', '')}"
+        elif action == "hedge_tune":
+            line += f" → {e.get('hedge_after_s', 0):.3f}s"
+        elif e.get("reason"):
+            line += f" — {e['reason']}"
+        if e.get("map_version") is not None:
+            line += f"  [map v{e['map_version']}]"
+        out.append(line)
+    for p in tail.get("problems", []):
+        out.append(f"  (tail problem: {p})")
+    return "\n".join(out)
+
+
 def tail_ledger(directory: str) -> dict:
     """Snapshot of a (possibly live) run from its ledger: run identity,
     last position, iteration-time EMA + ETA, transfer fraction."""
@@ -443,6 +521,9 @@ def tail_ledger(directory: str) -> dict:
     publish = publish_summary(rows)
     if publish:
         out["publish"] = publish
+    elastic = elastic_summary(rows)
+    if elastic:
+        out["elastic"] = elastic
     alerts = [r for r in rows if r.get("kind") == "watchdog"]
     if alerts:
         out["watchdog_alerts"] = [
@@ -530,6 +611,11 @@ def render_tail(tail: dict) -> str:
                    f"{pub['published']} publish(es), "
                    f"{len(pub['rollbacks'])} rollback(s) "
                    f"(--publish for the ladder view)")
+    el = tail.get("elastic")
+    if el:
+        out.append(f"  elastic: {el['decisions']} decision(s), "
+                   f"map v{el.get('map_version', '?')} "
+                   f"(--elastic for the decision tape)")
     for p in tail.get("problems", []):
         out.append(f"  (tail problem: {p})")
     return "\n".join(out)
@@ -697,6 +783,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="publication view: delta versions, canary "
                         "verdicts, rollback events from the ledger's "
                         "publish rows (serving/publish.py ladder)")
+    t.add_argument("--elastic", action="store_true",
+                   help="elastic-control view: splits, migrations, "
+                        "scale events, brownouts and their triggering "
+                        "evidence from the ledger's elastic rows "
+                        "(serving/elastic.py controller)")
     d = sub.add_parser("diff",
                        help="compare two run ledgers: config delta, "
                             "convergence overlay, time-to-target, "
@@ -714,6 +805,9 @@ def _main_ledger(args) -> int:
             if getattr(args, "publish", False):
                 print(json.dumps(tail.get("publish", {}))
                       if args.json else render_publish_tail(tail))
+            elif getattr(args, "elastic", False):
+                print(json.dumps(tail.get("elastic", {}))
+                      if args.json else render_elastic_tail(tail))
             else:
                 print(json.dumps(tail) if args.json
                       else render_tail(tail))

@@ -57,6 +57,15 @@ FLEET_ROUTE = "fleet.route"
 FLEET_PROBE = "fleet.probe"
 FLEET_REPLICA_FLUSH = "fleet.replica_flush"
 
+# -- elastic fleet (serving/elastic.py; docs/SERVING.md "Elastic fleet") -----
+# Each fires BEFORE its map/fleet mutation, so a fault leaves the shard
+# map at exactly the old version — and the mutation itself is one
+# version bump under the map lock, so a fault after it leaves exactly
+# the new version: never torn (the mid-split kill contract).
+FLEET_SPLIT = "fleet.split"
+FLEET_MIGRATE = "fleet.migrate"
+FLEET_SCALE = "fleet.scale"
+
 # -- boot: mmap model publication (boot/mapfmt.py, boot/generations.py) ------
 BOOT_MAP_WRITE = "boot.map_write"
 BOOT_MAP_OPEN = "boot.map_open"  # corrupt_file (post-CRC bit rot in a blob)

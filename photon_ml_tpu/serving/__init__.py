@@ -10,9 +10,12 @@ and streams micro-batched requests through a shape-bucketed jitted scorer.
 from photon_ml_tpu.serving.batcher import (BatcherDied, BatcherQueueFull,
                                            DeadlineExceeded, MicroBatcher,
                                            bucket_batch)
+from photon_ml_tpu.serving.elastic import (ElasticConfig,
+                                           ElasticController,
+                                           parse_elastic_config)
 from photon_ml_tpu.serving.fleet import (FleetMetrics, ServingFleet,
                                          make_fleet_http_server)
-from photon_ml_tpu.serving.metrics import (STAGES, SLOTracker,
+from photon_ml_tpu.serving.metrics import (STAGES, ShardHeat, SLOTracker,
                                            ServingMetrics)
 from photon_ml_tpu.serving.model_store import (HashShardedStore,
                                                ResidentModelStore)
@@ -34,8 +37,12 @@ __all__ = [
     "BatcherDied",
     "BatcherQueueFull",
     "DeadlineExceeded",
+    "ElasticConfig",
+    "ElasticController",
     "MicroBatcher",
+    "ShardHeat",
     "bucket_batch",
+    "parse_elastic_config",
     "FleetMetrics",
     "FleetRouter",
     "ReplicaHTTPError",

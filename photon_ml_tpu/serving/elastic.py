@@ -1,0 +1,548 @@
+"""photon-elastic: the overload control loop for the replicated fleet.
+
+ROADMAP item 2's closing move: at Zipf skew the static ``id %
+num_shards`` map concentrates the head on one replica — the fleet's
+knee QPS becomes its hottest shard's knee, not its capacity. Every
+signal needed to fix that is already measured (per-shard request
+counts, queue depth, error-budget burn, stage seconds); this module
+closes the loop from measurement to ACTION, on the supervisor's
+monitor cadence (the Snap ML hierarchical resource-matching idea
+applied to serving — PAPERS.md):
+
+- **Heat model** (``serving/metrics.ShardHeat``): per-shard sliding
+  window of requests, distinct entities, and observed service seconds,
+  published as ``photon_fleet_shard_heat{shard=}`` and read here each
+  tick.
+- **Split + migrate** (``ShardMap.split``/``migrate``): a shard
+  carrying more than ``split_factor`` × the mean heat — and more than
+  one entity, one user cannot be split — splits into consistent-hash
+  children (cold entities never remap) and one child migrates to the
+  coldest live replica, with the re-home discipline: the target is
+  probed healthy BEFORE the table swap, the swap is one version bump
+  under the map lock, and in-flight requests drain through the retry
+  path that re-resolves owners. Scores are bit-identical throughout —
+  every replica holds the full host store.
+- **Burn-driven autoscale** (``ReplicaSupervisor.add_replica`` /
+  ``retire``): error-budget burn, fleet queue depth, or irreducible
+  heat imbalance sustained over ``hysteresis_ticks`` scales UP (spawn
+  → warm via the replica args' ``--boot-warmup`` → admit to the map →
+  replay the committed delta chain → migrate the hottest shards onto
+  it); sustained idle scales DOWN (drain → migrate every shard away,
+  each leg target-probed → retire), and a replica is NEVER retired
+  while it owns a shard — the guard is structural
+  (``ShardMap.remove_replica`` refuses).
+- **Adaptive hedging**: ``hedge_after_s`` re-derives from the p99 of
+  the router's recent successful sends (× ``hedge_factor``, clamped)
+  instead of a static knob — the hedge threshold tracks what "slow"
+  currently means.
+- **Brownout ladder**: when burn crosses ``brownout_burn`` AND one
+  shard carries ``brownout_heat_frac`` of the window's heat, admission
+  tightens for THAT shard first (its 503s name it) before the
+  fleet-wide bound engages; ``FleetDegraded`` events mark both edges.
+
+Every decision writes an ``elastic`` ledger row carrying its
+triggering evidence (heat snapshot, burn rate, queue fraction, map
+version) — ``photon-obs tail --elastic`` renders the decision tape.
+Fault sites ``fleet.split`` / ``fleet.migrate`` / ``fleet.scale`` fire
+BEFORE each mutation, so a chaos fault leaves the map at exactly the
+old version; the mutations themselves are single version bumps under
+the map lock, so the map is never torn (docs/ROBUSTNESS.md).
+
+All decisions are pure functions of the sampled window — two
+controllers reading the same tape act identically, so drills replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Optional
+
+from photon_ml_tpu import faults as flt
+from photon_ml_tpu.serving.router import route_key
+from photon_ml_tpu.serving.supervisor import _probe_healthz
+from photon_ml_tpu.utils.events import (FleetDegraded, ReplicaScaled,
+                                        ShardSplit)
+
+logger = logging.getLogger("photon_ml_tpu.serving.fleet")
+
+__all__ = ["ElasticConfig", "ElasticController", "parse_elastic_config"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Knobs of the elastic control loop (docs/SERVING.md "Elastic
+    fleet" documents each threshold's semantics)."""
+
+    interval_s: float = 0.5        # control-loop cadence
+    heat_window_s: float = 30.0    # sliding heat window
+    # -- split/migrate ------------------------------------------------------
+    split_factor: float = 4.0      # hottest > factor × mean heat → split
+    min_heat_requests: int = 32    # below this the window is noise
+    max_shards: int = 64           # leaf-count cap (split budget)
+    # -- autoscale ----------------------------------------------------------
+    scale_up_burn: float = 1.0     # error-budget burn rate threshold
+    scale_up_queue_frac: float = 0.5   # fleet inflight / max_inflight
+    scale_up_heat_frac: float = 0.7    # one replica carries > this share
+    scale_down_idle_frac: float = 0.05  # inflight share marking idle
+    scale_down_idle_qps: float = 0.5   # window QPS below this is idle
+    hysteresis_ticks: int = 3      # consecutive ticks before acting
+    cooldown_s: float = 10.0       # between scale actions
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # -- adaptive hedging ---------------------------------------------------
+    hedge_auto: bool = True
+    hedge_factor: float = 1.5      # hedge_after = factor × observed p99
+    hedge_min_s: float = 0.010
+    hedge_max_s: float = 5.0
+    # -- brownout -----------------------------------------------------------
+    brownout_burn: float = 2.0     # burn rate engaging per-shard admission
+    brownout_heat_frac: float = 0.5  # the shard share that names the culprit
+
+
+def parse_elastic_config(spec: str) -> ElasticConfig:
+    """Parse the ``key=value,...`` mini-DSL of ``photon-game-fleet
+    --elastic`` (the ``--staging``/``--streaming`` idiom). An empty
+    spec takes every default.
+
+    Keys: interval, window, split_factor, min_heat, max_shards, burn,
+    queue_frac, heat_frac, idle_frac, hysteresis, cooldown,
+    min_replicas, max_replicas, hedge (on|off), hedge_factor,
+    brownout_burn, brownout_frac.
+    """
+    fields = {
+        "interval": ("interval_s", float),
+        "window": ("heat_window_s", float),
+        "split_factor": ("split_factor", float),
+        "min_heat": ("min_heat_requests", int),
+        "max_shards": ("max_shards", int),
+        "burn": ("scale_up_burn", float),
+        "queue_frac": ("scale_up_queue_frac", float),
+        "heat_frac": ("scale_up_heat_frac", float),
+        "idle_frac": ("scale_down_idle_frac", float),
+        "idle_qps": ("scale_down_idle_qps", float),
+        "hysteresis": ("hysteresis_ticks", int),
+        "cooldown": ("cooldown_s", float),
+        "min_replicas": ("min_replicas", int),
+        "max_replicas": ("max_replicas", int),
+        "hedge": ("hedge_auto", lambda v: v.lower() in ("1", "on",
+                                                        "true", "yes")),
+        "hedge_factor": ("hedge_factor", float),
+        "brownout_burn": ("brownout_burn", float),
+        "brownout_frac": ("brownout_heat_frac", float),
+    }
+    kwargs = {}
+    for part in (p.strip() for p in spec.split(",") if p.strip()):
+        if "=" not in part:
+            raise ValueError(f"elastic spec entry {part!r} is not "
+                             f"key=value")
+        key, value = part.split("=", 1)
+        if key.strip() not in fields:
+            raise ValueError(f"unknown elastic key {key.strip()!r}; "
+                             f"expected {sorted(fields)}")
+        name, conv = fields[key.strip()]
+        kwargs[name] = conv(value.strip())
+    return ElasticConfig(**kwargs)
+
+
+class ElasticController:
+    """The control loop. One instance per :class:`ServingFleet`;
+    ``start()`` runs ``tick()`` on a daemon thread every
+    ``interval_s``, or tests call ``tick()`` directly — every decision
+    is a pure function of the sampled window, so direct ticks and the
+    thread behave identically."""
+
+    def __init__(self, fleet, config: Optional[ElasticConfig] = None):
+        self.fleet = fleet
+        self.config = config or ElasticConfig()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Hysteresis counters (controller-thread-private: tick() is
+        # never concurrent with itself).
+        self._hot_ticks = 0
+        self._idle_ticks = 0
+        self._last_scale_at = 0.0
+        self._brownout_on = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="photon-fleet-elastic", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # The control loop must outlive any one bad decision;
+                # the failed action already logged its own evidence.
+                logger.exception("elastic tick failed — the next tick "
+                                 "re-samples from scratch")
+
+    # -- signal sampling -----------------------------------------------------
+
+    def sample(self) -> dict:
+        """One coherent reading of every control signal. Heat events
+        re-resolve through the CURRENT map, so a split's evidence
+        follows the children instead of re-indicting the parent."""
+        fleet = self.fleet
+        heat = fleet.heat.snapshot(
+            resolver=lambda key: fleet.shard_map.shard_of_key(
+                route_key(key)))
+        total = sum(r["heat"] for r in heat.values())
+        slo = fleet.metrics.slo.snapshot()
+        by_replica: dict[int, float] = {}
+        for shard, row in heat.items():
+            try:
+                owner = fleet.shard_map.owner(shard)
+            except KeyError:
+                continue  # shard split away between snapshot and now
+            by_replica[owner] = by_replica.get(owner, 0.0) + row["heat"]
+        window_reqs = sum(r["requests"] for r in heat.values())
+        return {
+            "heat": heat,
+            "total_heat": total,
+            "heat_by_replica": by_replica,
+            "burn_rate": float(slo.get("budget_burn_rate", 0.0)),
+            "requests_in_window": int(slo.get("requests_in_window", 0)),
+            "window_qps": window_reqs / max(fleet.heat.window_s, 1e-9),
+            "inflight_frac": (fleet.inflight
+                              / max(fleet.max_inflight, 1)),
+            "map_version": fleet.shard_map.version,
+            "live_replicas": fleet.shard_map.live(),
+        }
+
+    # -- one control cycle ---------------------------------------------------
+
+    def tick(self) -> dict:
+        """One decision pass; returns the actions taken (tests assert
+        on this, the thread discards it)."""
+        s = self.sample()
+        actions: dict = {}
+        self._tune_hedging(actions)
+        self._update_brownout(s, actions)
+        # Split first — the cheaper action: a hot shard that CAN be
+        # subdivided should spread over the existing replicas before
+        # any new hardware spawns; pressure that splitting cannot
+        # relieve (one hot entity, or every replica already hot)
+        # persists into the next ticks and scales.
+        if not self._maybe_split(s, actions):
+            self._maybe_scale_up(s, actions)
+        self._maybe_scale_down(s, actions)
+        return actions
+
+    # -- adaptive hedging ----------------------------------------------------
+
+    def _tune_hedging(self, actions: dict) -> None:
+        cfg = self.config
+        if not cfg.hedge_auto:
+            return
+        p99 = self.fleet.router.observed_send_p99()
+        if p99 is None:
+            return
+        target = min(max(cfg.hedge_factor * p99, cfg.hedge_min_s),
+                     cfg.hedge_max_s)
+        current = self.fleet.router.hedge_after_s
+        # Re-tune only on material movement — a ledger row per tick
+        # would be noise, and sub-ms thrash has no routing effect.
+        if current is not None and abs(target - current) \
+                <= 0.2 * current:
+            return
+        self.fleet.router.hedge_after_s = target
+        actions["hedge_after_s"] = target
+        self.fleet._elastic_record(
+            action="hedge_tune", hedge_after_s=round(target, 6),
+            observed_send_p99_s=round(p99, 6))
+        logger.info("hedge_after_s auto-tuned to %.3fs (observed send "
+                    "p99 %.3fs × %.2f)", target, p99, cfg.hedge_factor)
+
+    # -- brownout ladder -----------------------------------------------------
+
+    def _update_brownout(self, s: dict, actions: dict) -> None:
+        cfg = self.config
+        total = s["total_heat"]
+        hot = []
+        if total > 0 and s["requests_in_window"] >= cfg.min_heat_requests:
+            hot = [shard for shard, row in s["heat"].items()
+                   if row["heat"] / total >= cfg.brownout_heat_frac]
+        engage = bool(hot) and s["burn_rate"] >= cfg.brownout_burn
+        if engage and not self._brownout_on:
+            reason = (f"burn {s['burn_rate']:.2f} >= "
+                      f"{cfg.brownout_burn:.2f} with shard(s) {hot} "
+                      f"over {cfg.brownout_heat_frac:.0%} of window "
+                      f"heat")
+            self.fleet.set_brownout(hot, reason)
+            self._brownout_on = True
+            actions["brownout"] = hot
+        elif self._brownout_on and (not hot or s["burn_rate"]
+                                    <= 0.5 * cfg.brownout_burn):
+            # Release with hysteresis: half the engage threshold, so
+            # the ladder does not flap at the boundary.
+            self.fleet.set_brownout([], "burn back under half the "
+                                        "brownout threshold")
+            self._brownout_on = False
+            actions["brownout_clear"] = True
+
+    # -- split + migrate -----------------------------------------------------
+
+    def _maybe_split(self, s: dict, actions: dict) -> bool:
+        cfg = self.config
+        heat = s["heat"]
+        if s["requests_in_window"] < cfg.min_heat_requests:
+            return False
+        leaves = self.fleet.shard_map.shards()
+        if len(leaves) >= cfg.max_shards:
+            return False
+        if not heat or s["total_heat"] <= 0:
+            return False
+        mean = s["total_heat"] / max(len(leaves), 1)
+        # Hottest SPLITTABLE shard: more than one distinct entity in
+        # the window (a single hot user cannot be split apart) and
+        # over the factor.
+        candidates = sorted(
+            ((row["heat"], shard) for shard, row in heat.items()
+             if row["entities"] > 1 and shard in
+             set(leaves)),
+            reverse=True)
+        if not candidates:
+            return False
+        top_heat, shard = candidates[0]
+        if top_heat < cfg.split_factor * mean:
+            return False
+        heat_frac = top_heat / s["total_heat"]
+        try:
+            flt.fire(flt.sites.FLEET_SPLIT, index=shard)
+        except Exception as e:
+            logger.error("fleet.split fault on shard %d (%s) — map "
+                         "stays at version %d", shard, e,
+                         self.fleet.shard_map.version)
+            return False
+        a, b = self.fleet.shard_map.split(shard)
+        self.fleet.metrics.record_split()
+        self.fleet.emitter.emit(ShardSplit(
+            shard=shard, children=(a, b), heat_fraction=heat_frac,
+            map_version=self.fleet.shard_map.version))
+        self.fleet._elastic_record(
+            action="split", shard=shard, children=[a, b],
+            heat_fraction=round(heat_frac, 4),
+            heat=round(top_heat, 3), mean_heat=round(mean, 3),
+            map_version=self.fleet.shard_map.version)
+        logger.info("split hot shard %d (%.0f%% of window heat) into "
+                    "%d + %d (map v%d)", shard, 100 * heat_frac, a, b,
+                    self.fleet.shard_map.version)
+        actions["split"] = (shard, a, b)
+        # Move one child to the coldest live replica so the split
+        # actually spreads load (both children inherit the owner).
+        target = self._coldest_replica(
+            s, exclude={self.fleet.shard_map.owner(b)})
+        if target is not None:
+            if self._migrate(b, target, reason="post-split spread"):
+                actions["migrate"] = (b, target)
+        return True
+
+    def _coldest_replica(self, s: dict,
+                         exclude: set[int] = frozenset()) -> \
+            Optional[int]:
+        live = [r for r in s["live_replicas"] if r not in exclude]
+        if not live:
+            return None
+        by_replica = s["heat_by_replica"]
+        return min(live, key=lambda r: (by_replica.get(r, 0.0), r))
+
+    def _migrate(self, shard: int, target: int, reason: str) -> bool:
+        """One migration leg under the re-home discipline: probe the
+        target healthy FIRST, then swap the table (one version bump).
+        In-flight requests to the old owner finish there — it serves
+        the same bits from its own host store; new requests route to
+        the target."""
+        fleet = self.fleet
+        try:
+            flt.fire(flt.sites.FLEET_MIGRATE, index=shard)
+            host, port = fleet.supervisor.endpoint(target)
+            _probe_healthz(f"http://{host}:{port}",
+                           fleet.probe_timeout_s)
+            old = fleet.shard_map.migrate(shard, target)
+        except Exception as e:
+            # A failed leg changes NOTHING: the probe precedes the
+            # swap, and the swap is atomic — the map stays at the old
+            # version with a valid owner.
+            logger.error("migration of shard %d → replica %d aborted "
+                         "(%s: %s) — map stays at version %d", shard,
+                         target, type(e).__name__, e,
+                         fleet.shard_map.version)
+            return False
+        fleet.metrics.record_migration()
+        fleet._elastic_record(
+            action="migrate", shard=shard, source=old, target=target,
+            reason=reason, map_version=fleet.shard_map.version)
+        logger.info("migrated shard %d: replica %d → %d (%s, map v%d)",
+                    shard, old, target, reason,
+                    fleet.shard_map.version)
+        return True
+
+    # -- autoscale -----------------------------------------------------------
+
+    def _pressure(self, s: dict) -> Optional[str]:
+        """The scale-up signal, or None. Named so the ledger row and
+        the ReplicaScaled event carry WHY."""
+        cfg = self.config
+        if s["burn_rate"] >= cfg.scale_up_burn \
+                and s["requests_in_window"] >= cfg.min_heat_requests:
+            return (f"error-budget burn {s['burn_rate']:.2f} >= "
+                    f"{cfg.scale_up_burn:.2f}")
+        if s["inflight_frac"] >= cfg.scale_up_queue_frac:
+            return (f"fleet queue {s['inflight_frac']:.0%} >= "
+                    f"{cfg.scale_up_queue_frac:.0%} of max_inflight")
+        by_replica = s["heat_by_replica"]
+        if s["total_heat"] > 0 and by_replica \
+                and s["requests_in_window"] >= cfg.min_heat_requests:
+            top = max(by_replica.values())
+            if top / s["total_heat"] >= cfg.scale_up_heat_frac \
+                    and len(s["live_replicas"]) >= 1:
+                return (f"one replica carries "
+                        f"{top / s['total_heat']:.0%} of window heat "
+                        f">= {cfg.scale_up_heat_frac:.0%}")
+        return None
+
+    def _maybe_scale_up(self, s: dict, actions: dict) -> bool:
+        cfg = self.config
+        reason = self._pressure(s)
+        if reason is None:
+            self._hot_ticks = 0
+            return False
+        self._hot_ticks += 1
+        if self._hot_ticks < cfg.hysteresis_ticks:
+            return False
+        now = time.monotonic()
+        if now - self._last_scale_at < cfg.cooldown_s:
+            return False
+        if len(s["live_replicas"]) >= cfg.max_replicas:
+            return False
+        try:
+            flt.fire(flt.sites.FLEET_SCALE, index=len(
+                s["live_replicas"]))
+        except Exception as e:
+            logger.error("fleet.scale fault (%s) — no replica "
+                         "spawned, map unchanged", e)
+            return False
+        try:
+            rid = self.fleet.add_replica()
+        except Exception as e:
+            logger.error("scale-up failed (%s: %s) — the fleet keeps "
+                         "its current shape", type(e).__name__, e)
+            return False
+        self._hot_ticks = 0
+        self._last_scale_at = now
+        n = len(self.fleet.shard_map.live())
+        self.fleet.metrics.record_scale("up")
+        self.fleet.emitter.emit(ReplicaScaled(
+            direction="up", replica_id=rid, num_replicas=n,
+            reason=reason))
+        self.fleet._elastic_record(
+            action="scale_up", replica=rid, num_replicas=n,
+            reason=reason, burn_rate=round(s["burn_rate"], 4),
+            inflight_frac=round(s["inflight_frac"], 4),
+            map_version=self.fleet.shard_map.version)
+        logger.info("scaled UP to %d replicas (replica %d admitted): "
+                    "%s", n, rid, reason)
+        actions["scale_up"] = rid
+        # Move the hottest shards onto the newcomer until it carries a
+        # fair share — the admit-then-rebalance leg.
+        heat_sorted = sorted(
+            ((row["heat"], shard) for shard, row in s["heat"].items()),
+            reverse=True)
+        fair = max(1, len(self.fleet.shard_map.shards()) // max(n, 1))
+        moved = 0
+        for _, shard in heat_sorted:
+            if moved >= fair:
+                break
+            try:
+                if self.fleet.shard_map.owner(shard) == rid:
+                    continue
+            except KeyError:
+                continue
+            if self._migrate(shard, rid, reason="scale-up rebalance"):
+                moved += 1
+        return True
+
+    def _maybe_scale_down(self, s: dict, actions: dict) -> None:
+        cfg = self.config
+        if actions.keys() & {"split", "scale_up", "migrate",
+                             "brownout"}:
+            # A tick that just acted on pressure is not an idle tick.
+            self._idle_ticks = 0
+            return
+        busy = (s["burn_rate"] > 0.0
+                or s["inflight_frac"] > cfg.scale_down_idle_frac
+                or s["window_qps"] > cfg.scale_down_idle_qps
+                or self._brownout_on)
+        if busy:
+            self._idle_ticks = 0
+            return
+        self._idle_ticks += 1
+        if self._idle_ticks < cfg.hysteresis_ticks:
+            return
+        live = s["live_replicas"]
+        if len(live) <= cfg.min_replicas:
+            return
+        now = time.monotonic()
+        if now - self._last_scale_at < cfg.cooldown_s:
+            return
+        victim = self._coldest_replica(s)
+        if victim is None:
+            return
+        try:
+            flt.fire(flt.sites.FLEET_SCALE, index=victim)
+        except Exception as e:
+            logger.error("fleet.scale fault on scale-down (%s) — "
+                         "replica %d keeps serving", e, victim)
+            return
+        fleet = self.fleet
+        fleet.shard_map.set_draining(victim, True)
+        owned = fleet.shard_map.shards_of(victim)
+        for shard in owned:
+            target = self._coldest_replica(s, exclude={victim})
+            if target is None or not self._migrate(
+                    shard, target, reason="scale-down drain"):
+                # Could not place a shard: undo the drain — the victim
+                # stays a full owner; NEVER retire the last owner.
+                fleet.shard_map.set_draining(victim, False)
+                logger.warning(
+                    "scale-down of replica %d aborted: shard %d has "
+                    "no healthy destination", victim, shard)
+                return
+        try:
+            fleet.shard_map.remove_replica(victim)
+        except ValueError as e:
+            fleet.shard_map.set_draining(victim, False)
+            logger.error("scale-down refused: %s", e)
+            return
+        fleet.supervisor.retire(victim)
+        self._idle_ticks = 0
+        self._last_scale_at = now
+        n = len(fleet.shard_map.live())
+        fleet.metrics.record_scale("down")
+        fleet.emitter.emit(ReplicaScaled(
+            direction="down", replica_id=victim, num_replicas=n,
+            reason="sustained idle"))
+        fleet._elastic_record(
+            action="scale_down", replica=victim, num_replicas=n,
+            reason="sustained idle",
+            inflight_frac=round(s["inflight_frac"], 4),
+            map_version=fleet.shard_map.version)
+        logger.info("scaled DOWN to %d replicas (replica %d drained + "
+                    "retired)", n, victim)
+        actions["scale_down"] = victim

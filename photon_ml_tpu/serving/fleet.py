@@ -57,10 +57,12 @@ from photon_ml_tpu.serving.publish import (CanaryRejected, ModelDelta,
                                            PublishError, read_delta)
 from photon_ml_tpu.serving.router import (FleetRouter, ReplicaHTTPError,
                                           ReplicaShed, ReplicaUnavailable,
-                                          ShardMap)
-from photon_ml_tpu.serving.supervisor import UP, ReplicaSupervisor
+                                          ShardMap, route_key)
+from photon_ml_tpu.serving.supervisor import (RETIRED, UP,
+                                              ReplicaSupervisor)
 from photon_ml_tpu.utils.events import (CanaryVerdict, DeltaPublished,
-                                        ReplicaDied, ReplicaRecovered,
+                                        FleetDegraded, ReplicaDied,
+                                        ReplicaRecovered,
                                         RollbackExecuted, ShardRehomed,
                                         default_emitter)
 
@@ -92,6 +94,12 @@ class FleetMetrics:
         self.rehome_deadline_misses_total = 0
         self.replica_deaths_total = 0
         self.replica_restarts_total = 0
+        # Elastic control loop (serving/elastic.py).
+        self.splits_total = 0
+        self.migrations_total = 0
+        self.scale_ups_total = 0
+        self.scale_downs_total = 0
+        self.brownout_sheds_total = 0
         # Continuous publication (serving/publish.py canary ladder).
         self.published_version = 0
         self.publishes_total = 0
@@ -171,6 +179,27 @@ class FleetMetrics:
         with self._lock:
             self.publish_rollbacks_total += n
 
+    def record_split(self) -> None:
+        with self._lock:
+            self.splits_total += 1
+
+    def record_migration(self) -> None:
+        with self._lock:
+            self.migrations_total += 1
+
+    def record_scale(self, direction: str) -> None:
+        with self._lock:
+            if direction == "up":
+                self.scale_ups_total += 1
+            else:
+                self.scale_downs_total += 1
+
+    def record_brownout_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.brownout_sheds_total += n
+            self.shed_total += n
+        self.slo.record_bad("shed", n)
+
     def record_rehome(self, seconds: float, deadline_s: float) -> None:
         with self._lock:
             self.rehomes_total += 1
@@ -199,6 +228,11 @@ class FleetMetrics:
                     self.rehome_deadline_misses_total,
                 "replica_deaths_total": self.replica_deaths_total,
                 "replica_restarts_total": self.replica_restarts_total,
+                "splits_total": self.splits_total,
+                "migrations_total": self.migrations_total,
+                "scale_ups_total": self.scale_ups_total,
+                "scale_downs_total": self.scale_downs_total,
+                "brownout_sheds_total": self.brownout_sheds_total,
                 "published_version": self.published_version,
                 "publishes_total": self.publishes_total,
                 "canary_rejects_total": self.canary_rejects_total,
@@ -210,13 +244,18 @@ class FleetMetrics:
             }
 
     def render_text(self, states: dict[int, str], degraded: bool,
-                    boot_seconds: Optional[dict[int, float]] = None
+                    boot_seconds: Optional[dict[int, float]] = None,
+                    shard_heat: Optional[dict[int, dict]] = None,
+                    map_version: Optional[int] = None,
+                    hedge_after_s: Optional[float] = None,
+                    num_live: Optional[int] = None,
                     ) -> str:
         """Prometheus-style ``photon_fleet_*`` lines (the metric
         catalog rows in docs/OBSERVABILITY.md)."""
         s = self.snapshot()
         lines = [
-            f"photon_fleet_replicas {self.num_replicas}",
+            f"photon_fleet_replicas "
+            f"{num_live if num_live is not None else self.num_replicas}",
             f"photon_fleet_degraded {1 if degraded else 0}",
             f"photon_fleet_requests_total {s['requests_total']}",
             f"photon_fleet_shed_total {s['shed_total']}",
@@ -239,6 +278,12 @@ class FleetMetrics:
             f"{s['replica_deaths_total']}",
             f"photon_fleet_replica_restarts_total "
             f"{s['replica_restarts_total']}",
+            f"photon_fleet_splits_total {s['splits_total']}",
+            f"photon_fleet_migrations_total {s['migrations_total']}",
+            f"photon_fleet_scale_ups_total {s['scale_ups_total']}",
+            f"photon_fleet_scale_downs_total {s['scale_downs_total']}",
+            f"photon_fleet_brownout_sheds_total "
+            f"{s['brownout_sheds_total']}",
             f"photon_publish_model_version {s['published_version']}",
             f"photon_publish_deltas_total {s['publishes_total']}",
             f"photon_publish_canary_rejects_total "
@@ -264,6 +309,16 @@ class FleetMetrics:
                 lines.append(
                     f"photon_fleet_replica_boot_seconds"
                     f"{{replica=\"{rid}\"}} {boot_seconds[rid]:.6f}")
+        if map_version is not None:
+            lines.append(f"photon_fleet_map_version {map_version}")
+        if hedge_after_s is not None:
+            lines.append(f"photon_fleet_hedge_after_seconds "
+                         f"{hedge_after_s:.6f}")
+        if shard_heat:
+            for shard in sorted(shard_heat):
+                lines.append(
+                    f"photon_fleet_shard_heat{{shard=\"{shard}\"}} "
+                    f"{shard_heat[shard]['heat']:.4f}")
         slo = self.slo.snapshot()
         lines.append(f"photon_fleet_slo_requests_in_window "
                      f"{slo['requests_in_window']}")
@@ -306,6 +361,7 @@ class ServingFleet:
         rehome_deadline_s: float = 5.0,
         start_timeout_s: float = 120.0,
         max_restarts: int = 3,
+        backoff_reset_s: float = 60.0,
         max_inflight: Optional[int] = None,
         fault_plan_file: Optional[str] = None,
         slo_window_s: float = 60.0,
@@ -314,6 +370,7 @@ class ServingFleet:
         publish_dir: Optional[str] = None,
         publish_bake_s: float = 0.5,
         publish_burn_threshold: float = 1.0,
+        elastic=None,
         emitter=default_emitter,
     ):
         self.replica_args = list(replica_args)
@@ -344,6 +401,7 @@ class ServingFleet:
             heartbeat_deadline_s=heartbeat_deadline_s,
             start_timeout_s=start_timeout_s,
             max_restarts=max_restarts,
+            backoff_reset_s=backoff_reset_s,
             on_death=self._on_death,
             on_recovered=self._on_recovered)
         self.router = FleetRouter(
@@ -351,10 +409,31 @@ class ServingFleet:
             route_re_type=route_re_type,
             request_timeout_s=request_timeout_s,
             retries=retries, retry_backoff_s=retry_backoff_s,
-            hedge_after_s=hedge_after_s, metrics=self.metrics)
+            hedge_after_s=hedge_after_s, metrics=self.metrics,
+            health_fn=self._replica_healthy)
         self._degraded = False
         self._rehoming = False
         self._closed = False
+        # Elastic control loop (serving/elastic.py; docs/SERVING.md
+        # "Elastic fleet"): heat model always on (cheap sliding window
+        # — /metrics readers want the gauge even with the loop off),
+        # the controller only when an ElasticConfig is handed in.
+        from photon_ml_tpu.serving.elastic import (ElasticConfig,
+                                                   ElasticController)
+        from photon_ml_tpu.serving.metrics import ShardHeat
+
+        self.elastic_config = elastic
+        self.heat = ShardHeat(
+            window_s=(elastic.heat_window_s
+                      if isinstance(elastic, ElasticConfig)
+                      else 30.0))
+        self.elastic = (ElasticController(self, elastic)
+                        if elastic is not None else None)
+        # Brownout state: written only by the controller thread via
+        # set_brownout, read by HTTP handler threads; dict swap is
+        # atomic under the GIL and staleness of one tick is by design.
+        self._brownout: dict[int, str] = {}
+        self._elastic_ledger = None
         # Continuous publication state (serving/publish.py ladder):
         # committed deltas newest-last (restarted replicas replay them),
         # one publish at a time, and the publish ledger (lazy — the row
@@ -436,11 +515,94 @@ class ServingFleet:
         # serve stale rows for every published entity.
         self._reapply_published(replica_id)
         states = self.supervisor.states()
-        if all(st == UP for st in states.values()):
+        if all(st in (UP, RETIRED) for st in states.values()):
             self._degraded = False  # pml: allow[PML015] single-writer monitor-thread publish; healthz re-derives from supervisor states anyway
         logger.info("replica %d recovered; %d shard(s) back home; "
                     "fleet %s", replica_id, len(back),
                     "healthy" if not self._degraded else "still degraded")
+
+    # -- elastic fleet (serving/elastic.py; docs/SERVING.md "Elastic
+    #    fleet") ---------------------------------------------------------------
+
+    def _replica_healthy(self, replica_id: int) -> bool:
+        """The router's liveness oracle beyond the shard map: the
+        supervisor's state machine knows a replica is down/restarting
+        BEFORE the map re-homes it — hedges must not aim into that
+        gap (ISSUE 15 satellite fix)."""
+        try:
+            return self.supervisor.replicas[replica_id].state == UP
+        except IndexError:
+            return False
+
+    def set_brownout(self, hot_shards, reason: str) -> None:
+        """Engage (or with an empty list, release) per-shard admission
+        tightening — the first rung of the overload ladder: requests
+        routed to a browned-out shard shed with a 503 NAMING the shard,
+        while every other shard keeps serving; the fleet-wide
+        ``max_inflight`` bound stays the second rung."""
+        new = {int(s): reason for s in hot_shards}
+        was = self._brownout
+        # Single-writer publish: only the controller thread swaps this
+        # dict; handler reads tolerate one-tick staleness by design.
+        self._brownout = new
+        if new and not was:
+            self.emitter.emit(FleetDegraded(
+                mode="brownout", hot_shards=tuple(sorted(new)),
+                reason=reason))
+            self._elastic_record(action="brownout",
+                                 hot_shards=sorted(new), reason=reason)
+            logger.warning("BROWNOUT: per-shard admission tightened "
+                           "for shard(s) %s (%s)", sorted(new), reason)
+        elif was and not new:
+            self.emitter.emit(FleetDegraded(
+                mode="recovered", hot_shards=(), reason=reason))
+            self._elastic_record(action="brownout_clear",
+                                 reason=reason)
+            logger.info("brownout released (%s)", reason)
+
+    def brownout_shard_of(self, request_objs: Sequence[dict]
+                          ) -> Optional[tuple[int, str]]:
+        """The first browned-out shard a body routes to, or None."""
+        hot = self._brownout
+        if not hot:
+            return None
+        for obj in request_objs:
+            shard = self.router.shard_for(obj)
+            if shard in hot:
+                return shard, hot[shard]
+        return None
+
+    def add_replica(self) -> int:
+        """The scale-up leg: spawn + warm a new supervised replica,
+        admit it to the shard map only after it answered /healthz, and
+        replay the committed delta chain so it serves the same model
+        version as the rest of the fleet."""
+        rid = self.supervisor.add_replica()
+        admitted = self.shard_map.add_replica()
+        if admitted != rid:  # pragma: no cover — ids advance together
+            logger.error("replica id drift: supervisor %d vs map %d",
+                         rid, admitted)
+        self.num_replicas = len(self.shard_map.live())
+        self._reapply_published(rid)
+        return rid
+
+    def _elastic_record(self, **fields) -> None:
+        """One ``elastic`` ledger row (append-as-produced, per-row CRC
+        — the obs/ledger.py discipline; ``photon-obs tail --elastic``
+        renders the decision tape). Lazy like the publish ledger; rows
+        land in ``<workdir>/elastic/ledger``."""
+        with self._publish_lock:
+            if self._elastic_ledger is None:
+                from photon_ml_tpu.obs.ledger import RunLedger
+
+                self._elastic_ledger = RunLedger.resume(
+                    os.path.join(self.workdir, "elastic", "ledger"),
+                    config={"kind": "elastic",
+                            "num_replicas": self.num_replicas,
+                            "num_shards": self.num_shards})
+            self._elastic_ledger.record(
+                "elastic", map_snapshot_version=self.shard_map.version,
+                **fields)
 
     # -- continuous publication (serving/publish.py; docs/SERVING.md
     #    "Continuous publication") --------------------------------------------
@@ -722,6 +884,8 @@ class ServingFleet:
     def start(self) -> None:
         os.makedirs(self.workdir, exist_ok=True)
         self.supervisor.start()
+        if self.elastic is not None:
+            self.elastic.start()
 
     def score(self, request_objs: Sequence[dict],
               want_trace: bool = False) -> dict:
@@ -730,14 +894,32 @@ class ServingFleet:
         front end maps them to status codes; programmatic callers get
         the same exception taxonomy."""
         counts: dict[int, int] = {}
+        shards: list[Optional[int]] = []
         for obj in request_objs:
-            rid = self.router.replica_for(obj)
+            shard = self.router.shard_for(obj)
+            shards.append(shard)
+            if shard is not None:
+                # Heat model feed: the request count + distinct-entity
+                # cardinality half of the shard's window.
+                ents = obj.get("entity_ids") or {}
+                key = ents[min(ents)] if ents else None
+                self.heat.record(shard, entity=key)
+                rid = self.shard_map.owner(shard)
+            else:
+                rid = self.router.replica_for(obj)
             counts[rid] = counts.get(rid, 0) + 1
         self.metrics.record_routed(counts)
         t0 = time.monotonic()
         out = self.router.score(request_objs, want_trace=want_trace)
         dt = time.monotonic() - t0
         self.metrics.record_ok(dt, n=len(request_objs))
+        # The service-seconds half: a shard whose requests run long is
+        # hotter at equal QPS (queue contribution, approximated by the
+        # body wall split evenly over its requests).
+        per = dt / max(len(request_objs), 1)
+        for shard in shards:
+            if shard is not None:
+                self.heat.record_seconds(shard, per)
         return out
 
     def admission_acquire(self) -> bool:
@@ -758,17 +940,21 @@ class ServingFleet:
 
     def healthz(self) -> dict:
         states = self.supervisor.states()
-        degraded = self._degraded or any(st != UP
+        # RETIRED is a deliberate scale-down outcome, not degradation.
+        degraded = self._degraded or any(st not in (UP, RETIRED)
                                          for st in states.values())
+        leaves = self.shard_map.shards()
         return {
             "status": "degraded" if degraded else "ok",
             "degraded": degraded,
             "rehoming": self._rehoming,
-            "fleet_depth": self.num_replicas,
+            "fleet_depth": len(self.shard_map.live()),
             "replicas": {str(k): v for k, v in states.items()},
-            "num_shards": self.num_shards,
+            "num_shards": len(leaves),
+            "map_version": self.shard_map.version,
+            "hot_shards": sorted(self._brownout),
             "shards_away_from_home": sum(
-                1 for s in range(self.num_shards)
+                1 for s in leaves
                 if self.shard_map.owner(s) != self.shard_map.home(s)),
             "published_version": self.published_version,
         }
@@ -778,7 +964,13 @@ class ServingFleet:
             self.supervisor.states(), self.healthz()["degraded"],
             boot_seconds={h.replica_id: h.boot_seconds
                           for h in self.supervisor.replicas
-                          if h.boot_seconds > 0.0})
+                          if h.boot_seconds > 0.0},
+            shard_heat=self.heat.snapshot(
+                resolver=lambda key: self.shard_map.shard_of_key(
+                    route_key(key))),
+            map_version=self.shard_map.version,
+            hedge_after_s=self.router.hedge_after_s or 0.0,
+            num_live=len(self.shard_map.live()))
 
     def slo_snapshot(self) -> dict:
         out = self.metrics.slo.snapshot()
@@ -789,10 +981,14 @@ class ServingFleet:
         if self._closed:
             return
         self._closed = True
+        if self.elastic is not None:
+            self.elastic.stop()
         self.router.close()
         self.supervisor.stop()
         if self._publish_ledger is not None:
             self._publish_ledger.close()
+        if self._elastic_ledger is not None:
+            self._elastic_ledger.close()
 
     def __enter__(self):
         return self
@@ -894,6 +1090,22 @@ class _FleetHandler(BaseHTTPRequestHandler):
             want_trace = bool(payload.get("trace", False))
         except (ValueError, TypeError, AttributeError, KeyError) as exc:
             self._json(400, {"error": f"malformed request: {exc}"})
+            return
+        # Overload ladder rung 1 — per-shard brownout: admission
+        # tightens for the HOT shard before anything fleet-wide, and
+        # the 503 NAMES it (docs/SERVING.md "Elastic fleet").
+        hot = fleet.brownout_shard_of(reqs)
+        if hot is not None:
+            shard, reason = hot
+            fleet.metrics.record_brownout_shed(len(reqs))
+            self._json(503, {
+                "error": f"brownout: shard {shard} is overloaded "
+                         f"({reason})",
+                "hot_shard": shard,
+                "replica_id": None,
+                "fleet_depth": fleet.num_replicas,
+                "degraded": True,
+            })
             return
         if not fleet.admission_acquire():
             # Fleet-level admission: the 503 names the FLEET (no single

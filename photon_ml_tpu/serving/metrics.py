@@ -35,8 +35,8 @@ import numpy as np
 from photon_ml_tpu.obs.metrics import Gauge
 from photon_ml_tpu.obs.metrics import Histogram as LatencyHistogram
 
-__all__ = ["CacheCounters", "LatencyHistogram", "STAGES", "SLOTracker",
-           "ServingMetrics"]
+__all__ = ["CacheCounters", "LatencyHistogram", "STAGES", "ShardHeat",
+           "SLOTracker", "ServingMetrics"]
 
 # The request lifecycle stages (docs/SERVING.md): a queued request's
 # end-to-end latency decomposes into exactly these four intervals.
@@ -143,6 +143,97 @@ class SLOTracker:
             "p95_ms": p95 * 1e3,
             "p99_ms": p99 * 1e3,
         }
+
+
+class ShardHeat:
+    """Per-shard sliding-window load accounting — the HEAT MODEL the
+    elastic control loop acts on (serving/elastic.py; docs/SERVING.md
+    "Elastic fleet").
+
+    Each routed request records against its shard: a request count, the
+    entity it named (distinct-entity cardinality separates "one hot
+    user" — unsplittable — from "a hot shard of many users", the case
+    splitting fixes), and later its observed service seconds (the
+    queue/stage contribution: a shard whose requests take longer is
+    hotter at equal QPS). The window prunes lazily, the same discipline
+    as :class:`SLOTracker`; ``heat(shard)`` is the window request count
+    weighted by the shard's mean service seconds — a pure function of
+    the window, so two controllers reading the same tape reach the same
+    decisions (the drills replay).
+
+    Thread-safe: router/handler threads record, the controller thread
+    snapshots.
+    """
+
+    def __init__(self, window_s: float = 30.0, max_samples: int = 65536):
+        self._lock = threading.Lock()
+        self.window_s = float(window_s)
+        # (monotonic_t, shard, entity_key | None, seconds)
+        self._events: collections.deque = collections.deque(
+            maxlen=max_samples)
+
+    def record(self, shard: int, entity=None, seconds: float = 0.0,
+               now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._events.append((now, int(shard), entity,
+                                 float(seconds), True))
+            self._prune_locked(now)
+
+    def record_seconds(self, shard: int, seconds: float,
+                       now: Optional[float] = None) -> None:
+        """Attribute observed service seconds to ``shard`` without
+        counting another request (the post-response half)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._events.append((now, int(shard), None,
+                                 float(seconds), False))
+            self._prune_locked(now)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def snapshot(self, now: Optional[float] = None,
+                 resolver=None) -> dict[int, dict]:
+        """{shard: {requests, entities, seconds, heat}} over the
+        window. ``heat`` = requests × (1 + mean service seconds): a
+        rate signal with a queue-contribution weight.
+
+        ``resolver(entity_key) -> shard`` re-resolves each
+        entity-carrying event through the CURRENT shard map: after a
+        split, the window's evidence follows the children instead of
+        pinning the parent's residue — without this, stale pre-split
+        events keep the parent looking multi-entity-hot for a full
+        window and the controller re-splits it on evidence that no
+        longer routes there (the repeated-split bug the live drill
+        caught). Events without an entity key keep their recorded
+        shard (misattribution bounded by the window)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(now)
+            events = list(self._events)
+        out: dict[int, dict] = {}
+        ents: dict[int, set] = {}
+        for _, shard, entity, seconds, is_request in events:
+            if resolver is not None and entity is not None:
+                shard = resolver(entity)
+            row = out.setdefault(shard, {"requests": 0, "entities": 0,
+                                         "seconds": 0.0, "heat": 0.0})
+            if is_request:
+                row["requests"] += 1
+                if entity is not None:
+                    ents.setdefault(shard, set()).add(entity)
+            row["seconds"] += seconds
+        for shard, row in out.items():
+            row["entities"] = len(ents.get(shard, ()))
+            n = max(row["requests"], 1)
+            row["heat"] = row["requests"] * (1.0 + row["seconds"] / n)
+        return out
+
+    def total_heat(self, now: Optional[float] = None) -> float:
+        return sum(r["heat"] for r in self.snapshot(now).values())
 
 
 class CacheCounters:

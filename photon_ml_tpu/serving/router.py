@@ -36,6 +36,7 @@ Every blocking HTTP call carries an explicit timeout (PML011).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import logging
@@ -104,7 +105,7 @@ def route_key(value) -> int:
 
 
 class ShardMap:
-    """The shard → replica assignment table (thread-safe).
+    """The VERSIONED shard → replica assignment table (thread-safe).
 
     ``home(shard) = shard % num_replicas`` is the balanced layout;
     ``mark_down`` re-homes a dead replica's shards to the surviving
@@ -112,6 +113,22 @@ class ShardMap:
     and ``restore`` sends a recovered replica's home shards back. The
     table is tiny and swapped under one lock: re-homing is O(shards),
     never O(entities) — the host stores already hold every row.
+
+    photon-elastic extended the table from the static ``key %
+    num_shards`` layout to a consistent-hash-style split trie: a hot
+    shard ``s`` (residue ``s`` under modulus ``m``, base ``m =
+    num_shards``) SPLITS into children ``s`` and ``s + m`` under modulus
+    ``2m`` — entities of every OTHER shard keep their residue, so a
+    split never remaps a cold entity (the consistent-hash property the
+    ISSUE requires). Shard ids stay plain ints: a leaf's residue is
+    globally unique because its integer encodes the base shard (low
+    bits) plus its split path (high bits). ``shard_of_key`` descends
+    the trie; with no splits it is exactly ``key % num_shards``.
+
+    Every mutation (split, migrate, re-home, restore, add/remove
+    replica, drain) bumps ``version`` under the one lock — readers see
+    the OLD table or the NEW one, never a torn mix, which is what makes
+    a kill mid-split recoverable by construction (docs/ROBUSTNESS.md).
     """
 
     def __init__(self, num_shards: int, num_replicas: int):
@@ -122,8 +139,15 @@ class ShardMap:
         self.num_shards = int(num_shards)
         self.num_replicas = int(num_replicas)
         self._lock = threading.Lock()
-        self._owner = [s % num_replicas for s in range(num_shards)]
+        # Leaves: residue → owning replica / residue → modulus; interior
+        # (split) nodes are (residue, modulus) pairs the routing loop
+        # descends through.
+        self._owner = {s: s % num_replicas for s in range(num_shards)}
+        self._modulus = {s: int(num_shards) for s in range(num_shards)}
+        self._interior: set[tuple[int, int]] = set()
         self._up = set(range(num_replicas))
+        self._draining: set[int] = set()
+        self.version = 1
 
     def home(self, shard: int) -> int:
         return shard % self.num_replicas
@@ -136,14 +160,115 @@ class ShardMap:
         with self._lock:
             return sorted(self._up)
 
+    def live(self) -> list[int]:
+        """Healthy AND accepting new traffic (up minus draining) — the
+        set hedges and entity-less round-robin route through."""
+        with self._lock:
+            return sorted(self._up - self._draining)
+
     def is_up(self, replica_id: int) -> bool:
         with self._lock:
             return replica_id in self._up
 
+    def is_live(self, replica_id: int) -> bool:
+        with self._lock:
+            return (replica_id in self._up
+                    and replica_id not in self._draining)
+
+    def shards(self) -> list[int]:
+        """Every LEAF shard (sorted); grows as hot shards split."""
+        with self._lock:
+            return sorted(self._owner)
+
     def shards_of(self, replica_id: int) -> list[int]:
         with self._lock:
-            return [s for s, r in enumerate(self._owner)
-                    if r == replica_id]
+            return sorted(s for s, r in self._owner.items()
+                          if r == replica_id)
+
+    def shard_of_key(self, key: int) -> int:
+        """Route a non-negative key to its LEAF shard: ``key %
+        num_shards``, descending split children until a leaf."""
+        with self._lock:
+            m = self.num_shards
+            r = key % m
+            while (r, m) in self._interior:
+                m *= 2
+                r = key % m
+            return r
+
+    def modulus_of(self, shard: int) -> int:
+        with self._lock:
+            return self._modulus[shard]
+
+    def split(self, shard: int) -> tuple[int, int]:
+        """Split leaf ``shard`` into two children under the doubled
+        modulus; both children inherit the parent's owner (migration is
+        a separate, also-atomic step). Returns ``(child_a, child_b)``.
+        One version bump: routing sees the pre-split or post-split
+        table, never a half-split one."""
+        with self._lock:
+            if shard not in self._owner:
+                raise KeyError(f"shard {shard} is not a leaf")
+            m = self._modulus[shard]
+            owner = self._owner[shard]
+            a, b = shard, shard + m
+            self._interior.add((shard, m))
+            self._owner[a] = owner
+            self._owner[b] = owner
+            self._modulus[a] = 2 * m
+            self._modulus[b] = 2 * m
+            self.version += 1
+            return a, b
+
+    def migrate(self, shard: int, new_owner: int) -> int:
+        """Re-assign leaf ``shard`` to ``new_owner`` (one table write,
+        one version bump). Returns the previous owner. Every replica
+        holds the full host store, so this is the whole migration —
+        the re-home discipline's table-swap leg, reused."""
+        with self._lock:
+            if shard not in self._owner:
+                raise KeyError(f"shard {shard} is not a leaf")
+            if new_owner not in self._up:
+                raise ReplicaUnavailable(
+                    f"migration target replica {new_owner} is not up",
+                    replica_id=new_owner)
+            old = self._owner[shard]
+            self._owner[shard] = int(new_owner)
+            self.version += 1
+            return old
+
+    def add_replica(self) -> int:
+        """Admit one new replica id (the next integer) to the map —
+        ownerless until migrations move shards onto it."""
+        with self._lock:
+            rid = self.num_replicas
+            self.num_replicas += 1
+            self._up.add(rid)
+            self.version += 1
+            return rid
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Retire a DRAINED replica from the map (it must own nothing —
+        scale-down migrates its shards away first; the guard is what
+        makes 'never retire the last owner of any shard' structural)."""
+        with self._lock:
+            owned = [s for s, r in self._owner.items()
+                     if r == replica_id]
+            if owned:
+                raise ValueError(
+                    f"replica {replica_id} still owns shard(s) {owned} "
+                    f"— migrate them away before retiring")
+            self._up.discard(replica_id)
+            self._draining.discard(replica_id)
+            self.version += 1
+
+    def set_draining(self, replica_id: int, draining: bool) -> None:
+        with self._lock:
+            if draining:
+                self._draining.add(replica_id)
+            else:
+                self._draining.discard(replica_id)
+            self.version += 1
 
     def mark_down(self, replica_id: int) -> dict[int, int]:
         """Re-home ``replica_id``'s shards to survivors; returns
@@ -151,18 +276,20 @@ class ShardMap:
         zero replicas cannot degrade gracefully — it is down)."""
         with self._lock:
             self._up.discard(replica_id)
-            survivors = sorted(self._up)
+            survivors = sorted(self._up - self._draining) \
+                or sorted(self._up)
             if not survivors:
                 raise ReplicaUnavailable(
                     "no surviving replica to re-home to",
                     replica_id=replica_id)
             moved = {}
             ring = itertools.cycle(survivors)
-            for s, r in enumerate(self._owner):
-                if r == replica_id:
+            for s in sorted(self._owner):
+                if self._owner[s] == replica_id:
                     new = next(ring)
                     self._owner[s] = new
                     moved[s] = new
+            self.version += 1
             return moved
 
     def restore(self, replica_id: int) -> list[int]:
@@ -170,26 +297,42 @@ class ShardMap:
         to it; returns the shards that moved back."""
         with self._lock:
             self._up.add(replica_id)
+            self._draining.discard(replica_id)
             back = []
-            for s in range(self.num_shards):
+            for s in sorted(self._owner):
                 if (self.home(s) == replica_id
                         and self._owner[s] != replica_id):
                     self._owner[s] = replica_id
                     back.append(s)
+            self.version += 1
             return back
 
     def next_up(self, after: int) -> int:
-        """The next healthy replica on the ring after ``after`` (the
-        hedge target: deterministic, never ``after`` itself unless it
-        is the only survivor)."""
+        """The next healthy, non-draining replica on the ring after
+        ``after`` (deterministic, never ``after`` itself unless it is
+        the only survivor)."""
         with self._lock:
-            if not self._up:
+            pool = (self._up - self._draining) or self._up
+            if not pool:
                 raise ReplicaUnavailable("no replica is up")
             for delta in range(1, self.num_replicas + 1):
                 cand = (after + delta) % self.num_replicas
-                if cand in self._up:
+                if cand in pool:
                     return cand
             return after  # pragma: no cover — unreachable (set nonempty)
+
+    def snapshot(self) -> dict:
+        """The whole assignment, for ledger evidence and /healthz."""
+        with self._lock:
+            return {
+                "version": self.version,
+                "num_shards": self.num_shards,
+                "num_replicas": self.num_replicas,
+                "owners": dict(self._owner),
+                "moduli": dict(self._modulus),
+                "up": sorted(self._up),
+                "draining": sorted(self._draining),
+            }
 
 
 class FleetRouter:
@@ -212,6 +355,7 @@ class FleetRouter:
         retry_backoff_s: float = 0.1,
         hedge_after_s: Optional[float] = None,
         metrics=None,
+        health_fn: Optional[Callable[[int], bool]] = None,
     ):
         self.shard_map = shard_map
         self._endpoint = endpoint_fn
@@ -222,7 +366,20 @@ class FleetRouter:
         self.hedge_after_s = (None if hedge_after_s is None
                               else float(hedge_after_s))
         self.metrics = metrics
+        # Liveness beyond the shard map's own view: the supervisor
+        # declares a replica dead/restarting BEFORE the map re-homes
+        # (the map swap runs on the monitor thread, after detection) —
+        # a hedge aimed into that gap burns the hedge budget on a
+        # corpse. None = trust the map alone.
+        self._health = health_fn
         self._rr = itertools.count()  # entity-less requests round-robin
+        # Recent successful send walls (submit → response), the signal
+        # the elastic controller auto-tunes hedge_after_s from
+        # (serving/elastic.py): p99 of THESE is what "the primary is
+        # slow" should mean, not a static guess.
+        self._send_lock = threading.Lock()
+        self._send_walls: collections.deque = collections.deque(
+            maxlen=512)
         # Forward pool: grouped per-replica sends of one /score body run
         # concurrently; hedges ride the same pool.
         # TWO pools, strictly layered: group threads (one per per-replica
@@ -252,16 +409,46 @@ class FleetRouter:
                 return None
         else:
             key = ents[min(ents)]
-        return route_key(key) % self.shard_map.num_shards
+        return self.shard_map.shard_of_key(route_key(key))
 
     def replica_for(self, request_obj: dict) -> int:
         shard = self.shard_for(request_obj)
         if shard is None:
-            up = self.shard_map.up()
-            if not up:
+            live = self.shard_map.live()
+            if not live:
                 raise ReplicaUnavailable("no replica is up")
-            return up[next(self._rr) % len(up)]
+            return live[next(self._rr) % len(live)]
         return self.shard_map.owner(shard)
+
+    # -- hedging ------------------------------------------------------------
+
+    def _is_live(self, replica_id: int) -> bool:
+        if not self.shard_map.is_live(replica_id):
+            return False
+        return self._health is None or self._health(replica_id)
+
+    def hedge_target(self, after: int) -> Optional[int]:
+        """The next LIVE replica on the ring after ``after`` — up in
+        the map, not draining, and healthy per the supervisor's view
+        when one is wired. None = no useful hedge target exists (a
+        hedge to a known-dead or draining replica only burns budget —
+        the satellite fix of ISSUE 15)."""
+        for delta in range(1, self.shard_map.num_replicas + 1):
+            cand = (after + delta) % self.shard_map.num_replicas
+            if cand == after:
+                continue
+            if self._is_live(cand):
+                return cand
+        return None
+
+    def observed_send_p99(self) -> Optional[float]:
+        """p99 of the recent successful send walls (seconds); None
+        until enough samples exist to make a tail meaningful."""
+        with self._send_lock:
+            if len(self._send_walls) < 20:
+                return None
+            walls = sorted(self._send_walls)
+        return walls[min(len(walls) - 1, int(0.99 * len(walls)))]
 
     # -- forwarding ----------------------------------------------------------
 
@@ -277,10 +464,14 @@ class FleetRouter:
         req = urllib.request.Request(
             f"http://{host}:{port}/score", data=body,
             headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s) as resp:
-                return json.loads(resp.read())
+                out = json.loads(resp.read())
+            with self._send_lock:
+                self._send_walls.append(time.monotonic() - t0)
+            return out
         except urllib.error.HTTPError as e:
             try:
                 payload = json.loads(e.read())
@@ -309,11 +500,13 @@ class FleetRouter:
         done, _ = wait([primary], timeout=self.hedge_after_s)
         if done:
             return primary.result()
-        # Primary is slow: duplicate to the next healthy replica. Both
-        # futures race; the first SUCCESSFUL response wins (a fast
+        # Primary is slow: duplicate to the next LIVE replica (up in
+        # the map, not draining, healthy per the supervisor — a hedge
+        # to a known-dead replica would burn the budget for nothing).
+        # Both futures race; the first SUCCESSFUL response wins (a fast
         # failure must not beat a slow success).
-        hedge_to = self.shard_map.next_up(replica_id)
-        if hedge_to == replica_id:
+        hedge_to = self.hedge_target(replica_id)
+        if hedge_to is None:
             return primary.result(timeout=self.request_timeout_s + 1)
         if self.metrics is not None:
             self.metrics.record_hedge()

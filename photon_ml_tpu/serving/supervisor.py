@@ -54,6 +54,7 @@ UP = "up"
 DOWN = "down"
 RESTARTING = "restarting"
 FAILED = "failed"  # restart budget exhausted — stays down, fleet degraded
+RETIRED = "retired"  # scaled down deliberately — not a failure state
 
 
 class ReplicaStartupError(RuntimeError):
@@ -72,6 +73,8 @@ class ReplicaHandle:
     state: str = STARTING
     last_ok: float = 0.0  # monotonic instant of the last good probe
     restarts: int = 0
+    generation: int = 0  # bumped per spawn — ready files never reused
+    last_restart_at: float = 0.0  # monotonic instant of the last restart
     log_path: str = ""
     boot_seconds: float = 0.0  # spawn → first healthy probe, last (re)start
     spawned_at: float = 0.0  # monotonic instant of the last _spawn
@@ -110,6 +113,7 @@ class ReplicaSupervisor:
         start_timeout_s: float = 120.0,
         max_restarts: int = 3,
         restart_backoff_s: float = 0.1,
+        backoff_reset_s: float = 60.0,
         on_death: Optional[Callable[[int], None]] = None,
         on_recovered: Optional[Callable[[int], None]] = None,
     ):
@@ -124,6 +128,11 @@ class ReplicaSupervisor:
         self.start_timeout_s = float(start_timeout_s)
         self.max_restarts = int(max_restarts)
         self.restart_backoff_s = float(restart_backoff_s)
+        # The backoff-ladder amnesty (ISSUE 15 satellite): a replica
+        # healthy this long after a restart earns its ladder back — a
+        # crash-once-then-healthy-for-hours replica must not pay the
+        # escalated backoff (and restart budget) on its NEXT death.
+        self.backoff_reset_s = float(backoff_reset_s)
         self._on_death = on_death
         self._on_recovered = on_recovered
         self.replicas = [ReplicaHandle(replica_id=i)
@@ -141,7 +150,11 @@ class ReplicaSupervisor:
 
     def _spawn(self, handle: ReplicaHandle) -> None:
         rid = handle.replica_id
-        ready = self._ready_file(rid, handle.restarts)
+        # Generation, not restart count, names the ready file: the
+        # backoff-reset amnesty rewinds `restarts`, and a rewound name
+        # could collide with a DEAD incarnation's file.
+        handle.generation += 1
+        ready = self._ready_file(rid, handle.generation)
         if os.path.exists(ready):
             os.unlink(ready)
         handle.log_path = os.path.join(self.workdir, f"replica-{rid}.log")
@@ -176,7 +189,7 @@ class ReplicaSupervisor:
         reads it back as ``photon_fleet_replica_boot_seconds``)."""
         rid = handle.replica_id
         t_spawn = handle.spawned_at or time.monotonic()
-        ready = self._ready_file(rid, handle.restarts)
+        ready = self._ready_file(rid, handle.generation)
         deadline = time.monotonic() + self.start_timeout_s
         while time.monotonic() < deadline:
             if handle.proc.poll() is not None:
@@ -230,6 +243,55 @@ class ReplicaSupervisor:
             daemon=True)
         self._monitor.start()
 
+    # -- elastic scale (docs/SERVING.md "Elastic fleet") ---------------------
+
+    def add_replica(self) -> int:
+        """Spawn ONE more supervised replica (the scale-up leg): next
+        integer id, full spawn → ready-file → healthy handshake before
+        it is visible to routing. Returns the new replica id; raises
+        ``ReplicaStartupError`` (and reaps the half-started process) on
+        failure — the fleet's map never learns about a replica that
+        did not reach healthy."""
+        handle = ReplicaHandle(replica_id=len(self.replicas))
+        self._spawn(handle)
+        try:
+            self._await_ready(handle)
+        except ReplicaStartupError:
+            if handle.proc is not None and handle.proc.poll() is None:
+                handle.proc.kill()
+                try:
+                    handle.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    logger.warning("could not reap failed scale-up "
+                                   "replica %d", handle.replica_id)
+            raise
+        with self._lock:
+            self.replicas.append(handle)
+        logger.info("replica %d scaled up (fleet now %d)",
+                    handle.replica_id, len(self.replicas))
+        return handle.replica_id
+
+    def retire(self, replica_id: int) -> None:
+        """Retire a DRAINED replica (the scale-down leg): mark it
+        RETIRED first — the monitor never restarts a retired replica —
+        then terminate the process. Deliberate, not a death: no
+        on_death fires, no restart follows."""
+        handle = self.replicas[replica_id]
+        with self._lock:
+            handle.state = RETIRED
+        if handle.proc is not None and handle.proc.poll() is None:
+            handle.proc.terminate()
+            try:
+                handle.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                handle.proc.kill()
+                try:
+                    handle.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    logger.warning("could not reap retired replica %d",
+                                   replica_id)
+        logger.info("replica %d retired", replica_id)
+
     # -- monitoring ----------------------------------------------------------
 
     def _probe_once(self, handle: ReplicaHandle) -> bool:
@@ -247,9 +309,30 @@ class ReplicaSupervisor:
         except (OSError, ValueError):
             return False
 
+    def maybe_reset_backoff(self, handle: ReplicaHandle,
+                            now: Optional[float] = None) -> bool:
+        """Reset a replica's restart ladder after ``backoff_reset_s``
+        of healthy uptime since its last restart; True = reset
+        happened. Pure bookkeeping — callable from tests directly."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if (handle.state == UP and handle.restarts > 0
+                    and handle.last_restart_at > 0.0
+                    and now - handle.last_restart_at
+                    >= self.backoff_reset_s):
+                logger.info(
+                    "replica %d healthy %.0fs since its last restart — "
+                    "resetting its backoff ladder (%d restart(s) "
+                    "forgiven)", handle.replica_id,
+                    now - handle.last_restart_at, handle.restarts)
+                handle.restarts = 0
+                handle.last_restart_at = 0.0
+                return True
+        return False
+
     def _monitor_loop(self) -> None:
         while self._running:
-            for handle in self.replicas:
+            for handle in list(self.replicas):
                 if not self._running:
                     return
                 if handle.state not in (UP,):
@@ -258,6 +341,7 @@ class ReplicaSupervisor:
                 if self._probe_once(handle):
                     with self._lock:
                         handle.last_ok = now
+                    self.maybe_reset_backoff(handle, now)
                 elif (handle.proc.poll() is not None
                       or now - handle.last_ok
                       >= self.heartbeat_deadline_s):
@@ -302,6 +386,7 @@ class ReplicaSupervisor:
         with self._lock:
             handle.state = RESTARTING
             handle.restarts += 1
+            handle.last_restart_at = time.monotonic()
         # Deterministic backoff (no jitter: drills must replay exactly).
         time.sleep(self.restart_backoff_s * handle.restarts)
         try:

@@ -289,6 +289,47 @@ class ReplicaRecovered(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShardSplit(Event):
+    """A hot shard split into two consistent-hash children
+    (serving/router.py ShardMap.split + serving/elastic.py;
+    docs/SERVING.md "Elastic fleet"). ``heat_fraction`` is the share of
+    the window's total heat the parent carried when the controller
+    ruled — the triggering evidence, also written to the ``elastic``
+    ledger row."""
+
+    shard: int
+    children: tuple[int, int]
+    heat_fraction: float
+    map_version: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaScaled(Event):
+    """The elastic controller changed the fleet's replica count:
+    ``direction`` "up" (spawned + warmed + admitted to the map) or
+    "down" (drained → migrated empty → retired). ``reason`` names the
+    triggering signal (error-budget burn, queue depth, heat
+    imbalance, idle)."""
+
+    direction: str
+    replica_id: int
+    num_replicas: int
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetDegraded(Event):
+    """The overload ladder changed state (docs/SERVING.md "Elastic
+    fleet" brownout semantics): ``mode`` "brownout" = per-shard
+    admission tightened on ``hot_shards`` (their 503s name the shard),
+    "recovered" = the ladder released."""
+
+    mode: str
+    hot_shards: tuple[int, ...]
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
 class DeltaPublished(Event):
     """A versioned model delta finished the canary ladder and is live on
     EVERY replica (serving/publish.py + fleet.py; docs/SERVING.md

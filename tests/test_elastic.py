@@ -1,0 +1,742 @@
+"""Elastic-fleet chaos suite (photon_ml_tpu/serving/elastic.py +
+router.py ShardMap v2 + supervisor scale legs; docs/SERVING.md
+"Elastic fleet").
+
+The contract under test, ROADMAP item 2's closing loop:
+
+    a deterministic Zipf hot spot pinned to one shard triggers a live
+    split + a scale-up and the load spreads, with every score
+    BIT-identical to the single-process oracle before, during, and
+    after; a fault mid-split/mid-migrate/mid-scale leaves the shard
+    map at exactly the old or the new version — never torn — and
+    scale-down can never retire the last owner of any shard.
+
+Unit tests drive the controller against a fake fleet (pure decision
+logic, no subprocesses); the live tests share one module-scoped
+2-replica fleet that scales to 3 (each replica is a JAX interpreter —
+spawn once, tick the controller deterministically from the test
+thread; its own loop idles at a huge interval).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import faults
+from photon_ml_tpu.serving import elastic as elastic_mod
+from photon_ml_tpu.serving.elastic import (ElasticConfig,
+                                           ElasticController,
+                                           parse_elastic_config)
+from photon_ml_tpu.serving.fleet import FleetMetrics
+from photon_ml_tpu.serving.metrics import ShardHeat
+from photon_ml_tpu.serving.router import FleetRouter, ShardMap
+from photon_ml_tpu.utils import events as ev
+from photon_ml_tpu.utils.events import EventEmitter
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.install(None)
+
+
+# ------------------------------------------------- shard map v2 units
+
+
+def test_split_is_consistent_hash_cold_entities_never_remap():
+    """Splitting shard 1 must not move ANY key of shards 0/2/3, and
+    the children must exactly partition the parent's keys by the next
+    modulus bit."""
+    sm = ShardMap(num_shards=4, num_replicas=2)
+    before = {k: sm.shard_of_key(k) for k in range(64)}
+    v0 = sm.version
+    a, b = sm.split(1)
+    assert (a, b) == (1, 5)
+    assert sm.version == v0 + 1
+    assert sm.shards() == [0, 1, 2, 3, 5]
+    for k in range(64):
+        if before[k] != 1:
+            assert sm.shard_of_key(k) == before[k], k  # cold: untouched
+        else:
+            child = sm.shard_of_key(k)
+            assert child == (a if k % 8 == 1 else b), k
+    # Children inherit the parent's owner until a migration moves one.
+    assert sm.owner(a) == sm.owner(b) == 1
+    # Recursive split of a child keeps the property.
+    a2, b2 = sm.split(b)
+    assert (a2, b2) == (5, 13)
+    assert sm.shard_of_key(5) == 5 and sm.shard_of_key(13) == 13
+    assert sm.shard_of_key(1) == 1
+
+
+def test_split_and_migrate_version_discipline():
+    sm = ShardMap(num_shards=4, num_replicas=2)
+    v0 = sm.version
+    _, b = sm.split(1)
+    old = sm.migrate(b, 0)
+    assert old == 1 and sm.owner(b) == 0
+    assert sm.version == v0 + 2  # one bump per mutation, none torn
+    with pytest.raises(KeyError):
+        sm.split(99)  # not a leaf
+    with pytest.raises(KeyError):
+        sm.migrate(99, 0)
+    sm2 = ShardMap(num_shards=4, num_replicas=2)
+    sm2.split(1)
+    sm2.migrate(5, 0)
+    assert sm2.snapshot()["owners"] == sm.snapshot()["owners"]  # replay
+
+
+def test_add_remove_replica_and_drain():
+    sm = ShardMap(num_shards=4, num_replicas=2)
+    rid = sm.add_replica()
+    assert rid == 2 and sm.live() == [0, 1, 2]
+    # A replica that still owns shards can NEVER be retired.
+    with pytest.raises(ValueError):
+        sm.remove_replica(0)
+    sm.set_draining(2, True)
+    assert sm.live() == [0, 1] and sm.up() == [0, 1, 2]
+    # Draining replicas receive no re-homed shards.
+    moved = sm.mark_down(0)
+    assert set(moved.values()) == {1}
+    sm.remove_replica(2)  # owns nothing → fine
+    assert sm.up() == [1]
+
+
+def test_shard_heat_window_entities_and_weighting():
+    h = ShardHeat(window_s=60.0)
+    now = 1000.0
+    h.record(1, entity=7, now=now)
+    h.record(1, entity=9, now=now)
+    h.record(2, entity=7, now=now)
+    h.record_seconds(1, 1.0, now=now)
+    snap = h.snapshot(now=now)
+    assert snap[1]["requests"] == 2 and snap[1]["entities"] == 2
+    assert snap[2]["requests"] == 1
+    # seconds weight: heat = requests × (1 + mean service seconds)
+    assert snap[1]["heat"] == pytest.approx(2 * 1.5)
+    # Window pruning drops everything past the horizon.
+    assert h.snapshot(now=now + 61.0) == {}
+
+
+def test_shard_heat_resolver_follows_the_current_map():
+    """Post-split, the window's evidence must RE-RESOLVE through the
+    current map: stale pre-split events may not keep the parent shard
+    looking multi-entity-hot (the repeated-split bug the live CLI
+    drill caught — the controller split the same shard once per tick
+    for a full window on evidence that no longer routed there)."""
+    from photon_ml_tpu.serving.router import route_key
+
+    sm = ShardMap(num_shards=8, num_replicas=2)
+    h = ShardHeat(window_s=60.0)
+    now = 1000.0
+    h.record(1, entity=1, now=now)
+    h.record(1, entity=9, now=now)  # 9 % 8 == 1: same shard, pre-split
+    resolve = lambda key: sm.shard_of_key(route_key(key))  # noqa: E731
+    snap = h.snapshot(now=now, resolver=resolve)
+    assert snap[1]["entities"] == 2  # pre-split: both on shard 1
+    sm.split(1)  # children 1 and 9 under modulus 16
+    snap = h.snapshot(now=now, resolver=resolve)
+    assert snap[1]["entities"] == 1  # entity 1 stays
+    assert snap[9]["entities"] == 1  # entity 9's events FOLLOWED it
+    # Without a resolver the stale attribution persists — the raw view.
+    raw = h.snapshot(now=now)
+    assert raw[1]["entities"] == 2
+
+
+# ------------------------------------- hedge-health satellite (fix 1)
+
+
+def test_hedge_target_skips_dead_and_draining_replicas():
+    """The regression the satellite names: a hedge must never aim at a
+    replica the supervisor already knows is dead (or that is
+    draining), even while the shard map still lists it up."""
+    sm = ShardMap(num_shards=8, num_replicas=3)
+    alive = {0: True, 1: True, 2: True}
+    router = FleetRouter(sm, lambda rid: ("127.0.0.1", 1),
+                        health_fn=lambda rid: alive[rid])
+    try:
+        assert router.hedge_target(1) == 2
+        alive[2] = False  # supervisor sees the death; map not yet
+        assert sm.is_up(2)
+        assert router.hedge_target(1) == 0
+        sm.set_draining(0, True)  # draining: no new traffic, no hedges
+        assert router.hedge_target(1) is None
+        alive[2] = True
+        assert router.hedge_target(1) == 2
+    finally:
+        router.close()
+
+
+# --------------------------------- backoff-reset satellite (fix 2)
+
+
+def test_restart_backoff_resets_after_healthy_interval():
+    from photon_ml_tpu.serving.supervisor import (UP, ReplicaHandle,
+                                                  ReplicaSupervisor)
+
+    sup = ReplicaSupervisor(lambda rid, rf: ["true"], 1, "/tmp",
+                            backoff_reset_s=30.0)
+    h = ReplicaHandle(replica_id=0, state=UP, restarts=2,
+                      last_restart_at=100.0)
+    # Healthy but not long enough: the ladder stays escalated.
+    assert not sup.maybe_reset_backoff(h, now=100.0 + 29.0)
+    assert h.restarts == 2
+    # Past the amnesty interval: the ladder (and budget) reset.
+    assert sup.maybe_reset_backoff(h, now=100.0 + 31.0)
+    assert h.restarts == 0 and h.last_restart_at == 0.0
+    # Never-restarted or non-UP handles are untouched.
+    assert not sup.maybe_reset_backoff(h, now=1e9)
+    h2 = ReplicaHandle(replica_id=1, state="down", restarts=3,
+                       last_restart_at=1.0)
+    assert not sup.maybe_reset_backoff(h2, now=1e9)
+    assert h2.restarts == 3
+
+
+def test_parse_elastic_config():
+    cfg = parse_elastic_config("")
+    assert cfg == ElasticConfig()
+    cfg = parse_elastic_config("split_factor=3, interval=0.25,"
+                               "hedge=off,max_replicas=5")
+    assert cfg.split_factor == 3.0 and cfg.interval_s == 0.25
+    assert cfg.hedge_auto is False and cfg.max_replicas == 5
+    with pytest.raises(ValueError):
+        parse_elastic_config("bogus_key=1")
+    with pytest.raises(ValueError):
+        parse_elastic_config("split_factor")
+
+
+# ------------------------------------------- controller decision units
+
+
+class _StubRouter:
+    def __init__(self):
+        self.hedge_after_s = None
+        self.p99 = None
+
+    def observed_send_p99(self):
+        return self.p99
+
+
+class _StubSupervisor:
+    def __init__(self, n):
+        self.endpoints = {i: ("127.0.0.1", 1) for i in range(n)}
+        self.retired = []
+
+    def endpoint(self, rid):
+        return self.endpoints.get(rid, ("127.0.0.1", 1))
+
+    def retire(self, rid):
+        self.retired.append(rid)
+
+
+class _FakeFleet:
+    """Just the surface ElasticController touches — real ShardMap,
+    real FleetMetrics, real ShardHeat, stub I/O."""
+
+    def __init__(self, num_shards=4, num_replicas=2):
+        self.shard_map = ShardMap(num_shards, num_replicas)
+        self.metrics = FleetMetrics(num_replicas)
+        self.heat = ShardHeat(window_s=60.0)
+        self.router = _StubRouter()
+        self.supervisor = _StubSupervisor(num_replicas)
+        self.emitter = EventEmitter()
+        self.max_inflight = 32
+        self.inflight = 0
+        self.probe_timeout_s = 0.2
+        self.brownouts = []
+        self.records = []
+        self.added = []
+
+    def set_brownout(self, shards, reason):
+        self.brownouts.append((sorted(int(s) for s in shards), reason))
+
+    def add_replica(self):
+        rid = self.shard_map.add_replica()
+        self.supervisor.endpoints[rid] = ("127.0.0.1", 1)
+        self.added.append(rid)
+        return rid
+
+    def _elastic_record(self, **fields):
+        self.records.append(fields)
+
+
+@pytest.fixture
+def probe_ok(monkeypatch):
+    monkeypatch.setattr(elastic_mod, "_probe_healthz",
+                        lambda url, timeout_s: {"status": "ok"})
+
+
+def _heat_up(fleet, shard_entities, n=16):
+    """Seed the heat window + SLO window deterministically."""
+    for i in range(n):
+        for shard, entity in shard_entities:
+            fleet.heat.record(shard, entity=entity)
+            fleet.metrics.slo.record_ok(0.001)
+
+
+def test_controller_splits_hot_shard_and_migrates_child(probe_ok):
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        split_factor=2.0, min_heat_requests=8, hysteresis_ticks=99,
+        hedge_auto=False))
+    events = []
+    fleet.emitter.register(events.append)
+    _heat_up(fleet, [(1, 101), (1, 105)])
+    actions = ctl.tick()
+    assert actions["split"] == (1, 1, 5)
+    assert actions["migrate"] == (5, 0)  # coldest live replica
+    assert fleet.shard_map.owner(5) == 0
+    assert fleet.metrics.snapshot()["splits_total"] == 1
+    assert fleet.metrics.snapshot()["migrations_total"] == 1
+    splits = [e for e in events if isinstance(e, ev.ShardSplit)]
+    assert splits and splits[0].shard == 1
+    assert splits[0].heat_fraction == pytest.approx(1.0)
+    acts = [r["action"] for r in fleet.records]
+    assert acts == ["split", "migrate"]
+    # The decision is a pure function of the tape: a second fleet with
+    # the same window makes the identical decision.
+    fleet2 = _FakeFleet()
+    ctl2 = ElasticController(fleet2, ctl.config)
+    _heat_up(fleet2, [(1, 101), (1, 105)])
+    assert ctl2.tick()["split"] == (1, 1, 5)
+
+
+def test_controller_never_splits_a_single_entity_hot_spot(probe_ok):
+    """One hot user cannot be split apart — the controller must not
+    burn the shard budget trying."""
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        split_factor=2.0, min_heat_requests=8, hysteresis_ticks=99,
+        hedge_auto=False))
+    _heat_up(fleet, [(1, 101)])
+    actions = ctl.tick()
+    assert "split" not in actions
+    assert fleet.shard_map.shards() == [0, 1, 2, 3]
+
+
+def test_controller_scale_up_hysteresis_and_rebalance(probe_ok):
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        min_heat_requests=8, scale_up_heat_frac=0.6,
+        hysteresis_ticks=2, cooldown_s=0.0, max_replicas=3,
+        split_factor=1e9, hedge_auto=False))
+    events = []
+    fleet.emitter.register(events.append)
+    _heat_up(fleet, [(1, 101)])  # all heat on replica 1, unsplittable
+    assert "scale_up" not in ctl.tick()  # tick 1: hysteresis holds
+    actions = ctl.tick()  # tick 2: sustained → scale
+    assert actions["scale_up"] == 2
+    assert fleet.added == [2]
+    # The hottest shard rebalances onto the newcomer.
+    assert fleet.shard_map.owner(1) == 2
+    assert fleet.metrics.snapshot()["scale_ups_total"] == 1
+    scaled = [e for e in events if isinstance(e, ev.ReplicaScaled)]
+    assert scaled and scaled[0].direction == "up"
+    assert "heat" in scaled[0].reason
+    # max_replicas caps: sustained pressure cannot scale past the lid.
+    ctl.tick()
+    ctl.tick()
+    assert fleet.added == [2]
+
+
+def test_controller_scale_down_drains_and_retires(probe_ok):
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        hysteresis_ticks=1, cooldown_s=0.0, min_replicas=1,
+        hedge_auto=False))
+    actions = ctl.tick()  # zero burn, zero inflight, zero window QPS
+    assert actions["scale_down"] == 0  # coldest (tie → lowest id)
+    assert fleet.supervisor.retired == [0]
+    assert fleet.shard_map.live() == [1]
+    assert all(fleet.shard_map.owner(s) == 1
+               for s in fleet.shard_map.shards())
+    assert fleet.metrics.snapshot()["scale_downs_total"] == 1
+    # At min_replicas the fleet never drains itself to nothing.
+    assert "scale_down" not in ctl.tick()
+    assert fleet.shard_map.live() == [1]
+
+
+def test_controller_scale_down_aborts_when_no_destination(monkeypatch):
+    """The 'never retire the last owner of any shard' guard: if a
+    shard cannot be placed (target probe fails), the drain is undone
+    and the victim keeps serving."""
+    fleet = _FakeFleet()
+
+    def probe_dead(url, timeout_s):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(elastic_mod, "_probe_healthz", probe_dead)
+    ctl = ElasticController(fleet, ElasticConfig(
+        hysteresis_ticks=1, cooldown_s=0.0, min_replicas=1,
+        hedge_auto=False))
+    actions = ctl.tick()
+    assert "scale_down" not in actions
+    assert fleet.supervisor.retired == []
+    assert fleet.shard_map.live() == [0, 1]  # drain undone
+    assert fleet.shard_map.shards_of(0)  # victim still owns its shards
+
+
+def test_controller_faults_leave_map_consistent(probe_ok):
+    """Chaos at the three new sites: each fault leaves the map at
+    exactly the old version (fire precedes the mutation) — never
+    torn, and the next clean tick proceeds."""
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        split_factor=2.0, min_heat_requests=8, scale_up_heat_frac=0.6,
+        hysteresis_ticks=1, cooldown_s=0.0, max_replicas=3,
+        hedge_auto=False))
+    _heat_up(fleet, [(1, 101), (1, 105)])
+    v0 = fleet.shard_map.version
+    faults.install(faults.FaultPlan(specs=(
+        faults.FaultSpec(site=faults.sites.FLEET_SPLIT, kind="raise"),
+        faults.FaultSpec(site=faults.sites.FLEET_SCALE, kind="raise"),
+    )))
+    actions = ctl.tick()
+    assert "split" not in actions and "scale_up" not in actions
+    assert fleet.shard_map.version == v0  # exactly the old version
+    assert fleet.shard_map.shards() == [0, 1, 2, 3]
+    assert fleet.added == []
+    # Migrate fault: the split lands (new version), the child stays
+    # with a VALID owner — old or new, never torn.
+    faults.install(faults.FaultPlan(specs=(
+        faults.FaultSpec(site=faults.sites.FLEET_MIGRATE,
+                         kind="raise"),)))
+    actions = ctl.tick()
+    assert actions["split"] == (1, 1, 5)
+    assert "migrate" not in actions
+    assert fleet.shard_map.owner(5) == 1  # inherited, valid
+    assert fleet.shard_map.version == v0 + 1  # split bump only
+    faults.install(None)
+
+
+def test_controller_brownout_engages_names_shard_and_releases():
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        min_heat_requests=4, brownout_burn=2.0,
+        brownout_heat_frac=0.5, split_factor=1e9,
+        hysteresis_ticks=99, hedge_auto=False))
+    _heat_up(fleet, [(1, 101)], n=8)
+    for _ in range(4):
+        fleet.metrics.slo.record_bad("shed")
+    actions = ctl.tick()
+    assert actions["brownout"] == [1]
+    assert fleet.brownouts[-1][0] == [1]
+    # Burn subsides → the ladder releases with hysteresis.
+    from photon_ml_tpu.serving.metrics import SLOTracker
+
+    fleet.metrics.slo = SLOTracker()
+    actions = ctl.tick()
+    assert actions.get("brownout_clear") is True
+    assert fleet.brownouts[-1][0] == []
+
+
+def test_controller_hedge_autotune_clamped():
+    fleet = _FakeFleet()
+    ctl = ElasticController(fleet, ElasticConfig(
+        hedge_factor=1.5, hedge_min_s=0.01, hedge_max_s=5.0,
+        hysteresis_ticks=99, hedge_auto=True))
+    ctl.tick()
+    assert fleet.router.hedge_after_s is None  # no samples yet
+    fleet.router.p99 = 0.1
+    ctl.tick()
+    assert fleet.router.hedge_after_s == pytest.approx(0.15)
+    assert fleet.records[-1]["action"] == "hedge_tune"
+    n_records = len(fleet.records)
+    fleet.router.p99 = 0.101  # immaterial movement: no re-tune churn
+    ctl.tick()
+    assert len(fleet.records) == n_records
+    fleet.router.p99 = 1e-6
+    ctl.tick()
+    assert fleet.router.hedge_after_s == pytest.approx(0.01)  # floor
+    fleet.router.p99 = 100.0
+    ctl.tick()
+    assert fleet.router.hedge_after_s == pytest.approx(5.0)  # ceiling
+
+
+# ----------------------------------------------------- live fleet tests
+
+
+E, DG, DR = 32, 6, 4
+
+
+def _tiny_model():
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(11)
+    return GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=DG).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(E, DR)).astype(np.float32))),
+    })
+
+
+def _request_objs(entities, seed=5):
+    rng = np.random.default_rng(seed)
+    objs = []
+    for i, eid in enumerate(entities):
+        objs.append({
+            "features": {
+                "global": rng.normal(size=DG).astype(
+                    np.float32).tolist(),
+                "re_userId": rng.normal(size=DR).astype(
+                    np.float32).tolist()},
+            "entity_ids": {"userId": int(eid)}, "uid": i})
+    return objs
+
+
+def _oracle_scores(model, objs):
+    from photon_ml_tpu.serving import ScoringRequest, ScoringService
+
+    svc = ScoringService(model, max_wait_ms=0.5)
+    try:
+        return np.asarray([
+            float(svc.submit(ScoringRequest(
+                features={k: np.asarray(v, np.float32)
+                          for k, v in o["features"].items()},
+                entity_ids=o["entity_ids"])).result(timeout=60))
+            for o in objs], np.float32)
+    finally:
+        svc.close()
+
+
+def _post(url, objs, timeout=60.0):
+    import urllib.request
+
+    body = json.dumps({"requests": objs}).encode()
+    req = urllib.request.Request(
+        url + "/score", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+HEAT_WINDOW_S = 2.0
+
+
+@pytest.fixture(scope="module")
+def elastic_env(tmp_path_factory):
+    """One 2-replica elastic fleet (scales to 3 during the suite); the
+    controller thread idles at a huge interval — tests tick it
+    deterministically."""
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+
+    td = tmp_path_factory.mktemp("elastic")
+    model = _tiny_model()
+    model_dir = str(td / "model")
+    model_io.save_game_model(model, model_dir)
+    fleet = ServingFleet(
+        replica_args=["--model-dir", model_dir, "--max-wait-ms", "0.5"],
+        num_replicas=2, workdir=str(td / "work"), num_shards=4,
+        probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+        rehome_deadline_s=5.0, retry_backoff_s=0.1, retries=3,
+        elastic=ElasticConfig(
+            interval_s=9999.0, heat_window_s=HEAT_WINDOW_S,
+            split_factor=2.0, min_heat_requests=8,
+            scale_up_heat_frac=0.6, hysteresis_ticks=1,
+            cooldown_s=0.0, max_replicas=3, min_replicas=2,
+            hedge_auto=False))
+    server = None
+    events = []
+    ev.default_emitter.register(events.append)
+    try:
+        fleet.start()
+        server = make_fleet_http_server(fleet, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        yield {"fleet": fleet, "url": url, "model": model,
+               "events": events, "workdir": str(td / "work")}
+    finally:
+        ev.default_emitter.unregister(events.append)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        fleet.close()
+
+
+def _age_out_heat():
+    time.sleep(HEAT_WINDOW_S + 0.3)
+
+
+def test_live_faulted_split_and_scale_leave_fleet_unchanged(
+        elastic_env):
+    """Runs FIRST (the map is pristine): with faults armed at
+    fleet.split AND fleet.scale, a hot window changes NOTHING — map at
+    exactly the old version, two replicas, and every score still
+    bit-identical."""
+    fleet, url = elastic_env["fleet"], elastic_env["url"]
+    objs = _request_objs([1, 5] * 8, seed=21)
+    expected = _oracle_scores(elastic_env["model"], objs)
+    got = np.asarray([_post(url, [o])["scores"][0] for o in objs],
+                     np.float32)
+    np.testing.assert_array_equal(got, expected)
+    v0 = fleet.shard_map.version
+    faults.install(faults.FaultPlan(specs=(
+        faults.FaultSpec(site=faults.sites.FLEET_SPLIT, kind="raise"),
+        faults.FaultSpec(site=faults.sites.FLEET_SCALE, kind="raise"),
+    )))
+    try:
+        actions = fleet.elastic.tick()
+    finally:
+        faults.install(None)
+    assert "split" not in actions and "scale_up" not in actions
+    assert fleet.shard_map.version == v0
+    assert fleet.shard_map.shards() == [0, 1, 2, 3]
+    assert len(fleet.supervisor.replicas) == 2
+    got = np.asarray([_post(url, [o])["scores"][0] for o in objs],
+                     np.float32)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_live_hot_spot_triggers_split_then_scale_up_bit_identical(
+        elastic_env):
+    """THE deterministic hot-spot scenario: entities {1, 5} pin the
+    Zipf head to shard 1 → the controller SPLITS it live and migrates
+    a child to the idle replica; then a single-entity hot spot
+    (unsplittable) sustains pressure → SCALE-UP spawns replica 2,
+    admits it, and rebalances the hot shard onto it. Every score is
+    bit-identical to the single-process oracle before, during, and
+    after — and the load provably spreads (SLO restored: zero
+    unserved, head entities on distinct replicas)."""
+    fleet, url = elastic_env["fleet"], elastic_env["url"]
+    events = elastic_env["events"]
+    objs = _request_objs([1, 5] * 8, seed=33)
+    expected = _oracle_scores(elastic_env["model"], objs)
+
+    _age_out_heat()  # a clean window: this test owns its evidence
+    got = np.asarray([_post(url, [o])["scores"][0] for o in objs],
+                     np.float32)
+    np.testing.assert_array_equal(got, expected)
+
+    # Phase 1: the hot shard splits and a child migrates away.
+    v0 = fleet.shard_map.version
+    actions = fleet.elastic.tick()
+    assert actions["split"] == (1, 1, 5), actions
+    assert actions["migrate"] == (5, 0)
+    assert fleet.shard_map.version == v0 + 2  # split + migrate
+    assert fleet.shard_map.owner(1) == 1
+    assert fleet.shard_map.owner(5) == 0
+    # Scores stay bit-identical THROUGH the split (full host store on
+    # every replica; the map swap only changes who answers).
+    got = np.asarray([_post(url, [o])["scores"][0] for o in objs],
+                     np.float32)
+    np.testing.assert_array_equal(got, expected)
+    # The head now provably spreads over distinct replicas.
+    assert fleet.router.replica_for(objs[0]) != \
+        fleet.router.replica_for(objs[1])
+
+    # Phase 2: one hot ENTITY (unsplittable) sustains pressure → the
+    # burn/queue/heat ladder scales the fleet up.
+    _age_out_heat()
+    solo = _request_objs([1] * 16, seed=44)
+    solo_expected = _oracle_scores(elastic_env["model"], solo)
+    for o in solo:
+        _post(url, [o])
+    actions = fleet.elastic.tick()  # spawns a REAL replica (JAX boot)
+    assert actions.get("scale_up") == 2, actions
+    assert len(fleet.supervisor.replicas) == 3
+    assert fleet.shard_map.live() == [0, 1, 2]
+    # The hot shard rebalanced onto the newcomer, which serves the
+    # SAME bits (it booted the same model and replayed the chain).
+    assert fleet.shard_map.owner(1) == 2
+    got = np.asarray([_post(url, [o])["scores"][0] for o in solo],
+                     np.float32)
+    np.testing.assert_array_equal(got, solo_expected)
+
+    # Evidence trail: events, metrics, healthz, ledger all moved.
+    snap = fleet.metrics.snapshot()
+    assert snap["splits_total"] == 1
+    assert snap["scale_ups_total"] == 1
+    assert snap["migrations_total"] >= 2
+    assert snap["unserved_total"] == 0  # SLO: nothing dropped
+    assert any(isinstance(e, ev.ShardSplit) and e.shard == 1
+               for e in events)
+    assert any(isinstance(e, ev.ReplicaScaled) and e.direction == "up"
+               for e in events)
+    hz = fleet.healthz()
+    assert hz["fleet_depth"] == 3 and hz["map_version"] >= v0 + 3
+    text = fleet.metrics_text()
+    assert "photon_fleet_splits_total 1" in text
+    assert "photon_fleet_scale_ups_total 1" in text
+    assert 'photon_fleet_shard_heat{shard="1"}' in text
+    assert f"photon_fleet_map_version {fleet.shard_map.version}" in text
+
+
+def test_live_fault_mid_migrate_leaves_split_committed_not_torn(
+        elastic_env):
+    """A fault between the split and its migration leg: the split
+    commits (new version), the child keeps a VALID owner, and scores
+    stay bit-identical to the pre-split oracle — the map is at old or
+    new, never torn."""
+    fleet, url = elastic_env["fleet"], elastic_env["url"]
+    objs = _request_objs([2, 6] * 8, seed=55)
+    expected = _oracle_scores(elastic_env["model"], objs)
+    _age_out_heat()
+    for o in objs:
+        _post(url, [o])
+    owner_before = fleet.shard_map.owner(2)
+    v0 = fleet.shard_map.version
+    faults.install(faults.FaultPlan(specs=(
+        faults.FaultSpec(site=faults.sites.FLEET_MIGRATE,
+                         kind="raise"),)))
+    try:
+        actions = fleet.elastic.tick()
+    finally:
+        faults.install(None)
+    assert actions.get("split") == (2, 2, 6), actions
+    assert "migrate" not in actions
+    assert fleet.shard_map.version == v0 + 1  # exactly the split bump
+    assert fleet.shard_map.owner(2) == owner_before
+    assert fleet.shard_map.owner(6) == owner_before  # valid, inherited
+    got = np.asarray([_post(url, [o])["scores"][0] for o in objs],
+                     np.float32)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_live_elastic_ledger_rows_render_via_obs_tail(elastic_env):
+    """The decision tape is durable and renders: elastic rows carry
+    their evidence, photon-obs tail --elastic shows them, and the
+    ledger passes verify."""
+    import os
+    import subprocess
+    import sys
+
+    fleet = elastic_env["fleet"]
+    ledger_dir = os.path.join(elastic_env["workdir"], "elastic",
+                              "ledger")
+    # Flush the buffered rows before reading from another process.
+    with fleet._publish_lock:
+        assert fleet._elastic_ledger is not None
+        fleet._elastic_ledger.flush()
+    from photon_ml_tpu.obs.ledger import read_rows
+
+    rows, problems = read_rows(ledger_dir)
+    assert not problems
+    el = [r for r in rows if r.get("kind") == "elastic"]
+    acts = {r.get("action") for r in el}
+    assert {"split", "migrate", "scale_up"} <= acts
+    split_row = next(r for r in el if r.get("action") == "split")
+    assert split_row.get("heat_fraction") is not None  # evidence rides
+    assert split_row.get("map_version") is not None
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.obs", "tail",
+         ledger_dir, "--elastic"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "split" in proc.stdout and "scale_up" in proc.stdout
+    assert "decision(s)" in proc.stdout
