@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -122,6 +123,13 @@ PUBLISH_SWAP_SECONDS_MAX = 1.0
 # band to be a TRANSFER claim rather than a CPU-convert measurement.
 INT8_BYTES_RATIO_MAX = 0.30
 QUANT_TRANSFER_BOUND_FRACTION = 0.5
+# Solver race (docs/STREAMING.md "Stochastic solvers"): the two final
+# fits must rank test rows the same way — the stochastic path may trade
+# wall clock, never accuracy (the established 5e-3 AUC parity band).
+# The time ratio is hardware truth: SDCA's cheaper passes must win
+# (≤ 1.0× band-adjusted) when the stream is transfer-bound; on a
+# compute-bound CPU box the ratio is reported only, like the quant wall.
+SOLVER_RACE_AUC_DELTA_MAX = 5e-3
 GUARDED = [
     "staging_bucketing_seconds",
     "staging_projection_seconds",
@@ -373,6 +381,67 @@ def main() -> int:
                     f"stream_quant_int8_pass_seconds: {t_int8:g}s > "
                     f"{limit:.3g}s on a transfer-bound pass — the "
                     f"quantized stream is slower than the f32 one")
+
+    # --- solver-race invariants (docs/STREAMING.md "Stochastic
+    # solvers"), within the fresh tail: both solvers must have REACHED
+    # the common target (the harness raises otherwise, so a present line
+    # with non-positive seconds means the ledger provenance broke), the
+    # SDCA gap certificate must be finite and non-negative, and the two
+    # final fits must agree on AUC. The wall ratio is printed with the
+    # load/calibration validity stamp honored — reported either way,
+    # never a verdict (which solver wins is a property of the box).
+    t_lb = fresh.get("solver_time_to_target_seconds_lbfgs")
+    t_sd = fresh.get("solver_time_to_target_seconds_sdca")
+    if t_lb is not None and t_sd is not None:
+        ok = (math.isfinite(float(t_lb)) and float(t_lb) > 0
+              and math.isfinite(float(t_sd)) and float(t_sd) > 0)
+        print(f"solver race time-to-target: lbfgs {t_lb:g}s, sdca "
+              f"{t_sd:g}s {'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"solver race: non-finite/non-positive time-to-target "
+                f"(lbfgs {t_lb!r}, sdca {t_sd!r}) — the ledger curves "
+                f"no longer carry usable provenance")
+        ratio = fresh.get("solver_race_ratio")
+        reason = _invalid(fresh, "solver_race")
+        if ratio is not None:
+            frac = fresh.get("solver_race_transfer_fraction")
+            bound = (reason is None and frac is not None
+                     and float(frac) >= QUANT_TRANSFER_BOUND_FRACTION)
+            ok = float(ratio) <= band
+            verdict = ("OK" if ok else
+                       "REGRESSION" if bound else
+                       "over limit (reported only: "
+                       + (reason or f"compute-bound box, transfer "
+                                    f"fraction {frac}") + ")")
+            print(f"solver_race_ratio: sdca/lbfgs {ratio:g}x "
+                  f"(limit {band:.3g}x on a transfer-bound stream) "
+                  f"{verdict}")
+            if bound and not ok:
+                failures.append(
+                    f"solver_race_ratio: {ratio:g}x > {band:.3g}x on a "
+                    f"transfer-bound stream — SDCA stopped paying for "
+                    f"its passes")
+        g = fresh.get("solver_race_final_gap_sdca")
+        if g is not None:
+            ok = math.isfinite(float(g)) and float(g) >= 0.0
+            print(f"solver_race_final_gap_sdca: {g:g} "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"solver_race_final_gap_sdca: {g!r} — the duality-"
+                    f"gap certificate went non-finite or negative")
+        delta = fresh.get("solver_race_auc_delta")
+        if delta is not None:
+            ok = float(delta) <= SOLVER_RACE_AUC_DELTA_MAX
+            print(f"solver_race_auc_delta: {delta:g} (limit "
+                  f"{SOLVER_RACE_AUC_DELTA_MAX:g}) "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"solver_race_auc_delta: {delta:g} > "
+                    f"{SOLVER_RACE_AUC_DELTA_MAX:g} — the stochastic "
+                    f"fit no longer matches L-BFGS ranking quality")
 
     # --- quantized device-LRU invariants (docs/SERVING.md "Quantized
     # device cache"): at a fixed HBM budget the int8 cache must hold

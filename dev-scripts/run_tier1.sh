@@ -72,6 +72,15 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/ledger_smoke.py; rc=$?
 fi
 
+# Solver race smoke (docs/STREAMING.md "Stochastic solvers"): the same
+# tiny streamed fit under solver=lbfgs and solver=sdca — both converge,
+# every accepted SDCA epoch carries a finite tightening duality-gap
+# certificate, both curves reach a common target, and photon-obs diff
+# across the two runs renders the gap-vs-wall overlay. Seconds on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/solver_race_smoke.py; rc=$?
+fi
+
 # Publish smoke (docs/SERVING.md "Continuous publication"): a 2-replica
 # fleet runs one refit->delta->canary->hot-swap cycle with cold-restart
 # score parity, plus a rejected delta auto-rolled back; the publish

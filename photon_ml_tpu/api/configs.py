@@ -60,7 +60,13 @@ class StreamingConfig:
     it. ``prefetch_depth``: transfers in flight ahead of compute
     per device. ``pin_chunks``: leading chunks pinned resident PER
     DEVICE (spare HBM traded for stream traffic). ``workers``: staging
-    canonicalization threads (None = host cores).
+    canonicalization threads (None = host cores). ``solver``: the
+    streamed driver — "lbfgs" (the batch default), "sdca"
+    (duality-gap-certified dual coordinate ascent), or "sgd" (primal
+    mini-batch fallback) — docs/STREAMING.md "Stochastic solvers"; a
+    per-coordinate ``--opt-config optimizer=SDCA|SGD`` overrides it.
+    Under sdca, ``pin_chunks`` becomes the GAP-DRIVEN residency budget
+    (the pin set re-ranks by per-chunk gap contribution each epoch).
     """
 
     chunk_rows: int = 262144
@@ -69,6 +75,7 @@ class StreamingConfig:
     prefetch_depth: int = 2
     pin_chunks: int = 0
     workers: Optional[int] = None
+    solver: str = "lbfgs"
 
     def __post_init__(self):
         if self.chunk_rows < 1:
@@ -89,6 +96,10 @@ class StreamingConfig:
                 f"pin_chunks must be >= 0, got {self.pin_chunks}")
         if self.workers is not None and self.workers < 1:
             raise ValueError(f"workers must be >= 1, got {self.workers}")
+        if self.solver not in ("lbfgs", "sdca", "sgd"):
+            raise ValueError(
+                f"unsupported streaming solver {self.solver!r}; "
+                "expected lbfgs, sdca, or sgd")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,10 +324,13 @@ def parse_streaming_config(spec: str) -> StreamingConfig:
     columns per chunk), dtype (float32|bfloat16|int8 chunk storage;
     default inherits the coordinate's dtype), depth (prefetch transfers
     in flight per device), pin (leading chunks pinned per device),
-    workers (staging canonicalization threads).
+    workers (staging canonicalization threads), solver
+    (lbfgs|sdca|sgd streamed driver — docs/STREAMING.md "Stochastic
+    solvers").
     """
     kv = parse_kv(spec)
-    known = {"chunk_rows", "num_hot", "dtype", "depth", "pin", "workers"}
+    known = {"chunk_rows", "num_hot", "dtype", "depth", "pin", "workers",
+             "solver"}
     unknown = set(kv) - known
     if unknown:
         raise ValueError(f"unknown streaming keys {sorted(unknown)}; "
@@ -331,6 +345,8 @@ def parse_streaming_config(spec: str) -> StreamingConfig:
                         else defaults.prefetch_depth),
         pin_chunks=int(kv["pin"]) if "pin" in kv else defaults.pin_chunks,
         workers=int(kv["workers"]) if "workers" in kv else None,
+        solver=(kv["solver"].lower() if "solver" in kv
+                else defaults.solver),
     )
 
 

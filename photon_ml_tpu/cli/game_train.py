@@ -174,10 +174,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimization. Optional mini-DSL "
                         "'chunk_rows=262144,num_hot=512,"
                         "dtype=float32|bfloat16|int8,depth=2,pin=0,"
-                        "workers=8' (bare --streaming takes every "
-                        "default; dtype=int8 quarters the streamed "
-                        "bytes — symmetric per-column quantization with "
-                        "f32 accumulation, docs/STREAMING.md)")
+                        "workers=8,solver=lbfgs|sdca|sgd' (bare "
+                        "--streaming takes every default; dtype=int8 "
+                        "quarters the streamed bytes — symmetric "
+                        "per-column quantization with f32 accumulation; "
+                        "solver=sdca|sgd runs the duality-gap-certified "
+                        "stochastic solvers over the same chunk feed, "
+                        "docs/STREAMING.md)")
     p.add_argument("--ingest-cache-dir",
                    help="persist decoded Avro columns here (columnar "
                         "mmap ingest cache, keyed by file identity + "

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from typing import Optional
@@ -660,11 +661,17 @@ def render_publish_tail(tail: dict) -> str:
 
 
 def _overlay(curve_a: list, curve_b: list, x_key: str,
-             width: int = 56, height: int = 12) -> list[str]:
+             width: int = 56, height: int = 12,
+             y_key: str = "value") -> list[str]:
     """Two convergence curves on one downsampled text grid
-    (A = ``a``/``*`` where they overlap, B = ``b``)."""
-    pts = [(float(p[x_key]), float(p["value"]), 0) for p in curve_a] + \
-          [(float(p[x_key]), float(p["value"]), 1) for p in curve_b]
+    (A = ``a``/``*`` where they overlap, B = ``b``). ``y_key`` picks the
+    plotted series (``value`` default; ``gap`` for the duality-gap
+    certificate of the stochastic solvers) — points where the series is
+    absent/None are skipped."""
+    pts = [(float(p[x_key]), float(p[y_key]), 0) for p in curve_a
+           if p.get(y_key) is not None] + \
+          [(float(p[x_key]), float(p[y_key]), 1) for p in curve_b
+           if p.get(y_key) is not None]
     if not pts:
         return []
     xs = [p[0] for p in pts]
@@ -727,6 +734,11 @@ def render_diff(diff: dict) -> str:
         out += _overlay(entry["curve_a"], entry["curve_b"], "t")
         out.append("  value vs streamed passes:")
         out += _overlay(entry["curve_a"], entry["curve_b"], "passes")
+        if any(math.isfinite(p["gap"]) for c in ("curve_a", "curve_b")
+               for p in entry[c] if p.get("gap") is not None):
+            out.append("  duality gap vs wall clock (a=A, b=B, *=both):")
+            out += _overlay(entry["curve_a"], entry["curve_b"], "t",
+                            y_key="gap")
     fm = diff["final_metrics"]
     coords = sorted(set(fm["a"]) | set(fm["b"]))
     if coords:

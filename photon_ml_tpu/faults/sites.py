@@ -42,6 +42,15 @@ STREAM_CHECKPOINT_WRITE = "stream.checkpoint_write"
 STREAM_CHECKPOINT_LOAD = "stream.checkpoint_load"
 STREAM_CHECKPOINT_ARTIFACT = "stream.checkpoint_artifact"  # corrupt_file
 
+# -- stochastic streamed solvers (optim/stochastic.py) -----------------------
+# OPT_DUAL_UPDATE fires BEFORE each chunk's stochastic update (kill seam:
+# a SIGKILL mid-epoch must resume from the last epoch-boundary (w, α)
+# snapshot to bit-identical coefficients); OPT_GAP_CHECK poisons the
+# epoch's assembled duality gap (nan seam: the watchdog gap gate must
+# turn a sick certificate into a loud, defined error).
+OPT_DUAL_UPDATE = "opt.dual_update"
+OPT_GAP_CHECK = "opt.gap_check"  # poison_scalar (nan kind)
+
 # -- Avro ingestion (ingest/pipeline.py, ingest/cache.py) --------------------
 INGEST_DECODE_BLOCK = "ingest.decode_block"
 INGEST_CACHE_WRITE = "ingest.cache_write"

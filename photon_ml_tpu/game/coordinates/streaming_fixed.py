@@ -39,11 +39,26 @@ coordinate descent the full residual (base offsets + other coordinates'
 scores) arrives as the ``offsets`` argument of ``train_model``, and
 ``score`` must return pure wᵀx margins.
 
-Not supported at streaming scale (all raise with the reason): L1/OWL-QN
-(the orthant bookkeeping needs the compiled optimizer), normalization
-(Criteo-style sparse binary features train unnormalized; in-kernel factor
-application to the chunk stream is a straightforward extension),
-down-sampling, and SIMPLE/FULL variances.
+Solvers (docs/STREAMING.md "Stochastic solvers"): the default driver
+loop is the host-driven L-BFGS — now including L1/OWL-QN via
+pseudo-gradient direction + orthant-projected probes in the same
+streamed Armijo loop. ``solver=sdca`` / ``solver=sgd``
+(optim/stochastic.py) run behind the SAME train_model contract over the
+same chunk feed, emitting a per-epoch duality-gap certificate; a
+per-coordinate ``--opt-config optimizer=SDCA|SGD`` override wins over
+the streaming-level default, and SDCA on a loss without a cheap
+conjugate falls back to SGD (logged). Under the stochastic solvers the
+``pin_chunks`` budget becomes the gap-driven device-residency budget
+(ops/chunk_sampler.py) instead of static leading-chunk pins.
+
+Not supported at streaming scale (all raise with the reason):
+normalization (Criteo-style sparse binary features train unnormalized;
+in-kernel factor application to the chunk stream is a straightforward
+extension), down-sampling, SIMPLE/FULL variances; for the stochastic
+solvers additionally L1 (they need plain L2), meshes (the sequential
+dual update has no psum decomposition), and — SDCA only — an intercept
+excluded from regularization (w ≡ w(α) needs the all-ones L2 mask;
+use ``solver=sgd``).
 """
 
 from __future__ import annotations
@@ -62,10 +77,14 @@ from photon_ml_tpu.game.models import FixedEffectModel
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.ops import streaming_sparse as ss
 from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim.common import OptimizerType
+from photon_ml_tpu.optim.gap import CONJUGATE_LOSSES
 from photon_ml_tpu.optim.problem import (GLMOptimizationConfiguration,
                                          VarianceComputationType)
-from photon_ml_tpu.optim.regularization import (intercept_mask, with_l2,
+from photon_ml_tpu.optim.regularization import (intercept_mask,
+                                                l1_weights_vector, with_l2,
                                                 with_l2_value)
+from photon_ml_tpu.optim.stochastic import minimize_stochastic
 from photon_ml_tpu.optim.streaming import minimize_streaming
 from photon_ml_tpu.utils import events as ev_mod
 
@@ -73,15 +92,44 @@ Array = jax.Array
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
+_SOLVERS = ("lbfgs", "sdca", "sgd")
 
-def _validate_streaming_config(config: GLMOptimizationConfiguration) -> None:
+
+def _resolve_solver(solver: str, config: GLMOptimizationConfiguration,
+                    loss: PointwiseLoss, log=lambda m: None) -> str:
+    """Effective solver for a fit: a per-coordinate ``--opt-config
+    optimizer=SDCA|SGD`` override wins over the streaming-level
+    ``solver=`` default, and SDCA on a loss without a cheap conjugate
+    falls back to SGD (logged — the gap column degrades to the
+    ‖∇P‖²/2λ surrogate)."""
+    t = OptimizerType(config.optimizer.optimizer_type)
+    if t in (OptimizerType.SDCA, OptimizerType.SGD):
+        solver = t.value.lower()
+    if solver == "sdca" and loss.name not in CONJUGATE_LOSSES:
+        log(f"solver=sdca needs a conjugate-form loss "
+            f"({sorted(CONJUGATE_LOSSES)}); falling back to sgd for "
+            f"loss {loss.name!r}")
+        return "sgd"
+    return solver
+
+
+def _validate_streaming_config(config: GLMOptimizationConfiguration,
+                               solver: str = "lbfgs") -> None:
     """The streamed path's feature envelope, enforced at construction AND
     at every config swap (the estimator's grid/tuning path)."""
-    if config.regularization.l1_weight() != 0.0:
+    if solver not in _SOLVERS:
+        raise ValueError(f"streaming solver must be one of {_SOLVERS}, "
+                         f"got {solver!r}")
+    if config.regularization.l1_weight() != 0.0 and solver != "lbfgs":
         raise ValueError(
-            "L1/OWL-QN is not supported on the streaming path (the "
-            "orthant bookkeeping lives in the compiled optimizer); "
-            "use L2, or the device-resident SparseFixedEffectCoordinate")
+            "L1/OWL-QN rides the streamed L-BFGS driver only; the "
+            "stochastic solvers need plain L2 (the dual and the 1/λt "
+            "step size both assume it) — use solver=lbfgs")
+    if solver in ("sdca", "sgd") and \
+            config.regularization.l2_weight() <= 0.0:
+        raise ValueError(
+            f"solver={solver} requires l2_weight > 0 (SDCA's dual and "
+            f"SGD's 1/λt step size both need strong convexity)")
     if config.down_sampling_rate < 1.0:
         raise ValueError("down-sampling is not supported on the "
                          "streaming path")
@@ -106,6 +154,7 @@ class StreamingSparseFixedEffectCoordinate:
         intercept_index: Optional[int] = None,
         prefetch_depth: int = 2,
         pin_device_chunks: int = 0,
+        solver: str = "lbfgs",
         mesh=None,
         log=lambda m: None,
     ):
@@ -131,7 +180,26 @@ class StreamingSparseFixedEffectCoordinate:
                     "``train_model``, and ``score`` must return pure "
                     "wᵀx margins; staged offsets would be double-counted."
                 )
-        _validate_streaming_config(config)
+        self.solver = (solver or "lbfgs").lower()
+        effective = _resolve_solver(self.solver, config, loss, log)
+        _validate_streaming_config(config, effective)
+        if effective in ("sdca", "sgd") and mesh is not None:
+            # The sequential dual/primal update has no psum
+            # decomposition: the stochastic solvers are single-chip by
+            # design. Drivers that always build a mesh (the CLI) get the
+            # mesh-less path; giving up real parallelism is logged.
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            if n_dev > 1:
+                log(f"solver={effective} is single-chip (the sequential "
+                    f"dual update has no psum decomposition); ignoring "
+                    f"the {n_dev}-device mesh for this coordinate")
+            mesh = None
+        if effective == "sdca" and intercept_index is not None:
+            raise ValueError(
+                "solver=sdca regularizes every coordinate (w ≡ w(α) "
+                "needs the all-ones L2 mask, so an intercept excluded "
+                "from regularization has no dual representation) — use "
+                "solver=sgd, or include the intercept in the L2 term")
         self.dataset = dataset
         self.chunked = chunked
         self.shard_id = shard_id
@@ -154,7 +222,13 @@ class StreamingSparseFixedEffectCoordinate:
             self._stream = None
             # Spare-HBM chunk pinning: the caller sizes this against
             # whatever else the fit keeps resident (e.g. RE buckets).
-            self._pinned = ss.pin_chunks(chunked, pin_device_chunks)
+            # Under the stochastic solvers the same budget funds the
+            # gap-driven sampler's residency set instead (the solver
+            # re-pins by gap contribution each epoch), so nothing is
+            # statically pinned here.
+            self._pinned = ss.pin_chunks(
+                chunked,
+                0 if effective in ("sdca", "sgd") else pin_device_chunks)
             self._vg = ss.make_value_and_gradient(
                 loss, chunked, prefetch_depth=prefetch_depth,
                 pinned=self._pinned)
@@ -165,6 +239,7 @@ class StreamingSparseFixedEffectCoordinate:
                 loss, chunked, prefetch_depth=prefetch_depth,
                 pinned=self._pinned)
         self._prefetch_depth = prefetch_depth
+        self._pin_budget = pin_device_chunks
         self._padded_n = chunked.num_chunks * chunked.chunk_rows
         # Mid-optimization checkpoint binding (game/descent.py wires the
         # CheckpointManager's per-step stream dir through here).
@@ -224,7 +299,8 @@ class StreamingSparseFixedEffectCoordinate:
             dataset, chunked, shard_id, loss, config,
             intercept_index=dataset.intercept_index.get(shard_id),
             prefetch_depth=streaming.prefetch_depth,
-            pin_device_chunks=streaming.pin_chunks, mesh=mesh, log=log)
+            pin_device_chunks=streaming.pin_chunks,
+            solver=streaming.solver, mesh=mesh, log=log)
 
     def with_optimization_config(
         self, config: GLMOptimizationConfiguration
@@ -233,7 +309,23 @@ class StreamingSparseFixedEffectCoordinate:
         estimator's grid/tuning swap — staging is the expensive part)."""
         import copy
 
-        _validate_streaming_config(config)
+        effective = _resolve_solver(self.solver, config, self.loss,
+                                    self._log)
+        _validate_streaming_config(config, effective)
+        if effective == "sdca" and self.intercept_index is not None:
+            raise ValueError(
+                "solver=sdca regularizes every coordinate — use "
+                "solver=sgd, or include the intercept in the L2 term")
+        if effective in ("sdca", "sgd") and self.mesh is not None:
+            # Swapping a mesh-sharded L-BFGS coordinate onto a
+            # single-chip solver: rebuild on the mesh-less stream (the
+            # constructor logs the demotion).
+            return type(self)(
+                self.dataset, self.chunked, self.shard_id, self.loss,
+                config, intercept_index=self.intercept_index,
+                prefetch_depth=self._prefetch_depth,
+                pin_device_chunks=self._pin_budget,
+                solver=self.solver, mesh=self.mesh, log=self._log)
         c = copy.copy(self)
         c.config = config
         c._ckpt_store = None
@@ -263,12 +355,16 @@ class StreamingSparseFixedEffectCoordinate:
         self._ckpt_store = None
         self._ckpt_step = None
 
-    def _stream_fingerprint(self, offsets: Array, w0: Array) -> dict:
+    def _stream_fingerprint(self, offsets: Array, w0: Array,
+                            solver: str) -> dict:
         """What a mid-step snapshot must agree on to be resumable: the
-        step identity, the optimizer config, and digests of the residual
-        offsets and warm start (the objective the snapshot was taken
-        under — resuming against a different residual would silently
-        continue the wrong optimization)."""
+        step identity, the optimizer config, the EFFECTIVE solver (an
+        L-BFGS curvature ring and an SDCA dual vector are not each
+        other's state — a solver swap must discard, not reinterpret),
+        and digests of the residual offsets and warm start (the
+        objective the snapshot was taken under — resuming against a
+        different residual would silently continue the wrong
+        optimization)."""
         from photon_ml_tpu.game.descent import _jsonable
 
         h = hashlib.sha1()
@@ -279,6 +375,7 @@ class StreamingSparseFixedEffectCoordinate:
             "shard": self.shard_id,
             "config": _jsonable(self.config),
             "dim": self.dim,
+            "solver": solver,
             "objective_digest": h.hexdigest(),
         }
 
@@ -295,17 +392,20 @@ class StreamingSparseFixedEffectCoordinate:
         offsets: Array,
         initial: Optional[FixedEffectModel] = None,
     ) -> FixedEffectModel:
+        solver = _resolve_solver(self.solver, self.config, self.loss,
+                                 self._log)
         w0 = (initial.coefficients.means if initial is not None
               else jnp.zeros((self.dim,), jnp.float32))
         off = self._pad_offsets(offsets)
         mask = jnp.asarray(intercept_mask(self.dim, self.intercept_index))
         l2 = self.config.regularization.l2_weight()
+        l1 = self.config.regularization.l1_weight()
         vg = with_l2(lambda w: self._vg(w, off), l2, mask)
         v = with_l2_value(lambda w: self._v(w, off), l2, mask)
         checkpoint_save = None
         resume_state = None
         if self._ckpt_store is not None:
-            fp = self._stream_fingerprint(off, w0)
+            fp = self._stream_fingerprint(off, w0, solver)
             # The device environment rides BESIDE the fingerprint, never
             # inside it: a snapshot written at D devices must resume at
             # D′ ≠ D (the preemptible/resize contract — chunk ranges
@@ -323,10 +423,30 @@ class StreamingSparseFixedEffectCoordinate:
             def checkpoint_save(state, _store=store, _fp=fp, _env=env):
                 _store.save(state, fingerprint=_fp, environment=_env)
 
-        result = minimize_streaming(vg, w0, self.config.optimizer,
-                                    log=self._log, value_only=v,
-                                    checkpoint_save=checkpoint_save,
-                                    resume_state=resume_state)
+        if solver in ("sdca", "sgd"):
+            result = minimize_stochastic(
+                vg, w0, self.config.optimizer,
+                chunked=self.chunked, loss=self.loss, l2_weight=l2,
+                solver=solver, offsets=off,
+                reg_mask=(None if solver == "sdca" else mask),
+                log=self._log, value_only=v,
+                checkpoint_save=checkpoint_save,
+                resume_state=resume_state,
+                prefetch_depth=self._prefetch_depth,
+                # The pin budget funds the gap-driven sampler; when
+                # static pins exist (a coordinate built for L-BFGS then
+                # config-swapped onto a stochastic solver) the budget
+                # stays with them — double-pinning would double the
+                # HBM bill.
+                pin_budget=(0 if self._pinned else self._pin_budget))
+        else:
+            l1w = (l1_weights_vector(l1, self.dim, self.intercept_index)
+                   if l1 else None)
+            result = minimize_streaming(vg, w0, self.config.optimizer,
+                                        log=self._log, value_only=v,
+                                        checkpoint_save=checkpoint_save,
+                                        resume_state=resume_state,
+                                        l1_weights=l1w)
         return FixedEffectModel(shard_id=self.shard_id,
                                 coefficients=Coefficients(result.w))
 

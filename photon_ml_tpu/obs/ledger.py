@@ -516,9 +516,11 @@ def verify_ledger(directory: str) -> list[str]:
 
 def convergence_curves(rows: list[dict]) -> dict:
     """Per-coordinate convergence curves from ``opt_iter`` rows:
-    coordinate → list of {t, iteration, value, grad_norm, passes}
-    with ``passes`` the running streamed-pass total (value + gradient
-    passes; compiled spills count one pass per iteration)."""
+    coordinate → list of {t, iteration, value, grad_norm, gap, passes}
+    with ``passes`` the running streamed-pass total (value + gradient +
+    dual passes; compiled spills count one pass per iteration) and
+    ``gap`` the duality-gap certificate of the stochastic solvers
+    (None on L-BFGS/TRON rows, which never emit one)."""
     curves: dict = {}
     passes_cum: dict = {}
     for row in rows:
@@ -526,7 +528,8 @@ def convergence_curves(rows: list[dict]) -> dict:
             continue
         coord = row.get("coordinate") or "(run)"
         inc = float(row.get("value_passes") or 0) + \
-            float(row.get("grad_passes") or 0)
+            float(row.get("grad_passes") or 0) + \
+            float(row.get("dual_passes") or 0)
         p = passes_cum.get(coord, 0.0) + (inc if inc > 0 else 1.0)
         passes_cum[coord] = p
         curves.setdefault(coord, []).append({
@@ -535,6 +538,8 @@ def convergence_curves(rows: list[dict]) -> dict:
             "value": float(row["value"]),
             "grad_norm": (None if row.get("grad_norm") is None
                           else float(row["grad_norm"])),
+            "gap": (None if row.get("gap") is None
+                    else float(row["gap"])),
             "passes": p,
         })
     return curves

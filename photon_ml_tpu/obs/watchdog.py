@@ -22,6 +22,13 @@ Detectors (each independently armed by its config field):
   ``iter_seconds_factor ×`` the EMA of previous iterations (needs ≥ 3
   observations before it can fire — compile-heavy first iterations are
   expected).
+* ``gap``        — the duality-gap convergence gate of the stochastic
+  streamed solvers (optim/stochastic.py): ``gap <= gap_tolerance``
+  fires with ``gap_action`` (default ``stop`` — convergence certified,
+  stop paying for epochs); a NON-FINITE gap is the NaN failure shape
+  and fires the ``nan`` detector (default raise). Fed via
+  :meth:`ConvergenceWatchdog.observe_gap`; batch L-BFGS never calls it,
+  so arming ``gap=`` is a no-op there.
 
 Every alert emits a ``WatchdogAlert`` event (→ a timeline instant + the
 ``photon_watchdog_alerts_total{kind=...}`` counter via the obs bridge)
@@ -70,12 +77,15 @@ class WatchdogConfig:
     divergence_action: str = "raise"
     iter_seconds_factor: float = 0.0  # 0 = off
     iter_action: str = "warn"
+    gap_tolerance: float = 0.0      # 0 = off (absolute duality gap)
+    gap_action: str = "stop"
 
     def __post_init__(self):
         for field, value in (("nan", self.nan),
                              ("stall_action", self.stall_action),
                              ("divergence_action", self.divergence_action),
-                             ("iter_action", self.iter_action)):
+                             ("iter_action", self.iter_action),
+                             ("gap_action", self.gap_action)):
             if value not in _ACTIONS:
                 raise ValueError(f"watchdog {field} must be one of "
                                  f"{_ACTIONS}, got {value!r}")
@@ -83,12 +93,15 @@ class WatchdogConfig:
             raise ValueError("stall_iterations must be >= 0")
         if self.divergence_factor < 0 or self.iter_seconds_factor < 0:
             raise ValueError("watchdog factors must be >= 0")
+        if self.gap_tolerance < 0:
+            raise ValueError("gap_tolerance must be >= 0")
 
 
 def parse_watchdog_config(spec: str) -> WatchdogConfig:
     """``key=value,...`` mini-DSL (``game_train --watchdog``): ``nan=``
     raise|warn|stop|off; ``stall=K[:action]`` (iterations); ``stall_rtol=``;
-    ``divergence=F[:action]``; ``slow_iter=F[:action]``. A bare
+    ``divergence=F[:action]``; ``slow_iter=F[:action]``; ``gap=TOL[:action]``
+    (absolute duality-gap convergence gate, stochastic solvers only). A bare
     ``--watchdog`` takes every default (NaN → raise)."""
     kv: dict[str, str] = {}
     for part in (p for p in spec.split(",") if p.strip()):
@@ -96,7 +109,7 @@ def parse_watchdog_config(spec: str) -> WatchdogConfig:
         if not sep:
             raise ValueError(f"watchdog spec needs key=value, got {part!r}")
         kv[k.strip()] = v.strip()
-    known = {"nan", "stall", "stall_rtol", "divergence", "slow_iter"}
+    known = {"nan", "stall", "stall_rtol", "divergence", "slow_iter", "gap"}
     unknown = set(kv) - known
     if unknown:
         raise ValueError(f"unknown watchdog keys {sorted(unknown)}; "
@@ -122,6 +135,10 @@ def parse_watchdog_config(spec: str) -> WatchdogConfig:
         f, action = _split(kv["slow_iter"], d.iter_action)
         out["iter_seconds_factor"] = float(f)
         out["iter_action"] = action
+    if "gap" in kv:
+        f, action = _split(kv["gap"], d.gap_action)
+        out["gap_tolerance"] = float(f)
+        out["gap_action"] = action
     return WatchdogConfig(**out)
 
 
@@ -240,4 +257,26 @@ class ConvergenceWatchdog:
             self._ema = (seconds if self._ema is None
                          else 0.7 * self._ema + 0.3 * seconds)
             self._ema_n += 1
+        return None
+
+    def observe_gap(self, iteration: int, gap: float) -> Optional[str]:
+        """Feed the epoch's duality gap (stochastic solvers,
+        optim/stochastic.py). A NON-FINITE gap is the NaN failure shape
+        (a poisoned certificate must not silently certify convergence);
+        ``gap <= gap_tolerance`` fires the ``gap`` detector — the
+        default ``stop`` is the gap-gated convergence stop."""
+        cfg = self.config
+        if cfg.nan != "off" and not math.isfinite(gap):
+            return self._alert(
+                "nan", cfg.nan,
+                f"non-finite duality gap at iteration {iteration} "
+                f"(gap={gap!r})",
+                iteration=iteration, gap=gap)
+        if cfg.gap_tolerance > 0 and gap <= cfg.gap_tolerance:
+            return self._alert(
+                "gap", cfg.gap_action,
+                f"duality gap {gap:.6g} <= tolerance "
+                f"{cfg.gap_tolerance:g} at iteration {iteration} — "
+                f"convergence certified",
+                iteration=iteration, gap=gap)
         return None

@@ -1,0 +1,147 @@
+"""Gap-driven chunk residency: keep the chunks that still matter on HBM.
+
+The DuHL observation ("Large-Scale Stochastic Learning using GPUs",
+PAPERS.md): on a transfer-bound stream, the per-chunk DUALITY-GAP
+contribution (optim/gap.py — each row's Fenchel–Young term, summed over
+the chunk) says exactly how much convergence progress is still available
+in that chunk's rows. Chunks near dual-optimal contribute ~0 and can be
+streamed (or skipped) cheaply; high-gap chunks are re-visited every
+epoch and should sit in the PR 13 pinned device cache so their transfer
+cost amortizes to zero.
+
+:class:`GapChunkSampler` generalizes ``streaming_sparse.pin_chunks``
+(leading-``count`` pinning) to an ARBITRARY pinned set re-chosen per
+epoch: it starts with the leading chunks resident (byte-identical
+behavior to ``pin_chunks`` before the first score update), and after
+each epoch :meth:`update` re-pins the top-``capacity`` chunks by gap
+contribution, evicting the rest. Residency never changes chunk ORDER —
+:meth:`stream` always yields global chunk order, resident chunks in
+place — so the solver's result is bit-identical for every pin set; only
+the transfer bytes move (``photon_transfer_bytes_total`` drops by the
+pinned fraction, ``photon_stream_pin_swaps_total`` counts re-pins).
+
+Scores are STALE by one epoch by construction (the gap partials that
+rank epoch t's residency were measured during epoch t): DuHL shows the
+stale signal is enough — gap contributions shrink monotonically in
+expectation, so last epoch's hot set is a good predictor of this
+epoch's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.ops.streaming_sparse import (ChunkedHybrid, _delete_chunk,
+                                                _transfer)
+
+
+def _drop_pinned(ch) -> None:
+    """Release an evicted PINNED chunk's device buffers. Distinct from
+    ``_delete_chunk``: pinned chunks never passed through the accounted
+    transfer path, so they must not step the in-flight stream gauge."""
+    for leaf in jax.tree.leaves(ch):
+        if isinstance(leaf, jax.Array):
+            leaf.delete()
+
+
+class GapChunkSampler:
+    """Per-epoch gap-ranked chunk residency over one ``ChunkedHybrid``.
+
+    ``capacity`` is the pinned-chunk budget (0 = pure streaming — the
+    sampler degenerates to the plain prefetch loop); ``device`` pins to
+    a specific device (None = the default device, the single-device
+    stochastic path)."""
+
+    def __init__(self, chunked: ChunkedHybrid, capacity: int,
+                 device: Optional[jax.Device] = None):
+        self.chunked = chunked
+        self.capacity = min(max(0, int(capacity)), chunked.num_chunks)
+        self.device = device
+        # Leading-chunk start: identical residency to pin_chunks(count)
+        # until the first gap scores arrive.
+        self._resident: dict = {
+            i: jax.device_put(chunked.chunks[i], device)
+            for i in range(self.capacity)}
+
+    @property
+    def resident_indices(self) -> list:
+        return sorted(self._resident)
+
+    def update(self, gap_by_chunk) -> None:
+        """Re-pin the top-``capacity`` chunks by gap contribution.
+
+        Ties keep the CURRENT residents (stickiness — a swap that buys
+        no gap is pure transfer cost), then break by chunk index so the
+        pin set is a deterministic function of (scores, previous set)."""
+        if self.capacity == 0:
+            return
+        scores = np.asarray(gap_by_chunk, np.float64)
+        if scores.shape[0] != self.chunked.num_chunks:
+            raise ValueError(
+                f"gap_by_chunk has {scores.shape[0]} entries, stream "
+                f"has {self.chunked.num_chunks} chunks")
+        order = sorted(
+            range(self.chunked.num_chunks),
+            key=lambda i: (-scores[i], 0 if i in self._resident else 1, i))
+        want = set(order[:self.capacity])
+        evict = [i for i in self._resident if i not in want]
+        add = [i for i in want if i not in self._resident]
+        for i in evict:
+            _drop_pinned(self._resident.pop(i))
+        for i in add:
+            self._resident[i] = jax.device_put(self.chunked.chunks[i],
+                                               self.device)
+        if add:
+            mx = obs.metrics()
+            if mx is not None:
+                mx.counter("photon_stream_pin_swaps_total").inc(len(add))
+
+    def stream(self, depth: int):
+        """Yield ``(global_index, device_chunk, streamed)`` in global
+        chunk order — resident chunks in place (no transfer), the rest
+        through the accounted transfer path with ``depth`` copies in
+        flight ahead of the consumer (the ``_stream`` discipline).
+        Streamed chunks are the CALLER's to release (``_delete_chunk``
+        after its per-chunk barrier); resident chunks are this
+        sampler's."""
+        import collections
+
+        if depth < 1:
+            raise ValueError(f"prefetch_depth must be >= 1, got {depth}")
+        nonres = iter([i for i in range(self.chunked.num_chunks)
+                       if i not in self._resident])
+        q: collections.deque = collections.deque()
+        for _ in range(depth):
+            i = next(nonres, None)
+            if i is None:
+                break
+            q.append((i, _transfer(self.chunked.chunks[i], i,
+                                   self.device)))
+        for i in range(self.chunked.num_chunks):
+            ch = self._resident.get(i)
+            if ch is not None:
+                yield i, ch, False
+                continue
+            j, ready = q.popleft()
+            assert j == i, f"sampler stream order broke: {j} != {i}"
+            nxt = next(nonres, None)
+            if nxt is not None:
+                q.append((nxt, _transfer(self.chunked.chunks[nxt], nxt,
+                                         self.device)))
+            yield i, ready, True
+
+    def release(self) -> None:
+        """Drop every pinned chunk (end of the optimization — the
+        coordinate's staged host chunks stay, only device residency
+        goes)."""
+        for i in list(self._resident):
+            _drop_pinned(self._resident.pop(i))
+
+
+# Make _delete_chunk importable alongside the sampler for callers that
+# drive stream()/release() as a pair (optim/stochastic.py).
+__all__ = ["GapChunkSampler", "_delete_chunk"]

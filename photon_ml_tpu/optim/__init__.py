@@ -55,6 +55,13 @@ def optimize(
         if l1_weights is not None:
             raise ValueError("TRON does not support L1 (reference parity)")
         return minimize_tron(value_and_grad, hvp, w0, config)
+    if t in (OptimizerType.SDCA, OptimizerType.SGD):
+        raise ValueError(
+            f"{t.value} is a streamed-path stochastic solver (it needs "
+            f"the chunk feed for its per-row/per-chunk updates) — use "
+            f"the streaming coordinate (GameEstimator(streaming=...) / "
+            f"game_train --streaming solver={t.value.lower()}), not "
+            f"optimize()")
     raise ValueError(t)  # pragma: no cover
 
 

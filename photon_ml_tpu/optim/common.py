@@ -41,6 +41,13 @@ class OptimizerType(enum.Enum):
     LBFGS = "LBFGS"
     OWLQN = "OWLQN"
     TRON = "TRON"
+    # Stochastic solvers — streamed path only (optim/stochastic.py):
+    # duality-gap-certified dual coordinate ascent and its primal
+    # mini-batch fallback. ``optimize()`` rejects them (there is no
+    # compiled device-resident variant); the streamed coordinate
+    # dispatches them behind the minimize_streaming contract.
+    SDCA = "SDCA"
+    SGD = "SGD"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,189 @@
+"""Duality-gap machinery for the stochastic streamed solvers.
+
+The duality gap is the convergence certificate of the stochastic path
+(optim/stochastic.py): for the L2-regularized GLM
+
+    P(w) = Σᵢ ωᵢ·φ(zᵢ) + (λ/2)‖w‖²,    zᵢ = xᵢᵀw + oᵢ
+
+(the SUM objective the streamed kernels accumulate — photon's weighted
+per-row losses, ``oᵢ`` the coordinate-descent residual offsets), SDCA
+maintains a dual vector α with w ≡ w(α) = (1/λ)Σᵢ αᵢxᵢ, and
+
+    gap(w, α) = P(w) − D(α)
+              = Σᵢ [ωᵢ·φ(zᵢ) + φ*ᵢ(−αᵢ) + αᵢ·zᵢ]          (Fenchel–Young)
+
+where φ*ᵢ is the convex conjugate of the WEIGHTED per-row loss
+(φᵢ = ωᵢ·φ ⇒ φ*ᵢ(u) = ωᵢ·φ*(u/ωᵢ); ωᵢ = 0 pad rows contribute exactly
+0). Every bracketed term is ≥ 0, so per-row sums double as the DuHL
+importance signal (``ops/chunk_sampler.py``): a chunk's summed gap
+contribution says how much dual progress is still available in it.
+
+Because Σᵢ αᵢzᵢ = λ‖w‖² + Σᵢ αᵢoᵢ when w = w(α), the EXACT epoch gap
+assembles from quantities the streamed passes already produce:
+
+    gap = v(w) + conj_sum + alpha_off_sum + (λ/2)‖w‖²
+
+with ``v`` the L2-wrapped value pass (P(w) itself), ``conj_sum`` =
+Σ φ*ᵢ(−αᵢ) and ``alpha_off_sum`` = Σ αᵢoᵢ accumulated during the dual
+pass — each αᵢ is touched only in its own chunk, so per-chunk partials
+sum to the global terms exactly, in any grouping. ``gap ≥ P(w) − P(w*)``
+upper-bounds suboptimality at every iterate (tests/test_stochastic.py
+pins this against closed-form optima).
+
+The primal-only SGD fallback has no α; for a λ-strongly-convex P the
+surrogate ‖∇P(w)‖²/(2λ) ≥ P(w) − P(w*) is the same kind of certificate
+(:func:`sgd_gap_surrogate`).
+
+Losses with a cheap scalar conjugate: ``logistic`` (labels {0, 1}) and
+``squared``. ``poisson``/``smoothed_hinge`` route to SGD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+# Losses whose per-row conjugate has a closed form cheap enough to
+# evaluate once per row inside the sequential dual update loop. The
+# stochastic driver falls back to SGD (with the gap surrogate) for
+# anything else.
+CONJUGATE_LOSSES = frozenset({"logistic", "squared"})
+
+# Newton safeguard for the logistic dual update: σ(z) clipped into the
+# open unit interval so logit/1/(s(1-s)) stay finite.
+_SIGMOID_EPS = 1e-6
+_NEWTON_ITERS = 8
+
+
+def _xlogx(x: Array) -> Array:
+    """x·log(x) continued by 0 at x = 0 (the entropy endpoint)."""
+    safe = jnp.maximum(x, 1e-30)
+    return jnp.where(x > 0.0, x * jnp.log(safe), 0.0)
+
+
+def conjugate_term(loss_name: str):
+    """(alpha, label, weight) → φ*ᵢ(−alpha), the weighted per-row
+    conjugate term of the gap identity. Weight-0 (pad) rows return
+    exactly 0."""
+    if loss_name == "logistic":
+
+        def conj(alpha: Array, label: Array, weight: Array) -> Array:
+            w_safe = jnp.maximum(weight, 1e-30)
+            # φ*(−a) for φ(z) = softplus(z) − y·z is the binary entropy
+            # of s = y − a (negated): s·log s + (1−s)·log(1−s); weighted
+            # form substitutes s = y − a/ω and multiplies by ω.
+            s = jnp.clip(label - alpha / w_safe, 0.0, 1.0)
+            return jnp.where(weight > 0.0,
+                             weight * (_xlogx(s) + _xlogx(1.0 - s)), 0.0)
+
+        return conj
+    if loss_name == "squared":
+
+        def conj(alpha: Array, label: Array, weight: Array) -> Array:
+            w_safe = jnp.maximum(weight, 1e-30)
+            # φ*(−a) for φ(z) = ½(z − y)²: a²/(2ω) − a·y.
+            return jnp.where(weight > 0.0,
+                             alpha * alpha / (2.0 * w_safe)
+                             - alpha * label, 0.0)
+
+        return conj
+    raise ValueError(
+        f"loss {loss_name!r} has no cheap conjugate (supported: "
+        f"{sorted(CONJUGATE_LOSSES)}); use the SGD fallback")
+
+
+def sdca_delta(loss_name: str):
+    """(z, label, weight, alpha, xsq, lam) → Δα, the exact (squared) or
+    Newton-solved (logistic) single-coordinate dual ascent step.
+
+    ``z`` is the CURRENT margin xᵢᵀw + oᵢ, ``xsq`` = ‖xᵢ‖²; the caller
+    applies w ← w + (Δα/λ)·xᵢ so the w ≡ w(α) invariant — which the gap
+    identity rests on — holds after every row. Weight-0 rows get Δ = 0.
+    """
+    if loss_name == "squared":
+
+        def delta(z, label, weight, alpha, xsq, lam):
+            # Closed form: the new α satisfies α' = ω(y − z′) with
+            # z′ = z + Δ·xsq/λ ⇒ Δ = (ω(y − z) − α)/(1 + ω·xsq/λ).
+            d = (weight * (label - z) - alpha) / \
+                (1.0 + weight * xsq / lam)
+            return jnp.where(weight > 0.0, d, 0.0)
+
+        return delta
+    if loss_name == "logistic":
+
+        def delta(z, label, weight, alpha, xsq, lam):
+            # Optimal α' = ω(y − s) where s = σ(z′) at the post-update
+            # margin z′ = z + Δ·xsq/λ. Stationarity in s:
+            #   F(s) = logit(s) − z − (ωy − α)·q + s·ω·q = 0, q = xsq/λ
+            # F is strictly increasing ⇒ unique root; safeguarded Newton
+            # from s₀ = σ(z) converges in a handful of steps.
+            q = xsq / lam
+            c = (weight * label - alpha) * q
+            s0 = jnp.clip(jax.nn.sigmoid(z), _SIGMOID_EPS,
+                          1.0 - _SIGMOID_EPS)
+
+            def newton(_, s):
+                F = jnp.log(s) - jnp.log1p(-s) - z - c + s * weight * q
+                Fp = 1.0 / (s * (1.0 - s)) + weight * q
+                return jnp.clip(s - F / Fp, _SIGMOID_EPS,
+                                1.0 - _SIGMOID_EPS)
+
+            s = jax.lax.fori_loop(0, _NEWTON_ITERS, newton, s0)
+            d = weight * (label - s) - alpha
+            return jnp.where(weight > 0.0, d, 0.0)
+
+        return delta
+    raise ValueError(
+        f"loss {loss_name!r} has no SDCA update (supported: "
+        f"{sorted(CONJUGATE_LOSSES)}); use the SGD fallback")
+
+
+def assemble_gap(value: float, conj_sum: float, alpha_off_sum: float,
+                 l2_weight: float, w_sq: float) -> float:
+    """The exact epoch gap from its streamed pieces (module docstring):
+    ``value`` is the L2-WRAPPED objective P(w) (what the value pass
+    returns under ``with_l2_value``), so only ONE extra (λ/2)‖w‖² is
+    added here — P carries the other."""
+    return float(value) + float(conj_sum) + float(alpha_off_sum) + \
+        0.5 * float(l2_weight) * float(w_sq)
+
+
+def reduce_gap_partials(partials, num_devices: int) -> float:
+    """Reduce per-chunk gap partials the way the sharded stream would:
+    group chunks into the contiguous per-device ranges of
+    ``shard_chunk_ranges``, subtotal per device in chunk order, then sum
+    the device subtotals in device order.
+
+    This fixes the accumulation ORDER as a pure function of
+    ``(num_chunks, num_devices)`` — at ``num_devices=1`` the grouping is
+    the identity, so the reduction is BIT-identical to a plain
+    left-to-right sum over chunks (the D=1 parity contract,
+    tests/test_stochastic.py)."""
+    from photon_ml_tpu.ops.streaming_sparse import shard_chunk_ranges
+
+    parts = np.asarray(partials, np.float32)
+    subtotals = []
+    for lo, hi in shard_chunk_ranges(parts.shape[0], num_devices):
+        sub = np.float32(0.0)
+        for i in range(lo, hi):
+            sub = np.float32(sub + parts[i])
+        subtotals.append(sub)
+    total = np.float32(0.0)
+    for sub in subtotals:
+        total = np.float32(total + sub)
+    return float(total)
+
+
+def sgd_gap_surrogate(grad_norm: float, l2_weight: float) -> float:
+    """‖∇P(w)‖²/(2λ): a valid suboptimality upper bound for the
+    λ-strongly-convex P — the primal-only stand-in for the duality gap
+    on the SGD path (finite whenever the gradient is)."""
+    if l2_weight <= 0.0:
+        raise ValueError(
+            "the SGD gap surrogate needs l2_weight > 0 (strong "
+            f"convexity), got {l2_weight}")
+    return float(grad_norm) * float(grad_norm) / (2.0 * float(l2_weight))

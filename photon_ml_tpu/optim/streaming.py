@@ -20,6 +20,17 @@ budgets for ~10. Curvature pairs that fail s·y > 0 are skipped (standard
 damping), preserving a positive-definite inverse-Hessian model; parity
 with the compiled strong-Wolfe L-BFGS is pinned by test on shared small
 problems (tests/test_streaming.py).
+
+L1/OWL-QN (``l1_weights``): the same driver loop runs Andrew & Gao's
+orthant-wise scheme, mirroring the compiled ``minimize_owlqn``
+(optim/lbfgs.py) — the PSEUDO-gradient drives the two-loop direction and
+the convergence norm, every probe is projected onto the orthant of the
+current point (sign(w), or sign(−pg) at zeros), Armijo tests the TOTAL
+objective with the projected displacement ``pg·(cand − w)``, and
+curvature pairs come from the RAW smooth gradients. The streamed
+``value_and_grad``/``value_only`` stay the smooth part only; the L1 term
+is added host-side at the probe barrier (the value is already synced
+there) and is never differentiated.
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ from photon_ml_tpu import obs
 from photon_ml_tpu.obs.ledger import transfer_totals
 from photon_ml_tpu.obs.watchdog import ConvergenceWatchdog
 from photon_ml_tpu.optim.common import OptResult, OptimizerConfig
+from photon_ml_tpu.optim.lbfgs import _project_orthant, _pseudo_gradient
 
 Array = jax.Array
 
@@ -112,6 +124,7 @@ def minimize_streaming(
     value_only: Optional[Callable[[Array], Array]] = None,
     checkpoint_save: Optional[Callable[[dict], None]] = None,
     resume_state: Optional[dict] = None,
+    l1_weights: Optional[Array] = None,
 ) -> OptResult:
     """Driver-loop L-BFGS: minimize a host-driven (value, grad) callable.
 
@@ -139,6 +152,10 @@ def minimize_streaming(
     StreamingStateStore persists the snapshots). A resumed call skips
     the initial value/gradient pass entirely: the snapshot carries it.
 
+    ``l1_weights``, when given, switches the loop to OWL-QN (module
+    docstring) — ``value_and_grad``/``value_only`` must stay the SMOOTH
+    part only; the L1 term is never differentiated.
+
     Telemetry (docs/OBSERVABILITY.md "The run ledger"): when a run
     ledger is active (``obs.ledger()``), every accepted iteration
     records an ``opt_iter`` row LIVE — value, gradient norm, step,
@@ -155,6 +172,19 @@ def minimize_streaming(
     led = obs.ledger()
     wd_cfg = obs.watchdog_config()
     wd = (ConvergenceWatchdog(wd_cfg) if wd_cfg is not None else None)
+    l1 = (None if l1_weights is None
+          else jnp.asarray(l1_weights, jnp.float32))
+    opt_name = "lbfgs-stream" if l1 is None else "owlqn-stream"
+
+    def _sgrad(w, g):
+        """Gradient driving direction + convergence (pg under L1)."""
+        return g if l1 is None else _pseudo_gradient(w, g, l1)
+
+    def _l1_term(w) -> float:
+        if l1 is None:
+            return 0.0
+        return float(jnp.sum(l1 * jnp.abs(w)))
+
     v_passes = g_passes = 0  # streamed passes, cumulative this call
     if resume_state is not None:
         st = resume_state
@@ -178,6 +208,7 @@ def minimize_streaming(
         gns = np.full((max_it + 1,), np.nan, np.float32)
         k = min(st["vals"].shape[0], max_it + 1)
         vals[:k], gns[:k] = st["vals"][:k], st["gns"][:k]
+        sg = _sgrad(w, g)  # snapshot carries the RAW gradient
         log(f"resuming streamed L-BFGS at iteration {start_it} "
             f"(f={fv:.6g})")
     else:
@@ -185,7 +216,9 @@ def minimize_streaming(
         with obs.span("lbfgs.initial_pass", cat="optim"):
             f, g = value_and_grad(w)
         g_passes += 1
-        f0, gn0 = float(f), float(jnp.linalg.norm(g))
+        sg = _sgrad(w, g)
+        f0 = float(f) + _l1_term(w)
+        gn0 = float(jnp.linalg.norm(sg))
         s_stack = jnp.zeros((M, d), jnp.float32)
         y_stack = jnp.zeros((M, d), jnp.float32)
         rho = jnp.zeros((M,), jnp.float32)
@@ -205,20 +238,26 @@ def minimize_streaming(
         # streamed passes, probes, and the checkpoint write all nest
         # under it, so the trace waterfall reads as the optimizer ran.
         with obs.span("lbfgs.iteration", cat="optim", it=it):
-            direction = _two_loop(g, s_stack, y_stack, rho, m)
+            direction = _two_loop(sg, s_stack, y_stack, rho, m)
             # pml: allow[PML001] direction-validity guard is a host branch by design; one scalar read per iteration vs a full data pass
-            dg = float(jnp.dot(direction, g))
+            dg = float(jnp.dot(direction, sg))
             if not np.isfinite(dg) or dg >= 0.0:
                 # pml: allow[PML001] steepest-descent fallback needs the host scalar for the same Armijo branch; rare path
-                direction, dg = -g, -float(jnp.dot(g, g))
+                direction, dg = -sg, -float(jnp.dot(sg, sg))
             # First iteration: steepest descent scaled to unit step
             # length (Breeze's determineStepSize init); later γ-scaling
             # makes 1.0 the natural trial step.
             step = 1.0 if m_host > 0 else min(1.0,
                                               1.0 / max(gn_prev, 1e-12))
+            # OWL-QN probes live in the orthant of the CURRENT point
+            # (sign(w); sign(−pg) at zeros) — fixed across backtracks.
+            orthant = (None if l1 is None else
+                       jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-sg)))
             accepted = False
             for probe in range(config.max_line_search_steps):
                 w_try = w + step * direction
+                if orthant is not None:
+                    w_try = _project_orthant(w_try, orthant)
                 with obs.span("lbfgs.probe", cat="optim", it=it,
                               probe=probe, step=step):
                     if value_only is None:
@@ -230,12 +269,20 @@ def minimize_streaming(
                         v_passes += 1
                         # pml: allow[PML001] Armijo probe barrier, value-only pass (same by-design host decision as above)
                         f_try_h = float(value_only(w_try))
+                f_try_h += _l1_term(w_try)  # total objective under L1
                 # Watchdog chaos seam (docs/ROBUSTNESS.md): a "nan"
                 # fault spec here is the injected form of a numerically
                 # sick objective.
                 f_try_h = flt.poison_scalar(flt.sites.STREAM_OBJECTIVE, f_try_h)
+                if l1 is None:
+                    decrease = step * dg
+                else:
+                    # Armijo with the projected displacement (the
+                    # orthant projection breaks the step·dg identity).
+                    # pml: allow[PML001] same by-design probe barrier — one scalar per probe
+                    decrease = float(jnp.dot(sg, w_try - w))
                 if np.isfinite(f_try_h) and \
-                        f_try_h <= fv + config.wolfe_c1 * step * dg:
+                        f_try_h <= fv + config.wolfe_c1 * decrease:
                     accepted = True
                     break
                 step *= 0.5
@@ -255,7 +302,7 @@ def minimize_streaming(
                 _, g_try = value_and_grad(w_try)
                 g_passes += 1
             s = w_try - w
-            y = g_try - g
+            y = g_try - g  # RAW smooth gradients (OWL-QN included)
             # pml: allow[PML001] curvature-damping skip is a host branch; one scalar per accepted step
             sy = float(jnp.dot(s, y))
             if sy > 1e-10:
@@ -267,16 +314,17 @@ def minimize_streaming(
                 m = jnp.minimum(m + 1, M)
                 m_host = min(m_host + 1, M)
             w, g = w_try, g_try
+            sg = _sgrad(w, g)
             f_prev, fv = fv, f_try_h
             # pml: allow[PML001] convergence test runs on host once per iteration; the streamed pass dominates by orders of magnitude
-            gn = float(jnp.linalg.norm(g))
+            gn = float(jnp.linalg.norm(sg))
             vals[it], gns[it] = fv, gn
             log(f"iter {it}: f={fv:.6g} |g|={gn:.3g} step={step:.3g}")
             if led is not None:
                 # Append-as-produced: a SIGKILL one iteration later
                 # still leaves this point on the curve (the ledger's
                 # whole reason to exist).
-                led.record("opt_iter", opt="lbfgs-stream", iteration=it,
+                led.record("opt_iter", opt=opt_name, iteration=it,
                            value=fv, grad_norm=gn, step=step,
                            probes=probe + 1,
                            value_passes=v_passes - v0_passes,

@@ -966,3 +966,80 @@ def test_driver_sigkill_mid_lbfgs_resumes_bit_identical(tmp_path):
     assert sorted(a.files) == sorted(b.files)
     for k in a.files:
         np.testing.assert_array_equal(a[k], b[k])
+
+
+def _sdca_train_args(train_dir, out):
+    return [
+        "--train", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--update-sequence", "fixed",
+        "--opt-config", "fixed:optimizer=LBFGS,max_iter=40,reg=L2,"
+                        "reg_weight=1.0",
+        "--streaming", "chunk_rows=128,num_hot=8,workers=2,solver=sdca",
+        "--output-dir", out,
+    ]
+
+
+def test_driver_sigkill_mid_sdca_epoch_resumes_bit_identical(tmp_path):
+    """The photon-gap drill (ISSUE 16 acceptance): the training driver is
+    SIGKILLed MID-SDCA-EPOCH (``--fault-plan`` at an ``opt.dual_update``
+    chunk seam inside epoch 2); ``--resume`` reloads the last epoch
+    boundary's (w, α) snapshot and the final coefficients are
+    bit-identical to a never-killed run — the dual vector survives the
+    crash, not just w."""
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.data.game_data import from_sparse_batch
+    from photon_ml_tpu.data.io import save_game_dataset
+
+    batch, _ = sp.synthetic_sparse(700, 64, 5, seed=11)
+    ds = from_sparse_batch(batch)
+    train_dir = str(tmp_path / "train")
+    save_game_dataset(ds, train_dir)
+
+    # 700 rows / 128-row chunks → 6 dual updates per epoch; occurrence 8
+    # lands on epoch 2's third chunk — epoch 1's snapshot (w AND α) is on
+    # disk, epoch 2 is torn.
+    plan = faults.FaultPlan(specs=(faults.FaultSpec(
+        site="opt.dual_update", kind="kill", occurrences=(8,)),))
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        f.write(plan.to_json())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS",)}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + (os.pathsep + env["PYTHONPATH"]
+                                      if env.get("PYTHONPATH") else "")})
+    out_killed = str(tmp_path / "out-killed")
+    log_path = str(tmp_path / "phase1.log")
+    with open(log_path, "w") as log:
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_ml_tpu.cli.game_train"]
+            + _sdca_train_args(train_dir, out_killed)
+            + ["--fault-plan", plan_path],
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+            timeout=600)
+    assert proc.returncode == -9, (
+        f"driver survived the SIGKILL plan (rc={proc.returncode}):\n"
+        + open(log_path).read()[-3000:])
+    ckpt = os.path.join(out_killed, "checkpoints", "grid-0")
+    stream_dirs = [d for d in os.listdir(ckpt)
+                   if d.startswith("stream-step")]
+    assert stream_dirs, "no mid-fit stochastic state survived the kill"
+
+    # Phase 2 (in-process): --resume reloads (w, α) and replays the
+    # remaining epochs...
+    game_train.run(game_train.build_parser().parse_args(
+        _sdca_train_args(train_dir, out_killed) + ["--resume"]))
+
+    # ...and matches a never-killed run bit for bit.
+    out_clean = str(tmp_path / "out-clean")
+    game_train.run(game_train.build_parser().parse_args(
+        _sdca_train_args(train_dir, out_clean)))
+    a = np.load(os.path.join(out_killed, "best", "fixed-effect", "fixed",
+                             "coefficients.npz"))
+    b = np.load(os.path.join(out_clean, "best", "fixed-effect", "fixed",
+                             "coefficients.npz"))
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])

@@ -1,0 +1,454 @@
+"""Duality-gap-driven stochastic solvers (ISSUE 16, docs/STREAMING.md
+"Stochastic solvers"): SDCA + mini-batch SGD behind the streamed driver
+contract, with the per-epoch duality gap as a first-class convergence
+certificate.
+
+The load-bearing invariants pinned here:
+
+* the gap UPPER-BOUNDS suboptimality at every accepted epoch (weak
+  duality — a wrong conjugate or a dropped α·o term breaks this first);
+* the gap → 0 at the optimum on closed-form logistic/L2 and squared/L2
+  problems, and the SDCA iterate lands on the L-BFGS optimum;
+* the sharded gap reduction is BIT-identical to the plain chunk-order
+  sum at D=1 (the reproducible-certificate contract);
+* snapshot/resume replays the remaining epochs bit-identically (w AND α
+  ride in the snapshot — the chaos drill in test_chaos.py kills the
+  process for real, this pins the state round trip);
+* gap-driven chunk pinning is an execution detail: any pin set yields
+  bit-identical coefficients;
+* the watchdog gap gate stops the loop (ledger row + event), and a
+  poisoned (non-finite) gap is a LOUD defined error, never a silent
+  convergence certificate.
+"""
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import faults, obs
+from photon_ml_tpu.data import sparse as sp
+from photon_ml_tpu.obs.ledger import RunLedger, convergence_curves, read_rows
+from photon_ml_tpu.obs.watchdog import (WatchdogConfig, WatchdogError,
+                                        parse_watchdog_config)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops import streaming_sparse as ss
+from photon_ml_tpu.ops.chunk_sampler import GapChunkSampler
+from photon_ml_tpu.optim import OptimizerConfig, optimize
+from photon_ml_tpu.optim.common import OptimizerType
+from photon_ml_tpu.optim.gap import (CONJUGATE_LOSSES, assemble_gap,
+                                     conjugate_term, reduce_gap_partials,
+                                     sgd_gap_surrogate)
+from photon_ml_tpu.optim.stochastic import minimize_stochastic
+from photon_ml_tpu.optim.streaming import minimize_streaming
+from photon_ml_tpu.utils import events as ev_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    yield
+    obs.set_ledger(None)
+    obs.set_watchdog(None)
+    faults.install(None)
+
+
+def _chunks_of(batch, chunk_rows):
+    n = batch.num_rows
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        yield sp.SparseBatch(
+            indices=np.asarray(batch.indices)[lo:hi],
+            values=np.asarray(batch.values)[lo:hi],
+            labels=np.asarray(batch.labels)[lo:hi],
+            weights=np.asarray(batch.weights)[lo:hi],
+            offsets=np.asarray(batch.offsets)[lo:hi],
+            num_features=batch.num_features,
+        )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    b, _ = sp.synthetic_sparse(500, 64, 5, seed=7)
+    return b
+
+
+@pytest.fixture(scope="module")
+def chunked(batch):
+    # 500 rows / 192-row chunks → 3 chunks, last one SHORT (116 rows):
+    # the ω=0 pad rows must contribute exact zeros to α updates and gap
+    # partials on every test below.
+    return ss.build_chunked(_chunks_of(batch, 192), batch.num_features,
+                            192, num_hot=16)
+
+
+def _objective(chunked, loss, l2):
+    vg_stream = ss.make_value_and_gradient(loss, chunked)
+    v_stream = ss.make_value_only(loss, chunked)
+
+    def vg(w):
+        f, g = vg_stream(w)
+        return f + 0.5 * l2 * jnp.sum(w * w), g + l2 * w
+
+    def v(w):
+        return v_stream(w) + 0.5 * l2 * jnp.sum(w * w)
+
+    return vg, v
+
+
+def _w0(batch):
+    return jnp.zeros((batch.num_features,), jnp.float32)
+
+
+# ------------------------------------------------------------- gap math
+
+
+def test_conjugate_terms_zero_at_zero_dual():
+    """φ*ᵢ(0) = 0 for both conjugate losses — this is what makes
+    gap₀ = P(0) exact at the cold start (w, α) = (0, 0)."""
+    for name in sorted(CONJUGATE_LOSSES):
+        term = conjugate_term(name)
+        for label in (0.0, 1.0):
+            v = float(term(jnp.asarray(0.0), jnp.asarray(label),
+                           jnp.asarray(2.5)))
+            assert v == pytest.approx(0.0, abs=1e-7), (name, label)
+        # ω = 0 pad rows contribute exactly nothing whatever α says.
+        assert float(term(jnp.asarray(0.3), jnp.asarray(1.0),
+                          jnp.asarray(0.0))) == 0.0
+
+
+def test_assemble_gap_is_plain_sum():
+    assert assemble_gap(10.0, 3.0, -1.0, 2.0, 4.0) == \
+        pytest.approx(10.0 + 3.0 - 1.0 + 0.5 * 2.0 * 4.0)
+
+
+def test_sgd_gap_surrogate():
+    assert sgd_gap_surrogate(4.0, 2.0) == pytest.approx(16.0 / 4.0)
+    with pytest.raises(ValueError):
+        sgd_gap_surrogate(1.0, 0.0)
+
+
+def test_reduce_gap_partials_d1_bit_parity():
+    """At D=1 the grouped reduction IS the plain chunk-order np.float32
+    sum — bit-identical, so single-device gap certificates never move
+    when the reduction path changes."""
+    rng = np.random.default_rng(11)
+    parts = (rng.normal(size=37) * 100).astype(np.float32)
+    expected = np.float32(0.0)
+    for p in parts:
+        expected = np.float32(expected + p)
+    got = reduce_gap_partials(parts, 1)
+    assert np.float32(got) == expected  # bitwise: same f32 sequence
+    # Multi-device grouping stays finite and close (order moves with
+    # the shard ranges, exactly like the sharded value pass).
+    got3 = reduce_gap_partials(parts, 3)
+    assert math.isfinite(got3)
+    assert got3 == pytest.approx(float(expected), rel=1e-5, abs=1e-3)
+
+
+# ------------------------------------------------- SDCA correctness
+
+
+def test_sdca_logistic_gap_bounds_suboptimality(batch, chunked):
+    """Weak duality, observed: value(it) − f* ≤ gap(it) at EVERY epoch,
+    the gap trends to ~0, and the iterate lands on the L-BFGS optimum."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-10)
+    r = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.LOGISTIC, l2_weight=l2,
+                            solver="sdca", value_only=v)
+    r_ref = minimize_streaming(vg, _w0(batch),
+                               OptimizerConfig(max_iterations=80,
+                                               tolerance=1e-10),
+                               value_only=v)
+    fstar = float(r_ref.value)
+    vals = np.asarray(r.value_history)
+    gaps = np.asarray(r.grad_norm_history)  # gap rides the gn slots
+    lived = np.isfinite(vals)
+    assert lived.sum() >= 10
+    # Upper bound with a small f32-accumulation allowance.
+    slack = 1e-4 * max(abs(fstar), 1.0)
+    assert np.all(vals[lived] - fstar <= gaps[lived] + slack)
+    assert np.all(gaps[lived] >= 0.0)
+    final_gap = float(r.grad_norm)
+    assert final_gap < 0.02 * gaps[lived][0]  # monotone-trending to ~0
+    assert float(r.value) - fstar <= final_gap + slack
+    # λ-strong convexity: ‖w − w*‖ ≤ √(2·gap/λ) — the certificate's
+    # own distance guarantee, checked against the L-BFGS optimum.
+    dist = float(np.linalg.norm(np.asarray(r.w) - np.asarray(r_ref.w)))
+    assert dist <= math.sqrt(2.0 * (final_gap + slack) / l2)
+
+
+def test_sdca_squared_converges_with_vanishing_gap(batch, chunked):
+    """Squared loss has a CLOSED-FORM dual update — SDCA must certify its
+    own convergence (gap gate fires) and land within the λ-strong-convexity
+    ball of the streamed L-BFGS ridge fit."""
+    l2 = 10.0
+    vg, v = _objective(chunked, losses.SQUARED, l2)
+    cfg = OptimizerConfig(max_iterations=150, tolerance=1e-3)
+    r = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.SQUARED, l2_weight=l2,
+                            solver="sdca", value_only=v)
+    r_ref = minimize_streaming(vg, _w0(batch),
+                               OptimizerConfig(max_iterations=120,
+                                               tolerance=1e-10),
+                               value_only=v)
+    assert bool(r.converged)
+    assert int(r.iterations) < cfg.max_iterations
+    final_gap = float(r.grad_norm)
+    assert final_gap <= 1e-3 * max(abs(float(r.value)), 1.0)
+    slack = 1e-3
+    assert float(r.value) - float(r_ref.value) <= final_gap + slack
+    dist = float(np.linalg.norm(np.asarray(r.w) - np.asarray(r_ref.w)))
+    assert dist <= math.sqrt(2.0 * (final_gap + slack) / l2)
+
+
+def test_sdca_warm_start_ignored_and_logged(batch, chunked):
+    """w0 has no dual representation: SDCA must restart at (0, 0) — same
+    result for any warm start — and say so."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=5, tolerance=1e-10)
+    logs = []
+    r_zero = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                                 loss=losses.LOGISTIC, l2_weight=l2,
+                                 solver="sdca", value_only=v)
+    warm = jnp.ones((batch.num_features,), jnp.float32)
+    r_warm = minimize_stochastic(vg, warm, cfg, chunked=chunked,
+                                 loss=losses.LOGISTIC, l2_weight=l2,
+                                 solver="sdca", value_only=v,
+                                 log=logs.append)
+    np.testing.assert_array_equal(np.asarray(r_zero.w),
+                                  np.asarray(r_warm.w))
+    assert any("warm start" in m for m in logs)
+
+
+def test_sdca_resume_bit_identical(batch, chunked):
+    """Kill-free state round trip: 3 epochs + resume(3 more) must equal
+    6 straight epochs BITWISE — w and α both ride the snapshot."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    snaps = []
+    r_full = minimize_stochastic(
+        vg, _w0(batch), OptimizerConfig(max_iterations=6,
+                                        tolerance=1e-12),
+        chunked=chunked, loss=losses.LOGISTIC, l2_weight=l2,
+        solver="sdca", value_only=v)
+    minimize_stochastic(
+        vg, _w0(batch), OptimizerConfig(max_iterations=3,
+                                        tolerance=1e-12),
+        chunked=chunked, loss=losses.LOGISTIC, l2_weight=l2,
+        solver="sdca", value_only=v,
+        checkpoint_save=lambda st: snaps.append(st))
+    assert len(snaps) == 3 and int(snaps[-1]["it"]) == 3
+    assert snaps[-1]["alpha"].shape == \
+        (chunked.num_chunks * chunked.chunk_rows,)
+    r_res = minimize_stochastic(
+        vg, _w0(batch), OptimizerConfig(max_iterations=6,
+                                        tolerance=1e-12),
+        chunked=chunked, loss=losses.LOGISTIC, l2_weight=l2,
+        solver="sdca", value_only=v, resume_state=snaps[-1])
+    np.testing.assert_array_equal(np.asarray(r_res.w),
+                                  np.asarray(r_full.w))
+    assert float(r_res.grad_norm) == float(r_full.grad_norm)  # same gap
+
+
+def test_gap_pinning_changes_nothing(batch, chunked):
+    """The DuHL-style residency set is an execution detail: any pin
+    budget yields bit-identical coefficients and gaps."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=8, tolerance=1e-12)
+    results = [
+        minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.LOGISTIC, l2_weight=l2,
+                            solver="sdca", value_only=v, pin_budget=pin)
+        for pin in (0, 1, chunked.num_chunks)
+    ]
+    for r in results[1:]:
+        np.testing.assert_array_equal(np.asarray(results[0].w),
+                                      np.asarray(r.w))
+        np.testing.assert_array_equal(
+            np.asarray(results[0].grad_norm_history),
+            np.asarray(r.grad_norm_history))
+
+
+def test_gap_chunk_sampler_repins_by_score(chunked):
+    sampler = GapChunkSampler(chunked, capacity=1)
+    try:
+        assert sampler.resident_indices == [0]  # leading-chunk seed
+        sampler.update(np.asarray([0.0, 5.0, 1.0]))
+        assert sampler.resident_indices == [1]
+        # Stickiness: on ties the resident chunk wins (no churn).
+        sampler.update(np.asarray([5.0, 5.0, 1.0]))
+        assert sampler.resident_indices == [1]
+        order = [i for i, _, _ in sampler.stream(depth=2)]
+        assert order == [0, 1, 2]  # global order regardless of pins
+    finally:
+        sampler.release()
+
+
+# ------------------------------------------------------ SGD fallback
+
+
+def test_sgd_reports_finite_surrogate_and_descends(batch, chunked):
+    """Primal-only SGD: no dual, but the ledger still gets a FINITE gap
+    column (‖∇P‖²/2λ — a true upper bound by strong convexity)."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.POISSON, l2)
+    cfg = OptimizerConfig(max_iterations=12, tolerance=1e-12)
+    r = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.POISSON, l2_weight=l2,
+                            solver="sgd", value_only=v)
+    vals = np.asarray(r.value_history)
+    gaps = np.asarray(r.grad_norm_history)
+    lived = np.isfinite(vals)
+    assert np.all(np.isfinite(gaps[lived]))
+    assert float(vals[lived][-1]) < float(vals[lived][0])
+
+
+def test_sgd_warm_start_honoured(batch, chunked):
+    """SGD is primal — a warm start is real state, not ignored."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=2, tolerance=1e-12)
+    warm = jnp.full((batch.num_features,), 0.5, jnp.float32)
+    r_zero = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                                 loss=losses.LOGISTIC, l2_weight=l2,
+                                 solver="sgd", value_only=v)
+    r_warm = minimize_stochastic(vg, warm, cfg, chunked=chunked,
+                                 loss=losses.LOGISTIC, l2_weight=l2,
+                                 solver="sgd", value_only=v)
+    assert np.abs(np.asarray(r_zero.w) - np.asarray(r_warm.w)).max() > 0
+
+
+# ------------------------------------------- contract + observability
+
+
+def test_validation_rejections(batch, chunked):
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=2)
+    with pytest.raises(ValueError, match="conjugate"):
+        minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.POISSON, l2_weight=l2,
+                            solver="sdca", value_only=v)
+    with pytest.raises(ValueError, match="l2_weight"):
+        minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.LOGISTIC, l2_weight=0.0,
+                            solver="sdca", value_only=v)
+    with pytest.raises(ValueError, match="solver"):
+        minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.LOGISTIC, l2_weight=l2,
+                            solver="adam", value_only=v)
+    mask = np.ones((batch.num_features,), np.float32)
+    mask[0] = 0.0
+    with pytest.raises(ValueError, match="every coordinate regularized"):
+        minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                            loss=losses.LOGISTIC, l2_weight=l2,
+                            solver="sdca", value_only=v,
+                            reg_mask=jnp.asarray(mask))
+
+
+def test_optimize_rejects_streamed_only_types():
+    for t in (OptimizerType.SDCA, OptimizerType.SGD):
+        with pytest.raises(ValueError, match="streamed-path"):
+            optimize(lambda w: (jnp.sum(w * w), 2 * w),
+                     jnp.zeros((3,), jnp.float32),
+                     dataclasses.replace(OptimizerConfig(),
+                                         optimizer_type=t))
+
+
+def test_streaming_config_solver_knob():
+    from photon_ml_tpu.api.configs import (StreamingConfig,
+                                           parse_streaming_config)
+
+    assert parse_streaming_config("").solver == "lbfgs"
+    assert parse_streaming_config("solver=SDCA").solver == "sdca"
+    with pytest.raises(ValueError):
+        StreamingConfig(solver="adam")
+    with pytest.raises(ValueError):
+        parse_streaming_config("solver=adam")
+
+
+def test_watchdog_gap_config_parse():
+    cfg = parse_watchdog_config("gap=1e-3")
+    assert cfg.gap_tolerance == pytest.approx(1e-3)
+    assert cfg.gap_action == "stop"
+    cfg = parse_watchdog_config("gap=0.5:warn")
+    assert cfg.gap_action == "warn"
+    with pytest.raises(ValueError):
+        WatchdogConfig(gap_tolerance=-1.0)
+    with pytest.raises(ValueError):
+        WatchdogConfig(gap_action="explode")
+
+
+def test_opt_iter_rows_carry_gap_and_gate_stops(tmp_path, batch, chunked):
+    """The full observability contract in one run: every accepted epoch
+    writes an ``opt_iter`` row with a finite ``gap``; the armed watchdog
+    gap gate stops the loop early with a ``watchdog`` row + alert event;
+    convergence_curves carries the gap through to the diff/bench path."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    led = RunLedger.resume(str(tmp_path / "run"))
+    obs.set_ledger(led)
+    # Generous tolerance → the gate, not epoch exhaustion, ends the run.
+    obs.set_watchdog(parse_watchdog_config("gap=5.0"))
+    seen = []
+    ev_mod.default_emitter.register(seen.append)
+    try:
+        r = minimize_stochastic(
+            vg, _w0(batch), OptimizerConfig(max_iterations=200,
+                                            tolerance=1e-12),
+            chunked=chunked, loss=losses.LOGISTIC, l2_weight=l2,
+            solver="sdca", value_only=v)
+    finally:
+        ev_mod.default_emitter.unregister(seen.append)
+        led.close()
+    assert int(r.iterations) < 200  # the gate fired
+    assert float(r.grad_norm) <= 5.0
+    rows, problems = read_rows(led.directory)
+    assert problems == []
+    iters = [row for row in rows if row["kind"] == "opt_iter"]
+    assert len(iters) == int(r.iterations)
+    assert all(math.isfinite(row["gap"]) for row in iters)
+    assert all(row["opt"] == "sdca-stream" for row in iters)
+    assert all(row["dual_passes"] == 1 for row in iters)
+    wd_rows = [row for row in rows if row["kind"] == "watchdog"]
+    assert wd_rows and wd_rows[-1]["watchdog_kind"] == "gap"
+    alerts = [e for e in seen if isinstance(e, ev_mod.WatchdogAlert)]
+    assert alerts and alerts[-1].kind == "gap" \
+        and alerts[-1].action == "stop"
+    curves = convergence_curves(rows)
+    curve = next(iter(curves.values()))
+    assert all(pt["gap"] is not None and pt["gap"] >= 0 for pt in curve)
+    # dual passes count toward the streamed-pass axis (value + dual).
+    assert curve[0]["passes"] == pytest.approx(2.0)
+
+
+def test_poisoned_gap_is_loud(batch, chunked):
+    """A NaN gap must never read as convergence: with the watchdog armed
+    it raises; without one the loop stops and says why."""
+    l2 = 1.0
+    vg, v = _objective(chunked, losses.LOGISTIC, l2)
+    cfg = OptimizerConfig(max_iterations=10, tolerance=1e-12)
+    plan = faults.FaultPlan(specs=(
+        faults.FaultSpec(site="opt.gap_check", kind="nan",
+                         occurrences=(1,)),))
+    obs.set_watchdog(WatchdogConfig())  # nan → raise (default)
+    with faults.installed(plan):
+        with pytest.raises(WatchdogError):
+            minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                                loss=losses.LOGISTIC, l2_weight=l2,
+                                solver="sdca", value_only=v)
+    obs.set_watchdog(None)
+    logs = []
+    with faults.installed(plan):
+        r = minimize_stochastic(vg, _w0(batch), cfg, chunked=chunked,
+                                loss=losses.LOGISTIC, l2_weight=l2,
+                                solver="sdca", value_only=v,
+                                log=logs.append)
+    assert int(r.iterations) == 2 and not bool(r.converged)
+    assert any("non-finite" in m for m in logs)

@@ -277,9 +277,18 @@ def test_streaming_grid_swap_keeps_staged_chunks(batch):
     swapped = coord.with_optimization_config(_cfg(max_iter=3))
     assert swapped.chunked is coord.chunked
     assert swapped.config.optimizer.max_iterations == 3
-    bad = GLMOptimizationConfiguration(
+    # L1 now swaps IN on the L-BFGS driver (OWL-QN, ISSUE 16) without
+    # restaging; the stochastic solvers still reject it at the swap.
+    l1_cfg = GLMOptimizationConfiguration(
         regularization=RegularizationContext(RegularizationType.L1, 0.5))
-    with pytest.raises(ValueError, match="L1"):
+    l1_swap = coord.with_optimization_config(l1_cfg)
+    assert l1_swap.chunked is coord.chunked
+    sdca = StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, _cfg(), solver="sdca")
+    with pytest.raises(ValueError, match="streamed L-BFGS driver"):
+        sdca.with_optimization_config(l1_cfg)
+    bad = GLMOptimizationConfiguration(down_sampling_rate=0.5)
+    with pytest.raises(ValueError, match="down-sampling"):
         coord.with_optimization_config(bad)
 
 

@@ -247,15 +247,21 @@ def test_streaming_coordinate_rejects_unsupported(batch):
 
     ds = from_sparse_batch(batch)
     chunked = _build(batch)
-    for bad in (
-        GLMOptimizationConfiguration(
-            regularization=RegularizationContext(RegularizationType.L1,
-                                                 0.5)),
-        GLMOptimizationConfiguration(down_sampling_rate=0.5),
-    ):
-        with pytest.raises(ValueError):
+    l1_cfg = GLMOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.L1, 0.5))
+    with pytest.raises(ValueError):
+        StreamingSparseFixedEffectCoordinate(
+            ds, chunked, "global", losses.LOGISTIC,
+            GLMOptimizationConfiguration(down_sampling_rate=0.5))
+    # L1 now RIDES the streamed L-BFGS driver (OWL-QN, ISSUE 16) but
+    # stays rejected for the stochastic solvers (they need plain L2).
+    StreamingSparseFixedEffectCoordinate(
+        ds, chunked, "global", losses.LOGISTIC, l1_cfg)
+    for solver in ("sdca", "sgd"):
+        with pytest.raises(ValueError, match="streamed L-BFGS driver"):
             StreamingSparseFixedEffectCoordinate(
-                ds, chunked, "global", losses.LOGISTIC, bad)
+                ds, chunked, "global", losses.LOGISTIC, l1_cfg,
+                solver=solver)
 
 
 def test_chunk_stream_shares_one_structure(batch):
@@ -301,3 +307,44 @@ def test_bf16_chunk_storage_close_to_f32(batch):
     assert abs(float(v32) - float(v16)) < 0.02 * max(1.0, abs(float(v32)))
     np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
                                rtol=0.05, atol=0.5)
+
+
+def test_streaming_owlqn_matches_compiled(batch):
+    """The streamed OWL-QN (pseudo-gradient + orthant-projected probes
+    in the driver's Armijo loop) lands on the compiled ``minimize_owlqn``
+    optimum with the same sparsity pattern."""
+    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+
+    chunked = _build(batch)
+    l2, d = 0.1, batch.num_features
+    l1 = jnp.full((d,), 2.0, jnp.float32)
+    vg_stream = ss.make_value_and_gradient(losses.LOGISTIC, chunked)
+    v_stream = ss.make_value_only(losses.LOGISTIC, chunked)
+
+    def vg(w):
+        f, g = vg_stream(w)
+        return f + 0.5 * l2 * jnp.sum(w * w), g + l2 * w
+
+    def v(w):
+        return v_stream(w) + 0.5 * l2 * jnp.sum(w * w)
+
+    cfg = OptimizerConfig(max_iterations=120, tolerance=1e-9)
+    w0 = jnp.zeros((d,), jnp.float32)
+    r_s = minimize_streaming(vg, w0, cfg, value_only=v, l1_weights=l1)
+
+    hb = hs.build_hybrid(batch)
+
+    def vg_c(wp):
+        f, g = hs.value_and_gradient(losses.LOGISTIC, wp, hb)
+        return f + 0.5 * l2 * jnp.sum(wp * wp), g + l2 * wp
+
+    r_c = minimize_owlqn(vg_c, w0, l1, cfg)
+    w_c = np.asarray(r_c.w)[np.asarray(hb.inv_perm)]
+    w_s = np.asarray(r_s.w)
+    assert abs(float(r_s.value) - float(r_c.value)) <= 1e-3 * max(
+        1.0, abs(float(r_c.value)))
+    np.testing.assert_allclose(w_s, w_c, rtol=5e-3, atol=5e-3)
+    # Same support: L1 zeros must agree exactly (the orthant machinery
+    # produces EXACT zeros, never small floats).
+    np.testing.assert_array_equal(w_s == 0.0, w_c == 0.0)
+    assert (w_s == 0.0).sum() > 0  # the L1 weight actually bites
