@@ -782,6 +782,142 @@ def bench_pallas_scatter(n=1 << 17, k=32, d=512):
     return out
 
 
+def bench_kernels():
+    """Fused-vs-XLA sweep over every registry kernel (docs/KERNELS.md
+    "The sweep workflow") — the evidence a registry default flip must
+    cite. For each kernel in ops/kernels/ the sweep times the Pallas
+    program against its registered XLA reference at the bench shapes
+    and computes the parity delta between the two.
+
+    Validity discipline: off-TPU the Pallas program only runs through
+    the interpreter, which is parity-grade but orders slower than any
+    real backend — those timing lines are stamped ``kernel_<name>_valid:
+    false`` so check_bench_regression.py never reads an interpret wall
+    as a fused-vs-XLA verdict. Parity deltas are ALWAYS computed and
+    always gated: interpret mode runs the same program the TPU would.
+
+    ``kernel_defaults_flipped`` carries the kernels whose registered
+    default is ON — the committed claim "the sweep showed a win here" —
+    which is exactly the set check_bench_regression.py holds to the
+    fused ≤ 1.0× XLA band on timing-valid tails."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import kernels as K
+
+    on_tpu = jax.default_backend() == "tpu"
+    reg = K.registry()
+    rng = np.random.default_rng(7)
+
+    def pick(big, small):
+        return big if on_tpu else small
+
+    # ell_scatter: the streamed RE rowterm scatter (moderate d).
+    n_sc, k_sc, d_sc = pick((1 << 17, 32, 512), (2048, 8, 256))
+    idx = jnp.asarray(rng.integers(0, d_sc, (n_sc, k_sc)).astype(np.int32))
+    rv = jnp.asarray(rng.normal(size=(n_sc, k_sc)).astype(np.float32))
+
+    # serving_score: gather -> int8 dequant -> einsum -> per-row scale.
+    n_sv, d_sv, e_sv = pick((4096, 512, 8192), (64, 128, 256))
+    mat = jnp.asarray(rng.normal(size=(n_sv, d_sv)).astype(np.float32))
+    slots = jnp.asarray(rng.integers(0, e_sv, (n_sv,)).astype(np.int32))
+    cache = jnp.asarray(
+        rng.integers(-127, 128, (e_sv, d_sv)).astype(np.int8))
+    scl = jnp.asarray(rng.uniform(1e-3, 2.0, (e_sv,)).astype(np.float32))
+
+    # stream_margins / stream_rmatvec: the int8 hot-dense matvec pair.
+    n_st, h_st = pick((1 << 15, 4096), (256, 512))
+    X_hot = jnp.asarray(
+        rng.integers(-127, 128, (n_st, h_st)).astype(np.int8))
+    w_hot = jnp.asarray(rng.normal(size=(h_st,)).astype(np.float32))
+    base = jnp.asarray(rng.normal(size=(n_st,)).astype(np.float32))
+    resid = jnp.asarray(rng.normal(size=(n_st,)).astype(np.float32))
+
+    # re_gather_rows / re_scatter_rows: bucket-solve row traffic, with
+    # invalid (-1) lanes in the final ragged wave. Rows are UNIQUE
+    # within the wave (the bucket-solve contract) — with duplicates the
+    # two backends' last-writer orders legitimately diverge.
+    e_re, d_re, b_re = pick((8192, 256, 2048), (256, 64, 64))
+    W = jnp.asarray(rng.normal(size=(e_re, d_re)).astype(np.float32))
+    rows_np = rng.permutation(e_re)[:b_re].astype(np.int32)
+    rows_np[:: max(b_re // 8, 1)] = -1
+    rows = jnp.asarray(rows_np)
+    vals = jnp.asarray(rng.normal(size=(b_re, d_re)).astype(np.float32))
+
+    from photon_ml_tpu.ops.kernels import (ell_scatter, re_rows,
+                                           serving_score, stream_fused)
+
+    # (name, pallas(*arrays, interpret=), xla(*arrays), arrays,
+    #  chain_idx) — chain_idx names the float operand the dependency
+    # chain perturbs so the async tunnel can't pipeline the timed loop.
+    cases = [
+        ("ell_scatter",
+         lambda i, v, **kw: ell_scatter.scatter_rowterm_pallas(
+             i, v, d_sc, **kw),
+         lambda i, v: ell_scatter.scatter_rowterm_xla(i, v, d_sc),
+         (idx, rv), 1),
+        ("serving_score", serving_score.score_rows_pallas,
+         serving_score.score_rows_xla, (mat, slots, cache, scl), 0),
+        ("stream_margins", stream_fused.hot_margins_pallas,
+         stream_fused.hot_margins_xla, (X_hot, w_hot, base), 1),
+        ("stream_rmatvec", stream_fused.hot_rmatvec_pallas,
+         stream_fused.hot_rmatvec_xla, (X_hot, resid), 1),
+        ("re_gather_rows", re_rows.gather_rows_pallas,
+         re_rows.gather_rows_xla, (W, rows), 0),
+        ("re_scatter_rows", re_rows.scatter_rows_pallas,
+         re_rows.scatter_rows_xla, (W, rows, vals), 2),
+    ]
+
+    out = {
+        "kernel_sweep_backend": jax.default_backend(),
+        "kernel_sweep_kernels": [c[0] for c in cases],
+        "kernel_defaults_flipped": [n for n in reg.names()
+                                    if reg.get(n).default_on],
+    }
+
+    for name, pallas_fn, xla_fn, arrays, ci in cases:
+        _progress(f"kernel sweep: {name}")
+        variants = (
+            ("pallas", jax.jit(lambda *a, _f=pallas_fn:
+                               _f(*a, interpret=not on_tpu))),
+            ("xla", jax.jit(lambda *a, _f=xla_fn: _f(*a))),
+        )
+        results = {}
+        for backend, f in variants:
+            def run(iters, _f=f, _arrays=arrays, _ci=ci):
+                a = list(_arrays)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    o = _f(*a)
+                    a[_ci] = a[_ci] * (1.0 + 1e-20
+                                       * o.ravel()[0].astype(jnp.float32)
+                                       .astype(a[_ci].dtype))
+                np.asarray(o.ravel()[:1])
+                return time.perf_counter() - t0
+
+            results[backend] = np.asarray(f(*arrays), np.float64)  # warm
+            if on_tpu:
+                out[f"kernel_{name}_{backend}_us"] = round(
+                    _slope(run, 5, 45) * 1e6, 1)
+            else:
+                run(1)
+                out[f"kernel_{name}_{backend}_us"] = round(
+                    min(run(1) for _ in range(3)) * 1e6, 1)
+        out[f"kernel_{name}_ratio"] = round(
+            out[f"kernel_{name}_pallas_us"]
+            / max(out[f"kernel_{name}_xla_us"], 1e-9), 3)
+        delta = float(np.max(np.abs(results["pallas"] - results["xla"])))
+        ref = float(np.max(np.abs(results["xla"])))
+        out[f"kernel_{name}_parity_delta"] = delta
+        out[f"kernel_{name}_parity_rel"] = delta / max(ref, 1e-9)
+        if not on_tpu:
+            out[f"kernel_{name}_valid"] = False
+            out[f"kernel_{name}_invalid_reason"] = (
+                "pallas timed through the interpreter (no TPU backend) "
+                "— parity-grade only")
+    return out
+
+
 def bench_avro_ingest(n=20_000, nnz=20):
     """Ingestion layer (docs/INGEST.md): native block decoder vs the
     pure-Python codec through AvroDataReader.read, the block-parallel
@@ -1374,6 +1510,8 @@ def main():
     race = bench_solver_race()
     _progress("pallas scatter")
     scatter = bench_pallas_scatter()  # {} off-TPU
+    _progress("kernel registry sweep: fused vs xla")
+    ksweep = bench_kernels()  # interpret lines stamped invalid off-TPU
     # Avro ingestion lines ride the fresh-host subprocess suite above
     # (bench_avro_ingest + bench_ingest_cold_fit inside
     # bench_fresh_host_suite) — host-side work measured in a clean
@@ -1412,6 +1550,7 @@ def main():
             **race,
             **staging,
             **{key: round(v, 1) for key, v in scatter.items()},
+            **ksweep,
             "game_cd_iteration_seconds": round(game_iter_s, 3),
             **game_20m,
             **criteo,
@@ -1423,4 +1562,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # ``python bench.py bench_kernels`` (or any other bench_* function)
+    # runs one section and prints its JSON — the sweep workflow in
+    # docs/KERNELS.md commits these objects as flip evidence.
+    if len(sys.argv) > 1:
+        fn = globals().get(sys.argv[1])
+        if not (sys.argv[1].startswith("bench_") and callable(fn)):
+            print(f"unknown bench section {sys.argv[1]!r} (want one of "
+                  f"{sorted(k for k in globals() if k.startswith('bench_'))})",
+                  file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(fn()))
+    else:
+        main()

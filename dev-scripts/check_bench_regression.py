@@ -130,6 +130,15 @@ QUANT_TRANSFER_BOUND_FRACTION = 0.5
 # (≤ 1.0× band-adjusted) when the stream is transfer-bound; on a
 # compute-bound CPU box the ratio is reported only, like the quant wall.
 SOLVER_RACE_AUC_DELTA_MAX = 5e-3
+# Kernel registry sweep (docs/KERNELS.md): a fused Pallas program and
+# its registered XLA reference compute the same math, so the sweep's
+# relative parity delta is a correctness tripwire, not a tolerance —
+# f32 accumulation-order noise sits orders below this band. Parity
+# gates on EVERY tail (interpret mode runs the same program a TPU
+# would); the fused-vs-XLA timing ratio gates only where the registry
+# default was flipped ON (the committed "sweep showed a win" claim)
+# AND the line is timing-valid (never in interpret mode).
+KERNEL_PARITY_REL_MAX = 1e-3
 GUARDED = [
     "staging_bucketing_seconds",
     "staging_projection_seconds",
@@ -442,6 +451,50 @@ def main() -> int:
                     f"solver_race_auc_delta: {delta:g} > "
                     f"{SOLVER_RACE_AUC_DELTA_MAX:g} — the stochastic "
                     f"fit no longer matches L-BFGS ranking quality")
+
+    # --- kernel-registry invariants (docs/KERNELS.md) -------------------
+    # bench_kernels' sweep lines. Two gates per kernel: the parity
+    # delta (always — a fused program that disagrees with its XLA
+    # reference is wrong, not slow), and the fused ≤ 1.0× XLA wall
+    # (band-adjusted) for kernels whose registry default is ON — a
+    # flipped default cites the sweep, so the sweep must keep showing
+    # the win. Interpret-stamped lines (kernel_<name>_valid: false)
+    # never produce a timing verdict.
+    flipped = set(fresh.get("kernel_defaults_flipped") or [])
+    for kname in fresh.get("kernel_sweep_kernels") or []:
+        rel = fresh.get(f"kernel_{kname}_parity_rel")
+        if rel is not None:
+            ok = float(rel) <= KERNEL_PARITY_REL_MAX
+            print(f"kernel_{kname}_parity_rel: {float(rel):.3g} (limit "
+                  f"{KERNEL_PARITY_REL_MAX:g}) "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"kernel_{kname}_parity_rel: {float(rel):.3g} > "
+                    f"{KERNEL_PARITY_REL_MAX:g} — the fused program "
+                    f"disagrees with its XLA reference (wrong, not "
+                    f"slow)")
+        ratio = fresh.get(f"kernel_{kname}_ratio")
+        if ratio is None:
+            continue
+        reason = _invalid(fresh, f"kernel_{kname}")
+        if reason is not None:
+            print(f"kernel_{kname}_ratio: {float(ratio):g}x INVALID "
+                  f"(reported only: {reason})")
+            continue
+        if kname not in flipped:
+            print(f"kernel_{kname}_ratio: {float(ratio):g}x (reported "
+                  f"only: default off, no flip claim to hold)")
+            continue
+        ok = float(ratio) <= band
+        print(f"kernel_{kname}_ratio: {float(ratio):g}x (limit "
+              f"{band:.3g}x — default flipped ON) "
+              f"{'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"kernel_{kname}_ratio: fused is {float(ratio):g}x the "
+                f"XLA wall (> {band:.3g}x) but the registry default is "
+                f"ON — the flip's sweep evidence no longer holds")
 
     # --- quantized device-LRU invariants (docs/SERVING.md "Quantized
     # device cache"): at a fixed HBM budget the int8 cache must hold

@@ -98,6 +98,16 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/boot_smoke.py; rc=$?
 fi
 
+# Kernel-registry smoke (docs/KERNELS.md): every registered Pallas
+# program runs through the interpreter on CPU and matches its XLA
+# reference; an enabled kernel without a backend degrades LOUDLY
+# (KernelFallback + counter); warm resolves are hits, never rebuilds;
+# the kernel.resolve instants render via summarize --kernels. Seconds
+# on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/kernel_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

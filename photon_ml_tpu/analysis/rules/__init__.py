@@ -7,10 +7,10 @@ every registered rule unless the CLI selects/ignores a subset."""
 from __future__ import annotations
 
 from photon_ml_tpu.analysis.rules import (concurrency, device, drift,
-                                          interproc, lifecycle, network,
-                                          numeric, obs_discipline,
-                                          resources, robustness,
-                                          timeclock, xclass)
+                                          interproc, kernels, lifecycle,
+                                          network, numeric,
+                                          obs_discipline, resources,
+                                          robustness, timeclock, xclass)
 
 # id → (check, one-line summary). Order is report order.
 ALL_RULES = {
@@ -38,6 +38,9 @@ ALL_RULES = {
                "buffered run-ledger API)"),
     "PML011": (network.check_blocking_network_timeout,
                "blocking socket/HTTP call without an explicit timeout"),
+    "PML017": (kernels.check_kernel_seam,
+               "direct pallas_call outside ops/kernels/ (bypasses the "
+               "kernel registry's flag/fallback/parity contract)"),
 }
 
 # Whole-program rules over the project graph (analysis/project.py):

@@ -1,0 +1,41 @@
+"""PML017 — the fused-kernel seam (docs/KERNELS.md).
+
+Every Pallas program in this repo lives in ``ops/kernels/`` behind the
+:class:`~photon_ml_tpu.ops.kernels.registry.KernelRegistry`: a per-kernel
+flag, an XLA reference closure, an interpret-mode CPU path, and the loud
+degradation ladder. A ``pl.pallas_call`` anywhere else bypasses all four
+— no flag to turn it off when the sweep stops justifying it, no
+reference for parity tests, no CPU smoke coverage, and a silent crash
+instead of a KernelFallback when the backend can't run it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.context import ModuleContext
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.taint import call_func_name
+
+_KERNEL_HOME = "photon_ml_tpu/ops/kernels/"
+
+
+def check_kernel_seam(ctx: ModuleContext) -> list[Finding]:
+    """A direct ``pallas_call`` outside ``ops/kernels/`` dodges the
+    registry's flag/fallback/parity/interpret contract."""
+    if ctx.path.startswith(_KERNEL_HOME):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_func_name(node)
+        if name is not None and name.rsplit(".", 1)[-1] == "pallas_call":
+            out.append(ctx.finding(
+                "PML017", node,
+                f"direct {name}(...) outside {_KERNEL_HOME}: fused "
+                f"programs must register in ops/kernels/__init__.py "
+                f"(flag + XLA reference + interpret path + loud "
+                f"fallback) and call sites must resolve through the "
+                f"registry (docs/KERNELS.md)"))
+    return out

@@ -58,6 +58,13 @@ def _spans(trace: dict) -> list[dict]:
     return [e for e in trace["traceEvents"] if e.get("ph") == "X"]
 
 
+def _instants(trace: dict, name: Optional[str] = None) -> list[dict]:
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    if name is not None:
+        evs = [e for e in evs if e.get("name") == name]
+    return evs
+
+
 # -- verify -----------------------------------------------------------------
 
 
@@ -334,6 +341,90 @@ def render_serving_summary(summary: dict) -> str:
 def _bar(frac: float, width: int = 30) -> str:
     n = max(0, min(width, round(frac * width)))
     return "#" * n + "." * (width - n)
+
+
+# -- kernel view (summarize --kernels) --------------------------------------
+
+
+def summarize_kernels(trace: dict) -> dict:
+    """Per-kernel attribution from the registry's timeline markers.
+
+    Every fresh (kernel, dtype, backend) resolution drops a
+    ``kernel.resolve`` instant (ops/kernels/registry.py), and every loud
+    degradation drops a ``kernel_fallback`` instant via the event bridge
+    — so a trace carries the full build ledger: which fused programs
+    were built, on which backend, for which dtypes, and why any of them
+    fell back to XLA. A kernel that resolved to BOTH backends in one
+    trace is flagged mixed-backend: flag flips mid-run mean two compiled
+    programs for one site (docs/KERNELS.md "The failure ladder")."""
+    kernels: dict[str, dict] = {}
+
+    def row(name: str) -> dict:
+        return kernels.setdefault(name, {
+            "builds": 0, "backends": set(), "dtypes": set(),
+            "interpret": False, "fallbacks": 0, "fallback_reasons": []})
+
+    for e in _instants(trace, "kernel.resolve"):
+        a = e.get("args", {})
+        r = row(str(a.get("kernel")))
+        r["builds"] += 1
+        r["backends"].add(str(a.get("backend")))
+        r["dtypes"].add(str(a.get("dtype")))
+        r["interpret"] = r["interpret"] or bool(a.get("interpret"))
+    for e in _instants(trace, "kernel_fallback"):
+        a = e.get("args", {})
+        r = row(str(a.get("kernel")))
+        r["fallbacks"] += 1
+        reason = str(a.get("reason"))
+        if reason not in r["fallback_reasons"]:
+            r["fallback_reasons"].append(reason)
+
+    out_rows = []
+    for name in sorted(kernels):
+        r = kernels[name]
+        out_rows.append({
+            "kernel": name, "builds": r["builds"],
+            "backends": sorted(r["backends"]),
+            "dtypes": sorted(r["dtypes"]),
+            "interpret": r["interpret"],
+            "mixed_backend": len(r["backends"]) > 1,
+            "fallbacks": r["fallbacks"],
+            "fallback_reasons": r["fallback_reasons"]})
+    return {
+        "kernels": out_rows,
+        "builds": sum(r["builds"] for r in out_rows),
+        "fallbacks": sum(r["fallbacks"] for r in out_rows),
+        "mixed_backend": [r["kernel"] for r in out_rows
+                          if r["mixed_backend"]],
+    }
+
+
+def render_kernel_summary(summary: dict) -> str:
+    rows = summary["kernels"]
+    if not rows:
+        return ("no kernel.resolve markers in this trace — either no "
+                "registry kernel was enabled, or the run predates the "
+                "kernel registry (docs/KERNELS.md)")
+    out = [f"{summary['builds']} kernel program build(s) across "
+           f"{len(rows)} kernel(s); {summary['fallbacks']} fallback(s)",
+           "",
+           f"  {'kernel':<18} {'builds':>6}  {'backend(s)':<22} "
+           f"{'dtype(s)':<14} {'fallbacks':>9}"]
+    for r in rows:
+        backends = ",".join(r["backends"])
+        if r["interpret"]:
+            backends += " (interpret)"
+        out.append(f"  {r['kernel']:<18} {r['builds']:>6}  "
+                   f"{backends:<22} {','.join(r['dtypes']):<14} "
+                   f"{r['fallbacks']:>9}")
+    for r in rows:
+        for reason in r["fallback_reasons"]:
+            out.append(f"    {r['kernel']}: fell back — {reason}")
+    if summary["mixed_backend"]:
+        out += ["", "  WARNING: mixed backends in one trace for "
+                    f"{', '.join(summary['mixed_backend'])} — a flag "
+                    f"flip mid-run built two programs for one site"]
+    return "\n".join(out)
 
 
 def render_summary(summary: dict) -> str:
@@ -778,6 +869,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "stage attribution (queue wait / assemble / "
                         "device score / respond), and the slowest "
                         "request's waterfall (docs/SERVING.md)")
+    s.add_argument("--kernels", action="store_true",
+                   help="kernel-registry view: per-kernel program "
+                        "builds by backend/dtype, interpret-mode "
+                        "markers, and fallback events with reasons "
+                        "(docs/KERNELS.md)")
     v = sub.add_parser("verify",
                        help="structural health check (CI smoke): trace "
                             "spans closed/nested, or — for a ledger "
@@ -869,6 +965,11 @@ def main(argv: Optional[list] = None) -> int:
         summary = summarize_serving(trace)
         print(json.dumps(summary) if args.json
               else render_serving_summary(summary))
+        return 0
+    if getattr(args, "kernels", False):
+        summary = summarize_kernels(trace)
+        print(json.dumps(summary) if args.json
+              else render_kernel_summary(summary))
         return 0
     summary = summarize_trace(trace, top=args.top)
     if args.json:

@@ -80,6 +80,14 @@ BOOT_MAP_WRITE = "boot.map_write"
 BOOT_MAP_OPEN = "boot.map_open"  # corrupt_file (post-CRC bit rot in a blob)
 BOOT_COMPACT = "boot.compact"
 
+# -- fused-kernel registry (ops/kernels/registry.py) -------------------------
+# Fires at the moment the registry commits to the Pallas backend for a
+# kernel — BEFORE any program is built — so a fault here exercises the
+# degradation contract: the resolve falls back to the XLA closure, emits
+# a KernelFallback event + photon_kernel_fallbacks_total, and the caller
+# never sees the failure (docs/KERNELS.md "Failure ladder").
+KERNEL_LAUNCH = "kernel.launch"
+
 # -- continuous publication (serving/publish.py, serving/fleet.py,
 #    serving/model_store.py) -------------------------------------------------
 PUBLISH_DELTA_WRITE = "publish.delta_write"

@@ -419,20 +419,49 @@ class RandomEffectCoordinate:
             return
         solve = jax.vmap(self._solve_one)
         var_one = jax.vmap(self._variance_one)
+        # Kernel-registry resolution at program-build time (docs/
+        # KERNELS.md): the bucket's row moves — warm-start gather,
+        # fitted-row scatter — can run as scalar-prefetch Pallas
+        # programs (registry ``re_gather_rows``/``re_scatter_rows``).
+        # Both are pure data movement, so a backend flip is bit-exact by
+        # construction and the refit bit-identity invariant holds either
+        # way. Projected fits keep the XLA moves: their gathers route
+        # through per-entity column maps, a different access pattern
+        # (documented in docs/KERNELS.md "What stays XLA").
+        from photon_ml_tpu.ops import kernels as _kernels
+        _reg = _kernels.registry()
+        gather_k = scatter_k = None
+        if _reg.enabled("re_gather_rows"):
+            rk = _reg.resolve("re_gather_rows")
+            if rk.backend == "pallas":
+                gather_k = rk
+        if _reg.enabled("re_scatter_rows"):
+            rk = _reg.resolve("re_scatter_rows")
+            if rk.backend == "pallas":
+                scatter_k = rk
+
+        def _gather_rows(W, rows):
+            if gather_k is not None:
+                return gather_k(W, rows)
+            return W[jnp.maximum(rows, 0)]
+
+        def _scatter_rows(W, rows, vals):
+            if scatter_k is not None:
+                return scatter_k(W, rows, vals)
+            safe = jnp.where(rows >= 0, rows, num_entities)
+            return W.at[safe].set(vals, mode="drop")
 
         def fit_bucket(W, offsets, Xb, yb, wb, ex, rows):
             ob = offsets[jnp.maximum(ex, 0)]
-            w0 = W[jnp.maximum(rows, 0)]
+            w0 = _gather_rows(W, rows)
             w_fit = solve(Xb, yb, wb, ob, w0)
-            safe = jnp.where(rows >= 0, rows, num_entities)
-            return W.at[safe].set(w_fit, mode="drop")
+            return _scatter_rows(W, rows, w_fit)
 
         def var_bucket(W, V, offsets, Xb, yb, wb, ex, rows):
             ob = offsets[jnp.maximum(ex, 0)]
-            w_opt = W[jnp.maximum(rows, 0)]
+            w_opt = _gather_rows(W, rows)
             var = var_one(Xb, yb, wb, ob, w_opt)
-            safe = jnp.where(rows >= 0, rows, num_entities)
-            return V.at[safe].set(var, mode="drop")
+            return _scatter_rows(V, rows, var)
 
         # Donate the table being rebuilt (W for fits, V for variances) so the
         # scatter updates in place instead of copying (E, d) per bucket.

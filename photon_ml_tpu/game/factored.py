@@ -269,16 +269,37 @@ class FactoredRandomEffectCoordinate:
 
         vsolve_z = jax.vmap(solve_z_one)
 
+        # Same registry-resolved row moves as the plain RE bucket solvers
+        # (game/coordinates/random_effect.py _build_fits) — the latent
+        # table Z is just a (num_entities, r) coefficient table, and the
+        # kernels are bit-exact data movement, so the flip is free of
+        # numerics. Resolved once, at program-build time.
+        from photon_ml_tpu.ops import kernels as _kernels
+        _reg = _kernels.registry()
+        _gather_k = _scatter_k = None
+        if _reg.enabled("re_gather_rows"):
+            rk = _reg.resolve("re_gather_rows")
+            if rk.backend == "pallas":
+                _gather_k = rk
+        if _reg.enabled("re_scatter_rows"):
+            rk = _reg.resolve("re_scatter_rows")
+            if rk.backend == "pallas":
+                _scatter_k = rk
+
         def z_step(A, Z, offsets):
             Xp = self._X @ A  # (n_pad, r)
             for yb, wb, ex, rows in self._bucket_data:
                 safe_ex = jnp.maximum(ex, 0)
                 Xb = Xp[safe_ex] * (ex >= 0)[..., None]
                 ob = offsets[safe_ex]
-                z0 = Z[jnp.maximum(rows, 0)]
+                z0 = (_gather_k(Z, rows) if _gather_k is not None
+                      else Z[jnp.maximum(rows, 0)])
                 z_fit = vsolve_z(Xb, yb, wb, ob, z0)
-                safe_rows = jnp.where(rows >= 0, rows, num_entities)
-                Z = Z.at[safe_rows].set(z_fit, mode="drop")
+                if _scatter_k is not None:
+                    Z = _scatter_k(Z, rows, z_fit)
+                else:
+                    safe_rows = jnp.where(rows >= 0, rows, num_entities)
+                    Z = Z.at[safe_rows].set(z_fit, mode="drop")
             return Z
 
         def a_step(A, Z, offsets):

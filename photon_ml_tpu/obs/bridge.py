@@ -141,6 +141,9 @@ class EventSpanBridge:
             elif name == "WatchdogAlert":
                 metrics.counter("photon_watchdog_alerts_total",
                                 kind=str(args.get("kind"))).inc()
+            elif name == "KernelFallback":
+                metrics.counter("photon_kernel_fallbacks_total",
+                                kernel=str(args.get("kernel"))).inc()
             elif name == "CoordinateUpdate":
                 metrics.histogram(
                     "photon_coordinate_update_seconds").observe(

@@ -1,0 +1,75 @@
+"""Fused Pallas kernels on the measured hot paths (docs/KERNELS.md).
+
+The registry (:mod:`.registry`) is the only way production code reaches
+a Pallas program — PML017 flags a raw ``pl.pallas_call`` anywhere else
+in the package — and importing THIS package is what populates it: each
+kernel module pairs a Pallas program with its XLA reference closure, and
+the specs below bind them under a flag.
+
+Flag defaults record the committed ``bench_kernels`` sweep (BENCH.md),
+not hope: ``ell_scatter`` ships ON because BENCH_r05 measured the Pallas
+scatter 4.6× over XLA on TPU at the bench shape (the auto-dispatch
+ops/sparse_aggregators.py has trusted since r05 — the registry keeps
+that decision, it just makes the fallback loud); the remaining five ship
+OFF until a sweep on a TPU box flips them (this tree's committed sweeps
+ran on the CPU host, where Pallas timings are interpret-mode and stamped
+invalid — docs/KERNELS.md "The sweep workflow").
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.ops.kernels import (ell_scatter, re_rows, serving_score,
+                                       stream_fused)
+from photon_ml_tpu.ops.kernels.registry import (KernelSpec, ResolvedKernel,
+                                                registry)
+
+registry().register(KernelSpec(
+    name="ell_scatter",
+    pallas_fn=ell_scatter.scatter_rowterm_pallas,
+    xla_fn=ell_scatter.scatter_rowterm_xla,
+    doc="ELL scatter-add as one-hot compare+accumulate tiles "
+        "(gradient of the sparse GLM pass)",
+    default_on=True,  # BENCH_r05 scatter_pallas_d512_us: 4.6x over XLA
+))
+
+registry().register(KernelSpec(
+    name="serving_score",
+    pallas_fn=serving_score.score_rows_pallas,
+    xla_fn=serving_score.score_rows_xla,
+    doc="serving gather->int8-dequant->row-dot->scale as one program "
+        "(int8 cache rows never materialize as f32 in HBM)",
+))
+
+registry().register(KernelSpec(
+    name="stream_margins",
+    pallas_fn=stream_fused.hot_margins_pallas,
+    xla_fn=stream_fused.hot_margins_xla,
+    doc="streamed hot-dense margins with int8 dequant fused into the "
+        "matvec tiles (no (n,H) f32 HBM copy)",
+))
+
+registry().register(KernelSpec(
+    name="stream_rmatvec",
+    pallas_fn=stream_fused.hot_rmatvec_pallas,
+    xla_fn=stream_fused.hot_rmatvec_xla,
+    doc="streamed hot-dense gradient rmatvec with fused dequant "
+        "(the gradient half of the chunk pass)",
+))
+
+registry().register(KernelSpec(
+    name="re_gather_rows",
+    pallas_fn=re_rows.gather_rows_pallas,
+    xla_fn=re_rows.gather_rows_xla,
+    doc="RE bucket warm-start row gather via scalar-prefetch block "
+        "addressing (bit-exact data movement)",
+))
+
+registry().register(KernelSpec(
+    name="re_scatter_rows",
+    pallas_fn=re_rows.scatter_rows_pallas,
+    xla_fn=re_rows.scatter_rows_xla,
+    doc="RE bucket fitted-row scatter, table aliased in place "
+        "(bit-exact data movement)",
+))
+
+__all__ = ["KernelSpec", "ResolvedKernel", "registry"]

@@ -1,0 +1,94 @@
+"""Serving score fusion (registry name ``serving_score``).
+
+One random-effect coordinate's contribution to a serving flush is the
+chain gather → int8 dequant → row-dot → per-row scale
+(serving/service.py ``_build_score_fn``):
+
+    rows = cache[slots]                         # (n, d) gather, int8
+    out  = einsum("nd,nd->n", mat, rows.f32)    # dequantized dot
+    out *= scale[slots]                         # per-row dequant scale
+
+As separate XLA programs the gathered rows round-trip HBM as f32 —
+4 bytes/element for codes the cache stores at 1 — and at million-entity
+stores that f32 materialization is the p99 and device-capacity tax the
+int8 cache was built to avoid. The fused program (docs/KERNELS.md memory
+diagram) gathers each code row straight into VMEM via scalar-prefetch
+block indexing, upcasts in registers, reduces, and applies the scale in
+the same grid step: the only HBM traffic is the int8 row read and one
+f32 scalar write per example.
+
+Grid: one step per batch row. ``slots`` rides
+``PrefetchScalarGridSpec``, so the cache BlockSpec's index_map addresses
+block (slots[i], 0) — the gather IS the block schedule, not an op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.ops.kernels.ell_scatter import _pad_axis
+
+Array = jax.Array
+
+_LANE = 128
+
+
+def _score_kernel(slots_ref, mat_ref, row_ref, sc_ref, out_ref):
+    del slots_ref  # consumed by the index maps, not the body
+    acc = jnp.sum(mat_ref[...] * row_ref[...].astype(jnp.float32))
+    out_ref[0, 0] = acc * sc_ref[0, 0]
+
+
+def score_rows_pallas(mat: Array, slots: Array, cache: Array,
+                      scale: Array | None,
+                      interpret: bool = False) -> Array:
+    """(n,) Σ_d mat[i,d]·dequant(cache[slots[i],d]) in one program.
+
+    ``mat``: (n, d) f32 features. ``slots``: (n,) int32 cache rows (the
+    service guarantees in-range: unknown entities resolve to the
+    fallback slot). ``cache``: (E, d) int8 codes or f32 rows. ``scale``:
+    (E,) f32 per-row dequant scales, or None for f32 caches (the
+    fallback slot's scale is 0, so it dequantizes to exactly zero — same
+    contract as the XLA chain)."""
+    n, d = mat.shape
+    mat_p = _pad_axis(jnp.asarray(mat, jnp.float32), _LANE, 1, 0.0)
+    cache_p = _pad_axis(cache, _LANE, 1, 0)
+    d_pad = mat_p.shape[1]
+    slots = jnp.clip(jnp.asarray(slots, jnp.int32), 0,
+                     cache.shape[0] - 1)
+    if scale is None:
+        # f32 cache: fold a unit scale so both modes share one program
+        # (×1.0 is bit-exact, and (E,) f32 is noise next to the table).
+        scale = jnp.ones((cache.shape[0],), jnp.float32)
+    scale_2d = jnp.asarray(scale, jnp.float32).reshape(-1, 1)
+    out = pl.pallas_call(
+        _score_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, d_pad), lambda i, s: (i, 0)),
+                pl.BlockSpec((1, d_pad), lambda i, s: (s[i], 0)),
+                pl.BlockSpec((1, 1), lambda i, s: (s[i], 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1), lambda i, s: (i, 0)),
+        ),
+        interpret=interpret,
+    )(slots, mat_p, cache_p, scale_2d)
+    return out[:, 0]
+
+
+def score_rows_xla(mat: Array, slots: Array, cache: Array,
+                   scale: Array | None) -> Array:
+    """The unfused chain exactly as ``_build_score_fn`` inlines it —
+    gather, f32 einsum, one per-row scale multiply (x·(s·q) = s·(x·q),
+    exact algebra)."""
+    rows = cache[slots]
+    out = jnp.einsum("nd,nd->n", mat, rows.astype(jnp.float32))
+    if scale is not None:
+        out = out * scale[slots]
+    return out

@@ -1,0 +1,140 @@
+"""Streamed int8 margin/gradient fusion (registry names
+``stream_margins`` / ``stream_rmatvec``).
+
+The streamed chunk pass's byte budget is dominated by its hot-dense
+tier: an int8 chunk stores ``X_hot`` as (n, H) codes, but the XLA path
+(ops/streaming_sparse.py ``_chunk_margins_of`` / ``_chunk_rowterm_grad``)
+opens with ``ch.X_hot.astype(jnp.float32)`` — materializing a 4×-larger
+f32 copy of the densest block in HBM before the matvec even starts, per
+chunk, per pass. These programs fold the dequant into the matvec tiles:
+codes stream HBM→VMEM as int8 and upcast in registers, so the f32 hot
+block never exists anywhere (docs/KERNELS.md memory diagram).
+
+Scope is deliberate (docs/KERNELS.md "What stays XLA"): the cold-ELL
+tier keeps its per-slot 1-D gathers/scatters — they are byte-small by
+construction (the hot/cold split put the mass in the hot tier) and an
+in-kernel vector gather over a d≈10⁶ table is exactly the layout the
+module's (n,k)-operand lesson forbids. The margins program instead takes
+the cold contribution pre-reduced as ``base``, so the chunk's margins
+are still produced by ONE fused program:
+
+    margins:  out[i] = base[i] + Σ_h X_hot[i,h]·w_hot[h]   (w pre-folded
+              with hot_scale: w·(s·q) = (w·s)·q, exact)
+    rmatvec:  out[h] = Σ_i X_hot[i,h]·r[i]                 (caller scales
+              the (H,) result once — O(H), not O(n·H))
+
+Both tile the hot block (rows × lanes) with the minor grid dimension
+accumulating in place — TPU grids iterate sequentially, so ``out_ref``
+accumulation over the minor dim is race-free, the ell_scatter pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from photon_ml_tpu.ops.hybrid_sparse import _hot_matvec, _hot_rmatvec
+from photon_ml_tpu.ops.kernels.ell_scatter import _pad_axis
+
+Array = jax.Array
+
+# Row tile amortizes grid overhead; the lane tile keeps one VMEM-resident
+# (rows × lanes) block per step small enough for any H (large hot tiers
+# tile across the minor grid dimension instead of growing the block).
+_ROW_TILE = 256
+_H_TILE = 512
+
+
+def _margins_kernel(x_ref, w_ref, base_ref, out_ref):
+    """Grid (n_tiles, h_tiles); h is the accumulation (minor) dim."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = base_ref[...]
+
+    x = x_ref[...].astype(jnp.float32)  # dequant upcast: registers only
+    out_ref[...] += jnp.sum(x * w_ref[...], axis=1, keepdims=True)
+
+
+def hot_margins_pallas(X_hot: Array, w_hot: Array, base: Array,
+                       interpret: bool = False) -> Array:
+    """(n,) base + X_hot @ w_hot with the upcast fused into the tiles.
+
+    ``X_hot``: (n, H) int8 codes (or f32/bf16 — the upcast is then a
+    no-op and the fusion still saves the separate matvec dispatch).
+    ``w_hot``: (H,) f32, already folded with the hot dequant scales.
+    ``base``: (n,) f32 offsets + cold-tier contribution."""
+    n, h = X_hot.shape
+    x = _pad_axis(_pad_axis(X_hot, _ROW_TILE, 0, 0), _H_TILE, 1, 0)
+    w = _pad_axis(jnp.asarray(w_hot, jnp.float32).reshape(1, -1),
+                  _H_TILE, 1, 0.0)
+    b = _pad_axis(jnp.asarray(base, jnp.float32).reshape(-1, 1),
+                  _ROW_TILE, 0, 0.0)
+    n_tiles = x.shape[0] // _ROW_TILE
+    h_tiles = x.shape[1] // _H_TILE
+    out = pl.pallas_call(
+        _margins_kernel,
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 1), jnp.float32),
+        grid=(n_tiles, h_tiles),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, _H_TILE), lambda i, j: (i, j)),
+            pl.BlockSpec((1, _H_TILE), lambda i, j: (0, j)),
+            pl.BlockSpec((_ROW_TILE, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROW_TILE, 1), lambda i, j: (i, 0)),
+        interpret=interpret,
+    )(x, w, b)
+    return out[:n, 0]
+
+
+def hot_margins_xla(X_hot: Array, w_hot: Array, base: Array) -> Array:
+    """The unfused reference: explicit f32 upcast (the HBM copy the
+    fused program exists to avoid), then the shared hot matvec."""
+    if X_hot.dtype == jnp.int8:
+        X_hot = X_hot.astype(jnp.float32)
+    return base + _hot_matvec(X_hot, w_hot)
+
+
+def _rmatvec_kernel(x_ref, r_ref, out_ref):
+    """Grid (h_tiles, n_tiles); n is the accumulation (minor) dim."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    out_ref[...] += jnp.sum(x * r_ref[...], axis=0, keepdims=True)
+
+
+def hot_rmatvec_pallas(X_hot: Array, r: Array,
+                       interpret: bool = False) -> Array:
+    """(H,) X_hotᵀ @ r, upcast fused. Unscaled: the caller multiplies
+    the (H,) result by hot_scale once (the gradient path's O(H) dequant
+    epilogue, ops/streaming_sparse.py ``_chunk_rowterm_grad``)."""
+    n, h = X_hot.shape
+    x = _pad_axis(_pad_axis(X_hot, _ROW_TILE, 0, 0), _H_TILE, 1, 0)
+    rr = _pad_axis(jnp.asarray(r, jnp.float32).reshape(-1, 1),
+                   _ROW_TILE, 0, 0.0)
+    h_tiles = x.shape[1] // _H_TILE
+    n_tiles = x.shape[0] // _ROW_TILE
+    out = pl.pallas_call(
+        _rmatvec_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, x.shape[1]), jnp.float32),
+        grid=(h_tiles, n_tiles),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, _H_TILE), lambda i, j: (j, i)),
+            pl.BlockSpec((_ROW_TILE, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _H_TILE), lambda i, j: (0, i)),
+        interpret=interpret,
+    )(x, rr)
+    return out[0, :h]
+
+
+def hot_rmatvec_xla(X_hot: Array, r: Array) -> Array:
+    if X_hot.dtype == jnp.int8:
+        X_hot = X_hot.astype(jnp.float32)
+    return _hot_rmatvec(X_hot, r)

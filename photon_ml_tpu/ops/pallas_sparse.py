@@ -1,22 +1,15 @@
-"""Pallas TPU kernel: sparse scatter-add as one-hot matmuls.
+"""Compatibility shim: the Pallas ELL scatter moved to ops/kernels/.
 
-The gradient of a sparse GLM pass is Σᵢ rᵢ·xᵢ — a scatter-add of
-``r ⊗ values`` into the (d,) coefficient shape. XLA lowers `.at[].add` to
-sort + segment-sum machinery; this kernel instead rides the MXU
-(SURVEY.md §7 step 9, the one genuinely new kernel): for each (column
-tile, row tile) grid cell it builds the one-hot match matrix between the
-tile's flattened ELL indices and its 128 columns and contracts
-
-    out[1, bd] += rv[1, R] @ onehot[R, bd]
-
-accumulating across the row-tile grid dimension (TPU grids iterate
-sequentially, so ``out_ref`` accumulation over the minor grid dim is
-race-free). ELL padding slots carry index == num_features, which never
-matches a column tile in [0, d), so padding contributes nothing — the
-same sentinel trick as ops/sparse_aggregators.py, no masks in the kernel.
-
-This is a drop-in for the scatter step only; gathers (margins) already
-vectorize well. `scatter_rowterm` pads to tile multiples and slices back.
+The kernel registry (ops/kernels/registry.py, docs/KERNELS.md) owns
+every Pallas program now — the scatter that used to live here is
+ops/kernels/ell_scatter.py (registry name ``ell_scatter``), unchanged
+tile-for-tile. This module keeps the original import path and the
+original jitted ``scatter_rowterm(indices, rowterm_values, dim,
+interpret=)`` signature for its existing callers (bench.py, tests);
+production dispatch goes through the registry via
+ops/sparse_aggregators.py, which is where the flag/fallback policy
+lives. Calling this wrapper is an EXPLICIT request for the Pallas
+program (a bench lane, a parity fixture) — no flag, no fallback.
 """
 
 from __future__ import annotations
@@ -24,82 +17,17 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
+
+from photon_ml_tpu.ops.kernels.ell_scatter import (  # noqa: F401
+    _COL_TILE, _ROW_TILE, scatter_rowterm_pallas, scatter_rowterm_xla)
 
 Array = jax.Array
-
-# Column tile = one lane register width; row tile amortizes grid overhead.
-_COL_TILE = 128
-_ROW_TILE = 256
-
-
-def _kernel(idx_ref, rv_ref, out_ref, *, col_tile: int):
-    """Grid (d_tiles, n_tiles); n is the accumulation (minor) dimension.
-
-    Per cell: unrolled loop over the ELL slots, each a vectorized
-    compare + select + add on a (row_tile, col_tile) register block —
-    no unaligned reshapes (Mosaic rejects flattening (R, k) ELL blocks),
-    same multiply-accumulate count as the explicit one-hot matmul.
-    """
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    idx = idx_ref[...]  # (row_tile, max_nnz) int32
-    rv = rv_ref[...]  # (row_tile, max_nnz) f32
-    rows = idx.shape[0]
-    d0 = pl.program_id(0) * col_tile
-    cols = d0 + jax.lax.broadcasted_iota(jnp.int32, (rows, col_tile), 1)
-    acc = jnp.zeros((rows, col_tile), jnp.float32)
-    for k in range(idx.shape[1]):
-        acc += jnp.where(idx[:, k:k + 1] == cols, rv[:, k:k + 1], 0.0)
-    out_ref[...] += jnp.sum(acc, axis=0, keepdims=True)
-
-
-def _pad_axis(x, mult, axis, fill):
-    pad = (-x.shape[axis]) % mult
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths, constant_values=fill)
 
 
 @functools.partial(jax.jit, static_argnames=("dim", "interpret"))
 def scatter_rowterm(indices: Array, rowterm_values: Array, dim: int,
                     interpret: bool = False) -> Array:
-    """Σᵢ Σₖ rv[i,k] · e(indices[i,k]) into shape (dim,).
-
-    ``indices``: (n, max_nnz) int32 ELL indices (padding == any id ≥ dim).
-    ``rowterm_values``: (n, max_nnz) f32, typically r[:, None] * values.
-    """
-    n_tiles_d = -(-dim // _COL_TILE)
-    d_pad = n_tiles_d * _COL_TILE
-    # Padding rows use an index ≥ d_pad so they match no column tile.
-    idx = _pad_axis(jnp.asarray(indices, jnp.int32), _ROW_TILE, 0, d_pad)
-    rv = _pad_axis(jnp.asarray(rowterm_values, jnp.float32), _ROW_TILE, 0,
-                   0.0)
-    n_tiles_r = idx.shape[0] // _ROW_TILE
-    # Under shard_map the output varies over the same mesh axes as the
-    # inputs (each shard scatters its local rows); propagate the vma so
-    # jax's check_vma accepts the kernel.
-    try:
-        vma = jax.typeof(idx).vma | jax.typeof(rv).vma
-        out_aval = jax.ShapeDtypeStruct((1, d_pad), jnp.float32, vma=vma)
-    except (AttributeError, TypeError):
-        out_aval = jax.ShapeDtypeStruct((1, d_pad), jnp.float32)
-    out = pl.pallas_call(
-        functools.partial(_kernel, col_tile=_COL_TILE),
-        out_shape=out_aval,
-        grid=(n_tiles_d, n_tiles_r),
-        in_specs=[
-            pl.BlockSpec((_ROW_TILE, idx.shape[1]), lambda i, j: (j, 0)),
-            pl.BlockSpec((_ROW_TILE, rv.shape[1]), lambda i, j: (j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, _COL_TILE), lambda i, j: (0, i)),
-        interpret=interpret,
-    )(idx, rv)
-    return out[0, :dim]
+    """Σᵢ Σₖ rv[i,k] · e(indices[i,k]) into shape (dim,) — see
+    ops/kernels/ell_scatter.py for the kernel."""
+    return scatter_rowterm_pallas(indices, rowterm_values, dim,
+                                  interpret=interpret)

@@ -14,10 +14,15 @@ anywhere in the hot path. Zero-weight (padded) ROWS are handled by the
 weight mask exactly as in the dense aggregators.
 
 Scatter-adds lower to XLA's sort+segment machinery on TPU; for small and
-moderate coefficient dimensions the Pallas compare+accumulate kernel in
-ops/pallas_sparse.py wins (it is O(d·nnz), so XLA's scatter takes over for
-large d — the auto-dispatch below picks by dimension; set ``USE_PALLAS``
-to force either path).
+moderate coefficient dimensions the Pallas compare+accumulate kernel
+(ops/kernels/ell_scatter.py, registry name ``ell_scatter``) wins — it is
+O(d·nnz), so XLA's scatter takes over for large d. The dimension policy
+below picks the CANDIDATE; whether the Pallas program actually runs is
+the kernel registry's call (flag + backend), and a registry-level
+degradation — flag on but no TPU, or an injected ``kernel.launch`` fault
+— is LOUD (KernelFallback event + counter), unlike the silent
+TPU-backend guard this module shipped with. Set ``USE_PALLAS`` to force
+either path past the dimension policy (tests, benchmarks).
 """
 
 from __future__ import annotations
@@ -61,15 +66,23 @@ def _masked(weights: Array, term: Array) -> Array:
 
 
 def _scatter_rowterm(batch: SparseBatch, r: Array, dim: int) -> Array:
-    """Σ_i r_i · x_i as a scatter-add of r ⊗ values into (d,)."""
+    """Σ_i r_i · x_i as a scatter-add of r ⊗ values into (d,).
+
+    Dimension policy (is the O(d·nnz) kernel even a candidate?) lives
+    here; backend policy (flag, TPU vs interpret vs loud XLA fallback)
+    is the registry's. When the candidate check or the flag says XLA,
+    the inline ``.at[].add`` runs untouched — zero registry traffic, so
+    a flag-off process is byte-identical to the pre-registry tree."""
     upd = r[..., None] * batch.values
     use_pallas = USE_PALLAS
-    if use_pallas is None:
-        use_pallas = (dim <= _PALLAS_DIM_MAX
-                      and jax.default_backend() == "tpu")
-    if use_pallas:
-        from photon_ml_tpu.ops import pallas_sparse
-        return pallas_sparse.scatter_rowterm(batch.indices, upd, dim)
+    if use_pallas is None or use_pallas:
+        from photon_ml_tpu.ops import kernels
+        reg = kernels.registry()
+        if use_pallas is None:
+            use_pallas = (dim <= _PALLAS_DIM_MAX
+                          and reg.enabled("ell_scatter"))
+        if use_pallas:
+            return reg.resolve("ell_scatter")(batch.indices, upd, dim)
     flat = batch.indices.reshape(-1)
     return jnp.zeros((dim + 1,), upd.dtype).at[flat].add(
         upd.reshape(-1))[:dim]

@@ -442,23 +442,64 @@ def _masked(weights: Array, term: Array) -> Array:
     return jnp.where(weights > 0.0, weights * term, 0.0)
 
 
-def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array,
-                      offsets: Array) -> Array:
+def _resolve_stream_fused(dtype: str):
+    """(margins, rmatvec) fused-kernel resolutions for this stream's
+    programs, or None where the flag is off or the resolve degraded.
+
+    Called from the program BUILDERS only (one resolve per compiled
+    program — the one-program-per-stream invariant extends to backend
+    choice). Flag off means NO registry traffic: the ledger a flag-off
+    run writes is byte-identical to the pre-registry tree, which is what
+    keeps the trace_smoke ≤3-builds needle honest."""
+    from photon_ml_tpu.ops import kernels
+    reg = kernels.registry()
+    fused_m = fused_r = None
+    if reg.enabled("stream_margins"):
+        rk = reg.resolve("stream_margins", dtype=dtype)
+        if rk.backend == "pallas":
+            fused_m = rk
+    if reg.enabled("stream_rmatvec"):
+        rk = reg.resolve("stream_rmatvec", dtype=dtype)
+        if rk.backend == "pallas":
+            fused_r = rk
+    return fused_m, fused_r
+
+
+def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array, offsets: Array,
+                      fused_margins=None) -> Array:
     """(n,) wᵀx + offset. Hot: one MXU matvec. Cold: one 1-D gather per
     ELL slot (per-slot, 1-D — see the module docstring's layout rules).
 
     int8 dequant prologue: the per-column scales FOLD into the
     coefficient side — w·(s·q) = (w·s)·q — so the quantized codes feed
     the same matvec/gathers with f32 accumulation and no dense f32
-    block is ever materialized."""
+    block is ever materialized.
+
+    ``fused_margins`` (registry ``stream_margins``, docs/KERNELS.md):
+    the cold per-slot terms become the PROLOGUE — summed into ``base``
+    first, byte-small by the hot/cold split — and the hot tier runs as
+    one Pallas program with the dequant upcast inside the matvec tiles,
+    so even the explicit ``astype`` copy below never materializes."""
     if ch.cold_scale is not None:
         w_cold = w_pad * ch.cold_scale
         w_hot = w_pad[ch.hot_cols] * ch.hot_scale
+        if fused_margins is not None:
+            base = offsets
+            for j in range(ch.cold_cols.shape[1]):
+                base = base + w_cold[ch.cold_cols[:, j]] * \
+                    ch.cold_vals[:, j].astype(jnp.float32)
+            return fused_margins(ch.X_hot, w_hot, base)
         z = offsets + _hot_matvec(ch.X_hot.astype(jnp.float32), w_hot)
         for j in range(ch.cold_cols.shape[1]):
             z = z + w_cold[ch.cold_cols[:, j]] * \
                 ch.cold_vals[:, j].astype(jnp.float32)
         return z
+    if fused_margins is not None:
+        base = offsets
+        for j in range(ch.cold_cols.shape[1]):
+            base = base + w_pad[ch.cold_cols[:, j]] * \
+                ch.cold_vals[:, j].astype(jnp.float32)
+        return fused_margins(ch.X_hot, w_pad[ch.hot_cols], base)
     z = offsets + _hot_matvec(ch.X_hot, w_pad[ch.hot_cols])
     for j in range(ch.cold_cols.shape[1]):
         z = z + w_pad[ch.cold_cols[:, j]] * \
@@ -466,29 +507,40 @@ def _chunk_margins_of(ch: CanonicalChunk, w_pad: Array,
     return z
 
 
-def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array) -> Array:
+def _chunk_rowterm_grad(ch: CanonicalChunk, r: Array,
+                        fused_rmatvec=None) -> Array:
     """Σᵢ rᵢ·xᵢ in original space: hot rmatvec + one (d+1,)-table
     scatter-add per cold ELL slot (pad entries land on the sentinel
     column d and are dropped).
 
     int8 dequant prologue: scatter the RAW r·q sums, then scale the
     (d+1,) accumulator once per column (g_col = s_col · Σ r·q) — the
-    dequant costs O(d + H) per chunk instead of O(n·k)."""
+    dequant costs O(d + H) per chunk instead of O(n·k).
+
+    ``fused_rmatvec`` (registry ``stream_rmatvec``): the hot tier's
+    Xᵀr runs with the int8 upcast inside the tiles (no (n,H) f32 copy);
+    the O(H) scale epilogue stays out here either way."""
     if ch.cold_scale is not None:
         acc = jnp.zeros((ch.num_features + 1,), jnp.float32)
         for j in range(ch.cold_cols.shape[1]):
             acc = acc.at[ch.cold_cols[:, j]].add(
                 r * ch.cold_vals[:, j].astype(jnp.float32))
         acc = acc * ch.cold_scale
-        g_hot = _hot_rmatvec(ch.X_hot.astype(jnp.float32), r) * \
-            ch.hot_scale
+        if fused_rmatvec is not None:
+            g_hot = fused_rmatvec(ch.X_hot, r) * ch.hot_scale
+        else:
+            g_hot = _hot_rmatvec(ch.X_hot.astype(jnp.float32), r) * \
+                ch.hot_scale
         acc = acc.at[ch.hot_cols].add(g_hot.astype(jnp.float32))
         return acc[:ch.num_features]
     acc = jnp.zeros((ch.num_features + 1,), jnp.float32)
     for j in range(ch.cold_cols.shape[1]):
         acc = acc.at[ch.cold_cols[:, j]].add(
             r * ch.cold_vals[:, j].astype(jnp.float32))
-    g_hot = _hot_rmatvec(ch.X_hot, r).astype(jnp.float32)
+    if fused_rmatvec is not None:
+        g_hot = fused_rmatvec(ch.X_hot, r).astype(jnp.float32)
+    else:
+        g_hot = _hot_rmatvec(ch.X_hot, r).astype(jnp.float32)
     acc = acc.at[ch.hot_cols].add(g_hot)
     return acc[:ch.num_features]
 
@@ -527,8 +579,14 @@ def _count_kernel_hit(cache: str, dtype: str) -> None:
 
 def _chunk_value_grad(loss: PointwiseLoss, dtype: str = "float32"):
     """One jitted per-chunk pass: original-space w in, original-space
-    (value, grad) out — shared by every chunk (identical structures)."""
-    f = _VG_KERNELS.get((loss.name, dtype))
+    (value, grad) out — shared by every chunk (identical structures).
+
+    The cache key carries the resolved fused-kernel state: a flag flip
+    mid-process gets a FRESH program (and a counted build) instead of
+    silently reusing the other backend's compile."""
+    fused_m, fused_r = _resolve_stream_fused(dtype)
+    key = (loss.name, dtype, fused_m is not None, fused_r is not None)
+    f = _VG_KERNELS.get(key)
     if f is not None:
         _count_kernel_hit("stream_value_grad", dtype)
         return f
@@ -537,13 +595,13 @@ def _chunk_value_grad(loss: PointwiseLoss, dtype: str = "float32"):
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
         w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
-        z = _chunk_margins_of(ch, w_pad, offsets)
+        z = _chunk_margins_of(ch, w_pad, offsets, fused_margins=fused_m)
         l, dl = loss.loss_and_dz(z, ch.labels)
         value = jnp.sum(_masked(ch.weights, l))
         r = _masked(ch.weights, dl)
-        return value, _chunk_rowterm_grad(ch, r)
+        return value, _chunk_rowterm_grad(ch, r, fused_rmatvec=fused_r)
 
-    _VG_KERNELS[(loss.name, dtype)] = f
+    _VG_KERNELS[key] = f
     return f
 
 
@@ -554,7 +612,9 @@ def _chunk_value(loss: PointwiseLoss, dtype: str = "float32"):
     pass). Armijo line-search probes only need the value to gate
     acceptance (ADVICE r5), so probing with this kernel skips the
     gradient work on every rejected step."""
-    f = _V_KERNELS.get((loss.name, dtype))
+    fused_m, _ = _resolve_stream_fused(dtype)
+    key = (loss.name, dtype, fused_m is not None)
+    f = _V_KERNELS.get(key)
     if f is not None:
         _count_kernel_hit("stream_value_only", dtype)
         return f
@@ -563,18 +623,34 @@ def _chunk_value(loss: PointwiseLoss, dtype: str = "float32"):
     @jax.jit
     def f(w: Array, offsets: Array, ch: CanonicalChunk):
         w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
-        z = _chunk_margins_of(ch, w_pad, offsets)
+        z = _chunk_margins_of(ch, w_pad, offsets, fused_margins=fused_m)
         l, _ = loss.loss_and_dz(z, ch.labels)
         return jnp.sum(_masked(ch.weights, l))
 
-    _V_KERNELS[(loss.name, dtype)] = f
+    _V_KERNELS[key] = f
     return f
 
 
-@jax.jit
+# Margins-only programs, keyed by fused-kernel state alone (jit
+# dispatches on chunk structure/dtype within each entry — the
+# pre-registry singleton behavior, per backend).
+_MARGINS_KERNELS: dict = {}
+
+
 def _margins_kernel(w: Array, offsets: Array, ch: CanonicalChunk):
-    w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
-    return _chunk_margins_of(ch, w_pad, offsets)
+    fused_m, _ = _resolve_stream_fused(str(jnp.asarray(ch.X_hot).dtype))
+    key = fused_m is not None
+    f = _MARGINS_KERNELS.get(key)
+    if f is None:
+        @jax.jit
+        def f(w: Array, offsets: Array, ch: CanonicalChunk,
+              _fused=fused_m):
+            w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+            return _chunk_margins_of(ch, w_pad, offsets,
+                                     fused_margins=_fused)
+
+        _MARGINS_KERNELS[key] = f
+    return f(w, offsets, ch)
 
 
 def _chunk_nbytes(ch) -> int:

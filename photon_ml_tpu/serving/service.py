@@ -174,12 +174,38 @@ class ScoringService:
                        for st in self.store.random)
         mean_fn = (losses_mod.loss_for_task(self.store.task).mean
                    if self.as_mean else None)
+        # Kernel-registry resolution happens HERE, at program-build time
+        # (docs/KERNELS.md): the backend choice is baked into the jitted
+        # program, so steady state never re-decides — a flag flip needs
+        # a service rebuild, same contract as every other config knob.
+        # Flag off = no registry traffic at all; flag on but no Pallas
+        # (no TPU, injected kernel.launch fault) already emitted its
+        # loud KernelFallback inside resolve, and the inline XLA chain
+        # below runs exactly as before.
+        from photon_ml_tpu.ops import kernels
+        reg = kernels.registry()
+        fused = None
+        self._kernel_backend = "xla"
+        if random and reg.enabled("serving_score"):
+            resolved = reg.resolve("serving_score",
+                                   dtype=self.store.cache_dtype)
+            self._kernel_backend = resolved.backend
+            if resolved.backend == "pallas":
+                fused = resolved
 
         def score(mats, offsets, slots, caches, scales):
             total = jnp.asarray(offsets)
             for _cid, sid, w in fixed:
                 total = total + mats[sid] @ w
             for cid, sid, quantized in random:
+                if fused is not None:
+                    # One program per coordinate: gather + int8 dequant
+                    # + row-dot + per-row scale, codes upcast in
+                    # registers (f32 rows never hit HBM).
+                    total = total + fused(
+                        mats[sid], slots[cid], caches[cid],
+                        scales[cid] if quantized else None)
+                    continue
                 rows = caches[cid][slots[cid]]
                 if quantized:
                     # int8 device cache: gather the codes, accumulate
@@ -262,9 +288,13 @@ class ScoringService:
                 self._compile_keys.add(padded)
                 self.metrics.record_compile()
                 if mx is not None:
+                    # backend= records which kernel the program scores
+                    # through (docs/KERNELS.md) — "xla" both when the
+                    # flag is off and when a resolve degraded loudly.
                     mx.counter("photon_compile_cache_misses_total",
                                cache="serving_score",
-                               dtype=self.store.cache_dtype).inc()
+                               dtype=self.store.cache_dtype,
+                               backend=self._kernel_backend).inc()
             elif mx is not None:
                 # The hit side of the program-cache ledger: a warm boot
                 # whose warmup re-runs already-owned bucket shapes shows
@@ -272,7 +302,8 @@ class ScoringService:
                 # restart").
                 mx.counter("photon_compile_cache_hits_total",
                            cache="serving_score",
-                           dtype=self.store.cache_dtype).inc()
+                           dtype=self.store.cache_dtype,
+                           backend=self._kernel_backend).inc()
             t_d0 = time.monotonic()  # device: dispatch + block on result
             out = self._score_fn(mats, offsets, slots_full,
                                  self.store.caches(),

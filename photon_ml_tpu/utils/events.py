@@ -177,6 +177,22 @@ class IngestFallback(Event):
 
 
 @dataclasses.dataclass(frozen=True)
+class KernelFallback(Event):
+    """A registered fused kernel (ops/kernels) degraded to its XLA
+    fallback closure instead of the Pallas program the flag asked for —
+    the kernel-registry analog of IngestFallback's loud-degradation
+    discipline. ``kernel`` is the registry name, ``backend`` the backend
+    the resolve actually landed on ("xla"), ``reason`` why (no TPU,
+    injected kernel.launch fault, ...). The obs bridge turns this into
+    ``photon_kernel_fallbacks_total{kernel=...}`` + a timeline instant;
+    a silent fallback would let a flagged perf win quietly evaporate."""
+
+    kernel: str
+    backend: str
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointRecovered(Event):
     """A corrupted checkpoint artifact failed its CRC and the manager
     fell back to the previous committed generation (game/checkpoint.py).

@@ -748,7 +748,8 @@ def test_rule_catalog_is_complete():
     from photon_ml_tpu.analysis.rules import PROJECT_RULES
 
     assert sorted(ALL_RULES) == \
-        [f"PML00{i}" for i in range(1, 10)] + ["PML010", "PML011"]
+        [f"PML00{i}" for i in range(1, 10)] + ["PML010", "PML011",
+                                               "PML017"]
     assert sorted(PROJECT_RULES) == \
         ["PML012", "PML013", "PML014", "PML015", "PML016"]
     assert not set(ALL_RULES) & set(PROJECT_RULES)
@@ -1002,6 +1003,44 @@ def test_pml011_clean_on_real_router_and_supervisor():
         with open(os.path.join(REPO, rel)) as f:
             ctx = ModuleContext.parse(rel, f.read())
         assert ALL_RULES["PML011"][0](ctx) == [], rel
+
+
+# ---------------------------------------------------------------- PML017
+
+
+def test_pml017_flags_pallas_call_outside_kernels():
+    src = """
+        import jax.experimental.pallas as pl
+
+        def scatter(idx, vals):
+            return pl.pallas_call(_kernel, out_shape=None)(idx, vals)
+    """
+    ctx = ModuleContext.parse("photon_ml_tpu/ops/hot_path.py",
+                              textwrap.dedent(src))
+    out = ALL_RULES["PML017"][0](ctx)
+    assert len(out) == 1 and out[0].rule == "PML017"
+    assert "ops/kernels" in out[0].message
+
+
+def test_pml017_clean_inside_kernel_home_and_on_real_modules():
+    src = """
+        import jax.experimental.pallas as pl
+
+        def scatter(idx, vals):
+            return pl.pallas_call(_kernel, out_shape=None)(idx, vals)
+    """
+    ctx = ModuleContext.parse(
+        "photon_ml_tpu/ops/kernels/ell_scatter.py", textwrap.dedent(src))
+    assert ALL_RULES["PML017"][0](ctx) == []
+    # The registry seam holds on the real tree: every module that
+    # launches Pallas lives in ops/kernels/ (the shim re-exports only).
+    for rel in ("photon_ml_tpu/ops/pallas_sparse.py",
+                "photon_ml_tpu/ops/sparse_aggregators.py",
+                "photon_ml_tpu/ops/streaming_sparse.py",
+                "photon_ml_tpu/serving/service.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            ctx = ModuleContext.parse(rel, f.read())
+        assert ALL_RULES["PML017"][0](ctx) == [], rel
 
 
 # =================================================== project graph (PR 11)
