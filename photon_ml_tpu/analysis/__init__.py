@@ -24,6 +24,8 @@ symbol table + call graph + cached per-file summaries):
 - PML014  string-registry drift (fault sites, metrics, spans, events)
 - PML015  cross-class callbacks writing shared state off-thread
 - PML016  resource lifecycle (subprocess/socket/server/pool leaks)
+- PML018  lock-order cycles in the global lock graph (photon-lockdep)
+- PML019  blocking calls reached while a lock is held
 
 Entry points: the ``photon-lint`` console script (cli/lint.py), or
 ``lint_paths()`` here. Pure stdlib — no JAX import, repo-wide in
@@ -36,6 +38,7 @@ from photon_ml_tpu.analysis.baseline import (BaselineEntry, DEFAULT_BASELINE,
 from photon_ml_tpu.analysis.engine import (LintResult, iter_python_files,
                                            lint_file, lint_paths)
 from photon_ml_tpu.analysis.findings import Finding, fingerprint_findings
+from photon_ml_tpu.analysis.locks import (lock_graph_json, reconcile)
 from photon_ml_tpu.analysis.project import (DEFAULT_CACHE, ProjectCache,
                                             ProjectGraph, build_catalog,
                                             summarize_file)
@@ -46,5 +49,6 @@ __all__ = [
     "Finding", "LintResult", "PROJECT_RULES", "ProjectCache",
     "ProjectGraph", "build_catalog", "entries_from_findings",
     "fingerprint_findings", "iter_python_files", "lint_file",
-    "lint_paths", "load_baseline", "save_baseline", "summarize_file",
+    "lint_paths", "load_baseline", "lock_graph_json", "reconcile",
+    "save_baseline", "summarize_file",
 ]

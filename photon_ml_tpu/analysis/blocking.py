@@ -1,0 +1,124 @@
+"""The ONE blocking-call predicate shared by PML011 and PML019.
+
+PML011 (per-file: blocking network call without a timeout) and PML019
+(whole-program: blocking call reached while a lock is held) care about
+the same call shapes — HTTP/socket primitives, ``Future.result()``,
+``Popen.wait()``, ``queue.get()``, ``time.sleep`` — but from different
+angles: PML011 asks "is the hang bounded?", PML019 asks "does a lock
+holder pay for it?". Keeping two copies of the shape/timeout tables was
+exactly the drift PML014 exists to prevent, so both rules classify
+through :func:`classify_call` and share :data:`NET_CALLS`.
+
+Timeout semantics (``TimeoutState``): a call site reports its
+``timeout=`` keyword as ``"finite"`` (present, not the literal
+``None``), ``"none"`` (the literal ``None`` — explicitly unbounded) or
+``""`` (absent). Positional timeouts are recognized per call shape
+(``Future.result(5)``, ``queue.get(True, 5)``, ``Popen.wait(5)``, and
+the network table's per-callee positions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# call leaf → (dotted-suffix requirements, positional index of timeout).
+# A call matches when its dotted name ends with one of the suffixes;
+# bare leaves like ``get`` never match the NETWORK table without their
+# module base (or ``dict.get`` would light up the repo).
+NET_CALLS = {
+    "urlopen": (("urllib.request.urlopen", "request.urlopen",
+                 "urlopen"), 2),
+    "create_connection": (("socket.create_connection",), 1),
+    "HTTPConnection": (("http.client.HTTPConnection",
+                        "client.HTTPConnection"), 2),
+    "HTTPSConnection": (("http.client.HTTPSConnection",
+                         "client.HTTPSConnection"), 2),
+    "get": (("requests.get",), None),
+    "post": (("requests.post",), None),
+    "put": (("requests.put",), None),
+    "delete": (("requests.delete",), None),
+    "head": (("requests.head",), None),
+    "request": (("requests.request",), None),
+}
+
+# Waiting primitives: leaf → positional index of their timeout argument.
+# ``result`` is Future.result(timeout=None); ``wait`` is Popen/Event/
+# Condition/Thread-shaped (a Condition.wait on the HELD lock releases it
+# — PML019 exempts that case by receiver, see locks.py); ``get`` is
+# queue.Queue.get(block=True, timeout=None) — matched only with ZERO
+# positional args so ``dict.get(key)`` never trips it.
+WAIT_CALLS = {"result": 0, "wait": 0, "get": 1, "join": 0}
+
+# time.sleep: bounded by construction but still a deliberate stall —
+# PML019 flags it under a lock regardless (every waiter inherits the
+# nap); PML011 does not care about it.
+SLEEP_SUFFIXES = ("time.sleep", "sleep")
+
+# Device-sync leafs that block the host on the accelerator (the flush
+# path's np.asarray(...)-style casts are caught by taint in project.py;
+# these names block by NAME regardless of taint).
+SYNC_LEAFS = {"block_until_ready", "device_get"}
+
+
+def net_spec(name: str):
+    """(suffixes, timeout_pos) when ``name`` is a known blocking network
+    callable, else None."""
+    leaf = name.rsplit(".", 1)[-1]
+    spec = NET_CALLS.get(leaf)
+    if spec is None:
+        return None
+    suffixes, pos = spec
+    if not any(name == s or name.endswith("." + s) for s in suffixes):
+        return None
+    return suffixes, pos
+
+
+def classify_call(name: str, arg_count: int, kwarg_names: list,
+                  timeout_state: str
+                  ) -> Optional[tuple[str, bool]]:
+    """(kind, bounded) for a blocking-shaped call, else None.
+
+    kinds: ``net`` (HTTP/socket), ``sleep``, ``result``
+    (Future.result), ``wait`` (Popen/Event/Condition/Thread),
+    ``queue_get``, ``sync`` (device sync by name). ``bounded`` means a
+    finite timeout rode along (positionally or by keyword) — the shared
+    exemption predicate PML019's "timeout-carrying call" rule and
+    PML011's timeout detection both apply.
+    """
+    leaf = name.rsplit(".", 1)[-1]
+    spec = net_spec(name)
+    if spec is not None:
+        _suffixes, pos = spec
+        bounded = timeout_state == "finite" \
+            or (pos is not None and arg_count > pos)
+        return "net", bounded
+    if name in SLEEP_SUFFIXES or any(
+            name.endswith("." + s) for s in ("time.sleep",)):
+        return "sleep", True  # bounded, but a stall every waiter inherits
+    if leaf in SYNC_LEAFS and "." in name:
+        return "sync", False
+    if leaf == "result" and "." in name:
+        bounded = timeout_state == "finite" \
+            or (arg_count > WAIT_CALLS["result"])
+        return "result", bounded
+    if leaf in ("wait", "join") and "." in name:
+        bounded = timeout_state == "finite" \
+            or (arg_count > WAIT_CALLS["wait"])
+        return "wait", bounded
+    if leaf == "get" and "." in name and arg_count == 0:
+        # queue.Queue.get() only ever takes (block, timeout); a
+        # positional arg means dict/mapping .get — not blocking.
+        bounded = timeout_state == "finite"
+        return "queue_get", bounded
+    return None
+
+
+def kind_label(kind: str) -> str:
+    return {
+        "net": "network call",
+        "sleep": "sleep",
+        "result": "Future.result()",
+        "wait": "wait()",
+        "queue_get": "queue.get()",
+        "sync": "host-device sync",
+    }.get(kind, kind)

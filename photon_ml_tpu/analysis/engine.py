@@ -29,6 +29,7 @@ import os
 from typing import Iterable, Optional
 
 from photon_ml_tpu.analysis import baseline as bl
+from photon_ml_tpu.analysis import locks as lk
 from photon_ml_tpu.analysis import project as pj
 from photon_ml_tpu.analysis.context import ModuleContext
 from photon_ml_tpu.analysis.findings import Finding
@@ -55,6 +56,7 @@ class LintResult:
     cache_hits: int = 0
     cache_misses: int = 0
     catalog: Optional[dict] = None  # built on demand (CLI --catalog)
+    lock_graph: Optional[dict] = None  # built on demand (CLI --locks)
 
     @property
     def exit_code(self) -> int:
@@ -180,7 +182,8 @@ def lint_paths(paths: Iterable[str],
                project: bool = True,
                cache_path: Optional[str] = None,
                package_prefix: str = "photon_ml_tpu",
-               want_catalog: bool = False) -> LintResult:
+               want_catalog: bool = False,
+               want_locks: bool = False) -> LintResult:
     requested = iter_python_files(paths)
     graph_files = list(requested)
     if project and os.path.isdir(package_prefix):
@@ -228,7 +231,7 @@ def lint_paths(paths: Iterable[str],
             unused_candidates.extend(unused)
 
     graph = pj.ProjectGraph(summaries, package_prefix=package_prefix) \
-        if (project or want_catalog) else None
+        if (project or want_catalog or want_locks) else None
 
     project_findings: list[Finding] = []
     if project and graph is not None:
@@ -259,6 +262,16 @@ def lint_paths(paths: Iterable[str],
                                 or f.path == "<project>"):
                 kept_project.append(f)
         findings.extend(kept_project)
+        # One finding per site: when PML019 (blocking under a lock) and
+        # PML011 (blocking without a timeout) land on the same line, the
+        # lock finding subsumes the timeout one — same call, and the
+        # lock context is the sharper diagnosis.
+        lock_sites = {(f.path, f.line) for f in findings
+                      if f.rule == "PML019"}
+        if lock_sites:
+            findings = [f for f in findings
+                        if f.rule != "PML011"
+                        or (f.path, f.line) not in lock_sites]
         # A suppression the per-file pass left unused may have just been
         # consumed by a project finding.
         unused_candidates = [
@@ -283,6 +296,8 @@ def lint_paths(paths: Iterable[str],
                         cache_misses=cache.misses if cache else 0)
     if want_catalog and graph is not None:
         result.catalog = pj.build_catalog(graph)
+    if want_locks and graph is not None:
+        result.lock_graph = lk.lock_graph_json(graph)
     if baseline_path and os.path.exists(baseline_path):
         entries = bl.load_baseline(baseline_path)
         res = bl.apply_baseline(result.findings, entries, baseline_path)

@@ -1,0 +1,585 @@
+"""PML018/PML019 — the static half of photon-lockdep.
+
+The serving/publish stack is hand-rolled threads (MicroBatcher flush
+loops, ReplicaSupervisor monitors, the fleet's publish ladder), which
+means the two deadlock shapes Spark gave the reference for free are
+ours to prove absent: lock-ORDER cycles (thread 1 takes A then B,
+thread 2 takes B then A) and blocking-UNDER-lock (a lock held across
+HTTP, ``Future.result()``, a sleep, or a host-device sync turns one
+slow call into a convoy).
+
+Both are whole-program properties. A single file shows ``with
+self._lock:`` around an innocuous-looking ``self._post(...)``; only the
+project graph knows ``_post`` is an HTTP round trip three modules away.
+So this module builds a **global lock graph** over the FileSummary pass
+PR 11 pays for anyway:
+
+- **nodes** are lock objects the summaries can name: ``self.X``
+  attributes whose constructor is ``threading.Lock/RLock/Condition``
+  (node id ``{module}.{Class}.{X}``) and module-level ``NAME =
+  threading.Lock()`` constants (``{module}.{NAME}``).
+- **edges** A→B mean "some thread acquires B while holding A": either
+  lexically (nested ``with``), or through the call graph (a call made
+  under A reaches a function that acquires B — closed over
+  ``may_acquire`` by bounded fixpoint, witness chains kept), or through
+  a **callback handoff** (``Supervisor(on_death=self._m)``: the
+  supervisor's monitor invokes the stored attr under its own lock, so
+  the edge starts at the supervisor's lock and lands on whatever ``_m``
+  acquires — the same constructor-param plumbing PML015 uses).
+
+**PML018** reports every non-trivial strongly-connected component (a
+cycle = an interleaving away from deadlock) with the witness chain of
+each participating edge, plus re-entrant self-acquisition of a
+non-reentrant lock type. **PML019** reports a blocking call reached —
+via the graph — while any lock is held, one finding per
+(function, lock, kind), with the exemptions and the hot-path severity
+split below. The blocking-call *shapes* live in
+:mod:`photon_ml_tpu.analysis.blocking`, shared with PML011 so the two
+rules can never drift on what "has a timeout" means.
+
+Exemptions (conservative: silence over a wrong edge, PR 11 doctrine):
+
+- ``result``/``wait``/``queue.get`` carrying a finite timeout are
+  bounded stalls — exempt.
+- ``cond.wait()`` while HOLDING ``cond`` releases the lock for the
+  duration — exempt for that lock (the MicroBatcher idiom). The wait
+  still blocks any *other* lock held above it; that case is only
+  reached through a caller edge and is deliberately not modeled.
+- network calls are flagged even with a timeout (every waiter inherits
+  the round trip); the message says which case you're in.
+
+The runtime half (:mod:`photon_ml_tpu.utils.lockdep`) observes the real
+acquisition DAG under tests; :func:`reconcile` diffs the two —
+runtime-only edges are resolver gaps, static-only edges are coverage
+debt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.analysis.blocking import classify_call, kind_label
+from photon_ml_tpu.analysis.findings import Finding
+from photon_ml_tpu.analysis.project import (FileSummary, FunctionSummary,
+                                            ProjectGraph)
+
+# Locks on the per-request scoring path: a blocking call under one of
+# these stalls every scorer fleet-wide, not just a background thread.
+# Matched by node-id suffix so the split survives module moves.
+HOT_LOCK_SUFFIXES = ("ScoringService._lock", "ResidentModelStore._lock")
+
+# Lock types whose self-edges are legal re-entry. threading.Condition's
+# default inner lock is an RLock, so ``with c: ... with c:`` is safe.
+_REENTRANT_TYPES = {"RLock", "Condition"}
+
+_MAX_CHAIN = 8       # witness chains deeper than this stop growing
+_FIXPOINT_ROUNDS = 6  # call-graph depth bound (PML012 precedent)
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """One ordered acquisition A→B with its first-found witness."""
+
+    src: str
+    dst: str
+    path: str      # file of the witnessing acquire/call site
+    line: int
+    witness: list  # call-chain strings, outermost frame first
+
+
+@dataclasses.dataclass
+class LockAnalysis:
+    nodes: dict    # lock id -> type leaf ("Lock"/"RLock"/"Condition")
+    edges: dict    # (src, dst) -> LockEdge
+    blocked: list  # [(fs, qname, lock_id, kind, bounded, chain, line)]
+
+
+# ------------------------------------------------------------ resolution
+
+
+def _lock_id(fs: FileSummary, qname: str, cand: str) -> Optional[str]:
+    """A held-candidate name ("self.X" / bare NAME) resolved to a lock
+    node id in the defining scope, or None when it isn't a lock."""
+    if cand.startswith("self."):
+        if "." not in qname:
+            return None
+        cls_name = qname.split(".", 1)[0]
+        attr = cand.split(".", 1)[1]
+        cls = fs.classes.get(cls_name)
+        if cls is not None and attr in cls.lock_attrs:
+            return f"{fs.module}.{cls_name}.{attr}"
+        return None
+    if cand in fs.module_locks:
+        return f"{fs.module}.{cand}"
+    return None
+
+
+def _callback_map(graph: ProjectGraph, files: list) -> dict:
+    """(path, class, attr) -> [(callee_path, callee_qname)] for every
+    ``Target(param=self.m)`` constructor handoff where Target stores
+    ``param`` on ``attr`` — so Target's own ``self.attr(...)`` sites
+    resolve to the caller's bound method (PML015's seam, reused here so
+    a lock held around the invocation flows into the callback)."""
+    out: dict = {}
+    for fs in files:
+        for qname, fn in fs.functions.items():
+            if "." not in qname:
+                continue
+            caller_cls = qname.split(".", 1)[0]
+            for c in fn.calls:
+                if not c.selfattr_args and not c.selfattr_kwargs:
+                    continue
+                rc = graph.resolve_class(fs, c.name)
+                if rc is None:
+                    continue
+                tfs, tcls = rc
+                param_attr: dict = {}
+                for m in tcls.methods.values():
+                    for p, attr in m.stores_params.items():
+                        param_attr[p] = attr
+                hooked = []
+                for kw, cattr in c.selfattr_kwargs.items():
+                    if kw in param_attr \
+                            and f"{caller_cls}.{cattr}" in fs.functions:
+                        hooked.append((param_attr[kw], cattr))
+                for pos_s, cattr in c.selfattr_args.items():
+                    pos = int(pos_s)
+                    if pos < len(tcls.init_params):
+                        p = tcls.init_params[pos]
+                        if p in param_attr \
+                                and f"{caller_cls}.{cattr}" in fs.functions:
+                            hooked.append((param_attr[p], cattr))
+                for tattr, cattr in hooked:
+                    out.setdefault((tfs.path, tcls.name, tattr), []) \
+                        .append((fs.path, f"{caller_cls}.{cattr}"))
+    return out
+
+
+# Generic verbs that exist on file handles, threads, futures, sockets
+# and half the stdlib: the unique-method-leaf fallback must never guess
+# an edge from one (``self._fh.flush()`` landing on RunLedger.flush()
+# would fabricate a deadlock). Lock analysis prefers a missed edge —
+# the runtime validator exists to catch those — over a fabricated one.
+_GENERIC_LEAFS = {"flush", "close", "join", "wait", "get", "put",
+                  "result", "acquire", "release", "start", "stop",
+                  "run", "send", "recv", "read", "write", "open",
+                  "item", "clear", "pop", "append", "update", "copy",
+                  "shutdown", "submit", "cancel", "set"}
+
+
+def _attr_types(graph: ProjectGraph, files: list) -> dict:
+    """(path, class, attr) -> (path, class) for every ``self.attr =
+    SomeProjectClass(...)`` constructor assignment — the receiver-type
+    facts that let ``self.attr.method()`` resolve precisely instead of
+    by leaf-name guessing."""
+    out: dict = {}
+    for fs in files:
+        for qname, fn in fs.functions.items():
+            if "." not in qname:
+                continue
+            cls = qname.split(".", 1)[0]
+            for c in fn.calls:
+                if not c.binding.startswith("self:"):
+                    continue
+                rc = graph.resolve_class(fs, c.name)
+                if rc is None:
+                    continue
+                tfs, tcls = rc
+                attr = c.binding.split(":", 1)[1]
+                out[(fs.path, cls, attr)] = (tfs.path, tcls.name)
+    return out
+
+
+def _call_targets(graph: ProjectGraph, fs: FileSummary, c, qname: str,
+                  callbacks: dict, attr_types: dict) -> list:
+    """Every (path, qname) a call site may land on. Stricter than
+    ``ProjectGraph.resolve_call``: its unique-method-leaf fallback is
+    fine when a missed edge merely silences a finding (PML012), but
+    here a WRONG edge fabricates a deadlock — so external-alias
+    receivers never fall back, ``self.attr.method()`` resolves only
+    through a known constructor assignment, and generic verb leafs
+    never resolve by uniqueness."""
+    parts = c.name.split(".")
+    if parts[0] == "self":
+        if len(parts) == 2:
+            r = graph.resolve_call(fs, c, caller=qname)
+            if r is not None:
+                return [(r[0].path, r[1].name)]
+            if "." in qname:
+                cls = qname.split(".", 1)[0]
+                return list(callbacks.get((fs.path, cls, parts[1]), ()))
+            return []
+        if len(parts) == 3 and "." in qname:
+            cls = qname.split(".", 1)[0]
+            t = attr_types.get((fs.path, cls, parts[1]))
+            if t is not None:
+                tpath, tcls = t
+                q = f"{tcls}.{parts[2]}"
+                tfs = graph.files.get(tpath)
+                if tfs is not None and q in tfs.functions:
+                    return [(tpath, q)]
+        return []
+    if len(parts) == 1:
+        r = graph.resolve_call(fs, c, caller=qname)
+        return [(r[0].path, r[1].name)] if r is not None else []
+    if parts[0] in fs.imports:
+        target = fs.imports[parts[0]]
+        roots = {m.split(".", 1)[0] for m in graph.modules}
+        if target.split(".", 1)[0] not in roots:
+            return []  # external library: never guess an edge
+        # Imported-Class.method resolves precisely through the class.
+        if len(parts) == 2:
+            rc = graph.resolve_class(fs, parts[0])
+            if rc is not None:
+                tfs, tcls = rc
+                q = f"{tcls.name}.{parts[1]}"
+                if q in tfs.functions:
+                    return [(tfs.path, q)]
+        r = graph.resolve_call(fs, c, caller=qname)
+        if r is not None:
+            return [(r[0].path, r[1].name)]
+        return []
+    # Local-variable receiver: allow the unique-leaf fallback, but
+    # never for generic verbs.
+    if parts[-1] in _GENERIC_LEAFS:
+        return []
+    r = graph.resolve_call(fs, c, caller=qname)
+    return [(r[0].path, r[1].name)] if r is not None else []
+
+
+# ------------------------------------------------------------- the build
+
+
+def _classify_site(c) -> Optional[tuple]:
+    """(kind, bounded) when this call site blocks, else None — device
+    syncs by taint (marked during summarization) or by shared-predicate
+    shape, with the timeout/cond-wait exemptions applied."""
+    if c.blocking_kind == "sync":
+        return "sync", False
+    b = classify_call(c.name, c.arg_count, list(c.kwarg_names),
+                      c.timeout_state)
+    if b is None:
+        return None
+    kind, bounded = b
+    if kind in ("result", "wait", "queue_get") and bounded:
+        return None  # a finite timeout bounds the stall
+    if kind == "wait":
+        receiver = c.name.rsplit(".", 1)[0]
+        if receiver in c.held:
+            return None  # cond.wait() RELEASES the held condition
+    return kind, bounded
+
+
+def _build(graph: ProjectGraph) -> LockAnalysis:
+    files = sorted(graph.package_files(), key=lambda fs: fs.path)
+
+    nodes: dict = {}
+    for fs in files:
+        for cname in sorted(fs.classes):
+            cls = fs.classes[cname]
+            for attr in sorted(cls.lock_types):
+                nodes[f"{fs.module}.{cname}.{attr}"] = \
+                    cls.lock_types[attr]
+        for name in sorted(fs.module_locks):
+            nodes[f"{fs.module}.{name}"] = fs.module_locks[name]
+
+    callbacks = _callback_map(graph, files)
+    attr_types = _attr_types(graph, files)
+
+    fkeys: dict = {}
+    for fs in files:
+        for qname, fn in fs.functions.items():
+            fkeys[(fs.path, qname)] = (fs, fn)
+
+    calls = []  # (fs, qname, fn, call, [target keys])
+    for fs in files:
+        for qname, fn in fs.functions.items():
+            for c in fn.calls:
+                tkeys = [t for t in _call_targets(graph, fs, c, qname,
+                                                  callbacks, attr_types)
+                         if t in fkeys]
+                calls.append((fs, qname, fn, c, tkeys))
+
+    edges: dict = {}
+
+    def add_edge(src: str, dst: str, path: str, line: int,
+                 witness: list) -> None:
+        if (src, dst) not in edges:
+            edges[(src, dst)] = LockEdge(src, dst, path, line,
+                                         list(witness))
+
+    # Direct acquisitions: may_acquire seeds + lexical nesting edges.
+    may_acquire: dict = {k: {} for k in fkeys}
+    for key in sorted(fkeys):
+        fs, fn = fkeys[key]
+        path, qname = key
+        for name, line, held in fn.acquires:
+            lock = _lock_id(fs, qname, name)
+            if lock is None:
+                continue
+            ma = may_acquire[key]
+            if lock not in ma:
+                ma[lock] = [f"{path}:{line} {qname}() acquires {lock}"]
+            for h in held:
+                hid = _lock_id(fs, qname, h)
+                if hid is None:
+                    continue
+                add_edge(hid, lock, path, line,
+                         [f"{path}:{line} {qname}() acquires {lock} "
+                          f"while holding {hid}"])
+
+    # Close may_acquire over the call graph (witness chains ride along).
+    for _ in range(_FIXPOINT_ROUNDS):
+        changed = False
+        for fs, qname, fn, c, tkeys in calls:
+            k = (fs.path, qname)
+            for tkey in tkeys:
+                for lock, chain in list(may_acquire[tkey].items()):
+                    if lock not in may_acquire[k] \
+                            and len(chain) < _MAX_CHAIN:
+                        may_acquire[k][lock] = \
+                            [f"{fs.path}:{c.line} {qname}() -> "
+                             f"{tkey[1]}()"] + chain
+                        changed = True
+        if not changed:
+            break
+
+    # Cross-function edges: a call made under H reaching an acquire of L.
+    for fs, qname, fn, c, tkeys in calls:
+        held_ids = [hid for h in c.held
+                    if (hid := _lock_id(fs, qname, h)) is not None]
+        if not held_ids:
+            continue
+        for tkey in tkeys:
+            for lock, chain in may_acquire[tkey].items():
+                for hid in held_ids:
+                    add_edge(hid, lock, fs.path, c.line,
+                             [f"{fs.path}:{c.line} {qname}() holds "
+                              f"{hid}, calls {tkey[1]}()"] + chain)
+
+    # may_block: which blocking behaviors a call into f can reach.
+    may_block: dict = {k: {} for k in fkeys}
+    for key in sorted(fkeys):
+        fs, fn = fkeys[key]
+        path, qname = key
+        for c in fn.calls:
+            b = _classify_site(c)
+            if b is None:
+                continue
+            kind, bounded = b
+            if kind not in may_block[key]:
+                may_block[key][kind] = (
+                    bounded,
+                    [f"{path}:{c.line} {qname}() — "
+                     f"{kind_label(kind)} ({c.name})"],
+                    c.line)
+    for _ in range(_FIXPOINT_ROUNDS):
+        changed = False
+        for fs, qname, fn, c, tkeys in calls:
+            k = (fs.path, qname)
+            for tkey in tkeys:
+                for kind, (bounded, chain, line) in \
+                        list(may_block[tkey].items()):
+                    if kind not in may_block[k] \
+                            and len(chain) < _MAX_CHAIN:
+                        may_block[k][kind] = (
+                            bounded,
+                            [f"{fs.path}:{c.line} {qname}() -> "
+                             f"{tkey[1]}()"] + chain,
+                            line)
+                        changed = True
+        if not changed:
+            break
+
+    # Blocking-under-lock sites, deduped to (function, lock, kind).
+    blocked = []
+    seen: set = set()
+    for fs, qname, fn, c, tkeys in calls:
+        held_ids = [hid for h in c.held
+                    if (hid := _lock_id(fs, qname, h)) is not None]
+        if not held_ids:
+            continue
+        events = []
+        direct = _classify_site(c)
+        if direct is not None:
+            events.append((direct[0], direct[1], [], c.line))
+        for tkey in tkeys:
+            for kind, (bounded, chain, line) in \
+                    may_block[tkey].items():
+                events.append((kind, bounded, chain, c.line))
+        for kind, bounded, chain, line in events:
+            for hid in held_ids:
+                dkey = (fs.path, qname, hid, kind)
+                if dkey in seen:
+                    continue
+                seen.add(dkey)
+                blocked.append((fs, qname, hid, kind, bounded,
+                                list(chain), c.line))
+
+    return LockAnalysis(nodes=nodes, edges=edges, blocked=blocked)
+
+
+def _analysis(graph: ProjectGraph) -> LockAnalysis:
+    cached = graph.__dict__.get("_lockdep")
+    if cached is None:
+        cached = graph.__dict__["_lockdep"] = _build(graph)
+    return cached
+
+
+# ----------------------------------------------------------------- PML018
+
+
+def _sccs(nodes, edge_keys) -> list:
+    """Tarjan over the lock graph (tiny: recursion is fine)."""
+    adj: dict = {n: [] for n in nodes}
+    for s, d in edge_keys:
+        adj.setdefault(s, []).append(d)
+        adj.setdefault(d, [])
+    index: dict = {}
+    low: dict = {}
+    stack: list = []
+    on: set = set()
+    out: list = []
+    counter = [0]
+
+    def strong(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def check_lock_order(graph: ProjectGraph) -> list[Finding]:
+    a = _analysis(graph)
+    out: list[Finding] = []
+    for comp in _sccs(a.nodes, a.edges.keys()):
+        if len(comp) < 2:
+            continue
+        internal = sorted(
+            (e for (s, d), e in a.edges.items()
+             if s in comp and d in comp),
+            key=lambda e: (e.path, e.line, e.src, e.dst))
+        anchor = internal[0]
+        legs = "; ".join(
+            f"{e.src} -> {e.dst} (witness: "
+            f"{' | '.join(e.witness[:3])})" for e in internal[:4])
+        out.append(Finding(
+            rule="PML018", path=anchor.path, line=anchor.line, col=0,
+            message=(
+                f"lock-order cycle among "
+                f"{{{', '.join(comp)}}} — two threads walking opposite "
+                f"legs deadlock: {legs}")))
+    for (s, d), e in sorted(a.edges.items()):
+        if s == d and a.nodes.get(s) not in _REENTRANT_TYPES:
+            out.append(Finding(
+                rule="PML018", path=e.path, line=e.line, col=0,
+                message=(
+                    f"re-entrant acquisition of non-reentrant lock "
+                    f"{s} ({a.nodes.get(s, 'Lock')}) — "
+                    f"{' | '.join(e.witness[:3])} — the second acquire "
+                    f"deadlocks the holding thread")))
+    out.sort(key=lambda f: (f.path, f.line, f.message))
+    return out
+
+
+# ----------------------------------------------------------------- PML019
+
+
+def check_blocking_under_lock(graph: ProjectGraph) -> list[Finding]:
+    a = _analysis(graph)
+    out: list[Finding] = []
+    for fs, qname, lock, kind, bounded, chain, line in a.blocked:
+        hot = any(lock.endswith(s) for s in HOT_LOCK_SUFFIXES)
+        label = kind_label(kind)
+        if chain:
+            body = (f"{qname}() holds {lock} across a call that "
+                    f"reaches a {label} "
+                    f"({' | '.join(chain[:4])})")
+        else:
+            body = (f"{qname}() makes a {label} while holding {lock}")
+        if kind == "net":
+            body += (" — the timeout bounds the stall but every waiter "
+                     "still pays the round trip" if bounded
+                     else " — with NO timeout: one hung peer wedges "
+                          "every thread behind this lock")
+        elif kind in ("result", "wait", "queue_get"):
+            body += " — unbounded"
+        if hot:
+            body += (" [hot-path lock: the scoring fleet serializes "
+                     "behind it]")
+        out.append(Finding(rule="PML019", path=fs.path, line=line,
+                           col=0, message=body))
+    out.sort(key=lambda f: (f.path, f.line, f.message))
+    return out
+
+
+# ------------------------------------------------- artifact + reconcile
+
+
+def lock_graph_json(graph: ProjectGraph) -> dict:
+    """The ``photon-lint --locks`` payload: deterministic node/edge
+    dump, diffable in review and consumed by :func:`reconcile`."""
+    a = _analysis(graph)
+    return {
+        "version": 1,
+        "nodes": [{"id": n, "type": a.nodes[n]}
+                  for n in sorted(a.nodes)],
+        "edges": [{"src": e.src, "dst": e.dst, "path": e.path,
+                   "line": e.line, "witness": e.witness}
+                  for (s, d), e in sorted(a.edges.items())],
+    }
+
+
+def reconcile(static_doc: dict, runtime_doc: dict,
+              allow_gaps: tuple = ()) -> dict:
+    """Diff the static lock graph against a runtime ``.photon-lockdep
+    .json`` dump. Runtime-only edges = the resolver missed a real
+    acquisition path (fix the analysis, or list the edge in
+    ``allow_gaps`` as "src -> dst" with a tracked reason); static-only
+    edges = paths no test exercises (coverage debt, reported not
+    failed)."""
+
+    def norm(g: str) -> tuple:
+        s, _, d = g.partition("->")
+        return s.strip(), d.strip()
+
+    allowed = {norm(g) for g in allow_gaps}
+    s_edges = {(e["src"], e["dst"])
+               for e in static_doc.get("edges", [])}
+    r_edges = {(e["src"], e["dst"])
+               for e in runtime_doc.get("edges", [])}
+    runtime_only = sorted(r_edges - s_edges)
+    gaps = [e for e in runtime_only if e not in allowed]
+    inversions = runtime_doc.get("inversions", [])
+    return {
+        "runtime_only": [f"{s} -> {d}" for s, d in runtime_only],
+        "resolver_gaps": [f"{s} -> {d}" for s, d in gaps],
+        "allowed_gaps": sorted(
+            f"{s} -> {d}"
+            for s, d in set(runtime_only) & allowed),
+        "unexercised": sorted(
+            f"{s} -> {d}" for s, d in s_edges - r_edges),
+        "inversions": len(inversions),
+        "ok": not gaps and not inversions,
+    }

@@ -84,6 +84,15 @@ class CallSite:
     # ^ kwarg name -> "attr" for ``kw=self.attr`` arguments
     arg_count: int = 0
     kwarg_names: list = dataclasses.field(default_factory=list)
+    # Lock context (PML018/PML019): the candidate lock names held when
+    # this call runs ("self.attr" / bare module-level NAME — resolved
+    # against class lock_attrs / module_locks by analysis/locks.py),
+    # the call's ``timeout=`` keyword state ("finite"/"none"/"" absent),
+    # and "sync" when this site host-syncs a device value (taint-aware,
+    # computed where the sync subject is known).
+    held: list = dataclasses.field(default_factory=list)
+    timeout_state: str = ""
+    blocking_kind: str = ""
     # Result binding (PML016): how the call's value is held.
     binding: str = "bare"   # "local:<n>" | "self:<attr>" | "other" | "bare"
     with_item: bool = False
@@ -120,6 +129,11 @@ class FunctionSummary:
     writes: list       # [WriteSite]
     write_params: list  # param indices raw-written (derived from writes)
     returns_resource: bool = False
+    # Lock acquisitions (PML018): [[lock_name, line, [held...]]] — every
+    # ``with self.X:`` / ``with NAME:`` / bare ``X.acquire()`` statement,
+    # with the candidate lock names already held at that point (the
+    # intra-function nesting edges fall straight out of this).
+    acquires: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -141,6 +155,9 @@ class ClassSummary:
     lock_attrs: list
     entrypoints: list   # PML005-style worker entrypoints
     init_params: list   # __init__ params, self excluded, in order
+    lock_types: dict = dataclasses.field(default_factory=dict)
+    # ^ lock attr -> constructor leaf ("Lock"/"RLock"/"Condition") —
+    #   PML018 exempts re-entrant self-edges only for RLock.
 
 
 @dataclasses.dataclass
@@ -159,6 +176,9 @@ class FileSummary:
     event_maps: list     # [[key, line]] dict keys mapping to photon_* values
     event_compares: list  # [[literal, line, func_qname]] CamelCase == lits
     registry_constants: dict  # NAME -> value (module-level str constants)
+    module_locks: dict = dataclasses.field(default_factory=dict)
+    # ^ NAME -> lock type leaf, for module-level ``_LOCK =
+    #   threading.Lock()`` constants (lock-graph nodes like class attrs)
 
 
 def _module_name(path: str) -> str:
@@ -341,6 +361,97 @@ def _binding_annotations(body: list[ast.stmt]):
     return closed, closed_fin, returned, escapes
 
 
+def _lock_expr_name(expr: ast.AST) -> Optional[str]:
+    """The candidate lock name of a with-item / acquire receiver:
+    ``self.X`` (one level) or a bare module-level NAME. Non-lock names
+    are filtered later against class ``lock_attrs`` / file
+    ``module_locks`` — recording here is deliberately over-broad."""
+    attr = self_attribute(expr)
+    if attr is not None:
+        return f"self.{attr}"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _held_lock_map(body: list[ast.stmt]
+                   ) -> tuple[dict[int, tuple], list[list]]:
+    """(statement-id -> candidate locks held when it runs, acquire
+    sites). Tracks ``with`` nesting plus statement-level bare
+    ``X.acquire()`` / ``X.release()`` pairs within a block (the
+    try/finally idiom); nested def/class bodies are separate scopes and
+    start lock-free (their code runs when CALLED, not here)."""
+    held_map: dict[int, tuple] = {}
+    acquires: list[list] = []
+
+    def walk(stmts: list[ast.stmt], held: tuple) -> None:
+        for stmt in stmts:
+            held_map[id(stmt)] = held
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    name = _lock_expr_name(item.context_expr)
+                    if name is not None:
+                        acquires.append([name, stmt.lineno,
+                                         sorted(inner)])
+                        if name not in inner:
+                            inner = inner + (name,)
+                walk(stmt.body, inner)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.If):
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, held)
+                for h in stmt.handlers:
+                    walk(h.body, held)
+                walk(stmt.orelse, held)
+                walk(stmt.finalbody, held)
+            elif isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                name = call_func_name(stmt.value) or ""
+                if name.endswith(".acquire") and not stmt.value.args \
+                        and not stmt.value.keywords:
+                    base = name[: -len(".acquire")]
+                    base = _normalize_lock_base(base)
+                    if base is not None:
+                        acquires.append([base, stmt.lineno,
+                                         sorted(held)])
+                        if base not in held:
+                            held = held + (base,)
+                elif name.endswith(".release"):
+                    base = _normalize_lock_base(name[: -len(".release")])
+                    if base is not None:
+                        held = tuple(h for h in held if h != base)
+
+    walk(body, ())
+    return held_map, acquires
+
+
+def _normalize_lock_base(base: str) -> Optional[str]:
+    """'self.X' or bare NAME; anything deeper is not a trackable lock."""
+    if base.startswith("self.") and base.count(".") == 1:
+        return base
+    if base and "." not in base:
+        return base
+    return None
+
+
+def _timeout_state(node: ast.Call) -> str:
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            if isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is None:
+                return "none"
+            return "finite"
+    return ""
+
+
 def _summarize_function(owner: Optional[str], fn, path: str
                         ) -> FunctionSummary:
     params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
@@ -358,12 +469,14 @@ def _summarize_function(owner: Optional[str], fn, path: str
     witness = ""
     derived = _param_derived(body, params)
     returns_resource = False
+    held_map, acquires = _held_lock_map(body)
 
     def record_call(node: ast.Call, depth: int, binding: str,
-                    with_item: bool, is_returned: bool) -> None:
+                    with_item: bool, is_returned: bool,
+                    held: tuple = ()) -> Optional[CallSite]:
         name = call_func_name(node)
         if name is None:
-            return
+            return None
         device_args = [i for i, a in enumerate(node.args)
                        if scope.is_device(a)]
         device_kwargs = [k.arg for k in node.keywords
@@ -385,6 +498,7 @@ def _summarize_function(owner: Optional[str], fn, path: str
             selfattr_args=selfattr_args, selfattr_kwargs=selfattr_kwargs,
             arg_count=len(node.args),
             kwarg_names=[k.arg for k in node.keywords if k.arg],
+            held=list(held), timeout_state=_timeout_state(node),
             binding=binding, with_item=with_item, is_returned=is_returned)
         if binding.startswith("local:"):
             n = binding.split(":", 1)[1]
@@ -393,6 +507,7 @@ def _summarize_function(owner: Optional[str], fn, path: str
             cs.bound_returned = n in returned
             cs.bound_escapes = n in escapes
         calls.append(cs)
+        return cs
 
     for stmt, depth in scope_statements(body):
         # How does this statement bind call results?
@@ -415,6 +530,7 @@ def _summarize_function(owner: Optional[str], fn, path: str
                                                          ast.Call):
             bindings[id(stmt.value)] = ("other", False, True)
 
+        stmt_held = held_map.get(id(stmt), ())
         for node in statement_exprs(stmt):
             if not isinstance(node, ast.Call):
                 continue
@@ -422,7 +538,8 @@ def _summarize_function(owner: Optional[str], fn, path: str
                 id(node), ("bare" if isinstance(stmt, ast.Expr)
                            and stmt.value is node else "other",
                            False, False))
-            record_call(node, depth, binding, with_item, is_ret)
+            cs = record_call(node, depth, binding, with_item, is_ret,
+                             held=stmt_held)
             subject = _sync_subject(node)
             if subject is not None:
                 names = _names_in(subject)
@@ -436,6 +553,8 @@ def _summarize_function(owner: Optional[str], fn, path: str
                 if scope.is_device(subject):
                     device_sync = True
                     witness = witness or f"{path}:{node.lineno}"
+                    if cs is not None:
+                        cs.blocking_kind = "sync"
         if isinstance(stmt, ast.Return) and stmt.value is not None:
             if isinstance(stmt.value, ast.Call):
                 rn = call_func_name(stmt.value) or ""
@@ -449,13 +568,14 @@ def _summarize_function(owner: Optional[str], fn, path: str
         name=name, line=fn.lineno, params=params, calls=calls,
         sync_params=sorted(sync_params), device_sync=device_sync,
         sync_witness=witness, writes=writes, write_params=write_params,
-        returns_resource=returns_resource)
+        returns_resource=returns_resource, acquires=acquires)
 
 
 def _summarize_class(cls: ast.ClassDef) -> ClassSummary:
     methods = {n.name: n for n in cls.body
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
     lock_attrs: set[str] = set()
+    lock_types: dict[str, str] = {}
     for fn in methods.values():
         for node in ast.walk(fn):
             if isinstance(node, ast.Assign) \
@@ -466,6 +586,7 @@ def _summarize_class(cls: ast.ClassDef) -> ClassSummary:
                         attr = self_attribute(t)
                         if attr:
                             lock_attrs.add(attr)
+                            lock_types[attr] = leaf
     # Worker entrypoints, PML005-style (target=, submit, callbacks, a
     # bound method escaping into a constructor).
     eps: set[str] = set()
@@ -553,7 +674,8 @@ def _summarize_class(cls: ast.ClassDef) -> ClassSummary:
                        if a.arg != "self"]
     return ClassSummary(name=cls.name, line=cls.lineno, methods=infos,
                         lock_attrs=sorted(lock_attrs),
-                        entrypoints=sorted(eps), init_params=init_params)
+                        entrypoints=sorted(eps), init_params=init_params,
+                        lock_types=lock_types)
 
 
 def _extract_imports(tree: ast.Module, module: str) -> dict[str, str]:
@@ -717,7 +839,7 @@ def summarize_file(path: str, tree: ast.Module,
         functions={}, classes={}, crash_module=False,
         site_literals=[], metric_defs=[], metric_refs=[],
         span_defs=[], event_classes=[], event_maps=[],
-        event_compares=[], registry_constants={})
+        event_compares=[], registry_constants={}, module_locks={})
 
     # Map expression nodes to the function that owns them (for the
     # event-compare heuristic's per-function grouping).
@@ -748,6 +870,12 @@ def summarize_file(path: str, tree: ast.Module,
                 and node.targets[0].id.isupper():
             summary.registry_constants[node.targets[0].id] = \
                 node.value.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            leaf = (call_func_name(node.value) or "").rsplit(".", 1)[-1]
+            if leaf in _LOCK_TYPES:
+                summary.module_locks[node.targets[0].id] = leaf
 
     imported = set(summary.imports.values())
     # Importing the atomic-write module IS the marker-protocol opt-in:
@@ -951,7 +1079,7 @@ def build_catalog(graph: ProjectGraph) -> dict:
 # ----------------------------------------------------------------- cache
 
 
-CACHE_VERSION = 3
+CACHE_VERSION = 4  # v4: lock-context summary fields (PML018/PML019)
 DEFAULT_CACHE = ".photon-lint-cache.json"
 
 

@@ -6,6 +6,7 @@ every registered rule unless the CLI selects/ignores a subset."""
 
 from __future__ import annotations
 
+from photon_ml_tpu.analysis import locks
 from photon_ml_tpu.analysis.rules import (concurrency, device, drift,
                                           interproc, kernels, lifecycle,
                                           network, numeric,
@@ -61,4 +62,10 @@ PROJECT_RULES = {
     "PML016": (resources.check_resource_lifecycle,
                "subprocess/socket/server/pool acquired without a "
                "guaranteed release"),
+    "PML018": (locks.check_lock_order,
+               "lock-order cycle (or non-reentrant re-entry) in the "
+               "global lock graph"),
+    "PML019": (locks.check_blocking_under_lock,
+               "blocking call (network/result/wait/sleep/device sync) "
+               "reached while a lock is held"),
 }

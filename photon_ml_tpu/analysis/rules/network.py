@@ -18,8 +18,16 @@ way PML004 mechanized wall-clock durations:
 - ``requests.get/post/...`` must pass ``timeout=`` (requests never
   times out by default — the classic production hang);
 - ``sock.settimeout(None)`` / an explicit ``timeout=None`` literal is
-  ALSO a finding: deliberately unbounded blocking needs a
+  ALSO a finding — including on the waiting primitives
+  ``Future.result(timeout=None)``, ``x.wait(timeout=None)`` and
+  ``queue.get(timeout=None)``: deliberately unbounded blocking needs a
   ``# pml: allow[PML011] <reason>`` stating why a hang is acceptable.
+
+The call *shapes* and timeout positions live in
+:mod:`photon_ml_tpu.analysis.blocking`, shared with PML019
+(blocking-under-lock) so the two rules agree forever on what counts as
+bounded; when a site is both lockless-unbounded AND under a lock, the
+engine keeps only the PML019 finding (one finding per site).
 
 Sites with a genuinely unbounded contract (an interactive REPL, a
 drain-forever worker) carry the inline allow like every other rule.
@@ -29,29 +37,10 @@ from __future__ import annotations
 
 import ast
 
+from photon_ml_tpu.analysis.blocking import WAIT_CALLS, net_spec
 from photon_ml_tpu.analysis.context import ModuleContext
 from photon_ml_tpu.analysis.findings import Finding
 from photon_ml_tpu.analysis.taint import dotted_name
-
-# call leaf → (dotted-suffix requirements, positional index of timeout).
-# A call matches when its dotted name ends with one of the suffixes;
-# bare leaves like ``get`` never match without their module base (or
-# ``dict.get`` would light up the repo).
-_BLOCKING = {
-    "urlopen": (("urllib.request.urlopen", "request.urlopen",
-                 "urlopen"), 2),
-    "create_connection": (("socket.create_connection",), 1),
-    "HTTPConnection": (("http.client.HTTPConnection",
-                        "client.HTTPConnection"), 2),
-    "HTTPSConnection": (("http.client.HTTPSConnection",
-                         "client.HTTPSConnection"), 2),
-    "get": (("requests.get",), None),
-    "post": (("requests.post",), None),
-    "put": (("requests.put",), None),
-    "delete": (("requests.delete",), None),
-    "head": (("requests.head",), None),
-    "request": (("requests.request",), None),
-}
 
 
 def _timeout_kwarg(node: ast.Call):
@@ -72,12 +61,9 @@ def check_blocking_network_timeout(ctx: ModuleContext) -> list[Finding]:
             continue
         name = dotted_name(node.func) or ""
         leaf = name.rsplit(".", 1)[-1]
-        spec = _BLOCKING.get(leaf)
+        spec = net_spec(name)
         if spec is not None:
-            suffixes, pos = spec
-            if not any(name == s or name.endswith("." + s)
-                       for s in suffixes):
-                continue
+            _suffixes, pos = spec
             kw = _timeout_kwarg(node)
             if kw is not None:
                 if _is_none(kw.value):
@@ -102,4 +88,15 @@ def check_blocking_network_timeout(ctx: ModuleContext) -> list[Finding]:
                 "settimeout(None) puts the socket in unbounded "
                 "blocking mode — a dead peer hangs this thread "
                 "forever; use a finite timeout or allow with a reason"))
+        elif leaf in WAIT_CALLS and "." in name:
+            # Waiting primitives only flag the EXPLICIT timeout=None
+            # form (a bare .result()/.get() is often join-at-shutdown;
+            # under a lock PML019 owns the bare form).
+            kw = _timeout_kwarg(node)
+            if kw is not None and _is_none(kw.value):
+                out.append(ctx.finding(
+                    "PML011", node,
+                    f"{name}(timeout=None) waits unboundedly — a "
+                    f"wedged producer hangs this thread forever; pass "
+                    f"a finite timeout or allow with a reason"))
     return out

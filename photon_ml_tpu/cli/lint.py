@@ -3,6 +3,9 @@
     photon-lint photon_ml_tpu/                 # human output, exit 0/1
     photon-lint --format json photon_ml_tpu/   # machine output
     photon-lint --catalog                      # string-registry JSON
+    photon-lint --locks                        # global lock graph JSON
+    photon-lint --locks --reconcile .photon-lockdep.json
+                                               # diff vs runtime lockdep
     photon-lint --write-baseline --reason "…"  # grandfather current findings
 
 Exit codes: 0 clean (baselined findings and stale-entry warnings do not
@@ -33,7 +36,7 @@ from typing import Optional
 from photon_ml_tpu.analysis import (ALL_RULES, DEFAULT_BASELINE,
                                     DEFAULT_CACHE, PROJECT_RULES,
                                     entries_from_findings, lint_paths,
-                                    save_baseline)
+                                    reconcile, save_baseline)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -66,6 +69,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--catalog", action="store_true",
                    help="emit the string-keyed registries (fault sites, "
                         "events, metrics, spans) as JSON and exit 0")
+    p.add_argument("--locks", action="store_true",
+                   help="emit the global lock graph (nodes = class.attr "
+                        "locks, edges with witness call chains) as "
+                        "deterministic JSON and exit 0")
+    p.add_argument("--reconcile", default=None, metavar="PATH",
+                   help="with --locks: diff the static graph against a "
+                        "runtime .photon-lockdep.json dump; exit 1 on "
+                        "inversions or runtime-only (resolver-gap) edges")
+    p.add_argument("--allow-gap", action="append", default=[],
+                   metavar="SRC->DST",
+                   help="with --reconcile: accept this runtime-only edge "
+                        "as a tracked known gap (repeatable)")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline file "
                         "and exit 0 (requires --reason)")
@@ -108,6 +123,24 @@ def main(argv: Optional[list[str]] = None) -> int:
                                 baseline_path=None, project=False,
                                 cache_path=cache, want_catalog=True)
             print(json.dumps(result.catalog, indent=2, sort_keys=True))
+            return 0
+        if args.locks or args.reconcile:
+            result = lint_paths(args.paths, select=select, ignore=ignore,
+                                baseline_path=None, project=False,
+                                cache_path=cache, want_locks=True)
+            if args.reconcile:
+                try:
+                    with open(args.reconcile) as fh:
+                        runtime = json.load(fh)
+                except (OSError, ValueError) as exc:
+                    print(f"photon-lint: cannot read runtime lock dump "
+                          f"{args.reconcile}: {exc}", file=sys.stderr)
+                    return 2
+                rep = reconcile(result.lock_graph, runtime,
+                                allow_gaps=tuple(args.allow_gap))
+                print(json.dumps(rep, indent=2, sort_keys=True))
+                return 0 if rep["ok"] else 1
+            print(json.dumps(result.lock_graph, indent=2))
             return 0
         if args.write_baseline:
             if not args.reason.strip():

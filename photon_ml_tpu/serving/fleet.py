@@ -442,10 +442,17 @@ class ServingFleet:
         self.publish_bake_s = float(publish_bake_s)
         self.publish_burn_threshold = float(publish_burn_threshold)
         self._published: list[tuple[int, str]] = []
-        # RLock: the monitor thread's recovery replay and the publish
-        # ladder both record ledger rows, and the ladder records while
-        # already holding the lock.
-        self._publish_lock = threading.RLock()
+        # Two locks, strictly ordered _ladder_lock -> _publish_lock
+        # (photon-lint --locks proves the graph stays acyclic):
+        # _ladder_lock serializes whole publish ladders and IS held
+        # across the canary HTTP + bake sleep by design (see the
+        # allow[PML019] notes in publish_delta) — only publish_delta
+        # takes it, so the monitor thread never convoys on a bake.
+        # _publish_lock guards the committed chain and the lazy ledger
+        # handles with short holds only; the monitor thread's recovery
+        # replay and /healthz readers take just this one.
+        self._ladder_lock = threading.Lock()
+        self._publish_lock = threading.Lock()
         self._publish_ledger = None
 
     # -- replica plumbing ----------------------------------------------------
@@ -781,9 +788,11 @@ class ServingFleet:
         # Replicas resolve the path from THEIR cwd (the workdir) — hand
         # them an absolute one.
         delta_dir = os.path.abspath(delta_dir)
-        with self._publish_lock:
+        with self._ladder_lock:
             delta = read_delta(delta_dir)  # DeltaCorrupt stops it here
-            current = self._published[-1][0] if self._published else 0
+            with self._publish_lock:
+                current = (self._published[-1][0]
+                           if self._published else 0)
             if delta.parent != current:
                 raise PublishError(
                     f"delta v{delta.version} was cut against version "
@@ -797,7 +806,9 @@ class ServingFleet:
                                  version=delta.version, replica=canary)
             t0 = time.monotonic()
             try:
+                # pml: allow[PML019] ladder lock held across fault hook + canary HTTP by design: one publish at a time IS the contract, and nothing on the request path ever takes _ladder_lock
                 flt.fire(flt.sites.PUBLISH_CANARY_APPLY, index=canary)
+                # pml: allow[PML019] ladder lock held across canary/fleet HTTP + bake by design; every leg carries a finite timeout and only publish_delta takes this lock
                 self._replica_post(canary, "/admin/delta",
                                    {"path": delta_dir})
             except urllib.error.HTTPError as e:
@@ -860,7 +871,8 @@ class ServingFleet:
                     self._rollback(applied + [rid], delta, reason)
                     raise PublishError(reason)
             swap_seconds = apply_s + (time.monotonic() - t1)
-            self._published.append((delta.version, delta_dir))
+            with self._publish_lock:
+                self._published.append((delta.version, delta_dir))
             self.metrics.record_publish(delta.version, swap_seconds)
             self.emitter.emit(DeltaPublished(
                 version=delta.version, coordinates=delta.coordinates,

@@ -308,6 +308,7 @@ class ScoringService:
             out = self._score_fn(mats, offsets, slots_full,
                                  self.store.caches(),
                                  self.store.cache_scales())
+            # pml: allow[PML019] flush-lock device sync IS the flush: one in-flight batch per device by design (docs/SERVING.md), and waiters queue in the batcher, not on this lock
             out = np.asarray(jax.block_until_ready(out))
             t_d1 = time.monotonic()
         dt = t_d1 - t_d0

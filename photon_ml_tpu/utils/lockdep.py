@@ -1,0 +1,474 @@
+"""The runtime half of photon-lockdep: an opt-in instrumented lock
+layer that observes the REAL acquisition DAG while tests run.
+
+The static graph (analysis/locks.py) proves what the resolver can see;
+this module catches what it can't — an order inversion that happened on
+a benign interleaving (thread 1 took A→B, thread 2 took B→A, nobody
+deadlocked *this* run), or a blocking call made while a package lock
+was held through a code path the call-graph resolver missed. The two
+halves meet in ``photon-lint --locks --reconcile .photon-lockdep.json``:
+runtime edges missing from the static graph are resolver gaps to fix;
+static edges never exercised are test-coverage debt to report.
+
+Discipline is photon-fault's: ONE env/flag check arms it
+(``PHOTON_LOCKDEP=1``, or ``instrument(force=True)``), and when it is
+off this module changes NOTHING — ``threading.Lock`` stays the builtin,
+no wrapper, no per-acquire bookkeeping, zero overhead (tests assert
+that). Armed, ``instrument()`` monkeypatches
+``threading.Lock/RLock/Condition`` so that constructions **from inside
+the package** return tracked wrappers; any other construction (stdlib
+queues, executors, third-party code) still gets the real thing.
+
+Tracked-lock node ids match the static graph exactly —
+``{module}.{Class}.{attr}`` for ``self.attr = threading.Lock()``
+assignments, ``{module}.{NAME}`` for module-level constants — derived
+from the constructing frame (``__name__``, ``type(self).__name__``, and
+the assignment target on the source line), which is what makes the
+reconciliation diff line up without a mapping table.
+
+Recorded, per process, dumped merged to ``.photon-lockdep.json``
+(``PHOTON_LOCKDEP_OUT`` overrides) at exit:
+
+- **edges**: (held → acquired) pairs with thread + site witness;
+- **inversions**: an edge whose reverse was already observed — both
+  witnesses kept; also bumps ``photon_lockdep_inversions_total`` when
+  the obs registry is live (run_tier1's lockdep leg fails on any);
+- **blocking**: ``time.sleep`` / ``urlopen`` / ``Future.result`` /
+  ``Popen.wait`` entered while a tracked lock was held (PML019's
+  runtime shadow; reported, not failing — the static rule owns the
+  verdict and its allows).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import linecache
+import os
+import re
+import sys
+import threading
+from typing import Optional
+
+DEFAULT_OUT = ".photon-lockdep.json"
+_PKG = "photon_ml_tpu"
+
+_SELF_ASSIGN_RE = re.compile(r"self\.(\w+)\s*=")
+_NAME_ASSIGN_RE = re.compile(r"^\s*(\w+)\s*=")
+
+
+class _State:
+    def __init__(self):
+        self.armed = False
+        self.guard = _REAL["Lock"]()  # real lock: the tracker itself
+        self.tls = threading.local()
+        self.nodes: dict = {}        # node id -> "Lock"/"RLock"/"Condition"
+        self.edges: dict = {}        # (src, dst) -> {count, witness}
+        self.inversions: list = []
+        self.blocking: list = []
+        self.dump_registered = False
+
+
+# The real constructors, captured at import so instrument()/deactivate()
+# round-trips even if called twice.
+_REAL = {
+    "Lock": threading.Lock,
+    "RLock": threading.RLock,
+    "Condition": threading.Condition,
+}
+_STATE = _State()
+_PATCHED_BLOCKING: dict = {}
+
+
+# -------------------------------------------------------------- bookkeeping
+
+
+def _held() -> list:
+    held = getattr(_STATE.tls, "held", None)
+    if held is None:
+        held = _STATE.tls.held = []
+    return held
+
+
+def _caller_site() -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        if mod.startswith(__name__) or mod == "threading":
+            f = f.f_back
+            continue
+        break
+    if f is None:
+        return "?"
+    try:
+        path = os.path.relpath(f.f_code.co_filename)
+    except ValueError:
+        path = f.f_code.co_filename
+    return f"{path.replace(os.sep, '/')}:{f.f_lineno}"
+
+
+def _bump_inversion_counter(n: int) -> None:
+    # Lazy and OUTSIDE _STATE.guard: importing obs constructs a
+    # package-level lock (obs/__init__._LOCK), which re-enters
+    # _register -> guard and would deadlock if we still held it.
+    try:
+        from photon_ml_tpu import obs
+        mx = obs.metrics()
+        if mx is not None:
+            mx.counter("photon_lockdep_inversions_total").inc(n)
+    # pml: allow[PML008] best-effort metric bump from inside the lock
+    # tracker: the inversion is already recorded; an obs failure here
+    # must never wedge or recurse into the instrumented path
+    except Exception:
+        pass
+
+
+def _note_acquire(node: str) -> None:
+    if not _STATE.armed:   # a leftover wrapper after deactivate()
+        return
+    held = _held()
+    if node in held:            # re-entrant (RLock): no new ordering fact
+        held.append(node)
+        return
+    site = _caller_site()
+    thread = threading.current_thread().name
+    inversions = 0
+    with _STATE.guard:
+        for h in dict.fromkeys(held):
+            if h == node:
+                continue
+            edge = (h, node)
+            entry = _STATE.edges.get(edge)
+            if entry is None:
+                witness = {"thread": thread, "site": site}
+                _STATE.edges[edge] = {"count": 1, "witness": witness}
+                rev = _STATE.edges.get((node, h))
+                if rev is not None:
+                    _STATE.inversions.append({
+                        "edge": f"{edge[0]} -> {edge[1]}",
+                        "prior": f"{node} -> {h}",
+                        "witness": {"thread": thread, "site": site},
+                        "prior_witness": rev["witness"],
+                    })
+                    inversions += 1
+            else:
+                entry["count"] += 1
+    held.append(node)
+    if inversions:
+        _bump_inversion_counter(inversions)
+
+
+def _note_release(node: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == node:
+            del held[i]
+            return
+
+
+def note_blocking(kind: str, bounded: bool) -> None:
+    """A blocking primitive is about to run on this thread; record it
+    when any tracked lock is held (the runtime shadow of PML019)."""
+    if not _STATE.armed:
+        return
+    held = _held()
+    if not held:
+        return
+    site = _caller_site()
+    with _STATE.guard:
+        _STATE.blocking.append({
+            "kind": kind, "bounded": bool(bounded), "site": site,
+            "locks": sorted(dict.fromkeys(held)),
+            "thread": threading.current_thread().name,
+        })
+
+
+# ------------------------------------------------------------- the wrappers
+
+
+class _TrackedLock:
+    """A named, order-tracked wrapper over a real Lock. Condition can
+    wrap one: it binds our acquire/release (we define none of the
+    ``_release_save`` fast-path attrs), so waits keep tracking."""
+
+    _reentrant = False
+
+    def __init__(self, inner, node: str):
+        self._inner = inner
+        self._node = node
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquire(self._node)
+        return got
+
+    def release(self):
+        _note_release(self._node)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockdep {type(self).__name__} {self._node}>"
+
+
+class _TrackedRLock(_TrackedLock):
+    """RLock wrapper carrying Condition's fast-path protocol, so
+    ``Condition(tracked_rlock).wait()`` releases/reacquires through the
+    tracker instead of around it."""
+
+    _reentrant = True
+
+    def _release_save(self):
+        _note_release(self._node)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        _note_acquire(self._node)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _node_from_frame(frame) -> Optional[str]:
+    """The static-graph node id for a lock constructed at ``frame``, or
+    None when the construction is outside the package (the caller then
+    hands back a REAL lock — zero tracking tax on foreign code)."""
+    if frame is None:
+        return None
+    mod = frame.f_globals.get("__name__", "")
+    if not (mod == _PKG or mod.startswith(_PKG + ".")):
+        return None
+    line = linecache.getline(frame.f_code.co_filename, frame.f_lineno)
+    m = _SELF_ASSIGN_RE.search(line)
+    if m is not None:
+        slf = frame.f_locals.get("self")
+        if slf is not None:
+            return f"{mod}.{type(slf).__name__}.{m.group(1)}"
+        return None
+    m = _NAME_ASSIGN_RE.match(line)
+    if m is not None:
+        return f"{mod}.{m.group(1)}"
+    return None
+
+
+def _register(node: str, type_leaf: str) -> None:
+    with _STATE.guard:
+        _STATE.nodes[node] = type_leaf
+
+
+def _lock_factory():
+    node = _node_from_frame(sys._getframe(1))
+    real = _REAL["Lock"]()
+    if node is None or not _STATE.armed:
+        return real
+    _register(node, "Lock")
+    return _TrackedLock(real, node)
+
+
+def _rlock_factory():
+    node = _node_from_frame(sys._getframe(1))
+    real = _REAL["RLock"]()
+    if node is None or not _STATE.armed:
+        return real
+    _register(node, "RLock")
+    return _TrackedRLock(real, node)
+
+
+def _condition_factory(lock=None):
+    if lock is not None:
+        # Caller-supplied lock: if it came from a patched constructor
+        # it is already tracked under its own name.
+        return _REAL["Condition"](lock)
+    node = _node_from_frame(sys._getframe(1))
+    if node is None or not _STATE.armed:
+        return _REAL["Condition"]()
+    _register(node, "Condition")
+    return _REAL["Condition"](_TrackedRLock(_REAL["RLock"](), node))
+
+
+# ------------------------------------------------------- blocking patches
+
+
+def _patch_blocking() -> None:
+    import time as _time
+    import urllib.request as _request
+    from concurrent.futures import Future as _Future
+    from subprocess import Popen as _Popen
+
+    if _PATCHED_BLOCKING:
+        return
+
+    real_sleep = _time.sleep
+    real_urlopen = _request.urlopen
+    real_result = _Future.result
+    real_wait = _Popen.wait
+
+    def sleep(seconds):
+        note_blocking("sleep", True)
+        return real_sleep(seconds)
+
+    def urlopen(*a, **kw):
+        note_blocking("net", "timeout" in kw or len(a) > 2)
+        return real_urlopen(*a, **kw)
+
+    def result(self, timeout=None):
+        note_blocking("result", timeout is not None)
+        return real_result(self, timeout)
+
+    def wait(self, timeout=None):
+        note_blocking("wait", timeout is not None)
+        return real_wait(self, timeout)
+
+    _PATCHED_BLOCKING.update({
+        (_time, "sleep"): real_sleep,
+        (_request, "urlopen"): real_urlopen,
+        (_Future, "result"): real_result,
+        (_Popen, "wait"): real_wait,
+    })
+    _time.sleep = sleep
+    _request.urlopen = urlopen
+    _Future.result = result
+    _Popen.wait = wait
+
+
+def _unpatch_blocking() -> None:
+    for (obj, name), orig in _PATCHED_BLOCKING.items():
+        setattr(obj, name, orig)
+    _PATCHED_BLOCKING.clear()
+
+
+# --------------------------------------------------------------- lifecycle
+
+
+def armed() -> bool:
+    return _STATE.armed
+
+
+def instrument(force: bool = False) -> bool:
+    """Arm the validator. Patches the lock constructors and the
+    blocking primitives; registers the exit dump. One env/flag check —
+    ``PHOTON_LOCKDEP=1`` or ``force=True`` — or this is a no-op
+    returning False with nothing touched."""
+    if not force and os.environ.get("PHOTON_LOCKDEP") != "1":
+        return False
+    if _STATE.armed:
+        return True
+    _STATE.armed = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    _patch_blocking()
+    if not _STATE.dump_registered:
+        _STATE.dump_registered = True
+        atexit.register(_dump_at_exit)
+    return True
+
+
+def maybe_instrument() -> bool:
+    """The conftest hook: arm iff ``PHOTON_LOCKDEP=1``."""
+    return instrument(force=False)
+
+
+def deactivate() -> None:
+    """Disarm: restore the real constructors and blocking primitives.
+    Locks already constructed keep their wrappers (harmless — they
+    still delegate to real locks) but record nothing new."""
+    if not _STATE.armed:
+        return
+    _STATE.armed = False
+    threading.Lock = _REAL["Lock"]
+    threading.RLock = _REAL["RLock"]
+    threading.Condition = _REAL["Condition"]
+    _unpatch_blocking()
+
+
+def reset() -> None:
+    """Drop every recorded fact (test isolation)."""
+    with _STATE.guard:
+        _STATE.nodes.clear()
+        _STATE.edges.clear()
+        _STATE.inversions.clear()
+        _STATE.blocking.clear()
+
+
+# ------------------------------------------------------------------ output
+
+
+def snapshot() -> dict:
+    """The current observation doc (the ``.photon-lockdep.json``
+    schema; ``photon-lint --reconcile`` consumes it)."""
+    with _STATE.guard:
+        return {
+            "version": 1,
+            "nodes": [{"id": n, "type": _STATE.nodes[n]}
+                      for n in sorted(_STATE.nodes)],
+            "edges": [{"src": s, "dst": d,
+                       "count": _STATE.edges[(s, d)]["count"],
+                       "witness": _STATE.edges[(s, d)]["witness"]}
+                      for s, d in sorted(_STATE.edges)],
+            "inversions": list(_STATE.inversions),
+            "blocking": list(_STATE.blocking),
+        }
+
+
+def _merge(into: dict, doc: dict) -> dict:
+    nodes = {n["id"]: n["type"] for n in into.get("nodes", [])}
+    nodes.update({n["id"]: n["type"] for n in doc.get("nodes", [])})
+    edges: dict = {(e["src"], e["dst"]): e
+                   for e in into.get("edges", [])}
+    for e in doc.get("edges", []):
+        key = (e["src"], e["dst"])
+        if key in edges:
+            edges[key]["count"] += e["count"]
+        else:
+            edges[key] = e
+    return {
+        "version": 1,
+        "nodes": [{"id": n, "type": nodes[n]} for n in sorted(nodes)],
+        "edges": [edges[k] for k in sorted(edges)],
+        "inversions": (into.get("inversions", [])
+                       + doc.get("inversions", [])),
+        "blocking": (into.get("blocking", [])
+                     + doc.get("blocking", [])),
+    }
+
+
+def dump(path: Optional[str] = None) -> dict:
+    """Write the merged observation doc (existing file + this process)
+    and return it."""
+    path = path or os.environ.get("PHOTON_LOCKDEP_OUT", DEFAULT_OUT)
+    doc = snapshot()
+    try:
+        with open(path) as fh:
+            doc = _merge(json.load(fh), doc)
+    except (OSError, ValueError):
+        pass
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return doc
+
+
+def _dump_at_exit() -> None:
+    try:
+        if _STATE.nodes or _STATE.edges or _STATE.inversions \
+                or _STATE.blocking:
+            dump()
+    # pml: allow[PML008] atexit hook: raising here would mask the
+    # process's real exit status; a lost dump only costs one
+    # reconciliation data point
+    except Exception:
+        pass

@@ -41,7 +41,14 @@ if (not _env_ok() and os.environ.get("_PHOTON_TEST_REEXEC") != "1"
 import numpy as np
 import pytest
 
+from photon_ml_tpu.utils import lockdep
 from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+# Arm the runtime lockdep validator iff PHOTON_LOCKDEP=1 (run_tier1.sh's
+# lockdep leg). Must happen before any package module constructs a lock,
+# i.e. before test modules import serving/fleet code — conftest import
+# time is the one place that is guaranteed.
+lockdep.maybe_instrument()
 
 # Persist compiled executables across test processes (separate cache from
 # the TPU one — the cache keys include the platform, so sharing a directory

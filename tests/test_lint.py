@@ -751,7 +751,8 @@ def test_rule_catalog_is_complete():
         [f"PML00{i}" for i in range(1, 10)] + ["PML010", "PML011",
                                                "PML017"]
     assert sorted(PROJECT_RULES) == \
-        ["PML012", "PML013", "PML014", "PML015", "PML016"]
+        ["PML012", "PML013", "PML014", "PML015", "PML016",
+         "PML018", "PML019"]
     assert not set(ALL_RULES) & set(PROJECT_RULES)
     for rid, (check, doc) in {**ALL_RULES, **PROJECT_RULES}.items():
         assert callable(check) and doc
@@ -1804,3 +1805,442 @@ def test_repo_wide_project_rules_are_green():
          "photon_ml_tpu/"],
         cwd=REPO, capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ================================== lock graph: PML018/PML019 (PR 18)
+#
+# PML018/PML019 run over the same ProjectGraph, through the lock-context
+# summary fields (held sets on call sites, acquires, lock_types) closed
+# into a global lock graph by analysis/locks.py.
+
+
+def test_pml018_flags_cross_module_lock_cycle():
+    """A cycle assembled across two modules: StoreA holds its lock while
+    refreshing StoreB (attr-type edge), StoreB holds its lock while
+    poking a StoreA back (unique-leaf edge) — neither file alone shows
+    the deadlock."""
+    out = project_findings("PML018", {
+        "pkg/a.py": """
+            import threading
+            from pkg.b import StoreB
+
+            class StoreA:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.b = StoreB()
+
+                def update(self):
+                    with self._lock:
+                        self.b.refresh()
+
+                def poke_a(self):
+                    with self._lock:
+                        pass
+        """,
+        "pkg/b.py": """
+            import threading
+
+            class StoreB:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def refresh(self):
+                    with self._lock:
+                        pass
+
+                def drain(self, peer):
+                    with self._lock:
+                        peer.poke_a()
+        """,
+    })
+    assert len(out) == 1 and out[0].rule == "PML018"
+    assert "pkg.a.StoreA._lock" in out[0].message
+    assert "pkg.b.StoreB._lock" in out[0].message
+    assert "witness" in out[0].message
+
+
+def test_pml018_clean_on_consistent_order_and_reentrant_rlock():
+    assert project_findings("PML018", {
+        "pkg/a.py": """
+            import threading
+            from pkg.b import StoreB
+
+            class StoreA:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.b = StoreB()
+
+                def update(self):
+                    with self._lock:
+                        self.b.refresh()
+
+            class Nest:
+                def __init__(self):
+                    self._r = threading.RLock()
+
+                def outer(self):
+                    with self._r:
+                        self.inner()
+
+                def inner(self):
+                    with self._r:
+                        pass
+        """,
+        "pkg/b.py": """
+            import threading
+
+            class StoreB:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def refresh(self):
+                    with self._lock:
+                        pass
+        """,
+    }) == []
+
+
+def test_pml018_flags_plain_lock_reentry():
+    out = project_findings("PML018", {
+        "pkg/m.py": """
+            import threading
+
+            class Nest:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """,
+    })
+    assert len(out) == 1
+    assert "re-entrant acquisition" in out[0].message
+    assert "pkg.m.Nest._lock" in out[0].message
+
+
+def test_pml018_callback_edge_cycle_from_constructor_handoff():
+    """The on_death idiom: Fleet hands its bound method to a Monitor at
+    construction; the Monitor invokes it while holding its own lock, so
+    the callback's lock acquisition closes a cycle no direct call
+    graph shows."""
+    out = project_findings("PML018", {
+        "pkg/fleet.py": """
+            import threading
+            from pkg.monitor import Monitor
+
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mon = Monitor(on_death=self._on_death)
+
+                def _on_death(self, rid):
+                    with self._lock:
+                        pass
+
+                def publish(self):
+                    with self._lock:
+                        self.mon.pause()
+        """,
+        "pkg/monitor.py": """
+            import threading
+
+            class Monitor:
+                def __init__(self, on_death):
+                    self._mu = threading.Lock()
+                    self.on_death = on_death
+
+                def sweep(self):
+                    with self._mu:
+                        self.on_death("r0")
+
+                def pause(self):
+                    with self._mu:
+                        pass
+        """,
+    })
+    assert len(out) == 1
+    assert "pkg.fleet.Fleet._lock" in out[0].message
+    assert "pkg.monitor.Monitor._mu" in out[0].message
+
+
+def test_pml019_flags_blocking_and_exempts_finite_timeouts():
+    src = {
+        "pkg/svc.py": """
+            import queue
+            import threading
+            import time
+            from urllib.request import urlopen
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def fetch(self):
+                    with self._lock:
+                        return urlopen("http://h/x", timeout=2).read()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.5)
+
+                def pop_forever(self):
+                    with self._lock:
+                        return self._q.get()
+
+                def pop_bounded(self):
+                    with self._lock:
+                        return self._q.get(timeout=1.0)
+
+                def await_bounded(self, fut):
+                    with self._lock:
+                        return fut.result(timeout=2.0)
+        """,
+    }
+    out = project_findings("PML019", src)
+    msgs = [f.message for f in out]
+    # urlopen flagged even with a finite timeout (slow-but-bounded
+    # still serializes the lock), sleep flagged, bare q.get() flagged.
+    assert len(out) == 3, msgs
+    assert any("network call" in m and "timeout bounds the stall" in m
+               for m in msgs)
+    assert any("sleep" in m for m in msgs)
+    assert any("queue" in m for m in msgs)
+    # The bounded get/result never show up.
+    assert not any("pop_bounded" in m or "await_bounded" in m
+                   for m in msgs)
+
+
+def test_pml019_condition_wait_under_own_lock_is_exempt():
+    assert project_findings("PML019", {
+        "pkg/cv.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._ready = False
+
+                def await_ready(self):
+                    with self._cond:
+                        while not self._ready:
+                            self._cond.wait()
+        """,
+    }) == []
+
+
+def test_pml019_indirect_chain_and_timeout_carrying_callee_exempt():
+    """The call-graph half: a lock held across a call that reaches a
+    blocking primitive two hops away is flagged with the witness chain;
+    the same shape whose leaf carries a finite timeout is not."""
+    out = project_findings("PML019", {
+        "pkg/a.py": """
+            import threading
+            from pkg import b
+
+            class Pub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def publish(self):
+                    with self._lock:
+                        b.settle()
+
+                def publish_bounded(self):
+                    with self._lock:
+                        b.settle_bounded(2.0)
+        """,
+        "pkg/b.py": """
+            import time
+
+            def settle():
+                time.sleep(1.0)
+
+            def settle_bounded(timeout, fut=None):
+                if fut is not None:
+                    fut.result(timeout=timeout)
+        """,
+    })
+    assert len(out) == 1
+    assert "publish()" in out[0].message
+    assert "reaches a sleep" in out[0].message
+    assert "settle" in out[0].message  # the witness chain names the hop
+
+
+def test_pml019_hot_path_lock_gets_severity_suffix():
+    out = project_findings("PML019", {
+        "pkg/scoring.py": """
+            import threading
+            import time
+
+            class ScoringService:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self):
+                    with self._lock:
+                        time.sleep(0.01)
+        """,
+    })
+    assert len(out) == 1
+    assert "hot-path lock" in out[0].message
+
+
+def test_pml018_019_real_fleet_ladder_stays_visible():
+    """The audit fix's regression guard: run the checks directly on the
+    real serving sources (bypassing inline allows, which only the
+    engine applies) and assert the reasoned-allow findings are still
+    produced — if the ladder seam goes dark, the allows are stale."""
+    import ast as ast_mod
+
+    from photon_ml_tpu.analysis import summarize_file
+    from photon_ml_tpu.analysis.project import ProjectGraph
+    from photon_ml_tpu.analysis.rules import PROJECT_RULES
+
+    summaries = {}
+    for rel in ("photon_ml_tpu/serving/fleet.py",
+                "photon_ml_tpu/serving/service.py",
+                "photon_ml_tpu/serving/supervisor.py",
+                "photon_ml_tpu/faults/injector.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            src = f.read()
+        summaries[rel] = summarize_file(rel, ast_mod.parse(src), src)
+    graph = ProjectGraph(summaries, package_prefix="photon_ml_tpu")
+    out = PROJECT_RULES["PML019"][0](graph)
+    locks_hit = {m for f in out for m in (
+        "_ladder_lock", "ScoringService._lock") if m in f.message}
+    assert "_ladder_lock" in locks_hit, \
+        "publish_delta's held-across-HTTP/bake seam went dark"
+    assert "ScoringService._lock" in locks_hit, \
+        "the flush-lock device-sync seam went dark"
+    # And the ladder split keeps the graph acyclic: no PML018 anywhere
+    # in serving.
+    assert PROJECT_RULES["PML018"][0](graph) == []
+
+
+def test_pml011_pml019_dedupe_one_finding_per_site(tmp_path):
+    """When PML019 (lock-held queue.get) and PML011 (timeout=None wait)
+    would hit the same line, the engine keeps only the project finding."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "w.py").write_text(textwrap.dedent("""
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def locked_pop(self):
+                with self._lock:
+                    return self._q.get(timeout=None)
+
+            def free_pop(self):
+                return self._q.get(timeout=None)
+    """))
+    res = lint_paths([str(tmp_path)], package_prefix=str(tmp_path))
+    by_rule = {}
+    for f in res.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # locked_pop: PML019 only (PML011 dropped at that site);
+    # free_pop: PML011 survives (no lock, no PML019 there).
+    assert len(by_rule.get("PML019", [])) == 1
+    assert len(by_rule.get("PML011", [])) == 1
+    assert "free_pop" in by_rule["PML011"][0].snippet or \
+        by_rule["PML011"][0].line != by_rule["PML019"][0].line
+
+
+def test_pml011_extends_to_result_and_queue_get_timeouts():
+    flagged = findings_for("PML011", """
+        def wait_on(fut, q):
+            fut.result(timeout=None)
+            q.get(timeout=None)
+    """)
+    assert len(flagged) == 2
+    assert all("timeout=None" in f.message for f in flagged)
+    assert findings_for("PML011", """
+        def wait_on(fut, q):
+            fut.result(timeout=2.0)
+            q.get(timeout=1.0)
+    """) == []
+
+
+def test_lock_graph_cli_snapshot_matches_committed(tmp_path):
+    """`photon-lint --locks` over the tree must agree with the committed
+    .photon-lockgraph.json on nodes and edge pairs (lines/witnesses are
+    allowed to drift with unrelated edits; topology is not)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    live = json.loads(proc.stdout)
+    with open(os.path.join(REPO, ".photon-lockgraph.json")) as f:
+        committed = json.load(f)
+    assert live["nodes"] == committed["nodes"]
+    live_pairs = [(e["src"], e["dst"]) for e in live["edges"]]
+    committed_pairs = [(e["src"], e["dst"]) for e in committed["edges"]]
+    assert live_pairs == committed_pairs
+    # Deterministic output: a second run byte-matches the first.
+    again = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert again.stdout == proc.stdout
+
+
+def test_reconcile_cli_exit_codes(tmp_path):
+    good = tmp_path / "runtime.json"
+    good.write_text(json.dumps(
+        {"version": 1, "nodes": [], "edges": [], "inversions": [],
+         "blocking": []}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "--reconcile", str(good), "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["ok"] and rep["resolver_gaps"] == []
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"version": 1, "nodes": [], "edges":
+         [{"src": "x.A._l", "dst": "x.B._l", "count": 1,
+           "witness": {}}], "inversions": [], "blocking": []}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "--reconcile", str(bad), "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "--reconcile", str(bad), "--allow-gap", "x.A._l -> x.B._l",
+         "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", "--locks",
+         "--reconcile", str(tmp_path / "missing.json"),
+         "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_repo_wide_lock_rules_are_green():
+    """PML018/PML019 over the real tree: zero unannotated findings (the
+    acceptance bar), through the same CLI path CI uses."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint",
+         "--select", "PML018,PML019", "photon_ml_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    findings = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("photon_ml_tpu/")
+                and ("PML018" in ln or "PML019" in ln)]
+    assert findings == [], "\n".join(findings)
