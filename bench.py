@@ -1370,6 +1370,333 @@ def bench_solver_race(n=1 << 15, d=4096, nnz=16, chunk_rows=1 << 12,
     return out
 
 
+def _fabric_chunked(n, d, nnz, chunk_rows, num_hot):
+    from photon_ml_tpu.data import sparse as sp
+    from photon_ml_tpu.ops import streaming_sparse as ss
+
+    batch, _ = sp.synthetic_sparse(n, d, nnz, seed=7)
+
+    def chunks():
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            yield sp.SparseBatch(
+                indices=np.asarray(batch.indices)[lo:hi],
+                values=np.asarray(batch.values)[lo:hi],
+                labels=np.asarray(batch.labels)[lo:hi],
+                weights=np.asarray(batch.weights)[lo:hi],
+                offsets=np.asarray(batch.offsets)[lo:hi],
+                num_features=d)
+
+    return ss.build_chunked(chunks(), d, chunk_rows, num_hot=num_hot)
+
+
+def _fabric_rehome_drill(out):
+    """Cross-machine re-home window (docs/SERVING.md "Multi-host
+    fleet"): 2 machine agents + a 2-replica remote fleet, whole-machine
+    SIGKILL under live traffic. Lines: the fleet's own shard re-home
+    window (``fabric_rehome_seconds``, gated <= its deadline), the full
+    cross-machine respawn wall (reported), unserved + client failures
+    (gated == 0), and drill-score parity vs the fleet's pre-drill bits.
+    On a <4-core box agents + replicas + fleet + driver share cores and
+    the walls measure scheduler contention — stamped invalid, gates
+    become reported-only."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.fabric.transport import RemoteTransport
+    from photon_ml_tpu.game.models import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models import io as model_io
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.serving.fleet import (ServingFleet,
+                                             make_fleet_http_server)
+    from photon_ml_tpu.types import TaskType
+
+    ents, dg, dr = 32, 6, 4
+    rng = np.random.default_rng(11)
+    model = GameModel(task=TaskType.LOGISTIC_REGRESSION, models={
+        "fixed": FixedEffectModel("global", Coefficients(
+            jnp.asarray(rng.normal(size=dg).astype(np.float32)))),
+        "per-user": RandomEffectModel(
+            "userId", "re_userId",
+            jnp.asarray(rng.normal(size=(ents, dr)).astype(np.float32))),
+    })
+    objs = []
+    req_rng = np.random.default_rng(5)
+    for i in range(12):
+        objs.append({
+            "features": {
+                "global": req_rng.normal(size=dg).astype(
+                    np.float32).tolist(),
+                "re_userId": req_rng.normal(size=dr).astype(
+                    np.float32).tolist()},
+            "entity_ids": {"userId": int(i % ents)}, "uid": i})
+
+    def post_one(url, obj):
+        body = json.dumps({"requests": [obj]}).encode()
+        req = urllib.request.Request(
+            url + "/score", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            return np.float32(json.loads(resp.read())["scores"][0])
+
+    def start_agent(workdir, name):
+        os.makedirs(workdir, exist_ok=True)
+        ready = os.path.join(workdir, "agent.ready")
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else repo)
+        with open(os.path.join(workdir, "agent.log"), "ab") as log_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "photon_ml_tpu.fabric.agent",
+                 "--workdir", workdir, "--machine", name,
+                 "--host", "127.0.0.1", "--port", "0",
+                 "--ready-file", ready],
+                stdout=log_f, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"agent {name} exited rc={proc.returncode}")
+            if os.path.exists(ready):
+                try:
+                    with open(ready) as f:
+                        info = json.load(f)
+                    return proc, f"http://127.0.0.1:{int(info['port'])}"
+                except (OSError, ValueError):
+                    pass  # torn read mid-write; poll again
+            time.sleep(0.05)
+        raise RuntimeError(f"agent {name} not ready before its deadline")
+
+    def kill_machine(proc):
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+    agents, server, fleet = [], None, None
+    rehome_deadline_s = 5.0
+    with tempfile.TemporaryDirectory(prefix="pml_bench_fabric_") as td:
+        model_dir = os.path.join(td, "model")
+        model_io.save_game_model(model, model_dir)
+        try:
+            agents = [start_agent(os.path.join(td, f"m{m}"), f"m{m}")
+                      for m in range(2)]
+            fleet = ServingFleet(
+                replica_args=["--model-dir", model_dir,
+                              "--max-wait-ms", "0.5"],
+                num_replicas=2, workdir=os.path.join(td, "work"),
+                probe_interval_s=0.1, heartbeat_deadline_s=1.0,
+                rehome_deadline_s=rehome_deadline_s,
+                retry_backoff_s=0.4, retries=4)
+            fleet.supervisor.transport = RemoteTransport(
+                [u for _, u in agents], fleet._replica_argv,
+                timeout_s=2.0)
+            fleet.start()
+            server = make_fleet_http_server(fleet, port=0)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            url = f"http://127.0.0.1:{server.server_address[1]}"
+            expected = np.asarray([post_one(url, o) for o in objs],
+                                  np.float32)
+            before = fleet.metrics.snapshot()
+            stop = threading.Event()
+            failures, served = [], []
+
+            def scorer():
+                i = 0
+                while not stop.is_set():
+                    obj = objs[i % len(objs)]
+                    try:
+                        served.append((i % len(objs), post_one(url, obj)))
+                    except Exception as e:  # noqa: BLE001 drill verdict
+                        failures.append((i, repr(e)))
+                    i += 1
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=scorer, daemon=True)
+            t.start()
+            try:
+                time.sleep(0.5)  # traffic flowing on both replicas
+                t0 = time.monotonic()
+                kill_machine(agents[0][0])  # machine 0 is GONE
+                # First the supervisor must NOTICE (probe/heartbeat
+                # deadline) — polling for "recovered" straight away
+                # would read the pre-death state as a 0-second drill.
+                deadline = time.monotonic() + 30.0
+                noticed = False
+                while time.monotonic() < deadline:
+                    if (fleet._degraded or fleet.supervisor.states()
+                            != {0: "up", 1: "up"}):
+                        noticed = True
+                        break
+                    time.sleep(0.05)
+                detect_s = time.monotonic() - t0
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    if (fleet.supervisor.states() == {0: "up", 1: "up"}
+                            and not fleet._degraded):
+                        break
+                    time.sleep(0.2)
+                recovery_s = time.monotonic() - t0
+                recovered = noticed and (
+                    fleet.supervisor.states() == {0: "up", 1: "up"}
+                    and not fleet._degraded)
+                time.sleep(0.5)  # a post-recovery traffic tail
+            finally:
+                stop.set()
+                t.join(timeout=60.0)
+            after = fleet.metrics.snapshot()
+            handle = fleet.supervisor.replicas[0]
+            mismatches = sum(1 for idx, s in served
+                             if s != expected[idx])
+            out["fabric_rehome_seconds"] = round(
+                after["rehome_seconds_max"], 3)
+            out["fabric_rehome_deadline_s"] = rehome_deadline_s
+            out["fabric_detect_seconds"] = round(detect_s, 3)
+            out["fabric_recovery_seconds"] = round(recovery_s, 3)
+            out["fabric_recovered"] = recovered
+            out["fabric_crossed_machines"] = (
+                handle.machine == agents[1][1])
+            out["fabric_unserved_total"] = int(
+                after["unserved_total"] - before["unserved_total"]
+                + len(failures))
+            out["fabric_drill_requests"] = len(served)
+            out["fabric_drill_parity_ok"] = mismatches == 0
+            out["fabric_drill_parity_mismatches"] = mismatches
+        finally:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+            if fleet is not None:
+                fleet.close()
+            for proc, _ in agents:
+                kill_machine(proc)
+
+
+def bench_fabric(n=1 << 14, d=2048, nnz=16, chunk_rows=1 << 11,
+                 passes=12):
+    """Multi-host fabric lines (docs/STREAMING.md "Multi-host
+    streaming"; gated by check_bench_regression.py):
+
+    - ``fabric_d1_parity_max_abs_diff`` — the W=1 short-circuit's
+      (value, gradient, margins) vs the local chunked stream; REQUIRED
+      exactly 0.0 (single-group runs must be BIT-identical, or every
+      single-host result becomes un-reproducible on the fabric path);
+    - ``fabric_dcn_allreduce_ms_per_pass`` / ``_bytes_per_pass`` — a
+      2-rank world (threaded hosts, real sockets) streaming the shared
+      pass; the per-round DCN wall and wire bytes come from the
+      fabric's own counters, so the line cross-checks the ONE-allreduce
+      -per-pass design invariant (``fabric_dcn_rounds_per_pass``);
+    - the cross-machine re-home drill lines (see
+      ``_fabric_rehome_drill``), validity-stamped on <4-core boxes.
+
+    Standalone (``python bench.py bench_fabric``): the drill spawns
+    agents + replica subprocesses, which would contend with the device
+    phases if run inside the full sweep."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.fabric.collective import FabricComm
+    from photon_ml_tpu.fabric.stream import FabricChunkStream
+    from photon_ml_tpu.obs.metrics import MetricsRegistry
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops import streaming_sparse as ss
+
+    chunked = _fabric_chunked(n, d, nnz, chunk_rows, num_hot=64)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    off = jnp.zeros((chunked.num_chunks * chunked.chunk_rows,))
+    out: dict = {
+        "fabric_pass_config":
+            f"n={n} d={d} chunks={chunked.num_chunks}",
+    }
+
+    # --- D=1 single-group bit-parity (the gate) ------------------------
+    comm = FabricComm(0, 1)
+    try:
+        fs = FabricChunkStream(chunked, comm)
+        v_f, g_f = fs.value_and_gradient(losses.LOGISTIC)(w, off)
+        m_f = np.asarray(fs.margins(w))
+    finally:
+        comm.close()
+    v_l, g_l = ss.make_value_and_gradient(losses.LOGISTIC, chunked)(w, off)
+    m_l = np.asarray(ss.margins_chunked(chunked, w))
+    out["fabric_d1_parity_max_abs_diff"] = float(max(
+        abs(float(v_f) - float(v_l)),
+        float(np.max(np.abs(np.asarray(g_f) - np.asarray(g_l)))),
+        float(np.max(np.abs(m_f - m_l)))))
+
+    # --- 2-rank DCN allreduce wall per pass ----------------------------
+    mx = MetricsRegistry()
+    with obs.activated(metrics_obj=mx):
+        comms = [FabricComm(0, 2, timeout_s=120.0)]
+        comms.append(FabricComm(1, 2, coordinator=comms[0].coordinator,
+                                timeout_s=120.0))
+        walls = [None, None]
+
+        def host(rank):
+            fs = FabricChunkStream(chunked, comms[rank])
+            vg = fs.value_and_gradient(losses.LOGISTIC)
+            vg(w, off)  # warm both ranks' compiled pass
+            t0 = time.perf_counter()
+            for _ in range(passes):
+                v, _g = vg(w, off)
+            float(v)
+            walls[rank] = time.perf_counter() - t0
+
+        import threading
+        threads = [threading.Thread(target=host, args=(r,), daemon=True)
+                   for r in (0, 1)]
+        try:
+            for t in threads:
+                t.start()
+        finally:
+            for t in threads:
+                t.join(600.0)
+        for c in comms:
+            c.close()
+    if any(wl is None for wl in walls):
+        raise RuntimeError("a fabric rank never finished its passes")
+    snap = mx.snapshot()
+    rounds = snap.get('photon_fabric_allreduce_total{op="allreduce"}', 0)
+    dcn_s = snap.get("photon_fabric_allreduce_seconds_total", 0.0)
+    wire = snap.get("photon_fabric_bytes_total", 0)
+    out["fabric_world"] = 2
+    out["fabric_passes"] = passes
+    # rounds counts per-rank completions: world x (warmup + passes).
+    out["fabric_dcn_rounds_per_pass"] = round(
+        rounds / (2 * (passes + 1)), 3)
+    out["fabric_dcn_allreduce_ms_per_pass"] = round(
+        1e3 * dcn_s / max(rounds, 1), 4)
+    out["fabric_dcn_bytes_per_pass"] = round(wire / max(rounds, 1))
+    out["fabric_pass_seconds"] = round(max(walls) / passes, 4)
+
+    # --- the cross-machine drill (validity-stamped) --------------------
+    _progress("fabric: cross-machine re-home drill (2 agents, "
+              "whole-machine SIGKILL)")
+    _fabric_rehome_drill(out)
+    cores = os.cpu_count() or 1
+    if cores < 4:
+        out["fabric_rehome_valid"] = False
+        out["fabric_rehome_invalid_reason"] = (
+            f"{cores} cores < 4 — agents, replicas, fleet, and driver "
+            f"share cores; the drill walls measure scheduler "
+            f"contention, not re-home")
+    return out
+
+
 def bench_game_iteration(n=100_000, n_users=2000, n_items=500):
     """One GAME coordinate-descent sweep (fixed + per-user + per-item),
     steady-state, by the slope between 1- and 6-iteration runs."""

@@ -655,6 +655,66 @@ def main() -> int:
                         f"> {limit:.3g}ms — the failure-window tail "
                         f"broke its band")
 
+    # --- multi-host fabric invariants (bench.py bench_fabric;
+    # docs/STREAMING.md "Multi-host streaming", docs/SERVING.md
+    # "Multi-host fleet") — guarded on line presence (committed tails
+    # predate the fabric). Correctness gates (D=1 bit-parity, unserved,
+    # drill parity) hold regardless of validity; the re-home wall is
+    # reported-only when the drill ran on a <4-core box
+    # (fabric_rehome_valid: false).
+    d1 = fresh.get("fabric_d1_parity_max_abs_diff")
+    if d1 is not None:
+        ok = float(d1) == 0.0
+        print(f"fabric_d1_parity_max_abs_diff: {d1:g} (must be 0) "
+              f"{'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"fabric_d1_parity_max_abs_diff: {d1:g} != 0 — the "
+                f"W=1 fabric short-circuit must be BIT-identical to "
+                f"the local stream, or single-host results stop "
+                f"reproducing on the fabric path")
+    fab_rehome = fresh.get("fabric_rehome_seconds")
+    if fab_rehome is not None:
+        fab_valid = fresh.get("fabric_rehome_valid") is not False
+        if fresh.get("fabric_recovered") is False:
+            failures.append(
+                "fabric_recovered: the killed machine's replica never "
+                "came back up — the cross-machine drill measured a "
+                "fleet that did not recover")
+            print("fabric_recovered: False REGRESSION")
+        if fresh.get("fabric_crossed_machines") is False:
+            failures.append(
+                "fabric_crossed_machines: the respawn did not fail "
+                "over to the surviving machine — whole-machine death "
+                "is unhandled")
+            print("fabric_crossed_machines: False REGRESSION")
+        ddl = float(fresh.get("fabric_rehome_deadline_s", 5.0))
+        ok = float(fab_rehome) <= ddl
+        print(f"fabric_rehome_seconds: {fab_rehome:g}s vs deadline "
+              f"{ddl:g}s "
+              f"{'OK' if ok else 'REGRESSION' if fab_valid else 'reported-only (invalid)'}")
+        if fab_valid and not ok:
+            failures.append(
+                f"fabric_rehome_seconds: {fab_rehome:g}s > {ddl:g}s — "
+                f"cross-machine shard re-home broke its deadline")
+        unserved = fresh.get("fabric_unserved_total")
+        if unserved is not None:
+            ok = int(unserved) == 0
+            print(f"fabric_unserved_total: {unserved} (must be 0) "
+                  f"{'OK' if ok else 'REGRESSION'}")
+            if not ok:
+                failures.append(
+                    f"fabric_unserved_total: {unserved} request(s) "
+                    f"went unserved through the whole-machine drill — "
+                    f"the cross-machine failover dropped traffic")
+        if fresh.get("fabric_drill_parity_ok") is False:
+            failures.append(
+                f"fabric_drill_parity_ok: "
+                f"{fresh.get('fabric_drill_parity_mismatches')} drill "
+                f"score(s) differ from the fleet's pre-drill bits — "
+                f"remote re-homed scoring is WRONG, not merely slow")
+            print("fabric_drill_parity_ok: False REGRESSION")
+
     # --- elastic Zipf-sweep invariants (docs/SERVING.md "Elastic
     # fleet"): knee QPS and steady p99 must HOLD as skew rises with
     # the control loop armed; the static map's degradation rides

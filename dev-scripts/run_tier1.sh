@@ -151,6 +151,18 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/kernel_smoke.py; rc=$?
 fi
 
+# Fabric smoke (docs/STREAMING.md "Multi-host streaming"): a REAL
+# 2-process jax.distributed CPU fit with the host-level fabric armed —
+# chunk ranges shard over the two ranks, host partials meet in one
+# cross-host allreduce per pass, coefficients match a single-process
+# streamed oracle within the 5e-3 sharded-parity band, and the shared
+# ledger carries a matching fabric_digest row per accepted iteration.
+# Guarded: skips loudly (rc 0) if jax.distributed cannot init here.
+# ~1-2 minutes on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/fabric_smoke.py; rc=$?
+fi
+
 # Opt-in staging-bench regression gate (slow: measures a fresh 10M-row
 # staging tail, several minutes). PML_CHECK_BENCH=1 enables it; a >20%
 # regression of the guarded staging lines vs the committed round

@@ -137,6 +137,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "tunes it (e.g. 'split_factor=3,interval=0.5,"
                         "max_replicas=6' — see "
                         "photon_ml_tpu/serving/elastic.py)")
+    # -- multi-host fleet (docs/SERVING.md "Multi-host fleet") -----------
+    p.add_argument("--machines", default=None,
+                   metavar="URL,URL,...",
+                   help="comma-separated machine-agent base URLs "
+                        "(python -m photon_ml_tpu.fabric.agent); when "
+                        "set, replicas run UNDER those agents "
+                        "(RemoteTransport: probe/adopt/restart by "
+                        "host:port) instead of as local subprocesses. "
+                        "Replica rid homes on machine rid %% N, with "
+                        "cross-machine failover on whole-machine death")
+    p.add_argument("--machine-timeout-s", type=float, default=5.0,
+                   help="per-call timeout for the agent control plane")
+    p.add_argument("--delta-base-url", default=None,
+                   help="replicas PULL publish deltas from this URL "
+                        "(a DeltaArtifactServer over the publish dir) "
+                        "instead of a shared-filesystem path; 'auto' "
+                        "starts one over --publish-dir and uses it")
     # -- fleet SLO -------------------------------------------------------
     p.add_argument("--slo-window-s", type=float, default=60.0)
     p.add_argument("--slo-availability", type=float, default=0.999)
@@ -190,7 +207,20 @@ def create_fleet(args) -> ServingFleet:
             flt.install(flt.FaultPlan.from_json(f.read()))
         logger.warning("fault plan %s ARMED in the fleet driver",
                        args.fault_plan)
-    return ServingFleet(
+    transport = None
+    machines = [m for m in (args.machines or "").split(",") if m]
+    delta_base_url = getattr(args, "delta_base_url", None)
+    delta_server = None
+    if delta_base_url == "auto":
+        if not args.publish_dir:
+            raise SystemExit("--delta-base-url auto needs --publish-dir")
+        from photon_ml_tpu.fabric.transport import DeltaArtifactServer
+
+        delta_server = DeltaArtifactServer(args.publish_dir)
+        delta_base_url = delta_server.base_url
+        logger.info("delta artifacts served at %s (over %s)",
+                    delta_base_url, args.publish_dir)
+    fleet = ServingFleet(
         replica_args=replica_args_from(args),
         num_replicas=args.replicas,
         workdir=workdir,
@@ -216,7 +246,22 @@ def create_fleet(args) -> ServingFleet:
         slo_latency_ms=args.slo_latency_ms,
         publish_dir=args.publish_dir,
         publish_bake_s=args.publish_bake_s,
-        publish_burn_threshold=args.publish_burn_threshold)
+        publish_burn_threshold=args.publish_burn_threshold,
+        transport=transport,
+        delta_base_url=delta_base_url)
+    if machines:
+        # The transport needs the fleet's argv builder — constructed
+        # after so the supervisor's default LocalTransport is simply
+        # replaced before anything spawned.
+        from photon_ml_tpu.fabric.transport import RemoteTransport
+
+        fleet.supervisor.transport = RemoteTransport(
+            machines, fleet._replica_argv,
+            timeout_s=args.machine_timeout_s)
+        logger.info("fleet runs REMOTE: %d machine agent(s) %s",
+                    len(machines), machines)
+    fleet.delta_server = delta_server
+    return fleet
 
 
 def run(args) -> None:
@@ -245,6 +290,8 @@ def run(args) -> None:
         if server is not None:
             server.server_close()
         fleet.close()
+        if getattr(fleet, "delta_server", None) is not None:
+            fleet.delta_server.close()
 
 
 def main(argv=None):

@@ -143,6 +143,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
                         "/ JAX_PROCESS_ID; automatic on Cloud TPU). "
                         "Recovery from a lost host is restart + --resume.")
+    p.add_argument("--fabric", action="store_true",
+                   help="arm the host-level DCN fabric for streamed "
+                        "fixed-effect fits (PHOTON_FABRIC_WORLD / "
+                        "PHOTON_FABRIC_RANK / PHOTON_FABRIC_COORDINATOR; "
+                        "docs/STREAMING.md \"Multi-host streaming\"): "
+                        "chunk ranges shard over hosts, host partials "
+                        "meet in one cross-host allreduce per pass, and "
+                        "every accepted iteration exchanges cross-rank "
+                        "digests. Composes with --distributed; the mesh "
+                        "then spans LOCAL devices only.")
     p.add_argument("--staging-cache-dir",
                    help="persist projected random-effect staging artifacts "
                         "here, keyed by dataset content digest — a re-run "
@@ -401,6 +411,18 @@ def _sync_global_devices_or_skip(tag: str) -> None:
             jax.default_backend(), e)
 
 
+def _disarm_fabric() -> None:
+    """Release the process-wide fabric (run bracket: an in-process
+    caller — tests, the smoke drivers — must not leak an armed comm or
+    a bound coordinator socket into the next run)."""
+    from photon_ml_tpu.fabric import runtime as fabric_runtime
+
+    comm = fabric_runtime.active()
+    if comm is not None:
+        fabric_runtime.install(None)
+        comm.close()
+
+
 def run(args) -> dict:
     """Driver entry: observability bracket around the real run (the
     trace/metrics dumps happen in a ``finally`` so a crashed fit still
@@ -430,7 +452,11 @@ def run(args) -> dict:
                     obs.dump_metrics(metrics_dump)
                     logger.info("wrote metrics %s", metrics_dump)
             obs.disable()
-    return _run(args)
+            _disarm_fabric()
+    try:
+        return _run(args)
+    finally:
+        _disarm_fabric()
 
 
 def _run(args) -> dict:
@@ -569,11 +595,30 @@ def _run(args) -> dict:
     if args.tuning != "NONE" and (not args.validation or not evaluators):
         # Fail at argument time, not after an hours-long grid sweep.
         raise ValueError("--tuning requires --validation and --evaluators")
+    fabric_comm = None
+    if getattr(args, "fabric", False):
+        # Arm the process-wide fabric BEFORE the estimator stages any
+        # streamed coordinate (fabric/runtime.py). The mesh goes LOCAL:
+        # cross-host traffic rides the FabricComm allreduce, never an
+        # XLA collective (unimplemented on CPU process groups).
+        from photon_ml_tpu.fabric import runtime as fabric_runtime
+
+        fabric_comm = fabric_runtime.comm_from_env()
+        if fabric_comm is None:
+            raise ValueError(
+                "--fabric needs PHOTON_FABRIC_WORLD >= 2 plus "
+                "PHOTON_FABRIC_RANK / PHOTON_FABRIC_COORDINATOR in the "
+                "environment (fabric/runtime.comm_from_env)")
+        fabric_runtime.install(fabric_comm)
+        logger.info("fabric armed: rank %d/%d (coordinator %s:%d)",
+                    fabric_comm.rank, fabric_comm.world,
+                    *fabric_comm.coordinator)
     est = GameEstimator(
         task=task,
         coordinates=coordinates,
         update_sequence=[c for c in args.update_sequence.split(",") if c],
-        mesh=make_mesh(distributed=getattr(args, "distributed", False)),
+        mesh=make_mesh(distributed=getattr(args, "distributed", False),
+                       local=fabric_comm is not None),
         descent_iterations=args.iterations,
         validation_evaluators=evaluators,
         staging_cache_dir=args.staging_cache_dir,
@@ -595,7 +640,8 @@ def _run(args) -> dict:
     # flow needs identical resume state — checkpoint_dir must be a shared
     # filesystem); SAVES are rank-0-only inside CheckpointManager.
     import jax
-    is_primary = jax.process_index() == 0
+    is_primary = jax.process_index() == 0 and (
+        fabric_comm is None or fabric_comm.rank == 0)
 
     if getattr(args, "resume", False) and not getattr(args, "checkpoint", True):
         raise ValueError("--resume requires checkpointing; "

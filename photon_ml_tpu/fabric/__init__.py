@@ -1,0 +1,44 @@
+"""photon-fabric: the multi-host seam (docs/STREAMING.md "Multi-host
+streaming", docs/SERVING.md "Multi-host fleet").
+
+Everything through PR 18 spans chips on ONE host; this package makes
+training and serving span machines, and makes every cross-machine edge
+survive the established fault kinds (``partition``, ``delay``,
+``replica_kill``) plus whole-host death:
+
+- ``collective.py`` — ``FabricComm``, the host-level DCN collective:
+  per-host ICI psum partials meet in ONE cross-host allreduce with the
+  chunk-transfer retry ladder extended to the DCN edge (bounded
+  deterministic backoff, then a loud ``FabricPartitioned`` — a silently
+  dropped partial changes the objective), plus per-iteration cross-rank
+  digest rows so rank divergence is DETECTED (``RankDivergence``), not
+  assumed away.
+- ``stream.py`` — ``FabricChunkStream``, the streamed fixed-effect pass
+  sharded rank-wise over hosts (same duck type as
+  ``ops/streaming_sparse.ShardedChunkStream``).
+- ``runtime.py`` — the per-process fabric registration the CLI arms
+  (``game_train --fabric``), read by the streaming coordinate and the
+  checkpoint store's primary-rank gate.
+- ``transport.py`` — the address-based replica transport behind
+  ``ReplicaSupervisor`` (``LocalTransport`` = the original subprocess
+  spawn, verbatim; ``RemoteTransport`` = probe/adopt/restart replicas
+  on machine agents by host:port), plus the HTTP delta artifact server
+  remote replicas pull CRC-fenced publish deltas from.
+- ``agent.py`` — the per-machine agent process that owns a machine's
+  replicas (one process group: whole-group SIGKILL == whole-machine
+  death in drills).
+"""
+
+from photon_ml_tpu.fabric.collective import (FabricComm, FabricError,
+                                             FabricPartitioned,
+                                             RankDivergence)
+from photon_ml_tpu.fabric.runtime import active, install
+
+__all__ = [
+    "FabricComm",
+    "FabricError",
+    "FabricPartitioned",
+    "RankDivergence",
+    "active",
+    "install",
+]

@@ -1,0 +1,284 @@
+"""The per-machine replica agent (docs/SERVING.md "Multi-host fleet").
+
+One agent process per "machine" owns that machine's scoring replicas:
+it spawns them (same spawn-not-fork, logs-to-files, generation-named
+ready-file discipline as the local supervisor), answers the control
+plane ``RemoteTransport`` drives (spawn / liveness / kill / stop), and
+— deliberately — keeps its replicas in its OWN process group: the
+agent is started as a session leader, children inherit the group, so a
+whole-machine death is one ``killpg`` in a drill and one power failure
+in production. The fleet's supervisor never sees a pid, only states.
+
+Control plane (every response JSON; the transport side carries the
+timeouts):
+
+- ``GET /healthz``        — agent liveness + replica state map
+- ``GET /replica/<rid>``  — one replica: ``absent`` / ``starting`` /
+  ``up`` (address known) / ``exited`` (rc)
+- ``POST /spawn``         — ``{"replica_id", "argv"}``; the agent
+  substitutes its own interpreter for ``argv[0]`` and its own workdir
+  path for the ``--ready-file`` value, then spawns
+- ``POST /kill``          — SIGKILL + reap
+- ``POST /stop``          — graceful terminate, escalating
+
+The agent itself follows the replica ready-file contract
+(``--ready-file`` written atomically after bind), so a harness can
+await it exactly like a replica.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import logging
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+logger = logging.getLogger("photon_ml_tpu.fabric.agent")
+
+
+class _Replica:
+    """One spawned replica's bookkeeping (guarded by the agent lock for
+    map access; the Popen object is thread-safe for poll/signal)."""
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self.proc: Optional[subprocess.Popen] = None
+        self.generation = 0
+        self.ready_file = ""
+        self.log_path = ""
+
+
+class MachineAgent:
+    def __init__(self, workdir: str, machine: str = "m0"):
+        self.workdir = workdir
+        self.machine = machine
+        self._replicas: dict[int, _Replica] = {}
+        self._lock = threading.Lock()
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- state views ---------------------------------------------------------
+
+    def _rec(self, rid: int) -> _Replica:
+        with self._lock:
+            rec = self._replicas.get(rid)
+            if rec is None:
+                rec = self._replicas[rid] = _Replica(rid)
+            return rec
+
+    def replica_info(self, rid: int) -> dict:
+        with self._lock:
+            rec = self._replicas.get(rid)
+        if rec is None or rec.proc is None:
+            return {"state": "absent"}
+        rc = rec.proc.poll()
+        if rc is not None:
+            return {"state": "exited", "rc": rc, "pid": rec.proc.pid,
+                    "log_path": rec.log_path,
+                    "generation": rec.generation}
+        info = {"state": "starting", "pid": rec.proc.pid,
+                "log_path": rec.log_path, "generation": rec.generation}
+        try:
+            with open(rec.ready_file) as f:
+                ready = json.load(f)
+            info.update({"state": "up",
+                         "host": ready.get("host", "127.0.0.1"),
+                         "port": int(ready["port"])})
+        except (OSError, ValueError, KeyError):
+            pass  # not ready yet (or torn mid-write) — still starting
+        return info
+
+    def healthz(self) -> dict:
+        with self._lock:
+            rids = list(self._replicas)
+        return {"status": "ok", "machine": self.machine,
+                "pid": os.getpid(),
+                "replicas": {str(r): self.replica_info(r)["state"]
+                             for r in rids}}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def spawn(self, rid: int, argv: list[str]) -> dict:
+        rec = self._rec(rid)
+        if rec.proc is not None and rec.proc.poll() is None:
+            # Respawn over a live incarnation: kill it first — two
+            # processes racing one replica id would split the shard.
+            self._kill_proc(rec.proc)
+        rec.generation += 1
+        rec.ready_file = os.path.join(
+            self.workdir, f"replica-{rid}.g{rec.generation}.ready")
+        if os.path.exists(rec.ready_file):
+            os.unlink(rec.ready_file)
+        rec.log_path = os.path.join(self.workdir, f"replica-{rid}.log")
+        argv = list(argv)
+        argv[0] = sys.executable  # the controller's interpreter path
+        for i, a in enumerate(argv):  # ... and its ready-file path ...
+            if a == "--ready-file" and i + 1 < len(argv):
+                argv[i + 1] = rec.ready_file  # ... are both ours now
+        import photon_ml_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(photon_ml_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+        log_f = open(rec.log_path, "ab")
+        try:
+            # No start_new_session: the replica stays in the AGENT's
+            # process group — whole-machine death is one killpg.
+            rec.proc = subprocess.Popen(
+                argv, stdout=log_f, stderr=subprocess.STDOUT,
+                cwd=self.workdir, env=env)
+        finally:
+            log_f.close()
+        logger.info("machine %s: replica %d spawned (pid %d, gen %d)",
+                    self.machine, rid, rec.proc.pid, rec.generation)
+        return {"ok": True, "generation": rec.generation,
+                "pid": rec.proc.pid}
+
+    @staticmethod
+    def _kill_proc(proc: subprocess.Popen) -> None:
+        try:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=5.0)
+        except (OSError, subprocess.TimeoutExpired):
+            logger.warning("could not reap pid %d", proc.pid)
+
+    def kill(self, rid: int) -> dict:
+        with self._lock:
+            rec = self._replicas.get(rid)
+        if rec is not None and rec.proc is not None \
+                and rec.proc.poll() is None:
+            self._kill_proc(rec.proc)
+        return {"ok": True}
+
+    def stop(self, rid: int, timeout_s: float = 10.0) -> dict:
+        with self._lock:
+            rec = self._replicas.get(rid)
+        if rec is not None and rec.proc is not None \
+                and rec.proc.poll() is None:
+            rec.proc.terminate()
+            try:
+                rec.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self._kill_proc(rec.proc)
+        return {"ok": True}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            recs = list(self._replicas.values())
+        for rec in recs:
+            if rec.proc is not None and rec.proc.poll() is None:
+                self.stop(rec.rid, timeout_s=5.0)
+
+
+class _AgentHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        logger.debug("agent: " + fmt, *args)
+
+    def _json(self, code: int, body: dict) -> None:
+        blob = json.dumps(body).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_GET(self):
+        agent: MachineAgent = self.server.agent  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            self._json(200, agent.healthz())
+        elif self.path.startswith("/replica/"):
+            try:
+                rid = int(self.path.rsplit("/", 1)[-1])
+            except ValueError:
+                self._json(400, {"error": "bad replica id"})
+                return
+            self._json(200, agent.replica_info(rid))
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        agent: MachineAgent = self.server.agent  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as e:
+            self._json(400, {"error": f"malformed request: {e}"})
+            return
+        try:
+            if self.path == "/spawn":
+                out = agent.spawn(int(payload["replica_id"]),
+                                  list(payload["argv"]))
+            elif self.path == "/kill":
+                out = agent.kill(int(payload["replica_id"]))
+            elif self.path == "/stop":
+                out = agent.stop(int(payload["replica_id"]),
+                                 float(payload.get("timeout_s", 10.0)))
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+        except (KeyError, TypeError, ValueError) as e:
+            self._json(400, {"error": f"malformed request: {e}"})
+            return
+        self._json(200, out)
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
+                           http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-fabric-agent", description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", required=True,
+                   help="replica logs + ready files live here")
+    p.add_argument("--machine", default="m0",
+                   help="machine name reported in logs and /healthz")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (read it from the ready file)")
+    p.add_argument("--ready-file",
+                   help="write {pid, host, port} here once bound (the "
+                        "replica ready-file contract, reused)")
+    return p
+
+
+def main(argv=None) -> int:
+    from photon_ml_tpu.utils.logging import setup_logging
+
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    agent = MachineAgent(args.workdir, machine=args.machine)
+    server = _ThreadingHTTPServer((args.host, args.port), _AgentHandler)
+    server.agent = agent  # type: ignore[attr-defined]
+    host, port = server.server_address[:2]
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "host": host, "port": port}, f)
+        os.replace(tmp, args.ready_file)
+    logger.info("machine agent %s up at http://%s:%d (workdir %s)",
+                args.machine, host, port, args.workdir)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.shutdown()
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

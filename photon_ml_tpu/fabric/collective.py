@@ -1,0 +1,380 @@
+"""``FabricComm``: the host-level DCN collective under the ICI psum.
+
+Snap ML's hierarchy (PAPERS.md) and the reference's ``treeAggregate``
+both reduce to the same shape: a fast intra-node level under ONE
+cross-node aggregation seam. Intra-host that seam is the compiled
+``psum`` in ``ops/streaming_sparse._merge_fn``; THIS module is the
+cross-host level — and because XLA's multiprocess collectives are not
+available on the CPU backend (the CI box, and any ``jax.distributed``
+CPU process group), the cross-host allreduce runs at the HOST level
+over plain TCP, where it can also be partitioned, delayed, and killed
+by the fault injector like any other edge in the system.
+
+Topology: rank 0 hosts the coordinator (one connection per request —
+no long-lived streams to half-close), every rank (rank 0 included, via
+loopback, so all ranks share one code path) contributes its host
+partial and blocks for the reduced result. Contributions are stored
+idempotently per ``(tag, seq, rank)`` — a retry after a torn send
+overwrites, never double-counts — and the reduction is computed in
+RANK ORDER, so the result is deterministic and byte-identical on every
+rank. World size 1 returns the contribution unchanged (bit-parity with
+the single-host path, asserted by the bench gate).
+
+Failure ladder (the chunk-transfer ladder of
+``ops/streaming_sparse._transfer``, extended to the DCN edge):
+
+- every socket operation carries a finite timeout (PML011);
+- a dropped/timed-out round retries with bounded DETERMINISTIC backoff
+  (``retry_backoff_s * attempt`` — drills must replay exactly), firing
+  ``fabric.dcn_allreduce`` per attempt;
+- exhaustion raises ``FabricPartitioned`` — loud and defined, because a
+  silently dropped partial CHANGES THE OBJECTIVE;
+- a rank arriving with the wrong sequence number for a tag, or a
+  per-iteration digest that disagrees across ranks, raises
+  ``RankDivergence`` on every rank: divergence is detected, not
+  assumed away.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu import faults as flt
+from photon_ml_tpu import obs
+
+logger = logging.getLogger("photon_ml_tpu.fabric")
+
+# The DCN edge's retry ladder: bounded, deterministic (no jitter — a
+# drill must replay exactly), then loud. Mirrors TRANSFER_MAX_RETRIES /
+# TRANSFER_RETRY_BACKOFF_S on the host→device edge.
+DCN_MAX_RETRIES = 2
+DCN_RETRY_BACKOFF_S = 0.05
+
+_HEADER_LIMIT = 1 << 16  # a header line larger than 64 KiB is a protocol bug
+
+
+class FabricError(RuntimeError):
+    """Base class for fabric transport failures."""
+
+
+class FabricPartitioned(FabricError):
+    """A cross-host round exhausted its retry ladder — the DCN edge is
+    (or is injected to be) partitioned. Loud by design: a silently
+    dropped partial changes the objective."""
+
+
+class RankDivergence(FabricError):
+    """Ranks disagree — wrong sequence number for a collective tag, or
+    mismatched per-iteration digests. The run is wrong on at least one
+    host; continuing would average two different optimizations."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(min(1 << 20, n - len(buf)))
+        if not part:
+            raise ConnectionError(
+                f"peer closed mid-payload ({len(buf)}/{n} bytes)")
+        buf += part
+    return bytes(buf)
+
+
+def _recv_header(sock: socket.socket) -> dict:
+    buf = bytearray()
+    while not buf.endswith(b"\n"):
+        if len(buf) > _HEADER_LIMIT:
+            raise ConnectionError("oversized fabric header")
+        part = sock.recv(1)
+        if not part:
+            raise ConnectionError("peer closed mid-header")
+        buf += part
+    return json.loads(buf.decode("utf-8"))
+
+
+def _send(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    sock.sendall(json.dumps(header).encode("utf-8") + b"\n" + payload)
+
+
+class _Round:
+    """One in-flight collective round for a tag (coordinator state)."""
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self.contrib: dict[int, object] = {}  # rank -> payload (idempotent)
+        self.result: Optional[object] = None
+        self.error: Optional[str] = None
+
+
+class _CoordinatorState:
+    """Rank-0 reduction state: per-tag open round + last completed
+    result (served to retries whose response was lost)."""
+
+    def __init__(self, world: int):
+        self.world = world
+        self.cond = threading.Condition()
+        self.open: dict[str, _Round] = {}
+        self.done_seq: dict[str, int] = {}
+        self.done_result: dict[str, object] = {}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: D102 - socketserver contract
+        st: _CoordinatorState = self.server.state  # type: ignore[attr-defined]
+        sock = self.request
+        sock.settimeout(self.server.timeout_s)  # type: ignore[attr-defined]
+        try:
+            hdr = _recv_header(sock)
+            payload = _recv_exact(sock, int(hdr.get("nbytes", 0)))
+            self._serve(st, sock, hdr, payload)
+        except (OSError, ValueError, KeyError) as e:
+            logger.debug("fabric coordinator: dropped request (%s)", e)
+
+    def _serve(self, st: _CoordinatorState, sock, hdr: dict,
+               payload: bytes) -> None:
+        rank, op = int(hdr["rank"]), str(hdr["op"])
+        tag, seq = str(hdr["tag"]), int(hdr["seq"])
+        deadline = time.monotonic() + self.server.timeout_s  # type: ignore[attr-defined]
+        with st.cond:
+            done = st.done_seq.get(tag, 0)
+            if seq == done:
+                # Retry of a COMPLETED round whose response was lost:
+                # serve the cached result — idempotent, never re-reduced.
+                self._reply(sock, op, hdr, st.done_result[tag])
+                return
+            if seq != done + 1:
+                # This rank is on a different iteration than the fabric:
+                # poison the open round so every waiter learns too.
+                msg = (f"rank {rank} sent seq {seq} for tag {tag!r} "
+                       f"(fabric is at {done})")
+                rnd = st.open.get(tag)
+                if rnd is not None:
+                    rnd.error = msg
+                    st.cond.notify_all()
+                _send(sock, {"ok": False, "kind": "divergence",
+                             "error": msg})
+                return
+            rnd = st.open.get(tag)
+            if rnd is None or rnd.seq != seq:
+                rnd = _Round(seq)
+                st.open[tag] = rnd
+            rnd.contrib[rank] = (hdr, payload)  # overwrite = retry-safe
+            if len(rnd.contrib) == st.world and rnd.result is None:
+                rnd.result = _reduce(op, rnd.contrib, st.world)
+                st.done_seq[tag] = seq
+                st.done_result[tag] = rnd.result
+                del st.open[tag]
+                st.cond.notify_all()
+            while rnd.result is None and rnd.error is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # Finite wait (PML011): an absent peer turns into a
+                    # timeout the CLIENT ladder retries, not a hang.
+                    _send(sock, {"ok": False, "kind": "timeout",
+                                 "error": f"round {tag}:{seq} incomplete "
+                                          f"({len(rnd.contrib)}/{st.world} "
+                                          f"ranks)"})
+                    return
+                st.cond.wait(timeout=remaining)
+            if rnd.error is not None:
+                _send(sock, {"ok": False, "kind": "divergence",
+                             "error": rnd.error})
+                return
+            self._reply(sock, op, hdr, rnd.result)
+
+    @staticmethod
+    def _reply(sock, op: str, hdr: dict, result) -> None:
+        if op == "digest":
+            blob = json.dumps(result).encode("utf-8")
+            _send(sock, {"ok": True, "nbytes": len(blob)}, blob)
+        else:
+            arr = result
+            _send(sock, {"ok": True, "nbytes": arr.nbytes,
+                         "shape": list(arr.shape)},
+                  arr.tobytes())
+
+
+def _reduce(op: str, contrib: dict, world: int):
+    """Deterministic rank-order reduction of a complete round."""
+    if op == "digest":
+        digests = {r: contrib[r][1].decode("utf-8") for r in range(world)}
+        return {"digests": digests,
+                "match": len(set(digests.values())) == 1}
+    arrays = []
+    for r in range(world):
+        hdr, payload = contrib[r]
+        arrays.append(np.frombuffer(payload, dtype=np.float64)
+                      .reshape(hdr["shape"]))
+    if op == "allgather":
+        return np.ascontiguousarray(np.concatenate(arrays, axis=0))
+    out = arrays[0].copy()
+    for r in range(1, world):  # rank order: byte-identical on every rank
+        out += arrays[r]
+    return out
+
+
+class _CoordinatorServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FabricComm:
+    """One rank's handle on the fabric (coordinator hosted by rank 0).
+
+    ``world == 1`` short-circuits every collective locally — the
+    single-host path pays zero sockets and stays bit-identical.
+    """
+
+    def __init__(self, rank: int, world: int,
+                 coordinator: tuple[str, int] = ("127.0.0.1", 0),
+                 timeout_s: float = 10.0,
+                 max_retries: int = DCN_MAX_RETRIES,
+                 retry_backoff_s: float = DCN_RETRY_BACKOFF_S):
+        if not 0 <= rank < world:
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.rank = int(rank)
+        self.world = int(world)
+        self.timeout_s = float(timeout_s)
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._seq: dict[str, int] = {}
+        self._seq_lock = threading.Lock()
+        self._server: Optional[_CoordinatorServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        if self.world > 1 and self.rank == 0:
+            self._server = _CoordinatorServer(coordinator, _Handler)
+            self._server.state = _CoordinatorState(self.world)  # type: ignore[attr-defined]
+            self._server.timeout_s = self.timeout_s  # type: ignore[attr-defined]
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="photon-fabric-coordinator", daemon=True)
+            self._server_thread.start()
+            coordinator = self._server.server_address[:2]
+        self.coordinator = (str(coordinator[0]), int(coordinator[1]))
+        mx = obs.metrics()
+        if mx is not None:
+            mx.gauge("photon_fabric_world_size").set(float(self.world))
+
+    # -- collectives ---------------------------------------------------------
+
+    def allreduce(self, x, tag: str) -> np.ndarray:
+        """Sum ``x`` across ranks (float64, rank-order reduction; the
+        ONE cross-host aggregation per streamed pass)."""
+        arr = np.ascontiguousarray(np.asarray(x, dtype=np.float64))
+        if self.world == 1:
+            return arr
+        return np.asarray(self._round("allreduce", tag, arr)) \
+            .reshape(arr.shape)
+
+    def allgather(self, x, tag: str) -> np.ndarray:
+        """Concatenate ``x`` across ranks along axis 0 in rank order
+        (the margins path: each rank's row slice → global row order)."""
+        arr = np.ascontiguousarray(np.asarray(x, dtype=np.float64))
+        if self.world == 1:
+            return arr
+        return np.asarray(self._round("allgather", tag, arr))
+
+    def digest_check(self, tag: str, digest: str) -> dict:
+        """Exchange per-iteration digests; every rank gets the full
+        rank→digest map. A mismatch raises ``RankDivergence`` on EVERY
+        rank (after counting it) — divergence is detected, not assumed."""
+        if self.world == 1:
+            return {"digests": {"0": digest}, "match": True}
+        out = self._round("digest", tag, digest.encode("utf-8"))
+        if not out["match"]:
+            mx = obs.metrics()
+            if mx is not None:
+                mx.counter("photon_fabric_digest_mismatch_total").inc()
+            raise RankDivergence(
+                f"rank digests diverged for {tag!r}: {out['digests']}")
+        return out
+
+    # -- the DCN retry ladder ------------------------------------------------
+
+    def _round(self, op: str, tag: str, payload) -> object:
+        with self._seq_lock:
+            seq = self._seq.get(tag, 0) + 1
+        t0 = time.perf_counter()
+        mx = obs.metrics()
+        last_err: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                # Injection seam: a `partition` spec here IS the DCN
+                # edge dropping this round (index = sequence number, so
+                # plans can target one iteration deterministically).
+                flt.fire(flt.sites.FABRIC_DCN_ALLREDUCE, index=seq)
+                result = self._exchange(op, tag, seq, payload)
+            except OSError as e:  # InjectedPartition is a ConnectionError
+                last_err = e
+                if attempt < self.max_retries:
+                    if mx is not None:
+                        mx.counter("photon_fabric_retries_total").inc()
+                    logger.warning(
+                        "fabric %s %s:%d attempt %d/%d failed (%s); "
+                        "retrying", op, tag, seq, attempt + 1,
+                        self.max_retries + 1, e)
+                    # Deterministic backoff — drills must replay exactly.
+                    time.sleep(self.retry_backoff_s * (attempt + 1))
+                continue
+            with self._seq_lock:
+                self._seq[tag] = seq
+            if mx is not None:
+                mx.counter("photon_fabric_allreduce_total", op=op).inc()
+                mx.counter("photon_fabric_allreduce_seconds_total").inc(
+                    time.perf_counter() - t0)
+            return result
+        raise FabricPartitioned(
+            f"fabric {op} {tag!r} seq {seq} failed after "
+            f"{self.max_retries + 1} attempts "
+            f"(coordinator {self.coordinator[0]}:{self.coordinator[1]}): "
+            f"{last_err}") from last_err
+
+    def _exchange(self, op: str, tag: str, seq: int, payload) -> object:
+        if op == "digest":
+            body, shape = payload, []
+        else:
+            body, shape = payload.tobytes(), list(payload.shape)
+        with socket.create_connection(
+                self.coordinator, timeout=self.timeout_s) as sock:
+            _send(sock, {"rank": self.rank, "op": op, "tag": tag,
+                         "seq": seq, "nbytes": len(body),
+                         "shape": shape}, body)
+            hdr = _recv_header(sock)
+            if not hdr.get("ok"):
+                if hdr.get("kind") == "divergence":
+                    raise RankDivergence(hdr.get("error", "divergence"))
+                raise ConnectionError(hdr.get("error", "fabric timeout"))
+            blob = _recv_exact(sock, int(hdr["nbytes"]))
+        mx = obs.metrics()
+        if mx is not None:
+            mx.counter("photon_fabric_bytes_total").inc(
+                len(body) + len(blob))
+        if op == "digest":
+            return json.loads(blob.decode("utf-8"))
+        return np.frombuffer(blob, dtype=np.float64) \
+            .reshape(hdr.get("shape", [-1]))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5.0)
+            self._server_thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

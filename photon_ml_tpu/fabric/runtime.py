@@ -1,0 +1,72 @@
+"""Per-process fabric registration (the ``faults.install`` pattern).
+
+The streamed coordinate is built deep inside the GAME engine
+(``game/descent.py`` → ``coordinates/streaming_fixed.py``); threading a
+transport handle through every constructor would churn the whole config
+surface for one process-wide fact. Instead the CLI arms the process
+("this rank participates in a fabric") and the two consumers read it:
+
+- ``StreamingSparseFixedEffectCoordinate`` wraps its chunk stream in a
+  ``FabricChunkStream`` when a fabric is active;
+- ``game/checkpoint.StreamingStateStore`` gates writes on the PRIMARY
+  rank (fabric rank 0), so W hosts never race one checkpoint directory.
+
+Install ``None`` to disarm (tests use the same fixture discipline as
+``faults.install``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard, typing only
+    from photon_ml_tpu.fabric.collective import FabricComm
+
+_lock = threading.Lock()
+_active: Optional["FabricComm"] = None
+
+
+def install(comm: Optional["FabricComm"]) -> None:
+    """Arm (or disarm, with ``None``) the process-wide fabric."""
+    global _active
+    with _lock:
+        _active = comm
+
+
+def active() -> Optional["FabricComm"]:
+    """The armed fabric, or ``None`` (single-host: every consumer's
+    fast path)."""
+    return _active
+
+
+def rank() -> int:
+    """This process's fabric rank (0 when no fabric is armed — the
+    single-host process IS the primary)."""
+    comm = _active
+    return comm.rank if comm is not None else 0
+
+
+def comm_from_env() -> Optional["FabricComm"]:
+    """Build a ``FabricComm`` from the launcher environment, or ``None``
+    when no fabric is configured. The contract mirrors JAX's own
+    coordinator discovery (``JAX_COORDINATOR_ADDRESS`` et al.):
+
+    - ``PHOTON_FABRIC_WORLD``       — host count W (absent/“1” = no fabric)
+    - ``PHOTON_FABRIC_RANK``        — this host's rank in [0, W)
+    - ``PHOTON_FABRIC_COORDINATOR`` — ``host:port`` of rank 0's data
+      plane (rank 0 BINDS this port; every rank dials it)
+    - ``PHOTON_FABRIC_TIMEOUT_S``   — optional per-round socket budget
+    """
+    world = int(os.environ.get("PHOTON_FABRIC_WORLD", "1"))
+    if world <= 1:
+        return None
+    from photon_ml_tpu.fabric.collective import FabricComm
+
+    fabric_rank = int(os.environ["PHOTON_FABRIC_RANK"])
+    host, _, port = os.environ["PHOTON_FABRIC_COORDINATOR"].rpartition(":")
+    timeout_s = float(os.environ.get("PHOTON_FABRIC_TIMEOUT_S", "30"))
+    return FabricComm(fabric_rank, world,
+                      coordinator=(host or "127.0.0.1", int(port)),
+                      timeout_s=timeout_s)

@@ -96,6 +96,23 @@ PUBLISH_CANARY_APPLY = "publish.canary_apply"
 PUBLISH_SWAP = "publish.swap"
 PUBLISH_ROLLBACK = "publish.rollback"
 
+# -- multi-host fabric (fabric/collective.py, fabric/transport.py,
+#    serving/publish.py fetch_delta; docs/ROBUSTNESS.md "Fabric") ------------
+# FABRIC_DCN_ALLREDUCE fires once per cross-host allreduce ATTEMPT
+# (index = the round's sequence number), inside the retry ladder — a
+# `partition` spec here models the DCN edge dropping a round;
+# FABRIC_HEARTBEAT fires before each machine-agent liveness query (the
+# remote analogue of FLEET_PROBE: a `delay` spec models a slow agent,
+# which must NOT be declared a death); FABRIC_ADOPT fires at the moment
+# a RemoteTransport adopts an already-running remote replica instead of
+# respawning; FABRIC_DELTA_FETCH fires once per artifact file pulled
+# over HTTP by a remote replica (a `partition`/`corrupt` spec models a
+# torn fetch, which must leave the previous model version servable).
+FABRIC_DCN_ALLREDUCE = "fabric.dcn_allreduce"
+FABRIC_ADOPT = "fabric.adopt"
+FABRIC_HEARTBEAT = "fabric.heartbeat"
+FABRIC_DELTA_FETCH = "fabric.delta_fetch"
+
 # Every registered site. Computed from the module's own constants so the
 # registry cannot drift from itself; PML014 reads the CONSTANTS above
 # via AST (this comprehension never runs under the linter).

@@ -418,6 +418,20 @@ class CheckpointManager:
         )
 
 
+def _is_primary_rank() -> bool:
+    """True on the ONE rank that owns shared checkpoint state: rank 0
+    of the ``jax.distributed`` world AND rank 0 of any armed fabric
+    (fabric/runtime.py). A CPU process group never initializes
+    ``jax.distributed`` collectives, so the fabric rank is the gate
+    that actually fires there — without it, W hosts would race one
+    store directory."""
+    import jax
+
+    from photon_ml_tpu.fabric import runtime as fabric_runtime
+
+    return jax.process_index() == 0 and fabric_runtime.rank() == 0
+
+
 class StreamingStateStore:
     """Mid-L-BFGS state for the streamed fixed-effect coordinate, under
     the repo's checkpoint discipline: atomic writes, a CRC32-carrying
@@ -458,11 +472,9 @@ class StreamingStateStore:
         at construction, so a checkpoint written at D devices resumes at
         D′ ≠ D (docs/STREAMING.md "Elastic resume"). What MUST match
         rides in ``fingerprint``."""
-        import jax
-
         from photon_ml_tpu.utils.diskio import atomic_write, file_crc32
 
-        if jax.process_index() != 0:
+        if not _is_primary_rank():
             return
         with obs.span("checkpoint.stream_state", cat="checkpoint",
                       iteration=int(state["it"])):
@@ -588,8 +600,6 @@ class StreamingStateStore:
     def clear(self) -> None:
         """Remove the store (the step committed; its mid-step state is
         stale and must not leak into a later run's resume)."""
-        import jax
-
-        if jax.process_index() != 0:
+        if not _is_primary_rank():
             return
         shutil.rmtree(self.directory, ignore_errors=True)

@@ -73,6 +73,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
+from photon_ml_tpu.fabric import runtime as fabric_runtime
+from photon_ml_tpu.fabric.stream import FabricChunkStream
 from photon_ml_tpu.game.models import FixedEffectModel
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.ops import streaming_sparse as ss
@@ -200,6 +203,17 @@ class StreamingSparseFixedEffectCoordinate:
                 "needs the all-ones L2 mask, so an intercept excluded "
                 "from regularization has no dual representation) — use "
                 "solver=sgd, or include the intercept in the L2 term")
+        fab = fabric_runtime.active()
+        if fab is not None and fab.world > 1 and \
+                effective in ("sdca", "sgd"):
+            # Unlike the mesh demotion above, a fabric demotion would
+            # run W redundant copies of the SAME sequential fit (and the
+            # dual update has no cross-host decomposition either) — a
+            # silently wasted fleet is worse than a loud config error.
+            raise ValueError(
+                f"solver={effective} is single-host (the sequential "
+                f"dual update has no cross-host decomposition); run "
+                f"this coordinate without --fabric, or use solver=lbfgs")
         self.dataset = dataset
         self.chunked = chunked
         self.shard_id = shard_id
@@ -208,7 +222,20 @@ class StreamingSparseFixedEffectCoordinate:
         self.intercept_index = intercept_index
         self.mesh = mesh
         self._log = log
-        if mesh is not None:
+        if fab is not None:
+            # Multi-host streaming (docs/STREAMING.md "Multi-host
+            # streaming"): chunk ranges partition over HOSTS first,
+            # each host's slice streams through its local mesh (ICI
+            # psum), host partials meet in ONE DCN allreduce per pass.
+            self._stream = FabricChunkStream(
+                chunked, fab, mesh=mesh, prefetch_depth=prefetch_depth,
+                pin_device_chunks=pin_device_chunks)
+            self._vg = self._stream.value_and_gradient(loss)
+            self._v = self._stream.value_only(loss)
+            log(f"fabric streaming: rank {fab.rank}/{fab.world} owns "
+                f"chunks [{self._stream._lo}, {self._stream._hi}) of "
+                f"{chunked.num_chunks}")
+        elif mesh is not None:
             # Sharded streaming: chunk ranges partition over the mesh's
             # data axis, per-device partials psum-merge (treeAggregate).
             # pin_device_chunks here is PER DEVICE (each chip's share of
@@ -379,6 +406,39 @@ class StreamingSparseFixedEffectCoordinate:
             "objective_digest": h.hexdigest(),
         }
 
+    def _fabric_digest_hook(self):
+        """Per-accepted-iteration cross-rank digest exchange (``None``
+        without an armed fabric — the single-host fast path).
+
+        Every rank digests its (w, f, |g|) after the update; the
+        fabric compares them and rank 0 — the ledger owner — records a
+        ``fabric_digest`` row carrying the full rank→digest map plus
+        the cumulative DCN provenance counters. A mismatch raises
+        ``RankDivergence`` on EVERY rank: divergence is detected at the
+        iteration it happens, not discovered at scoring time."""
+        fab = fabric_runtime.active()
+        if fab is None:
+            return None
+        from photon_ml_tpu.obs.ledger import fabric_totals
+
+        led = obs.ledger()
+        tag = f"digest/{self.shard_id}"
+
+        def on_accept(it, w, fv, gn):
+            h = hashlib.sha1()
+            h.update(np.ascontiguousarray(
+                np.asarray(w, np.float32)).tobytes())
+            h.update(np.float32(fv).tobytes())
+            h.update(np.float32(gn).tobytes())
+            out = fab.digest_check(tag, h.hexdigest())
+            if fab.rank == 0 and led is not None:
+                led.record("fabric_digest", iteration=it,
+                           digest=h.hexdigest(), world=fab.world,
+                           match=bool(out["match"]),
+                           **fabric_totals())
+
+        return on_accept
+
     def _pad_offsets(self, offsets: Array) -> Array:
         offsets = jnp.asarray(offsets, jnp.float32)
         pad = self._padded_n - offsets.shape[0]
@@ -413,6 +473,14 @@ class StreamingSparseFixedEffectCoordinate:
             # reason to discard driver-loop state.
             env = {"num_devices": (self._stream.num_devices
                                    if self._stream is not None else 1)}
+            fab_env = fabric_runtime.active()
+            if fab_env is not None:
+                # The host fan-out rides beside the fingerprint for the
+                # same reason device count does: a snapshot written at
+                # W hosts must resume at W′ ≠ W (a SIGKILL'd host
+                # becomes a logged W→W′ ELASTIC resume, not a dead
+                # run) — chunk ranges re-derive from (num_chunks, W′).
+                env["fabric_world"] = fab_env.world
             store = self._ckpt_store
             resume_state = store.load(expected_fingerprint=fp,
                                       environment=env)
@@ -446,7 +514,8 @@ class StreamingSparseFixedEffectCoordinate:
                                         log=self._log, value_only=v,
                                         checkpoint_save=checkpoint_save,
                                         resume_state=resume_state,
-                                        l1_weights=l1w)
+                                        l1_weights=l1w,
+                                        on_accept=self._fabric_digest_hook())
         return FixedEffectModel(shard_id=self.shard_id,
                                 coefficients=Coefficients(result.w))
 

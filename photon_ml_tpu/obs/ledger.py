@@ -387,6 +387,31 @@ def transfer_totals() -> dict:
     return out
 
 
+def fabric_totals() -> dict:
+    """Cumulative cross-host fabric counters from the active registry
+    (empty when metrics are off, or when no fabric ever fired) — the
+    ``fabric_digest`` rows' provenance columns: how many DCN rounds,
+    retries, and bytes stand behind the digest being attested."""
+    from photon_ml_tpu import obs
+
+    mx = obs.metrics()
+    if mx is None:
+        return {}
+    out = {}
+    snap = mx.snapshot()
+    for name, col in (("photon_fabric_allreduce_total",
+                       "fabric_allreduces"),
+                      ("photon_fabric_retries_total", "fabric_retries"),
+                      ("photon_fabric_bytes_total", "fabric_bytes")):
+        total = None
+        for k, v in snap.items():
+            if k == name or k.startswith(name + "{"):
+                total = (total or 0.0) + v
+        if total is not None:
+            out[col] = total
+    return out
+
+
 def spill_history(led: "RunLedger", values, grad_norms,
                   opt: str = "compiled") -> int:
     """Spill a compiled optimizer's NaN-padded value/grad-norm histories

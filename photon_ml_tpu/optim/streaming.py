@@ -125,6 +125,7 @@ def minimize_streaming(
     checkpoint_save: Optional[Callable[[dict], None]] = None,
     resume_state: Optional[dict] = None,
     l1_weights: Optional[Array] = None,
+    on_accept: Optional[Callable[[int, Array, float, float], None]] = None,
 ) -> OptResult:
     """Driver-loop L-BFGS: minimize a host-driven (value, grad) callable.
 
@@ -155,6 +156,13 @@ def minimize_streaming(
     ``l1_weights``, when given, switches the loop to OWL-QN (module
     docstring) — ``value_and_grad``/``value_only`` must stay the SMOOTH
     part only; the L1 term is never differentiated.
+
+    ``on_accept``, when given, runs once per ACCEPTED iteration with
+    ``(it, w, value, grad_norm)``, after the ledger row and the
+    checkpoint write — the fabric's cross-rank digest exchange hooks
+    here (fabric/stream.py), so a ``RankDivergence`` raised from the
+    hook still leaves a resumable snapshot and a flushed curve point
+    behind, exactly like a watchdog verdict.
 
     Telemetry (docs/OBSERVABILITY.md "The run ledger"): when a run
     ledger is active (``obs.ledger()``), every accepted iteration
@@ -339,6 +347,11 @@ def minimize_streaming(
                 checkpoint_save(snapshot_state(
                     w, g, s_stack, y_stack, rho, m_host, it, fv, gn, f0,
                     gn0, vals, gns))
+            if on_accept is not None:
+                # After the checkpoint write (same rationale as the
+                # watchdog below): a divergence raised here leaves a
+                # resumable snapshot + a flushed ledger row behind.
+                on_accept(it, w, fv, gn)
             if wd is not None:
                 # After the checkpoint write: a "raise" verdict still
                 # leaves a resumable snapshot + a flushed ledger row.

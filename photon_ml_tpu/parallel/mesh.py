@@ -107,6 +107,7 @@ def make_mesh(
     num_model: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     distributed: bool = False,
+    local: bool = False,
 ) -> Mesh:
     """Build a (data, model) mesh over the available devices.
 
@@ -114,9 +115,19 @@ def make_mesh(
     ``initialize_distributed``) so the mesh spans every host's devices;
     shardings over ``data`` then reduce over ICI within a slice and DCN
     across slices, exactly as laid out.
+
+    With ``local=True``, the mesh spans THIS HOST's devices only — the
+    fabric topology (fabric/collective.py): intra-host reductions stay
+    compiled ICI ``psum`` programs, and the cross-host level is the
+    host-driven ``FabricComm`` allreduce instead of an XLA collective
+    (mandatory on CPU process groups, where XLA's multiprocess
+    collectives are not implemented; on TPU it trades the compiled DCN
+    path for a faultable one).
     """
     if distributed:
         initialize_distributed()
+    if local and devices is None:
+        devices = jax.local_devices()
     devices = list(devices if devices is not None else jax.devices())
     if num_data is None:
         num_data = len(devices) // num_model

@@ -42,7 +42,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import signal
 import sys
 import threading
 import time
@@ -372,6 +371,8 @@ class ServingFleet:
         publish_burn_threshold: float = 1.0,
         elastic=None,
         emitter=default_emitter,
+        transport=None,
+        delta_base_url: Optional[str] = None,
     ):
         self.replica_args = list(replica_args)
         self.num_replicas = int(num_replicas)
@@ -403,7 +404,8 @@ class ServingFleet:
             max_restarts=max_restarts,
             backoff_reset_s=backoff_reset_s,
             on_death=self._on_death,
-            on_recovered=self._on_recovered)
+            on_recovered=self._on_recovered,
+            transport=transport)
         self.router = FleetRouter(
             self.shard_map, self.supervisor.endpoint,
             route_re_type=route_re_type,
@@ -441,6 +443,13 @@ class ServingFleet:
         self.publish_dir = publish_dir
         self.publish_bake_s = float(publish_bake_s)
         self.publish_burn_threshold = float(publish_burn_threshold)
+        # Publish-over-the-wire (docs/SERVING.md "Multi-host fleet"):
+        # when set, replicas are told to PULL delta artifacts from this
+        # base URL (a DeltaArtifactServer over the publish dir) instead
+        # of resolving a shared-filesystem path — remote replicas have
+        # no such filesystem. CRC verification stays with the artifact.
+        self.delta_base_url = (delta_base_url.rstrip("/")
+                               if delta_base_url else None)
         self._published: list[tuple[int, str]] = []
         # Two locks, strictly ordered _ladder_lock -> _publish_lock
         # (photon-lint --locks proves the graph stays acyclic):
@@ -660,17 +669,22 @@ class ServingFleet:
         supervisor restarts it from the base model and
         ``_reapply_published`` replays only the COMMITTED chain
         (consistency restored by construction)."""
-        handle = self.supervisor.replicas[replica_id]
-        if handle.proc is not None and handle.proc.poll() is None:
-            logger.error(
-                "replica %d could not roll back — killing it; the "
-                "supervised restart replays the committed delta chain",
-                replica_id)
-            try:
-                handle.proc.send_signal(signal.SIGKILL)
-            except OSError as e:
-                logger.error("could not kill replica %d (%s)",
-                             replica_id, e)
+        logger.error(
+            "replica %d could not roll back — killing it; the "
+            "supervised restart replays the committed delta chain",
+            replica_id)
+        self.supervisor.kill_replica(replica_id)
+
+    def _delta_payload(self, delta_dir: str) -> dict:
+        """The ``/admin/delta`` body: a shared-filesystem path, or —
+        with ``delta_base_url`` set — the URL the replica PULLS the
+        artifacts from (serving/publish.fetch_delta re-verifies the
+        CRC fence on its side of the wire)."""
+        if self.delta_base_url is not None:
+            return {"url":
+                    f"{self.delta_base_url}/"
+                    f"{os.path.basename(delta_dir.rstrip(os.sep))}"}
+        return {"path": delta_dir}
 
     def _reapply_published(self, replica_id: int) -> None:
         with self._publish_lock:
@@ -692,7 +706,7 @@ class ServingFleet:
                 continue
             try:
                 self._replica_post(replica_id, "/admin/delta",
-                                   {"path": path})
+                                   self._delta_payload(path))
                 self._publish_record(phase="reapply", version=version,
                                      replica=replica_id)
             except (OSError, ValueError) as e:
@@ -810,7 +824,7 @@ class ServingFleet:
                 flt.fire(flt.sites.PUBLISH_CANARY_APPLY, index=canary)
                 # pml: allow[PML019] ladder lock held across canary/fleet HTTP + bake by design; every leg carries a finite timeout and only publish_delta takes this lock
                 self._replica_post(canary, "/admin/delta",
-                                   {"path": delta_dir})
+                                   self._delta_payload(delta_dir))
             except urllib.error.HTTPError as e:
                 # The replica REFUSED (validation, chain break): nothing
                 # applied, nothing to roll back.
@@ -858,7 +872,7 @@ class ServingFleet:
                 try:
                     flt.fire(flt.sites.PUBLISH_SWAP, index=rid)
                     self._replica_post(rid, "/admin/delta",
-                                       {"path": delta_dir})
+                                       self._delta_payload(delta_dir))
                     applied.append(rid)
                     self._publish_record(phase="swap",
                                          version=delta.version,

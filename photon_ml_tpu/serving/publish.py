@@ -313,3 +313,53 @@ def read_delta(path: str) -> ModelDelta:
     return ModelDelta(version=int(marker["version"]),
                       parent=int(marker.get("parent", 0)),
                       rows=rows, path=path)
+
+
+def fetch_delta(url: str, dest_root: str, timeout_s: float = 30.0) -> str:
+    """Pull one delta directory's artifacts over HTTP (the wire leg of
+    docs/SERVING.md "Multi-host fleet": a DeltaArtifactServer exports
+    the publisher's directory; remote replicas call this instead of
+    assuming a shared filesystem). Returns the LOCAL delta directory,
+    ready for :func:`read_delta`.
+
+    The at-rest commit discipline crosses the wire intact: the payload
+    is fetched and atomically written FIRST, the marker LAST — a torn
+    fetch (connection cut, ``fabric.delta_fetch`` injection) leaves a
+    marker-less local directory that :func:`read_delta` refuses, and
+    the previously applied version stays servable. Every transfer
+    failure lands in the same :class:`DeltaCorrupt` taxonomy as a torn
+    shared-filesystem write; CRC verification happens in
+    :func:`read_delta` exactly as for a local artifact.
+    """
+    import urllib.request
+
+    from photon_ml_tpu import obs
+
+    url = url.rstrip("/")
+    name = url.rsplit("/", 1)[-1]
+    if not _DIR_RE.match(name):
+        raise DeltaCorrupt(f"{url} does not name a delta directory "
+                           f"(want .../delta-vNNNNNN)")
+    dest = os.path.join(dest_root, name)
+    os.makedirs(dest, exist_ok=True)
+    total = 0
+    # Payload first, marker LAST — the marker IS the commit point.
+    for i, fname in enumerate((_ROWS, _MARKER)):
+        try:
+            flt.fire(flt.sites.FABRIC_DELTA_FETCH, index=i)
+            with urllib.request.urlopen(f"{url}/{fname}",
+                                        timeout=timeout_s) as resp:
+                blob = resp.read()
+        except (OSError, ValueError) as e:
+            raise DeltaCorrupt(
+                f"fetch of {url}/{fname} failed ({type(e).__name__}: "
+                f"{e}) — previous version stays servable")
+        atomic_write(os.path.join(dest, fname),
+                     lambda f, b=blob: f.write(b))
+        total += len(blob)
+    mx = obs.metrics()
+    if mx is not None:
+        mx.counter("photon_fabric_delta_fetch_total").inc()
+        mx.counter("photon_fabric_delta_fetch_bytes_total").inc(total)
+    logger.info("fetched delta %s from %s (%d bytes)", name, url, total)
+    return dest

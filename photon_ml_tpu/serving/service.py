@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -469,6 +470,21 @@ class ScoringService:
 
         return self.apply_delta(read_delta(path))
 
+    def apply_delta_url(self, url: str) -> dict:
+        """Fetch a delta's artifacts over HTTP into a local spool, then
+        apply — the remote-replica leg of ``POST /admin/delta``
+        (``{"url": ...}`` body; docs/SERVING.md "Multi-host fleet").
+        ``fetch_delta`` keeps the marker-last commit discipline across
+        the wire and ``read_delta`` re-verifies the CRC fence on OUR
+        bytes, so a torn or bit-flipped transfer raises DeltaCorrupt
+        and the previously applied version stays servable."""
+        from photon_ml_tpu.serving.publish import fetch_delta, read_delta
+
+        spool = os.path.join(os.getcwd(),
+                             f"delta-spool-{os.getpid()}")
+        local = fetch_delta(url, spool)
+        return self.apply_delta(read_delta(local))
+
     def rollback_to(self, version: int) -> dict:
         """Back out deltas newer than ``version`` (the canary ladder's
         auto-rollback leg), under the same flush-serialized lock as
@@ -596,7 +612,12 @@ class _ServingHandler(BaseHTTPRequestHandler):
 
         try:
             if self.path == "/admin/delta":
-                out = self.service.apply_delta_dir(str(payload["path"]))
+                if "url" in payload:
+                    out = self.service.apply_delta_url(
+                        str(payload["url"]))
+                else:
+                    out = self.service.apply_delta_dir(
+                        str(payload["path"]))
             else:
                 out = self.service.rollback_to(
                     int(payload["to_version"]))

@@ -6,26 +6,34 @@ server over the same model. This module owns their LIFECYCLE — the
 process-level analogue of the micro-batcher's supervised worker thread
 (PR 4's ``BatcherDied`` discipline, lifted one level):
 
-- **Spawn.** Replicas are ``spawn``-style subprocesses (fresh
-  interpreters — the parent holds live XLA runtime threads and forking
-  them is undefined, the utils/workers.py rule). Child output goes to
-  FILES, never pipes: XLA's CPU warnings alone can overflow a 64 KB pipe
-  buffer, and an undrained pipe blocks the child mid-request (the
-  tests/test_multiprocess.py lesson). The bound port travels back
-  through a ready-file the replica writes after binding (``--ready-file``
-  in cli/serve.py) — no port-allocation race.
-- **Probe.** A monitor thread polls each replica: ``proc.poll()`` for
-  process death, then GET ``/healthz`` (explicit timeout — PML011) for
-  liveness. A replica whose last good probe is older than
-  ``heartbeat_deadline_s`` is DECLARED dead even if the process lingers
-  (a wedged replica is dead for routing purposes; the lingering process
-  is SIGKILLed so it cannot answer a stale hedge later).
+- **Spawn.** How an incarnation starts is the TRANSPORT's business
+  (fabric/transport.py): ``LocalTransport`` is the original subprocess
+  mechanism verbatim — ``spawn``-style children (fresh interpreters:
+  the parent holds live XLA runtime threads and forking them is
+  undefined, the utils/workers.py rule), output to FILES never pipes
+  (XLA's CPU warnings alone can overflow a 64 KB pipe buffer, and an
+  undrained pipe blocks the child mid-request — the
+  tests/test_multiprocess.py lesson), the bound port traveling back
+  through a generation-named ready-file (``--ready-file`` in
+  cli/serve.py — no port-allocation race). ``RemoteTransport`` starts
+  the same replica on another machine via its agent and hands back an
+  address. The LADDER below neither knows nor cares which.
+- **Probe.** A monitor thread polls each replica: transport-level
+  liveness (``proc.poll()`` locally; the agent's view remotely — with
+  ``None`` = "cannot see the process layer", which is NOT a death),
+  then GET ``/healthz`` (explicit timeout — PML011) for liveness. A
+  replica whose last good probe is older than ``heartbeat_deadline_s``
+  is DECLARED dead even if the process lingers (a wedged replica is
+  dead for routing purposes; the lingering process is SIGKILLed so it
+  cannot answer a stale hedge later).
 - **Recover.** Death fires ``on_death(replica_id)`` synchronously on the
   monitor thread — the fleet re-homes the replica's shards there, inside
   the detection-to-recovery window the rehome deadline measures — then
   the supervisor restarts the replica (bounded ``max_restarts``,
   deterministic backoff) and fires ``on_recovered(replica_id)`` once the
-  newcomer answers ``/healthz``.
+  newcomer answers ``/healthz``. Under a ``RemoteTransport``, a restart
+  whose home MACHINE is dead fails over to the next machine — the
+  whole-group-death drill's bounded cross-machine re-home.
 
 Every blocking network call in this module carries an explicit timeout
 (lint rule PML011 mechanizes that for router/supervisor code).
@@ -37,7 +45,6 @@ import dataclasses
 import json
 import logging
 import os
-import signal
 import subprocess
 import threading
 import time
@@ -45,6 +52,8 @@ import urllib.request
 from typing import Callable, Optional, Sequence
 
 from photon_ml_tpu import faults as flt
+from photon_ml_tpu.fabric.transport import (  # noqa: F401  (re-export)
+    LocalTransport, ReplicaStartupError, Transport)
 
 logger = logging.getLogger("photon_ml_tpu.serving.fleet")
 
@@ -57,17 +66,13 @@ FAILED = "failed"  # restart budget exhausted — stays down, fleet degraded
 RETIRED = "retired"  # scaled down deliberately — not a failure state
 
 
-class ReplicaStartupError(RuntimeError):
-    """A replica did not reach ready/healthy within its deadline."""
-
-
 @dataclasses.dataclass
 class ReplicaHandle:
     """One supervised replica process (mutable; guarded by the
     supervisor's lock for state transitions)."""
 
     replica_id: int
-    proc: Optional[subprocess.Popen] = None
+    proc: Optional[subprocess.Popen] = None  # LocalTransport only
     host: str = "127.0.0.1"
     port: int = 0
     state: str = STARTING
@@ -78,6 +83,7 @@ class ReplicaHandle:
     log_path: str = ""
     boot_seconds: float = 0.0  # spawn → first healthy probe, last (re)start
     spawned_at: float = 0.0  # monotonic instant of the last _spawn
+    machine: str = ""  # placement (agent base URL; '' when local)
 
     def base_url(self) -> str:
         return f"http://{self.host}:{self.port}"
@@ -100,6 +106,10 @@ class ReplicaSupervisor:
     declaration, and bounded restart. ``on_death`` / ``on_recovered``
     run on the monitor thread — re-homing happens inside ``on_death`` so
     the rehome clock starts at detection.
+
+    ``transport`` picks the replica-start MECHANISM (default: a
+    ``LocalTransport`` over ``make_argv``/``workdir``, which is the
+    original in-process-supervised subprocess behavior verbatim).
     """
 
     def __init__(
@@ -116,12 +126,15 @@ class ReplicaSupervisor:
         backoff_reset_s: float = 60.0,
         on_death: Optional[Callable[[int], None]] = None,
         on_recovered: Optional[Callable[[int], None]] = None,
+        transport: Optional[Transport] = None,
     ):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
         self._make_argv = make_argv
         self.workdir = workdir
+        self.transport = (transport if transport is not None
+                          else LocalTransport(make_argv, workdir))
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.heartbeat_deadline_s = float(heartbeat_deadline_s)
@@ -143,73 +156,28 @@ class ReplicaSupervisor:
 
     # -- spawn / handshake ---------------------------------------------------
 
-    def _ready_file(self, rid: int, generation: int) -> str:
-        # Generation in the name: a restart must never trust the ready
-        # file the DEAD incarnation wrote (its port is gone).
-        return os.path.join(self.workdir, f"replica-{rid}.g{generation}.ready")
-
     def _spawn(self, handle: ReplicaHandle) -> None:
-        rid = handle.replica_id
         # Generation, not restart count, names the ready file: the
         # backoff-reset amnesty rewinds `restarts`, and a rewound name
         # could collide with a DEAD incarnation's file.
         handle.generation += 1
-        ready = self._ready_file(rid, handle.generation)
-        if os.path.exists(ready):
-            os.unlink(ready)
-        handle.log_path = os.path.join(self.workdir, f"replica-{rid}.log")
-        argv = list(self._make_argv(rid, ready))
-        # The child's cwd is the workdir (its logs and ready files stay
-        # together), so put the package's root on its path explicitly —
-        # a dev checkout that was never pip-installed must still fleet.
-        import photon_ml_tpu
-
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(photon_ml_tpu.__file__)))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
-                             if env.get("PYTHONPATH") else pkg_root)
-        log_f = open(handle.log_path, "ab")
-        try:
-            handle.proc = subprocess.Popen(
-                argv, stdout=log_f, stderr=subprocess.STDOUT,
-                cwd=self.workdir, env=env)
-        finally:
-            log_f.close()  # the child holds its own descriptor now
         handle.state = STARTING
         handle.spawned_at = time.monotonic()
-        logger.info("replica %d spawned (pid %d, log %s)", rid,
-                    handle.proc.pid, handle.log_path)
+        self.transport.spawn(handle)
 
     def _await_ready(self, handle: ReplicaHandle) -> None:
-        """Wait for the ready-file handshake, then a first good probe.
-        The spawn→healthy wall lands in ``handle.boot_seconds`` — the
-        replica-restart tail photon-boot attacks, measured where the
-        fleet actually waits for it (``bench_serving.py --restart``
-        reads it back as ``photon_fleet_replica_boot_seconds``)."""
+        """Wait for the transport's address handshake, then a first
+        good probe. The spawn→healthy wall lands in
+        ``handle.boot_seconds`` — the replica-restart tail photon-boot
+        attacks, measured where the fleet actually waits for it
+        (``bench_serving.py --restart`` reads it back as
+        ``photon_fleet_replica_boot_seconds``)."""
         rid = handle.replica_id
         t_spawn = handle.spawned_at or time.monotonic()
-        ready = self._ready_file(rid, handle.generation)
         deadline = time.monotonic() + self.start_timeout_s
-        while time.monotonic() < deadline:
-            if handle.proc.poll() is not None:
-                raise ReplicaStartupError(
-                    f"replica {rid} exited rc={handle.proc.returncode} "
-                    f"before ready (see {handle.log_path})")
-            if os.path.exists(ready):
-                try:
-                    with open(ready) as f:
-                        info = json.load(f)
-                    break
-                except (OSError, ValueError):
-                    pass  # torn read of a mid-write file; poll again
-            time.sleep(0.02)
-        else:
-            raise ReplicaStartupError(
-                f"replica {rid} not ready within {self.start_timeout_s}s "
-                f"(see {handle.log_path})")
-        handle.host = info.get("host", "127.0.0.1")
-        handle.port = int(info["port"])
+        host, port = self.transport.await_ready(handle, deadline)
+        handle.host = host
+        handle.port = int(port)
         while time.monotonic() < deadline:
             try:
                 _probe_healthz(handle.base_url(), self.probe_timeout_s)
@@ -257,13 +225,7 @@ class ReplicaSupervisor:
         try:
             self._await_ready(handle)
         except ReplicaStartupError:
-            if handle.proc is not None and handle.proc.poll() is None:
-                handle.proc.kill()
-                try:
-                    handle.proc.wait(timeout=5.0)
-                except subprocess.TimeoutExpired:
-                    logger.warning("could not reap failed scale-up "
-                                   "replica %d", handle.replica_id)
+            self.transport.kill(handle)
             raise
         with self._lock:
             self.replicas.append(handle)
@@ -274,30 +236,27 @@ class ReplicaSupervisor:
     def retire(self, replica_id: int) -> None:
         """Retire a DRAINED replica (the scale-down leg): mark it
         RETIRED first — the monitor never restarts a retired replica —
-        then terminate the process. Deliberate, not a death: no
+        then terminate the process. Deliberate, not a failure: no
         on_death fires, no restart follows."""
         handle = self.replicas[replica_id]
         with self._lock:
             handle.state = RETIRED
-        if handle.proc is not None and handle.proc.poll() is None:
-            handle.proc.terminate()
-            try:
-                handle.proc.wait(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                handle.proc.kill()
-                try:
-                    handle.proc.wait(timeout=5.0)
-                except subprocess.TimeoutExpired:
-                    logger.warning("could not reap retired replica %d",
-                                   replica_id)
+        self.transport.terminate(handle, timeout_s=10.0)
         logger.info("replica %d retired", replica_id)
+
+    def kill_replica(self, replica_id: int) -> None:
+        """Hard-kill a replica's PROCESS without touching its state —
+        the chaos-drill seam (fleet ``/admin/kill``): the monitor must
+        DISCOVER the death through its own probes, so detection latency
+        stays in the measured rehome window."""
+        self.transport.kill(self.replicas[replica_id])
 
     # -- monitoring ----------------------------------------------------------
 
     def _probe_once(self, handle: ReplicaHandle) -> bool:
         """One liveness check; True = the replica looked alive."""
-        if handle.proc is None or handle.proc.poll() is not None:
-            return False
+        if self.transport.alive(handle) is False:
+            return False  # positively gone; None (can't see) still probes
         try:
             # Injection seam: a `partition` spec here models the
             # monitor losing sight of a replica (probes dropped while
@@ -342,9 +301,13 @@ class ReplicaSupervisor:
                     with self._lock:
                         handle.last_ok = now
                     self.maybe_reset_backoff(handle, now)
-                elif (handle.proc.poll() is not None
+                elif (self.transport.alive(handle) is False
                       or now - handle.last_ok
                       >= self.heartbeat_deadline_s):
+                    # Positive process death, or /healthz silence past
+                    # the deadline. An UNKNOWN process layer (remote
+                    # agent unreachable — fabric.heartbeat partition)
+                    # deliberately does NOT short-circuit to death.
                     self._handle_death(handle)
             time.sleep(self.probe_interval_s)
 
@@ -354,20 +317,16 @@ class ReplicaSupervisor:
             if handle.state != UP:
                 return
             handle.state = DOWN
-        rc = handle.proc.poll()
-        logger.error("replica %d declared dead (%s; last good probe "
-                     "%.2fs ago)", rid,
-                     f"exited rc={rc}" if rc is not None
-                     else "heartbeat deadline",
+        gone = self.transport.alive(handle) is False
+        where = self.transport.describe(handle)
+        logger.error("replica %d%s declared dead (%s; last good probe "
+                     "%.2fs ago)", rid, f" on {where}" if where else "",
+                     "process exited" if gone else "heartbeat deadline",
                      time.monotonic() - handle.last_ok)
         # A wedged-but-alive process must not answer a stale request
         # after its shards re-home — kill it before announcing death.
-        if rc is None:
-            try:
-                handle.proc.send_signal(signal.SIGKILL)
-                handle.proc.wait(timeout=5.0)
-            except (OSError, subprocess.TimeoutExpired):
-                logger.warning("could not reap wedged replica %d", rid)
+        if not gone:
+            self.transport.kill(handle)
         if self._on_death is not None:
             try:
                 self._on_death(rid)
@@ -424,15 +383,7 @@ class ReplicaSupervisor:
         if self._monitor is not None and self._monitor.is_alive():
             self._monitor.join(timeout=5.0)
         for handle in self.replicas:
-            if handle.proc is not None and handle.proc.poll() is None:
-                handle.proc.terminate()
-        for handle in self.replicas:
-            if handle.proc is not None:
-                try:
-                    handle.proc.wait(timeout=10.0)
-                except subprocess.TimeoutExpired:
-                    handle.proc.kill()
-                    handle.proc.wait(timeout=5.0)
+            self.transport.terminate(handle, timeout_s=10.0)
             handle.state = DOWN
 
     def __enter__(self):
