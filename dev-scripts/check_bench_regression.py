@@ -130,6 +130,8 @@ QUANT_TRANSFER_BOUND_FRACTION = 0.5
 # (≤ 1.0× band-adjusted) when the stream is transfer-bound; on a
 # compute-bound CPU box the ratio is reported only, like the quant wall.
 SOLVER_RACE_AUC_DELTA_MAX = 5e-3
+SWEEP_AUC_DELTA_MAX = 5e-3
+SWEEP_ITER2PLUS_SPEEDUP_MIN = 1.5
 # Kernel registry sweep (docs/KERNELS.md): a fused Pallas program and
 # its registered XLA reference compute the same math, so the sweep's
 # relative parity delta is a correctness tripwire, not a tolerance —
@@ -451,6 +453,84 @@ def main() -> int:
                     f"solver_race_auc_delta: {delta:g} > "
                     f"{SOLVER_RACE_AUC_DELTA_MAX:g} — the stochastic "
                     f"fit no longer matches L-BFGS ranking quality")
+
+    # --- dirty-gated sweeps (docs/SWEEPS.md) ----------------------------
+    # bench_sweep's parity ladder and perf claims. Always gated:
+    # gate=0 bit-identity (rung 1 — wrong, not slow, if it breaks),
+    # the gated arm's AUC band, the gate=0 wall staying in band of the
+    # ungated full path (the bare `--sweep` flag must cost nothing),
+    # and the steady-state gated/full iteration ratio ≤ 1.0× band —
+    # once the skip fraction saturates, a gated sweep dispatches almost
+    # nothing, on any box. The iter2+ SUMMED speedup ≥ 1.5× is the
+    # flagship acceptance reading and includes the gated arm's one-time
+    # compacted-wave compiles, which on a small CPU box are the same
+    # order as the solves — so it's a verdict only when the flagship
+    # config ran (sweep_flagship), reported otherwise.
+    bit = fresh.get("sweep_gate0_bit_identical")
+    if bit is not None:
+        print(f"sweep_gate0_bit_identical: {bit} "
+              f"{'OK' if bit else 'REGRESSION'}")
+        if not bit:
+            failures.append(
+                "sweep_gate0_bit_identical: false — gate=0 no longer "
+                "reproduces the ungated descent bit-for-bit (parity "
+                "ladder rung 1, SWEEPS.md)")
+    delta = fresh.get("sweep_auc_delta")
+    if delta is not None:
+        ok = float(delta) <= SWEEP_AUC_DELTA_MAX
+        print(f"sweep_auc_delta: {delta:g} (limit "
+              f"{SWEEP_AUC_DELTA_MAX:g}) {'OK' if ok else 'REGRESSION'}")
+        if not ok:
+            failures.append(
+                f"sweep_auc_delta: {delta:g} > {SWEEP_AUC_DELTA_MAX:g} "
+                f"— the gated fit left the full-sweep quality band "
+                f"despite the final full-sweep backstop")
+    w_full = fresh.get("sweep_wall_seconds_full")
+    w_g0 = fresh.get("sweep_wall_seconds_gate0")
+    sweep_reason = _invalid(fresh, "sweep")
+    if w_full is not None and w_g0 is not None:
+        ok = float(w_g0) <= float(w_full) * band
+        verdict = ("OK" if ok else
+                   "REGRESSION" if sweep_reason is None else
+                   f"over limit (reported only: {sweep_reason})")
+        print(f"sweep gate=0 wall: {w_g0:g}s vs full {w_full:g}s "
+              f"(limit {band:.3g}x) {verdict}")
+        if sweep_reason is None and not ok:
+            failures.append(
+                f"sweep gate=0 wall: {w_g0:g}s > {band:.3g}x full "
+                f"{w_full:g}s — the bare --sweep flag stopped being "
+                f"free")
+    sr = fresh.get("sweep_steady_ratio")
+    if sr is not None:
+        ok = float(sr) <= band
+        verdict = ("OK" if ok else
+                   "REGRESSION" if sweep_reason is None else
+                   f"over limit (reported only: {sweep_reason})")
+        print(f"sweep_steady_ratio: gated/full {sr:g}x steady-state "
+              f"sweep (limit {band:.3g}x) {verdict}")
+        if sweep_reason is None and not ok:
+            failures.append(
+                f"sweep_steady_ratio: {sr:g}x > {band:.3g}x — a "
+                f"saturated-skip gated sweep costs more than a full "
+                f"one; the gate is dispatching work it shouldn't")
+    sp = fresh.get("sweep_iter2plus_speedup")
+    if sp is not None:
+        flagship = (bool(fresh.get("sweep_flagship"))
+                    and sweep_reason is None)
+        ok = float(sp) >= SWEEP_ITER2PLUS_SPEEDUP_MIN
+        verdict = ("OK" if ok else
+                   "REGRESSION" if flagship else
+                   "under limit (reported only: "
+                   + (sweep_reason or "non-flagship scale, compile-"
+                                      "bound arms") + ")")
+        print(f"sweep_iter2plus_speedup: full/gated {sp:g}x over "
+              f"iterations >= 2 (limit {SWEEP_ITER2PLUS_SPEEDUP_MIN:g}x "
+              f"at flagship scale) {verdict}")
+        if flagship and not ok:
+            failures.append(
+                f"sweep_iter2plus_speedup: {sp:g}x < "
+                f"{SWEEP_ITER2PLUS_SPEEDUP_MIN:g}x at flagship scale — "
+                f"dirty-gated sweeps stopped paying for their waves")
 
     # --- kernel-registry invariants (docs/KERNELS.md) -------------------
     # bench_kernels' sweep lines. Two gates per kernel: the parity

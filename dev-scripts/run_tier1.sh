@@ -151,6 +151,16 @@ if [ "$rc" -eq 0 ]; then
   env JAX_PLATFORMS=cpu python dev-scripts/kernel_smoke.py; rc=$?
 fi
 
+# Sweep smoke (docs/SWEEPS.md): a tiny dirty-gated GAME fit through
+# the real CLI — bare --sweep bit-equal to the ungated leg, the gate
+# engaging then backstopping in the re_fit_wave ledger aggregates, the
+# refit/skipped counters agreeing with the ledger, the dirty-set
+# checkpoint artifact on disk, and photon-obs diff rendering the
+# entities-fit table. ~1 minute on CPU.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python dev-scripts/sweep_smoke.py; rc=$?
+fi
+
 # Fabric smoke (docs/STREAMING.md "Multi-host streaming"): a REAL
 # 2-process jax.distributed CPU fit with the host-level fabric armed —
 # chunk ranges shard over the two ranks, host partials meet in one

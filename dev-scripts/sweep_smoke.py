@@ -1,0 +1,157 @@
+"""CI sweep smoke: a tiny dirty-gated GAME fit through the real CLI —
+the gate engages, backstops, checkpoints, and renders (ISSUE 20
+satellite: run_tier1.sh gains this step).
+
+Asserts, in order:
+
+1. three ``game_train`` runs over one dataset — ungated, bare
+   ``--sweep`` (gate=0), and ``--sweep theta=0.05,grad_tol=0.05`` —
+   all converge, and the gate=0 leg's best coefficients (fixed AND
+   per-user) are BIT-EQUAL to the ungated leg's: parity ladder rung 1
+   of docs/SWEEPS.md through the full CLI surface, not just the
+   estimator;
+2. the gated leg's ledger ``re_fit_wave`` aggregates show the gate
+   engaging and backstopping: sweep 1 full (``min_sweeps_full``),
+   ``entities_skipped > 0`` by sweep 3, the final sweep full again
+   (``final_full_sweep``), and fit+skipped covering every trained
+   entity every sweep;
+3. the gated leg's ``--metrics-dump`` carries
+   ``photon_re_entities_skipped_total > 0`` and refit+skipped summing
+   to trained-entities x sweeps — the counters agree with the ledger;
+4. the gated leg wrote the dirty-set checkpoint artifact
+   (``checkpoints/grid-0/sweep/per-user.npz``, fault site
+   ``sweep.gate_state``);
+5. ``photon-obs diff`` of the ungated-vs-gated ledgers renders the
+   per-coordinate entities-fit table (docs/OBSERVABILITY.md).
+
+Runs on CPU in seconds — wired into dev-scripts/run_tier1.sh after the
+kernel smoke.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ITERATIONS = 4
+
+
+def _train_args(train_dir, out, cache, extra):
+    return [
+        "--train", train_dir,
+        "--coordinate", "name=fixed,type=fixed,shard=global",
+        "--coordinate", "name=per-user,type=random,shard=re_userId,"
+                        "re=userId",
+        "--update-sequence", "fixed,per-user",
+        "--iterations", str(ITERATIONS),
+        "--opt-config", "per-user:optimizer=LBFGS,reg=L2,reg_weight=1.0",
+        "--staging", "workers=2,shard_entities=8",
+        "--staging-cache", cache,
+        "--output-dir", out,
+    ] + extra
+
+
+def _best_arrays(out):
+    import numpy as np
+
+    arrays = {}
+    for kind, name in (("fixed-effect", "fixed"),
+                       ("random-effect", "per-user")):
+        path = os.path.join(out, "best", kind, name, "coefficients.npz")
+        with np.load(path) as z:
+            for k in z.files:
+                arrays[f"{name}/{k}"] = z[k]
+    return arrays
+
+
+def main() -> int:
+    import numpy as np
+
+    from photon_ml_tpu.cli import game_train
+    from photon_ml_tpu.cli.obs import render_diff
+    from photon_ml_tpu.data import synthetic
+    from photon_ml_tpu.data.game_data import from_synthetic
+    from photon_ml_tpu.data.io import save_game_dataset
+    from photon_ml_tpu.obs.ledger import (diff_ledgers, fit_wave_summary,
+                                          read_rows)
+    from photon_ml_tpu.obs.metrics import parse_prometheus_text
+
+    with tempfile.TemporaryDirectory(prefix="pml_sweep_smoke_") as td:
+        train_dir = os.path.join(td, "train")
+        rng = np.random.default_rng(20)
+        syn = synthetic.game_data(rng, n=800, d_global=4,
+                                  re_specs={"userId": (30, 3)})
+        save_game_dataset(from_synthetic(syn), train_dir)
+
+        legs = {
+            "full": [],
+            "gate0": ["--sweep"],
+            "gated": ["--sweep", "theta=0.05,grad_tol=0.05",
+                      "--metrics-dump", os.path.join(td, "metrics.txt")],
+        }
+        outs = {}
+        for leg, extra in legs.items():
+            outs[leg] = os.path.join(td, f"out-{leg}")
+            game_train.run(game_train.build_parser().parse_args(
+                _train_args(train_dir, outs[leg],
+                            os.path.join(td, f"cache-{leg}"), extra)))
+
+        # (1) bare --sweep is free: bit-equal to the ungated leg.
+        ungated, gate0 = _best_arrays(outs["full"]), _best_arrays(
+            outs["gate0"])
+        assert ungated.keys() == gate0.keys()
+        for k in ungated:
+            np.testing.assert_array_equal(ungated[k], gate0[k], err_msg=k)
+
+        # (2) the gated leg's wave ledger: full, engaged, backstop.
+        rows, problems = read_rows(os.path.join(outs["gated"], "ledger"))
+        assert not problems, f"gated ledger problems: {problems}"
+        waves = fit_wave_summary(rows).get("per-user")
+        assert waves, "no re_fit_wave rows for per-user in the gated leg"
+        by_iter = {w["outer_iteration"]: w for w in waves}
+        assert sorted(by_iter) == list(range(ITERATIONS)), sorted(by_iter)
+        trained = by_iter[0]["entities_fit"]
+        assert trained > 0 and by_iter[0]["entities_skipped"] == 0, \
+            f"sweep 1 was not full: {by_iter[0]}"
+        assert by_iter[ITERATIONS - 1]["entities_skipped"] == 0, \
+            f"final backstop sweep was not full: {by_iter[ITERATIONS - 1]}"
+        skipped = sum(w["entities_skipped"] for w in waves)
+        assert skipped > 0, \
+            f"gate never engaged across sweeps 2..{ITERATIONS - 1}: {waves}"
+        for w in waves:
+            assert w["entities_fit"] + w["entities_skipped"] == trained, \
+                f"sweep {w['outer_iteration']} lost entities: {w}"
+
+        # (3) the counters tell the same story as the ledger.
+        with open(os.path.join(td, "metrics.txt")) as f:
+            metrics = parse_prometheus_text(f.read())
+        refit = sum(v for k, v in metrics.items()
+                    if k.startswith("photon_re_entities_refit_total"))
+        skip = sum(v for k, v in metrics.items()
+                   if k.startswith("photon_re_entities_skipped_total"))
+        assert skip == skipped and skip > 0, (skip, skipped)
+        assert refit + skip == trained * ITERATIONS, (refit, skip, trained)
+
+        # (4) the dirty set rode the checkpoint.
+        sweep_npz = os.path.join(outs["gated"], "checkpoints", "grid-0",
+                                 "sweep", "per-user.npz")
+        assert os.path.exists(sweep_npz), f"missing {sweep_npz}"
+
+        # (5) the diff surface renders where the wall time went.
+        rendered = render_diff(diff_ledgers(
+            os.path.join(outs["full"], "ledger"),
+            os.path.join(outs["gated"], "ledger")))
+        assert "entities fit per outer iteration" in rendered, rendered
+        print(rendered)
+        print(f"sweep smoke ok: {trained} entities, "
+              f"{int(refit)} refit / {int(skip)} skipped over "
+              f"{ITERATIONS} sweeps; gate=0 bit-equal to ungated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -789,6 +789,30 @@ def _overlay(curve_a: list, curve_b: list, x_key: str,
     return lines
 
 
+def _fit_wave_table(entry: dict) -> list[str]:
+    """Per-outer-iteration entities_fit/skipped/seconds table for one
+    coordinate's ``re_fit_wave`` aggregates — where a gated-vs-full run
+    pair's wall time went (docs/SWEEPS.md). A plain table, not an
+    _overlay: lane counts are discrete per-iteration totals, not a
+    convergence curve."""
+    wa = {w["outer_iteration"]: w for w in entry.get("fit_waves_a", ())}
+    wb = {w["outer_iteration"]: w for w in entry.get("fit_waves_b", ())}
+
+    def _cells(w):
+        if w is None:
+            return f"{'-':>9} {'-':>9} {'-':>8}"
+        return (f"{w['entities_fit']:>9} {w['entities_skipped']:>9} "
+                f"{w['seconds']:>8.3f}")
+
+    lines = ["  entities fit per outer iteration (A | B):",
+             f"  {'iter':>6} {'A fit':>9} {'A skip':>9} {'A secs':>8}  "
+             f"{'B fit':>9} {'B skip':>9} {'B secs':>8}"]
+    for it in sorted(set(wa) | set(wb)):
+        lines.append(f"  {it:>6} {_cells(wa.get(it))}  "
+                     f"{_cells(wb.get(it))}")
+    return lines
+
+
 def render_diff(diff: dict) -> str:
     out = [f"run A: {diff['a']}  (run_id {diff['run_ids']['a']})",
            f"run B: {diff['b']}  (run_id {diff['run_ids']['b']})"]
@@ -805,31 +829,36 @@ def render_diff(diff: dict) -> str:
     else:
         out += ["", "config delta: none (identical configuration)"]
     for coord, entry in diff["coordinates"].items():
-        if "curve_a" not in entry:
+        has_waves = "fit_waves_a" in entry or "fit_waves_b" in entry
+        if "curve_a" not in entry and not has_waves:
             out += ["", f"coordinate {coord}: present in only one run"]
             continue
         out += ["", f"coordinate {coord}:"]
-        out.append(f"  final value  A {entry['final_value_a']:.6g}   "
-                   f"B {entry['final_value_b']:.6g}   "
-                   f"(delta {entry['final_value_delta']:+.3g})")
-        tta, ttb = entry["time_to_target_a"], entry["time_to_target_b"]
-        if tta and ttb:
-            out.append(
-                f"  time to target {entry['target_value']:.6g}:  "
-                f"A {tta['seconds']:.3f}s / {tta['passes']:.0f} passes   "
-                f"B {ttb['seconds']:.3f}s / {ttb['passes']:.0f} passes"
-                + (f"   (B/A {entry['time_to_target_ratio']:.2f}x)"
-                   if entry.get("time_to_target_ratio") is not None
-                   else ""))
-        out.append("  value vs wall clock (a=A, b=B, *=both):")
-        out += _overlay(entry["curve_a"], entry["curve_b"], "t")
-        out.append("  value vs streamed passes:")
-        out += _overlay(entry["curve_a"], entry["curve_b"], "passes")
-        if any(math.isfinite(p["gap"]) for c in ("curve_a", "curve_b")
-               for p in entry[c] if p.get("gap") is not None):
-            out.append("  duality gap vs wall clock (a=A, b=B, *=both):")
-            out += _overlay(entry["curve_a"], entry["curve_b"], "t",
-                            y_key="gap")
+        if "curve_a" in entry:
+            out.append(f"  final value  A {entry['final_value_a']:.6g}   "
+                       f"B {entry['final_value_b']:.6g}   "
+                       f"(delta {entry['final_value_delta']:+.3g})")
+            tta, ttb = entry["time_to_target_a"], entry["time_to_target_b"]
+            if tta and ttb:
+                out.append(
+                    f"  time to target {entry['target_value']:.6g}:  "
+                    f"A {tta['seconds']:.3f}s / {tta['passes']:.0f} passes   "
+                    f"B {ttb['seconds']:.3f}s / {ttb['passes']:.0f} passes"
+                    + (f"   (B/A {entry['time_to_target_ratio']:.2f}x)"
+                       if entry.get("time_to_target_ratio") is not None
+                       else ""))
+            out.append("  value vs wall clock (a=A, b=B, *=both):")
+            out += _overlay(entry["curve_a"], entry["curve_b"], "t")
+            out.append("  value vs streamed passes:")
+            out += _overlay(entry["curve_a"], entry["curve_b"], "passes")
+            if any(math.isfinite(p["gap"]) for c in ("curve_a", "curve_b")
+                   for p in entry[c] if p.get("gap") is not None):
+                out.append("  duality gap vs wall clock "
+                           "(a=A, b=B, *=both):")
+                out += _overlay(entry["curve_a"], entry["curve_b"], "t",
+                                y_key="gap")
+        if has_waves:
+            out += _fit_wave_table(entry)
     fm = diff["final_metrics"]
     coords = sorted(set(fm["a"]) | set(fm["b"]))
     if coords:

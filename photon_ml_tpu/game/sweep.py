@@ -1,0 +1,178 @@
+"""photon-sweep: dirty-gated incremental coordinate descent
+(docs/SWEEPS.md).
+
+The GAME outer loop refits every random-effect entity every outer
+iteration, yet after the first sweep most entities' residual offsets have
+barely moved and their local solves already sit at their optima. This
+module holds the gating state and math that lets outer iterations >= 2
+refit only *dirty* entities:
+
+    dirty_e  =  drift_e > theta * scale_e   OR   grad_norm_e > grad_tol
+
+where ``drift_e`` is the segment-summed |delta offset| over entity e's
+rows since e was last fit (computed on device from the same (n,) score
+vectors the descent loop already holds), ``scale_e`` is e's row count
+(so ``theta`` reads as a mean per-row offset-drift threshold), and
+``grad_norm_e`` is the final per-lane gradient norm spilled from the
+vmapped bucket solver at e's last fit.
+
+Parity ladder (docs/SWEEPS.md):
+
+* ``gate=0`` (theta=0, grad_tol=0) bypasses the gated machinery entirely
+  — the descent runs HEAD's full-sweep expressions and is BIT-IDENTICAL
+  to an ungated run (coefficients and residual total).
+* Gated runs use an incremental residual update (``total += delta`` with
+  delta exactly 0.0 on clean rows) and land inside the repo's 5e-3
+  coefficient band, with a mandatory final full sweep as the correctness
+  backstop (``final_full_sweep``).
+* The dirty-set state (``off_ref`` offsets-at-last-fit + per-entity grad
+  norms) rides in the descent checkpoint (``sweep/<cid>.npz``, fault
+  site ``sweep.gate_state``) so a SIGKILL'd gated run resumes
+  bit-identical to an unkilled gated run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepConfig:
+    """Dirty-gated sweep knobs (``GameEstimator(sweep=...)``, CLI
+    ``game_train --sweep "theta=...,grad_tol=..."``).
+
+    ``theta``: mean per-row residual-offset drift above which an entity
+    is refit (0 = drift never skips). ``grad_tol``: per-entity gradient
+    norm above which an entity is refit regardless of drift (0 = grad
+    evidence never skips; entities without evidence are always dirty).
+    ``min_sweeps_full``: leading outer iterations forced full — at least
+    1, both to seed the drift/grad evidence and to uphold the projected
+    path's active-column invariant (a full projected sweep rewrites
+    whole rows, so later active-column deltas are exact).
+    ``final_full_sweep``: force the last outer iteration full (the
+    parity-band backstop). ``gram``: reuse per-bucket normal-equation
+    (X^T W X) Gram blocks across sweeps for the squared-loss bucket
+    solver (built once at stage time; ineligible configurations fall
+    back to the iterative solver — see docs/SWEEPS.md).
+    """
+
+    theta: float = 0.0
+    grad_tol: float = 0.0
+    min_sweeps_full: int = 1
+    final_full_sweep: bool = True
+    gram: bool = False
+
+    def __post_init__(self):
+        if self.theta < 0:
+            raise ValueError(f"theta must be >= 0, got {self.theta}")
+        if self.grad_tol < 0:
+            raise ValueError(
+                f"grad_tol must be >= 0, got {self.grad_tol}")
+        if self.min_sweeps_full < 1:
+            raise ValueError(
+                f"min_sweeps_full must be >= 1, got "
+                f"{self.min_sweeps_full} (gated sweeps need one full "
+                "sweep of drift/gradient evidence first)")
+
+    @property
+    def gate_zero(self) -> bool:
+        """theta=0 AND grad_tol=0: every entity is always dirty — the
+        descent takes HEAD's bit-identical full-sweep path."""
+        return self.theta == 0.0 and self.grad_tol == 0.0
+
+
+def next_pow2(k: int) -> int:
+    """Smallest power of two >= k (k >= 1)."""
+    return 1 << (max(int(k), 1) - 1).bit_length()
+
+
+def compact_lanes(selected: int, pad: int, total: int) -> int:
+    """Quantized lane count for a compacted fit wave: power-of-two
+    growth (bounding the jit program-cache to O(log lanes) shapes per
+    staged tuple), floored at the coordinate's entity pad multiple and
+    capped at the tuple's own lane count."""
+    return max(int(pad), min(next_pow2(selected), int(total)))
+
+
+@functools.partial(jax.jit, static_argnames=("num_entities",))
+def _drift(offsets, off_ref, ids, num_entities):
+    return jax.ops.segment_sum(jnp.abs(offsets - off_ref), ids,
+                               num_segments=num_entities)
+
+
+@jax.jit
+def _dirty(drift, grad_norms, scale, trained, theta, grad_tol):
+    return trained & ((drift > theta * scale) | (grad_norms > grad_tol))
+
+
+@jax.jit
+def _advance_off_ref(off_ref, offsets, dirty, ids):
+    return jnp.where(dirty[ids], offsets, off_ref)
+
+
+class CoordinateSweepState:
+    """One random-effect coordinate's dirty-set evidence.
+
+    ``off_ref``: (n,) residual offsets each row's entity saw at its last
+    fit (None until the first tracked sweep). ``grad_norms``: (E,) final
+    solver gradient norms from each entity's last fit (+inf until
+    evidence exists, so unevidenced entities are always dirty).
+    ``scale``/``trained`` are derived from the coordinate's bucketing at
+    construction and are NOT checkpointed — they are a function of the
+    dataset, which the descent fingerprint already pins.
+    """
+
+    def __init__(self, num_entities: int, ids, scale, trained):
+        self.num_entities = int(num_entities)
+        self.ids = jnp.asarray(ids)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self._trained_host = np.asarray(trained, bool)
+        self.trained = jnp.asarray(self._trained_host)
+        self.grad_norms = jnp.full((self.num_entities,), jnp.inf,
+                                   jnp.float32)
+        self.off_ref: Optional[jax.Array] = None
+
+    def gate(self, offsets, config: SweepConfig):
+        """(dirty (E,) bool, drift (E,) f32) for the coming sweep.
+        Requires evidence (``off_ref`` set by a prior tracked sweep)."""
+        drift = _drift(jnp.asarray(offsets), self.off_ref, self.ids,
+                       self.num_entities)
+        dirty = _dirty(drift, self.grad_norms, self.scale, self.trained,
+                       config.theta, config.grad_tol)
+        return dirty, drift
+
+    def advance(self, offsets, dirty=None) -> None:
+        """Move refit entities' offset references to the offsets they
+        were just fit against (all trained entities when ``dirty`` is
+        None — a full sweep)."""
+        offsets = jnp.asarray(offsets)
+        if dirty is None or self.off_ref is None:
+            self.off_ref = offsets
+        else:
+            self.off_ref = _advance_off_ref(self.off_ref, offsets,
+                                            dirty, self.ids)
+
+    def drift_p99(self, drift) -> float:
+        """p99 of per-entity drift over trained entities (telemetry)."""
+        d = np.asarray(drift)[self._trained_host]
+        return float(np.percentile(d, 99)) if d.size else 0.0
+
+    # -- checkpoint serialization (game/checkpoint.py sweep/<cid>.npz) --
+
+    def to_arrays(self) -> dict:
+        out = {"grad_norms": np.asarray(self.grad_norms)}
+        if self.off_ref is not None:
+            out["off_ref"] = np.asarray(self.off_ref)
+        return out
+
+    def restore(self, arrays: dict) -> None:
+        if "grad_norms" in arrays:
+            self.grad_norms = jnp.asarray(arrays["grad_norms"])
+        if "off_ref" in arrays:
+            self.off_ref = jnp.asarray(arrays["off_ref"])

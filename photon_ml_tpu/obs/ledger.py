@@ -35,7 +35,10 @@ coordinate, outer iteration, descent step, grid point, tuning trial):
   iteration).
 * ``coordinate_update`` — one descent step: coordinate, seconds,
   validation metrics.
-* ``re_fit_wave`` — one vmapped random-effect fit-wave dispatch.
+* ``re_fit_wave`` — one vmapped random-effect fit-wave dispatch:
+  re_type, wave index, seconds, ``entities_fit``/``entities_skipped``
+  lane counts, and (gated sweeps, docs/SWEEPS.md) ``drift_p99`` — the
+  p99 per-entity residual-offset drift the gate saw this sweep.
 * ``tuning_trial`` — one hyperparameter trial: sampled point, expected
   improvement (GP search), objective, wall seconds.
 * ``watchdog`` — a convergence-watchdog alert (obs/watchdog.py).
@@ -638,6 +641,32 @@ def final_validation_metrics(rows: list[dict]) -> dict:
     return out
 
 
+def fit_wave_summary(rows: list[dict]) -> dict:
+    """Per-(coordinate, outer iteration) aggregation of ``re_fit_wave``
+    rows: lane counts fit/skipped, wave seconds, and the max drift_p99
+    the gate saw. The ``photon-obs diff`` entities_fit overlay's data —
+    recorded by every random-effect train call, gated or not."""
+    agg: dict = {}
+    for row in rows:
+        if row.get("kind") != "re_fit_wave":
+            continue
+        coord = row.get("coordinate") or row.get("re_type") or "(run)"
+        it = int(row.get("outer_iteration") or 0)
+        e = agg.setdefault(coord, {}).setdefault(
+            it, {"outer_iteration": it, "entities_fit": 0,
+                 "entities_skipped": 0, "seconds": 0.0, "waves": 0,
+                 "drift_p99": 0.0})
+        e["entities_fit"] += int(row.get("entities_fit") or 0)
+        e["entities_skipped"] += int(row.get("entities_skipped") or 0)
+        e["seconds"] = round(e["seconds"] + float(row.get("seconds") or 0.0),
+                             6)
+        e["waves"] += 1
+        e["drift_p99"] = max(e["drift_p99"],
+                             float(row.get("drift_p99") or 0.0))
+    return {coord: [per_it[k] for k in sorted(per_it)]
+            for coord, per_it in agg.items()}
+
+
 def diff_ledgers(dir_a: str, dir_b: str,
                  fraction: float = 0.99) -> dict:
     """Compare two run ledgers: config delta, per-coordinate
@@ -691,6 +720,14 @@ def diff_ledgers(dir_a: str, dir_b: str,
             entry["curve_a"] = ca
             entry["curve_b"] = cb
         coords[coord] = entry
+    waves_a = fit_wave_summary(rows_a)
+    waves_b = fit_wave_summary(rows_b)
+    for coord in sorted(set(waves_a) | set(waves_b)):
+        entry = coords.setdefault(coord, {})
+        if coord in waves_a:
+            entry["fit_waves_a"] = waves_a[coord]
+        if coord in waves_b:
+            entry["fit_waves_b"] = waves_b[coord]
     out["coordinates"] = coords
     out["final_metrics"] = {"a": final_validation_metrics(rows_a),
                             "b": final_validation_metrics(rows_b)}
